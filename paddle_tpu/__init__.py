@@ -1,0 +1,108 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle's
+capability surface, built on JAX/XLA/Pallas.
+
+Layering (mirrors SURVEY.md §1 of the reference analysis):
+  core/     tensor + autograd + device/flags         (L0, L3a)
+  ops/      YAML op registry + jax kernels           (L1, L2)
+  nn/ ...   user API                                  (L4)
+  jit/      trace-and-compile executor                (L3b/L3c -> XLA)
+  distributed/  mesh, collectives, parallelism        (L5)
+"""
+from __future__ import annotations
+
+from .core import (  # noqa: F401
+    CPUPlace,
+    CUDAPlace,
+    Place,
+    TPUPlace,
+    Tensor,
+    device_count,
+    enable_grad,
+    get_device,
+    grad,
+    is_grad_enabled,
+    no_grad,
+    set_device,
+    set_grad_enabled,
+    to_tensor,
+)
+from .core.dtype import (  # noqa: F401
+    bfloat16,
+    bool_ as bool,  # noqa: A001
+    complex64,
+    complex128,
+    float16,
+    float32,
+    float64,
+    get_default_dtype,
+    int8,
+    int16,
+    int32,
+    int64,
+    set_default_dtype,
+    uint8,
+)
+from .core.flags import get_flags, set_flags  # noqa: F401
+from .core.random import get_rng_state, seed, set_rng_state  # noqa: F401
+
+from . import ops  # noqa: F401  (loads the YAML registry)
+from . import tensor_methods  # noqa: F401  (installs Tensor methods)
+
+# Re-export every registered op as a top-level function (paddle.add, ...).
+import sys as _sys
+
+_this = _sys.modules[__name__]
+for _name in ops.all_ops():
+    if not hasattr(_this, _name):
+        setattr(_this, _name, getattr(ops.api, _name))
+del _name, _this, _sys
+
+# paddle-style aliases
+mod = ops.api.remainder
+multiply_add = ops.api.multiply_add
+concat = ops.api.concat
+
+
+def add_n(inputs):
+    """paddle.add_n: elementwise sum of a list of tensors."""
+    out = inputs[0]
+    for x in inputs[1:]:
+        out = ops.api.add(out, x)
+    return out
+
+
+from . import amp  # noqa: F401, E402
+from . import nn  # noqa: F401, E402
+from . import optimizer  # noqa: F401, E402
+from . import io  # noqa: F401, E402
+from . import jit  # noqa: F401, E402
+from . import metric  # noqa: F401, E402
+from . import vision  # noqa: F401, E402
+from . import distributed  # noqa: F401, E402
+from . import static  # noqa: F401, E402
+from . import models  # noqa: F401, E402
+from .framework.io import load, save  # noqa: F401, E402
+from .hapi.model import Model, summary  # noqa: F401, E402
+
+version = "0.1.0"
+__version__ = version
+
+
+def disable_static():
+    pass  # dynamic mode is the default and only eager mode
+
+
+def enable_static():
+    from .static import _enable_static_mode
+
+    _enable_static_mode()
+
+
+def in_dynamic_mode():
+    from .static import _in_static_mode
+
+    return not _in_static_mode()
+
+
+def is_grad_enabled_():  # legacy alias
+    return is_grad_enabled()

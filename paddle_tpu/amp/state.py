@@ -1,0 +1,75 @@
+"""AMP dispatch state, consulted by the op dispatcher on every call.
+
+Reference: auto-cast hooks in generated forwards (paddle/fluid/eager/
+amp_utils.h, eager_amp_auto_cast.h) + op lists (python/paddle/amp/amp_lists.py).
+On TPU the native low precision is bfloat16 (MXU-native), so O1/O2 default to
+bf16 and no loss scaling is required (GradScaler stays API-compatible).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_state = _AmpState()
+
+
+def amp_state() -> _AmpState:
+    return _state
+
+
+def _cast_tensor_leaves(obj, target_dtype, only_from=None):
+    from ..core.tensor import Tensor
+    from ..ops.registry import api as _api  # registered `cast` op keeps grad graph
+
+    def cast_one(x):
+        if isinstance(x, Tensor) and jnp.issubdtype(x.dtype, jnp.floating):
+            if only_from is None or x.dtype in only_from:
+                if x.dtype != jnp.dtype(target_dtype):
+                    return _api.cast(x, target_dtype)
+        return x
+
+    return jax.tree_util.tree_map(cast_one, obj, is_leaf=lambda x: isinstance(x, Tensor))
+
+
+class _NoAmp:
+    """Re-entrancy guard: casts run through the dispatcher with amp off."""
+
+    def __enter__(self):
+        self._prev = _state.enabled
+        _state.enabled = False
+
+    def __exit__(self, *exc):
+        _state.enabled = self._prev
+
+
+def cast_args(state, opdef, args, kwargs):
+    name = opdef.name
+    category = opdef.amp
+    if name in state.custom_white:
+        category = "white"
+    elif name in state.custom_black:
+        category = "black"
+    with _NoAmp():
+        if category == "white" or (state.level == "O2" and category != "black"):
+            args = _cast_tensor_leaves(args, state.dtype, only_from=(jnp.dtype(jnp.float32),))
+            kwargs = _cast_tensor_leaves(kwargs, state.dtype, only_from=(jnp.dtype(jnp.float32),))
+        elif category == "black":
+            args = _cast_tensor_leaves(args, jnp.float32, only_from=(jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16)))
+            kwargs = _cast_tensor_leaves(kwargs, jnp.float32, only_from=(jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16)))
+    return args, kwargs
+
+
+# bind as method for dispatcher convenience
+_AmpState.cast_args = lambda self, opdef, args, kwargs: cast_args(self, opdef, args, kwargs)

@@ -1,0 +1,20 @@
+from . import dtype, flags, place, random  # noqa: F401
+from .autograd import (  # noqa: F401
+    enable_grad,
+    grad,
+    is_grad_enabled,
+    no_grad,
+    run_backward,
+    set_grad_enabled,
+)
+from .place import (  # noqa: F401
+    CPUPlace,
+    CUDAPlace,
+    Place,
+    TPUPlace,
+    device_count,
+    get_device,
+    get_place,
+    set_device,
+)
+from .tensor import Tensor, to_tensor  # noqa: F401

@@ -1,0 +1,252 @@
+"""Eager autograd engine.
+
+Reference design: GradNodeBase/Edge (paddle/fluid/eager/grad_node_info.h:50,168),
+RunBackward topological queue walk (paddle/fluid/eager/backward.cc:104,246,278),
+leaf accumulation (eager/accumulation/accumulation_node.cc).
+
+TPU-native twist: each op's backward is not a hand-written grad kernel but the
+jax.vjp of its forward — recorded at dispatch time as a closure. The engine is a
+reverse-topological walk over GradNodes; it runs identically under eager
+execution and inside a jax trace (so a whole forward+backward+update step can be
+captured into ONE XLA program by the jit executor).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_state = _GradState()
+
+
+def is_grad_enabled() -> bool:
+    return _state.enabled
+
+
+def set_grad_enabled(mode: bool):
+    _state.enabled = bool(mode)
+
+
+class no_grad:
+    """Context manager & decorator disabling grad-graph recording (paddle.no_grad)."""
+
+    def __enter__(self):
+        self._prev = _state.enabled
+        _state.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with no_grad():
+                return fn(*a, **k)
+
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = _state.enabled
+        _state.enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self._prev
+        return False
+
+
+class GradNode:
+    """One recorded op application in the grad graph.
+
+    ``vjp_fn`` maps output cotangents -> input cotangents (one per tensor input).
+    ``edges[i]`` routes input-cotangent i: ('node', parent_node, out_idx),
+    ('leaf', tensor), or None for stop_gradient inputs.
+    """
+
+    __slots__ = ("op_name", "vjp_fn", "edges", "out_avals", "out_hooks", "__weakref__")
+
+    def __init__(self, op_name: str, vjp_fn, edges, out_avals):
+        self.op_name = op_name
+        self.vjp_fn = vjp_fn
+        self.edges = edges
+        self.out_avals = out_avals  # list of jax.ShapeDtypeStruct, one per output
+        self.out_hooks = None  # {out_idx: [hook, ...]} grads flowing out of this node's outputs
+
+    def add_out_hook(self, out_idx: int, hook):
+        if self.out_hooks is None:
+            self.out_hooks = {}
+        self.out_hooks.setdefault(out_idx, []).append(hook)
+
+
+def _zeros_like_aval(aval):
+    if not jnp.issubdtype(aval.dtype, jnp.inexact):
+        # Integer/bool outputs take symbolic-zero cotangents (dtype float0).
+        import numpy as np
+
+        return np.zeros(aval.shape, dtype=jax.dtypes.float0)
+    return jnp.zeros(aval.shape, aval.dtype)
+
+
+def _accumulate(a, b):
+    return b if a is None else a + b
+
+
+def run_backward(
+    tensors: Sequence[Any],
+    grad_tensors: Optional[Sequence[Any]] = None,
+    retain_graph: bool = False,
+    sink: Optional[dict] = None,
+):
+    """egr::Backward equivalent (eager/backward.cc:421).
+
+    When ``sink`` is given (paddle.grad path), leaf gradients accumulate into
+    ``sink[id(leaf)]`` instead of each leaf's .grad slot, so partial-graph
+    grads never pollute parameter .grad state.
+    """
+    from .tensor import Tensor
+
+    roots = [t for t in tensors if isinstance(t, Tensor)]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(roots)
+
+    # node -> list of output cotangents (accumulated)
+    pending = {}
+    root_nodes = []
+    for t, g in zip(roots, grad_tensors):
+        if t._grad_node is None:
+            continue  # leaf or stop_gradient root: nothing to do
+        node, idx = t._grad_node
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {t.shape}"
+                )
+            g_val = jnp.ones(t._value.shape, t._value.dtype)
+        else:
+            g_val = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+        buf = pending.get(node)
+        if buf is None:
+            buf = [None] * len(node.out_avals)
+            pending[node] = buf
+            root_nodes.append(node)
+        buf[idx] = _accumulate(buf[idx], g_val)
+
+    # Topological order: consumers before producers (DFS postorder, reversed).
+    order: List[GradNode] = []
+    seen = set()
+    for root in root_nodes:
+        stack = [(root, False)]
+        while stack:
+            node, processed = stack.pop()
+            if processed:
+                order.append(node)
+                continue
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            stack.append((node, True))
+            for edge in node.edges:
+                if edge is not None and edge[0] == "node" and id(edge[1]) not in seen:
+                    stack.append((edge[1], False))
+    order.reverse()  # consumers first
+
+    for node in order:
+        out_grads = pending.pop(node, None)
+        if out_grads is None:
+            continue
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                f"Grad graph for op '{node.op_name}' was already freed; "
+                "call backward(retain_graph=True) to backprop twice."
+            )
+        # Fill missing cotangents with zeros; apply output-side hooks.
+        cots = []
+        for i, (g, aval) in enumerate(zip(out_grads, node.out_avals)):
+            if g is None:
+                g = _zeros_like_aval(aval)
+            if node.out_hooks and i in node.out_hooks:
+                for hook in node.out_hooks[i]:
+                    new = hook(g)
+                    if new is not None:
+                        g = new
+            cots.append(g)
+        cot_struct = cots[0] if len(cots) == 1 else tuple(cots)
+        in_grads = node.vjp_fn(cot_struct)
+        if not isinstance(in_grads, (tuple, list)):
+            in_grads = (in_grads,)
+        if not retain_graph:
+            node.vjp_fn = None
+        for edge, ig in zip(node.edges, in_grads):
+            if edge is None or ig is None:
+                continue
+            kind = edge[0]
+            if kind == "node":
+                _, parent, out_idx = edge
+                buf = pending.get(parent)
+                if buf is None:
+                    buf = [None] * len(parent.out_avals)
+                    pending[parent] = buf
+                buf[out_idx] = _accumulate(buf[out_idx], ig)
+            else:  # leaf
+                leaf: Tensor = edge[1]
+                for hook in leaf._grad_hooks:
+                    new = hook(ig)
+                    if new is not None:
+                        ig = new
+                if sink is not None:
+                    prev = sink.get(id(leaf))
+                    sink[id(leaf)] = ig if prev is None else prev + ig
+                elif leaf._grad is None:
+                    leaf._grad = Tensor(ig, stop_gradient=True)
+                else:
+                    leaf._grad = Tensor(leaf._grad._value + ig, stop_gradient=True)
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=False,
+    create_graph=False,
+    allow_unused=False,
+):
+    """paddle.grad equivalent (partial-graph gradients, eager/general_grad.h).
+
+    Implemented by running the engine with accumulation redirected into fresh
+    buffers for ``inputs`` instead of their .grad slots.
+    """
+    from .tensor import Tensor
+
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+
+    sink: dict = {}
+    run_backward(outputs, grad_outputs, retain_graph=retain_graph or create_graph, sink=sink)
+    results = []
+    for t in inputs:
+        g = sink.get(id(t))
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "One of the differentiated tensors appears unused in the "
+                    "graph; pass allow_unused=True to return None for it."
+                )
+            results.append(None)
+        else:
+            results.append(Tensor(g, stop_gradient=True))
+    return results

@@ -1,0 +1,85 @@
+"""Dtype system.
+
+Mirrors the reference's phi dtype surface (paddle/phi/common/data_type.h) with
+paddle-style string names, but values are jnp dtypes — XLA is the only consumer.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects are numpy dtype instances (what jnp uses internally).
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+uint16 = jnp.uint16
+uint32 = jnp.uint32
+uint64 = jnp.uint64
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+float8_e4m3fn = jnp.float8_e4m3fn
+float8_e5m2 = jnp.float8_e5m2
+
+_NAME_TO_DTYPE = {
+    "float16": float16, "fp16": float16, "half": float16,
+    "bfloat16": bfloat16, "bf16": bfloat16,
+    "float32": float32, "fp32": float32, "float": float32,
+    "float64": float64, "fp64": float64, "double": float64,
+    "int8": int8, "int16": int16, "int32": int32, "int64": int64,
+    "uint8": uint8, "uint16": uint16, "uint32": uint32, "uint64": uint64,
+    "bool": bool_,
+    "complex64": complex64, "complex128": complex128,
+    "float8_e4m3fn": float8_e4m3fn, "float8_e5m2": float8_e5m2,
+}
+
+_FLOATING = {float16, bfloat16, float32, float64, float8_e4m3fn, float8_e5m2}
+_INTEGER = {int8, int16, int32, int64, uint8, uint16, uint32, uint64}
+
+
+def convert_dtype(dtype):
+    """Normalize any dtype spec (str / np.dtype / jnp dtype / Tensor dtype) to a jnp dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        try:
+            return _NAME_TO_DTYPE[dtype]
+        except KeyError:
+            raise ValueError(f"Unknown dtype name: {dtype!r}")
+    if hasattr(dtype, "dtype"):  # ShapeDtypeStruct / array-likes
+        return np.dtype(dtype.dtype).type if not hasattr(dtype.dtype, "type") else dtype.dtype.type
+    return jnp.dtype(dtype).type if not isinstance(dtype, type) else dtype
+
+
+def dtype_name(dtype) -> str:
+    return jnp.dtype(dtype).name
+
+
+def is_floating_point(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.floating)
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.integer)
+
+
+# Paddle keeps a process-wide default dtype (fluid/data_feeder.py get_default_dtype).
+_default_dtype = float32
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    d = convert_dtype(d)
+    if d not in (float16, bfloat16, float32, float64):
+        raise TypeError(f"set_default_dtype only supports floating dtypes, got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype():
+    return _default_dtype

@@ -1,0 +1,76 @@
+"""Runtime flag registry.
+
+Reference: PHI_DEFINE_EXPORTED_* gflags (paddle/phi/core/flags.cc, 91 flags) +
+paddle.set_flags/get_flags (python/paddle/fluid/framework.py:7493). One typed
+registry with env-var override (FLAGS_xxx), per SURVEY.md §5.6.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclass
+class _Flag:
+    name: str
+    default: Any
+    value: Any
+    doc: str
+    type: type
+    on_change: Optional[Callable[[Any], None]] = None
+
+
+_registry: Dict[str, _Flag] = {}
+_lock = threading.Lock()
+
+
+def _coerce(ty, raw):
+    if ty is bool:
+        if isinstance(raw, str):
+            return raw.lower() in ("1", "true", "yes", "on")
+        return bool(raw)
+    return ty(raw)
+
+
+def define_flag(name: str, default, doc: str = "", on_change=None):
+    ty = type(default)
+    value = default
+    env = os.environ.get(f"FLAGS_{name}")
+    if env is not None:
+        value = _coerce(ty, env)
+    with _lock:
+        _registry[name] = _Flag(name, default, value, doc, ty, on_change)
+    return value
+
+
+def get_flags(names=None):
+    if names is None:
+        names = list(_registry)
+    if isinstance(names, str):
+        names = [names]
+    return {n: _registry[n].value for n in names}
+
+
+def get_flag(name: str):
+    return _registry[name].value
+
+
+def set_flags(flags: Dict[str, Any]):
+    for name, v in flags.items():
+        f = _registry.get(name)
+        if f is None:
+            raise KeyError(f"Unknown flag {name!r}; known: {sorted(_registry)}")
+        f.value = _coerce(f.type, v)
+        if f.on_change:
+            f.on_change(f.value)
+
+
+# --- core flags (analogs of the reference's most-used ones) ---
+define_flag("check_nan_inf", False, "Check every op output for NaN/Inf (eager mode).")
+define_flag("eager_op_jit", True, "Compile+cache single-op programs in eager mode.")
+define_flag("low_precision_op_list", False, "Record ops executed in low precision.")
+define_flag("benchmark", False, "Synchronize after every op (timing mode).")
+define_flag("use_donated_buffers", True, "Donate param/opt-state buffers in compiled steps.")
+define_flag("default_seed", 0, "Global RNG seed when none set explicitly.")

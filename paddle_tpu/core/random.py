@@ -1,0 +1,79 @@
+"""RNG state.
+
+Reference: phi::Generator (paddle/phi/core/generator.h) — seeded Philox state
+per device. TPU-native: jax threaded PRNG keys. A process-global generator
+hands out keys by folding a monotone counter into the seed key; inside a jit
+trace the counter can be overridden with a *traced* seed so compiled train
+steps stay pure while remaining stochastic across steps (the Trainer threads a
+step-seed input through the program).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from . import flags
+
+
+class Generator:
+    def __init__(self, seed: int = 0):
+        self._seed = int(seed)
+        self._counter = 0
+        self._lock = threading.Lock()
+        self._trace_seed = None  # traced scalar override (set by jit executor)
+
+    def manual_seed(self, seed: int):
+        self._seed = int(seed)
+        self._counter = 0
+        return self
+
+    @property
+    def initial_seed(self):
+        return self._seed
+
+    def next_key(self):
+        with self._lock:
+            c = self._counter
+            self._counter += 1
+        base = jax.random.PRNGKey(self._seed)
+        if self._trace_seed is not None:
+            base = jax.random.fold_in(base, self._trace_seed)
+        return jax.random.fold_in(base, c)
+
+    def push_trace_seed(self, seed_scalar):
+        """Executor hook: make keys depend on a traced per-step seed."""
+        prev = self._trace_seed
+        self._trace_seed = seed_scalar
+        return prev
+
+    def pop_trace_seed(self, prev):
+        self._trace_seed = prev
+
+    def get_state(self):
+        return (self._seed, self._counter)
+
+    def set_state(self, state):
+        self._seed, self._counter = state
+
+
+default_generator = Generator(flags.get_flag("default_seed"))
+
+
+def seed(s: int):
+    """paddle.seed"""
+    default_generator.manual_seed(s)
+    return default_generator
+
+
+def get_rng_state():
+    return default_generator.get_state()
+
+
+def set_rng_state(state):
+    default_generator.set_state(state)
+
+
+def next_key():
+    return default_generator.next_key()

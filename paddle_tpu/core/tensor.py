@@ -1,0 +1,257 @@
+"""Tensor: the framework's value type.
+
+Reference: phi::DenseTensor (paddle/phi/core/dense_tensor.h:43) + the pybind
+eager Tensor (paddle/fluid/pybind/eager.cc) + AutogradMeta
+(paddle/fluid/eager/autograd_meta.h:61), fused into one Python class.
+
+TPU-native design: the storage is a jax.Array (a PJRT buffer on HBM, or a
+tracer inside a jit trace — the same Tensor type flows through both eager and
+compiled execution). Autograd metadata rides on the Python object; the grad
+graph is built by the op dispatcher (ops/registry.py) and walked by
+core/autograd.run_backward.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import autograd as _ag
+from .dtype import convert_dtype, get_default_dtype
+from .place import get_place
+
+
+def _to_jax_value(data, dtype=None, place=None):
+    if isinstance(data, Tensor):
+        val = data._value
+        if dtype is not None:
+            val = val.astype(convert_dtype(dtype))
+        return val
+    dtype = convert_dtype(dtype)
+    if isinstance(data, (bool, int, float, complex)) or (
+        isinstance(data, np.ndarray) and data.dtype != object
+    ) or isinstance(data, (list, tuple)):
+        arr = np.asarray(data)
+        if dtype is None and arr.dtype == np.float64:
+            arr = arr.astype(np.dtype(get_default_dtype()))
+        if dtype is not None:
+            arr = arr.astype(np.dtype(dtype))
+        data = arr
+    val = jnp.asarray(data)
+    if dtype is not None and val.dtype != jnp.dtype(dtype):
+        val = val.astype(dtype)
+    return val
+
+
+class Tensor:
+    __slots__ = (
+        "_value",
+        "stop_gradient",
+        "_grad_node",
+        "_grad",
+        "_grad_hooks",
+        "name",
+        "persistable",
+        "trainable",
+        "__weakref__",
+        "__dict__",
+    )
+
+    def __init__(self, data, dtype=None, place=None, stop_gradient=True, name=None):
+        self._value = _to_jax_value(data, dtype, place)
+        self.stop_gradient = stop_gradient
+        self._grad_node = None
+        self._grad: Optional[Tensor] = None
+        self._grad_hooks = []
+        self.name = name
+        self.persistable = False
+        self.trainable = not stop_gradient
+
+    # --- basic properties -------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    dim = rank = lambda self: self._value.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def place(self):
+        return get_place()
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @grad.setter
+    def grad(self, value):
+        self._grad = value if (value is None or isinstance(value, Tensor)) else Tensor(value)
+
+    # --- value access -----------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        return self._value.item(*args)
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._value)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(self._value)
+
+    def __int__(self):
+        return int(self._value)
+
+    def __bool__(self):
+        return bool(self._value)
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __hash__(self):
+        return id(self)
+
+    # --- autograd ---------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        _ag.run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self._grad = None
+
+    clear_gradient = clear_grad
+
+    def register_hook(self, hook):
+        """Register a grad hook (paddle Tensor.register_hook)."""
+        if self.stop_gradient:
+            raise RuntimeError("Cannot register hook on a tensor that stops gradient.")
+        if self._grad_node is None:
+            self._grad_hooks.append(hook)
+            handle = _HookHandle(self._grad_hooks, hook)
+        else:
+            node, idx = self._grad_node
+            node.add_out_hook(idx, hook)
+            handle = _HookHandle(node.out_hooks[idx], hook)
+        return handle
+
+    def detach(self):
+        t = Tensor.__new__(Tensor)
+        t._value = self._value
+        t.stop_gradient = True
+        t._grad_node = None
+        t._grad = None
+        t._grad_hooks = []
+        t.name = self.name
+        t.persistable = self.persistable
+        t.trainable = False
+        return t
+
+    def detach_(self):
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self):
+        from ..ops import api as _api
+
+        return _api.assign(self)
+
+    # --- in-place value replacement (reference: tensor.copy_ / set_value) --
+    def set_value(self, value):
+        new = _to_jax_value(value)
+        if tuple(new.shape) != tuple(self._value.shape):
+            raise ValueError(f"shape mismatch: {new.shape} vs {self._value.shape}")
+        self._value = new.astype(self._value.dtype)
+        return self
+
+    def copy_(self, other, blocking=True):
+        return self.set_value(other)
+
+    def _replace_value(self, value):
+        """Internal: swap storage (used by optimizers/compiled steps)."""
+        self._value = value
+        return self
+
+    # --- misc -------------------------------------------------------------
+    def __repr__(self):
+        sg = self.stop_gradient
+        try:
+            data = np.asarray(self._value)
+            body = np.array2string(data, precision=4, suppress_small=True, threshold=40)
+        except Exception:
+            body = f"<traced {self._value}>"
+        return (
+            f"Tensor(shape={self.shape}, dtype={jnp.dtype(self.dtype).name}, "
+            f"stop_gradient={sg},\n       {body})"
+        )
+
+    def block_until_ready(self):
+        if hasattr(self._value, "block_until_ready"):
+            self._value.block_until_ready()
+        return self
+
+
+class _HookHandle:
+    def __init__(self, container, hook):
+        self._container = container
+        self._hook = hook
+
+    def remove(self):
+        try:
+            self._container.remove(self._hook)
+        except ValueError:
+            pass
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor."""
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+
+
+# jax pytree registration: Tensors flatten to their value so whole models /
+# optimizer states can cross jit boundaries as pytrees.
+jax.tree_util.register_pytree_node(
+    Tensor,
+    lambda t: ((t._value,), (t.stop_gradient, t.name)),
+    lambda aux, vals: _unflatten_tensor(aux, vals),
+)
+
+
+def _unflatten_tensor(aux, vals):
+    t = Tensor.__new__(Tensor)
+    t._value = vals[0]
+    t.stop_gradient = aux[0]
+    t._grad_node = None
+    t._grad = None
+    t._grad_hooks = []
+    t.name = aux[1]
+    t.persistable = False
+    t.trainable = not aux[0]
+    return t

@@ -1,0 +1,56 @@
+"""paddle_tpu.distributed — mesh, collectives, parallelism (SURVEY.md §2.6).
+
+Architecture stance (SURVEY.md §5.8): single-controller. Collectives are
+compiled XLA ops over a named jax Mesh (ICI); the host-side DCN layer is jax's
+coordination service (rendezvous) — the TCPStore/ProcessGroup split of the
+reference maps to (coordination service, mesh axes).
+"""
+from . import auto_parallel  # noqa: F401
+from . import fleet  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    Partial,
+    Replicate,
+    Shard,
+    dtensor_from_fn,
+    reshard,
+    shard_layer,
+    shard_tensor,
+)
+from .collective import (  # noqa: F401
+    Group,
+    ReduceOp,
+    all_gather,
+    all_gather_concat,
+    all_reduce,
+    all_to_all,
+    alltoall_single,
+    axis_context,
+    barrier,
+    broadcast,
+    collective_permute,
+    get_group,
+    new_group,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+)
+from .env import (  # noqa: F401
+    ParallelEnv,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+    is_initialized,
+)
+from .mesh import (  # noqa: F401
+    CommunicateTopology,
+    HybridCommunicateGroup,
+    ProcessMesh,
+    auto_mesh,
+    build_mesh,
+    get_mesh,
+    set_mesh,
+)
+from .parallel import DataParallel  # noqa: F401
+from .sharded import shard_map, shard_tensor_to, sharded_fn  # noqa: F401

@@ -1,0 +1,113 @@
+"""Semi-automatic parallelism: DistTensor over ProcessMesh.
+
+Reference: DistTensor/TensorDistAttr (paddle/phi/core/distributed/
+auto_parallel/dist_tensor.h:27, dist_attr.h:35), shard_tensor
+(python/paddle/distributed/auto_parallel/interface.py), SPMD rules
+(fluid/distributed/auto_parallel/spmd_rules/).
+
+TPU-native: a DistTensor IS a jax.Array with a NamedSharding — placement
+propagation (the reference's Completer + SPMD rules) is XLA GSPMD's job; we
+only annotate. Reshard = device_put to a new sharding (XLA emits the
+collectives).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ...core.tensor import Tensor
+from ..mesh import ProcessMesh  # noqa: F401
+
+
+class Placement:
+    pass
+
+
+class Replicate(Placement):
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+
+class Shard(Placement):
+    def __init__(self, dim):
+        self.dim = dim
+
+    def get_dim(self):
+        return self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+
+class Partial(Placement):
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+
+def _placements_to_spec(placements: Sequence[Placement], mesh: ProcessMesh, ndim: int) -> PartitionSpec:
+    """Map per-mesh-dim placements -> a PartitionSpec over tensor dims."""
+    entries = [None] * ndim
+    for mesh_dim, placement in enumerate(placements):
+        if isinstance(placement, Shard):
+            axis_name = mesh.dim_names[mesh_dim]
+            d = placement.dim
+            if entries[d] is None:
+                entries[d] = axis_name
+            elif isinstance(entries[d], tuple):
+                entries[d] = entries[d] + (axis_name,)
+            else:
+                entries[d] = (entries[d], axis_name)
+    return PartitionSpec(*entries)
+
+
+def shard_tensor(x, mesh: ProcessMesh, placements: Sequence[Placement], stop_gradient=None):
+    """paddle.distributed.shard_tensor: place `x` on `mesh` with `placements`."""
+    t = x if isinstance(x, Tensor) else Tensor(x)
+    spec = _placements_to_spec(placements, mesh, t._value.ndim)
+    sharding = NamedSharding(mesh.jax_mesh, spec)
+    t._value = jax.device_put(t._value, sharding)
+    t.placements = list(placements)
+    t.process_mesh = mesh
+    return t
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def reshard(x: Tensor, mesh: ProcessMesh, placements: Sequence[Placement]):
+    """Resharding collectives (reference: auto_parallel/static/reshard.py:978)
+    — XLA emits all-gather/all-to-all/slice as needed from the device_put."""
+    spec = _placements_to_spec(placements, mesh, x._value.ndim)
+    x._value = jax.device_put(x._value, NamedSharding(mesh.jax_mesh, spec))
+    x.placements = list(placements)
+    x.process_mesh = mesh
+    return x
+
+
+def shard_layer(layer, mesh: ProcessMesh, shard_fn=None, input_fn=None, output_fn=None):
+    """Shard a Layer's parameters over `mesh` via shard_fn(name, layer, mesh)."""
+    if shard_fn is None:
+        def shard_fn(name, sublayer, mesh_):
+            for pname, p in sublayer._parameters.items():
+                if p is not None:
+                    shard_tensor(p, mesh_, [Replicate()])
+
+    for name, sub in layer.named_sublayers(include_self=True):
+        shard_fn(name, sub, mesh)
+    return layer
+
+
+def get_placement(x):
+    return getattr(x, "placements", None)

@@ -1,0 +1,283 @@
+"""Collective communication API.
+
+Reference: ProcessGroup virtual API (paddle/fluid/distributed/collective/
+process_group.h:53) + python/paddle/distributed/communication/*.
+
+TPU-native (SURVEY.md §5.8): collectives are *compiled program ops* — inside a
+shard_map/jit trace over a Mesh they lower to XLA all-reduce / all-gather /
+reduce-scatter / all-to-all / collective-permute riding ICI. The Group object
+carries the mesh axis name(s) (the "communicator"); channel ids are XLA's
+problem. Outside any mesh context (single chip eager) they degenerate to
+identity, matching the reference's world_size==1 behavior.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor
+from ..ops.registry import register_op, api
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A communicator: a set of ranks bound to one or more mesh axis names."""
+
+    def __init__(self, rank, world_size, id=0, ranks=None, axis_name: Optional[str] = None):
+        self.rank = rank
+        self.nranks = world_size
+        self.id = id
+        self.ranks = ranks or list(range(world_size))
+        self.axis_name = axis_name
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def __repr__(self):
+        return f"Group(id={self.id}, n={self.nranks}, axis={self.axis_name})"
+
+
+_groups = {}
+_next_group_id = [1]
+_world_group: Optional[Group] = None
+
+
+def _get_world_group() -> Group:
+    global _world_group
+    if _world_group is None:
+        from .env import get_rank, get_world_size
+
+        _world_group = Group(get_rank(), get_world_size(), 0, axis_name=None)
+    return _world_group
+
+
+def get_group(gid=0) -> Group:
+    if gid == 0:
+        return _get_world_group()
+    return _groups[gid]
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis_name=None) -> Group:
+    from .env import get_rank
+
+    gid = _next_group_id[0]
+    _next_group_id[0] += 1
+    ranks = list(ranks) if ranks is not None else list(range(_get_world_group().nranks))
+    g = Group(get_rank(), len(ranks), gid, ranks, axis_name=axis_name)
+    _groups[gid] = g
+    return g
+
+
+# --- mesh-axis context: set while tracing inside shard_map -------------------
+class _AxisCtx(threading.local):
+    def __init__(self):
+        self.axes: List[str] = []
+
+
+_axis_ctx = _AxisCtx()
+
+
+class axis_context:
+    """Marks that the enclosed trace runs under shard_map with `axes` bound.
+    Used by the sharded executor (distributed/sharded.py) and tests."""
+
+    def __init__(self, *axes):
+        self.axes = [a for a in axes if a]
+
+    def __enter__(self):
+        _axis_ctx.axes.extend(self.axes)
+        return self
+
+    def __exit__(self, *exc):
+        for _ in self.axes:
+            _axis_ctx.axes.pop()
+        return False
+
+
+def _bound_axis(group: Optional[Group]) -> Optional[str]:
+    """Resolve the mesh axis this collective should use, if we're inside a
+    shard_map trace that bound it."""
+    if group is not None and group.axis_name and group.axis_name in _axis_ctx.axes:
+        return group.axis_name
+    if group is None and _axis_ctx.axes:
+        return _axis_ctx.axes[-1]
+    return None
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _wrap(v, like: Optional[Tensor] = None):
+    t = Tensor(v)
+    if like is not None:
+        t.stop_gradient = like.stop_gradient
+    return t
+
+
+# --- collectives -------------------------------------------------------------
+def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None, sync_op=True):
+    axis = _bound_axis(group)
+    if axis is None:
+        return tensor  # world of 1 / outside mesh: identity
+    v = _val(tensor)
+    if op in (ReduceOp.SUM, ReduceOp.AVG):
+        out = lax.psum(v, axis)
+        if op == ReduceOp.AVG:
+            out = out / lax.psum(jnp.ones((), v.dtype), axis)
+    elif op == ReduceOp.MAX:
+        out = lax.pmax(v, axis)
+    elif op == ReduceOp.MIN:
+        out = lax.pmin(v, axis)
+    elif op == ReduceOp.PROD:
+        # sign/zero-safe product: magnitude via log-sum, sign via parity count
+        mag = jnp.exp(lax.psum(jnp.log(jnp.maximum(jnp.abs(v), 1e-300)), axis))
+        neg_parity = lax.psum((v < 0).astype(v.dtype), axis) % 2
+        has_zero = lax.pmax((v == 0).astype(v.dtype), axis)
+        out = jnp.where(has_zero > 0, 0.0, mag * (1.0 - 2.0 * neg_parity)).astype(v.dtype)
+    else:
+        raise ValueError(f"unknown reduce op {op}")
+    tensor._value = out
+    return tensor
+
+
+def all_gather(tensor_list: Optional[list], tensor: Tensor, group: Optional[Group] = None, sync_op=True, axis=0):
+    bound = _bound_axis(group)
+    if bound is None:
+        if tensor_list is not None:
+            tensor_list.append(tensor)
+            return tensor_list
+        return tensor
+    v = _val(tensor)
+    out = lax.all_gather(v, bound, axis=0, tiled=False)
+    if tensor_list is not None:
+        n = out.shape[0]
+        for i in range(n):
+            tensor_list.append(_wrap(out[i], tensor))
+        return tensor_list
+    return _wrap(out, tensor)
+
+
+def all_gather_concat(tensor: Tensor, axis=0, group: Optional[Group] = None):
+    """all_gather + concat along `axis` (tiled) — the SP/TP building block."""
+    bound = _bound_axis(group)
+    if bound is None:
+        return tensor
+    out = lax.all_gather(_val(tensor), bound, axis=axis, tiled=True)
+    return _wrap(out, tensor)
+
+
+def reduce_scatter(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None, sync_op=True, axis=0):
+    bound = _bound_axis(group)
+    if bound is None:
+        return tensor
+    out = lax.psum_scatter(_val(tensor), bound, scatter_dimension=axis, tiled=True)
+    return _wrap(out, tensor)
+
+
+def broadcast(tensor: Tensor, src=0, group: Optional[Group] = None, sync_op=True):
+    bound = _bound_axis(group)
+    if bound is None:
+        return tensor
+    v = _val(tensor)
+    src_local = group.get_group_rank(src) if group is not None else src
+    idx = lax.axis_index(bound)
+    masked = jnp.where(idx == src_local, v, jnp.zeros_like(v))
+    tensor._value = lax.psum(masked, bound)
+    return tensor
+
+
+def reduce(tensor: Tensor, dst=0, op=ReduceOp.SUM, group: Optional[Group] = None, sync_op=True):
+    # On TPU a reduce is an all-reduce (result replicated; dst semantics kept at API level).
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group: Optional[Group] = None, sync_op=True):
+    bound = _bound_axis(group)
+    if bound is None:
+        out_tensor_list.extend(in_tensor_list)
+        return out_tensor_list
+    stacked = jnp.stack([_val(t) for t in in_tensor_list], axis=0)
+    out = lax.all_to_all(stacked, bound, split_axis=0, concat_axis=0, tiled=False)
+    for i in range(out.shape[0]):
+        out_tensor_list.append(Tensor(out[i]))
+    return out_tensor_list
+
+
+def alltoall_single(tensor: Tensor, group: Optional[Group] = None, split_axis=0, concat_axis=0):
+    """Single-tensor all-to-all (the EP/Ulysses building block)."""
+    bound = _bound_axis(group)
+    if bound is None:
+        return tensor
+    out = lax.all_to_all(_val(tensor), bound, split_axis=split_axis, concat_axis=concat_axis, tiled=True)
+    return _wrap(out, tensor)
+
+
+def collective_permute(tensor: Tensor, perm: Sequence[tuple], group: Optional[Group] = None):
+    """Ring shift over ICI neighbors (reference analog: p2p send/recv pairs in
+    PP; here one XLA collective-permute)."""
+    bound = _bound_axis(group)
+    if bound is None:
+        return tensor
+    out = lax.ppermute(_val(tensor), bound, list(perm))
+    return _wrap(out, tensor)
+
+
+def scatter(tensor: Tensor, tensor_list=None, src=0, group: Optional[Group] = None, sync_op=True):
+    bound = _bound_axis(group)
+    if bound is None:
+        return tensor
+    stacked = jnp.stack([_val(t) for t in tensor_list], axis=0) if tensor_list else _val(tensor)
+    idx = lax.axis_index(bound)
+    out = jnp.take(stacked, idx, axis=0)
+    tensor._value = out
+    return tensor
+
+
+def barrier(group: Optional[Group] = None):
+    bound = _bound_axis(group)
+    if bound is None:
+        return
+    lax.psum(jnp.ones(()), bound)
+
+
+def get_rank(group=None):
+    from .env import get_rank as _gr
+
+    return _gr()
+
+
+def get_world_size(group=None):
+    from .env import get_world_size as _gw
+
+    return _gw()
+
+
+# p2p API surface (compiled to ppermute pairs on TPU)
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "Point-to-point send/recv compile to collective_permute on TPU; "
+        "use distributed.collective_permute or the pipeline executor."
+    )
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "Point-to-point send/recv compile to collective_permute on TPU; "
+        "use distributed.collective_permute or the pipeline executor."
+    )

@@ -1,0 +1,84 @@
+"""Process/rank environment.
+
+Reference: ParallelEnv (python/paddle/distributed/parallel.py) reading
+PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM set by the launcher.
+
+TPU-native stance (SURVEY.md §5.8): SINGLE-CONTROLLER. One Python process per
+host drives all local chips through jax; multi-host jobs call
+jax.distributed.initialize (DCN rendezvous) and then every host sees the
+global device list. "rank" below is the *process* index (host), while data
+parallelism happens across mesh axes inside compiled programs.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+_initialized = False
+
+
+class ParallelEnv:
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return int(os.environ.get("PADDLE_LOCAL_RANK", "0"))
+
+    @property
+    def dev_id(self):
+        return self.local_rank
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def device_type(self):
+        return jax.default_backend()
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:6170")
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else [self.current_endpoint]
+
+
+def get_rank(group=None) -> int:
+    return int(os.environ.get("PADDLE_TRAINER_ID", jax.process_index()))
+
+
+def get_world_size(group=None) -> int:
+    n = os.environ.get("PADDLE_TRAINERS_NUM")
+    return int(n) if n is not None else jax.process_count()
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def init_parallel_env(strategy=None):
+    """Reference: python/paddle/distributed/parallel.py:914. Bootstraps the
+    multi-host runtime (DCN rendezvous via jax coordination service — the
+    TCPStore analog) when launcher env vars are present."""
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    coord = os.environ.get("PADDLE_MASTER") or os.environ.get("COORDINATOR_ADDRESS")
+    nproc = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    pid = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if coord and nproc > 1:
+        jax.distributed.initialize(
+            coordinator_address=coord, num_processes=nproc, process_id=pid
+        )
+    _initialized = True
+    return ParallelEnv()

@@ -1,0 +1,68 @@
+"""HybridParallelOptimizer (reference: fleet/meta_optimizers/dygraph_optimizer/
+hybrid_parallel_optimizer.py:253 — TP-aware grad clip + DP fused allreduce).
+
+TPU-native: grad synchronization happens inside the compiled step via sharding
+(XLA inserts the reduce), so this wrapper's job is the TP-aware global-norm
+clip semantics and API parity (step/clear_grad/state_dict passthrough).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...nn.clip import ClipGradByGlobalNorm
+from ..collective import ReduceOp, _bound_axis, all_reduce
+
+
+class HybridParallelClipGrad(ClipGradByGlobalNorm):
+    """Global-norm clip whose squared-norm sum is all-reduced over the
+    mp/pp/sharding axes when running under shard_map (so every rank scales by
+    the same global norm — reference behavior)."""
+
+    def __init__(self, clip_norm, hcg):
+        super().__init__(clip_norm)
+        self._hcg = hcg
+
+    def functional_clip(self, g_vals):
+        sq = 0.0
+        for g in g_vals:
+            sq = sq + jnp.sum(jnp.square(g.astype(jnp.float32)))
+        for group in (
+            self._hcg.get_model_parallel_group(),
+            self._hcg.get_pipe_parallel_group(),
+            self._hcg.get_sharding_parallel_group(),
+        ):
+            if _bound_axis(group) is not None:
+                t = Tensor(sq)
+                sq = all_reduce(t, ReduceOp.SUM, group)._value
+        global_norm = jnp.sqrt(sq)
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        return [(g.astype(jnp.float32) * scale).astype(g.dtype) for g in g_vals]
+
+    def __call__(self, params_grads):
+        g_vals = [g._value if isinstance(g, Tensor) else g for _, g in params_grads]
+        clipped = self.functional_clip(g_vals)
+        return [(p, Tensor(c)) for (p, _), c in zip(params_grads, clipped)]
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg, strategy=None):
+        self._inner = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+        if isinstance(optimizer._grad_clip, ClipGradByGlobalNorm) and not isinstance(
+            optimizer._grad_clip, HybridParallelClipGrad
+        ):
+            optimizer._grad_clip = HybridParallelClipGrad(optimizer._grad_clip.clip_norm, hcg)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def step(self):
+        self._inner.step()
+
+    def clear_grad(self, *a, **k):
+        self._inner.clear_grad(*a, **k)
+
+    def minimize(self, loss, *a, **k):
+        return self._inner.minimize(loss, *a, **k)

@@ -1,0 +1,104 @@
+"""Pipeline parallelism (reference: fleet/meta_parallel/pipeline_parallel.py:131
+1F1B forward_backward_pipeline:382, pp_layers.py PipeLayer partitioning).
+
+TPU-native round-1 implementation: GPipe-style microbatching where stages are
+jit-compiled programs and stage handoff is a sharding annotation over the 'pp'
+mesh axis (XLA inserts the device-to-device copies over ICI). The 1F1B
+host-side schedule with donated activation buffers lands with the PP milestone
+(SURVEY.md §7 M5); this class provides the reference's train_batch API shape.
+"""
+from __future__ import annotations
+
+from ...core.tensor import Tensor
+from ...nn.layer import Layer
+from ...ops import api
+
+
+class LayerDesc:
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_cls(*self.args, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_cls, forward_func=None, shared_weight_attr="weight", *args, **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    """Reference: parallel_layers/pp_layers.py PipeLayer — holds the full layer
+    list plus a segmentation into stages."""
+
+    def __init__(self, layers, num_stages=1, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0, **kwargs):
+        super().__init__()
+        from ...nn.container import LayerList
+
+        self._loss_fn = loss_fn
+        self._num_stages = num_stages
+        built = []
+        for desc in layers:
+            built.append(desc.build_layer() if isinstance(desc, LayerDesc) else desc)
+        self.run_function = LayerList(built)
+        # uniform segmentation (reference: segment by layer count)
+        n = len(built)
+        per = (n + num_stages - 1) // num_stages
+        self._stage_bounds = [(i * per, min((i + 1) * per, n)) for i in range(num_stages)]
+
+    def forward(self, x):
+        for layer in self.run_function:
+            x = layer(x)
+        return x
+
+    def get_stage_layers(self, stage_id):
+        lo, hi = self._stage_bounds[stage_id]
+        return list(self.run_function)[lo:hi]
+
+
+class PipelineParallel(Layer):
+    def __init__(self, layers, hcg, strategy):
+        super().__init__()
+        self._layers = layers
+        self.add_sublayer("_layers", layers)
+        self._hcg = hcg
+        pcfg = strategy.pipeline_configs if strategy else {}
+        self.accumulate_steps = pcfg.get("accumulate_steps", 1)
+        self.micro_batch_size = pcfg.get("micro_batch_size", 1)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """Microbatched forward/backward with grad accumulation; stage-to-stage
+        transfer is XLA's problem via the 'pp' sharding of layer params."""
+        inputs, labels = data
+        mb = self.accumulate_steps
+        total = inputs.shape[0]
+        step = max(total // mb, 1)
+        losses = []
+        for i in range(0, total, step):
+            x = inputs[i : i + step]
+            y = labels[i : i + step]
+            out = self._layers(x)
+            loss = self._layers._loss_fn(out, y) if hasattr(self._layers, "_loss_fn") and self._layers._loss_fn else out
+            loss = loss / mb
+            if scaler is not None:
+                scaler.scale(loss).backward()
+            else:
+                loss.backward()
+            losses.append(loss)
+        if scaler is not None:
+            scaler.step(optimizer)
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return api.add_n([l.detach() for l in losses])

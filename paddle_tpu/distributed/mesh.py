@@ -1,0 +1,184 @@
+"""Device mesh & hybrid topology.
+
+Reference: CommunicateTopology / HybridCommunicateGroup
+(python/paddle/distributed/fleet/base/topology.py:58,144 — 4-D axis order
+["data","pipe","sharding","model"]) and ProcessMesh
+(paddle/phi/core/distributed/auto_parallel/process_mesh.h:32).
+
+TPU-native: both map onto ONE jax.sharding.Mesh whose named axes are the
+parallelism axes; XLA lays collectives onto ICI rings per axis. We add "sep"
+(sequence/context parallel) as a first-class axis — absent in the reference
+(SURVEY.md §5.7) but required here.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+_current_mesh: Optional[Mesh] = None
+
+# Canonical axis order (outer->inner): dp outermost (DCN-friendly), then pp,
+# sharding, sep, mp innermost (mp needs the fastest ICI links).
+AXIS_ORDER = ("dp", "pp", "sharding", "sep", "mp")
+
+
+def build_mesh(
+    dp: int = 1, mp: int = 1, pp: int = 1, sharding: int = 1, sep: int = 1,
+    devices=None,
+) -> Mesh:
+    devices = list(devices) if devices is not None else jax.devices()
+    sizes = {"dp": dp, "pp": pp, "sharding": sharding, "sep": sep, "mp": mp}
+    total = int(np.prod(list(sizes.values())))
+    if total > len(devices):
+        raise ValueError(f"mesh needs {total} devices, have {len(devices)}")
+    devs = np.array(devices[:total]).reshape([sizes[a] for a in AXIS_ORDER])
+    return Mesh(devs, AXIS_ORDER)
+
+
+def set_mesh(mesh: Mesh):
+    global _current_mesh
+    _current_mesh = mesh
+
+
+def get_mesh() -> Optional[Mesh]:
+    return _current_mesh
+
+
+def auto_mesh() -> Mesh:
+    """Default data-parallel mesh over all visible devices."""
+    global _current_mesh
+    if _current_mesh is None:
+        _current_mesh = build_mesh(dp=len(jax.devices()))
+    return _current_mesh
+
+
+class ProcessMesh:
+    """Semi-auto-parallel mesh (reference: python/paddle/distributed/
+    auto_parallel ProcessMesh). Wraps a jax Mesh with arbitrary dim names."""
+
+    def __init__(self, mesh, dim_names: Optional[Sequence[str]] = None, shape=None, process_ids=None):
+        arr = np.asarray(mesh)
+        self._shape = list(arr.shape)
+        self._process_ids = arr.flatten().tolist()
+        self._dim_names = list(dim_names) if dim_names else [f"d{i}" for i in range(arr.ndim)]
+        devices = jax.devices()
+        devs = np.array([devices[i % len(devices)] for i in self._process_ids]).reshape(arr.shape)
+        self.jax_mesh = Mesh(devs, tuple(self._dim_names))
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def process_ids(self):
+        return self._process_ids
+
+    @property
+    def dim_names(self):
+        return self._dim_names
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    def get_dim_size(self, name):
+        return self._shape[self._dim_names.index(name)]
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self._shape}, dims={self._dim_names})"
+
+
+class CommunicateTopology:
+    """Reference: fleet/base/topology.py:58."""
+
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "sep", "model"),
+                 dims=(1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = {}
+        self._world = int(np.prod(self._dims))
+
+    def world_size(self):
+        return self._world
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+
+class HybridCommunicateGroup:
+    """Reference: fleet/base/topology.py:144. Holds per-axis Groups whose
+    axis_name binds to the jax Mesh axes (dp/pp/sharding/sep/mp)."""
+
+    _AXIS_MAP = {"data": "dp", "pipe": "pp", "sharding": "sharding", "sep": "sep", "model": "mp"}
+
+    def __init__(self, topology: CommunicateTopology):
+        from .collective import new_group
+
+        self._topo = topology
+        self._dp_degree = topology.get_dim("data")
+        self._pp_degree = topology.get_dim("pipe")
+        self._sharding_degree = topology.get_dim("sharding")
+        self._sep_degree = topology.get_dim("sep") if "sep" in topology.get_hybrid_group_names() else 1
+        self._mp_degree = topology.get_dim("model")
+        self.global_rank = 0
+        self._dp_group = new_group(list(range(self._dp_degree)), axis_name="dp")
+        self._pp_group = new_group(list(range(self._pp_degree)), axis_name="pp")
+        self._sharding_group = new_group(list(range(self._sharding_degree)), axis_name="sharding")
+        self._sep_group = new_group(list(range(self._sep_degree)), axis_name="sep")
+        self._mp_group = new_group(list(range(self._mp_degree)), axis_name="mp")
+        self.mesh = build_mesh(
+            dp=self._dp_degree, mp=self._mp_degree, pp=self._pp_degree,
+            sharding=self._sharding_degree, sep=self._sep_degree,
+        ) if int(np.prod([self._dp_degree, self._mp_degree, self._pp_degree,
+                          self._sharding_degree, self._sep_degree])) <= len(jax.devices()) else None
+        if self.mesh is not None:
+            set_mesh(self.mesh)
+
+    # --- reference API surface ---
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    def get_data_parallel_group(self):
+        return self._dp_group
+
+    def get_model_parallel_group(self):
+        return self._mp_group
+
+    def get_pipe_parallel_group(self):
+        return self._pp_group
+
+    def get_sharding_parallel_group(self):
+        return self._sharding_group
+
+    def get_sep_parallel_group(self):
+        return self._sep_group
+
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_model_parallel_group_src_rank(self):
+        return 0

@@ -1,0 +1,55 @@
+"""DataParallel (reference: python/paddle/distributed/parallel.py:190 +
+C++ EagerReducer bucketed allreduce, collective/reducer.cc).
+
+TPU-native: under the compiled train step, DP is a sharding annotation — the
+batch is sharded over the 'dp' mesh axis and XLA inserts ONE fused
+reduce-scatter/all-gather (or all-reduce) for the gradients, which is exactly
+what EagerReducer's bucketing approximates by hand. Eagerly (single process)
+it is an identity wrapper, matching reference behavior at world_size==1.
+"""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from .collective import ReduceOp, all_reduce, get_world_size
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False, group=None):
+        super().__init__()
+        self._layers = layers
+        self.add_sublayer("_layers", layers)
+        self._group = group
+        self.find_unused_parameters = find_unused_parameters
+        if get_world_size() > 1 or group is not None:
+            self._register_grad_hooks()
+
+    def _register_grad_hooks(self):
+        group = self._group
+
+        def make_hook():
+            def hook(grad):
+                return all_reduce(Tensor(grad) if not isinstance(grad, Tensor) else grad,
+                                  op=ReduceOp.SUM, group=group)
+
+            return hook
+
+        for p in self._layers.parameters():
+            if p.trainable:
+                p._grad_hooks.append(make_hook())
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass

@@ -1,0 +1,61 @@
+"""paddle.save / paddle.load (reference: python/paddle/framework/io.py:646,888).
+
+Pickles nested containers with tensors converted to numpy, like the reference.
+Sharded/async distributed checkpointing lives in distributed/checkpoint.py
+(orbax-backed).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer import Parameter
+
+
+class _TensorPayload:
+    def __init__(self, array, is_param, name):
+        self.array = array
+        self.is_param = is_param
+        self.name = name
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj._value), isinstance(obj, Parameter), obj.name)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.array
+        t = Parameter(obj.array, name=obj.name) if obj.is_param else Tensor(obj.array)
+        return t
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy=return_numpy)

@@ -1,0 +1,159 @@
+"""High-level Model API (reference: python/paddle/hapi/model.py:1050 —
+Model.fit/evaluate/predict + callbacks).
+
+TPU-native: fit() trains through the compiled TrainStep (one XLA program per
+step), so hapi users get compiled-mode performance without touching jit."""
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..io import DataLoader
+from ..jit.trainer import TrainStep
+from ..nn.layer import Layer
+
+
+class Model:
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._train_step = None
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) else ([metrics] if metrics else [])
+        return self
+
+    def _get_train_step(self):
+        if self._train_step is None:
+            net, loss_fn = self.network, self._loss
+
+            def step_loss(x, y):
+                out = net(x)
+                return loss_fn(out, y)
+
+            self._train_step = TrainStep(net, step_loss, self._optimizer)
+        return self._train_step
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+        loader = train_data if isinstance(train_data, DataLoader) else DataLoader(
+            train_data, batch_size=batch_size, shuffle=shuffle,
+            drop_last=drop_last, num_workers=num_workers,
+        )
+        step_fn = self._get_train_step()
+        history = {"loss": []}
+        for epoch in range(epochs):
+            self.network.train()
+            t0 = time.time()
+            losses = []
+            for i, batch in enumerate(loader):
+                x, y = batch[0], batch[1]
+                loss = step_fn(x, y)
+                losses.append(float(loss.item()))
+                if verbose and log_freq and (i + 1) % log_freq == 0:
+                    print(f"Epoch {epoch + 1}/{epochs} step {i + 1}: loss={np.mean(losses[-log_freq:]):.4f}")
+            history["loss"].append(float(np.mean(losses)) if losses else float("nan"))
+            if verbose:
+                print(f"Epoch {epoch + 1}: mean loss {history['loss'][-1]:.4f} ({time.time() - t0:.1f}s)")
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size, verbose=verbose)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/epoch_{epoch}")
+        step_fn.sync_to_optimizer()
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2, num_workers=0, callbacks=None):
+        loader = eval_data if isinstance(eval_data, DataLoader) else DataLoader(
+            eval_data, batch_size=batch_size, num_workers=num_workers,
+        )
+        self.network.eval()
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            x, y = batch[0], batch[1]
+            out = self.network(x)
+            if self._loss is not None:
+                losses.append(float(self._loss(out, y).item()))
+            for m in self._metrics:
+                m.update(m.compute(out, y))
+        result = {"loss": [float(np.mean(losses))] if losses else []}
+        for m in self._metrics:
+            result[m.name()] = m.accumulate()
+        if verbose:
+            print("Eval:", result)
+        return result
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False, verbose=1, callbacks=None):
+        loader = test_data if isinstance(test_data, DataLoader) else DataLoader(
+            test_data, batch_size=batch_size, num_workers=num_workers,
+        )
+        self.network.eval()
+        outputs = []
+        for batch in loader:
+            x = batch[0] if isinstance(batch, (tuple, list)) else batch
+            outputs.append(self.network(x))
+        if stack_outputs:
+            from ..ops import api
+
+            return api.concat(outputs, axis=0)
+        return outputs
+
+    def train_batch(self, inputs, labels=None):
+        step_fn = self._get_train_step()
+        loss = step_fn(inputs if not isinstance(inputs, (list, tuple)) else inputs[0],
+                       labels if not isinstance(labels, (list, tuple)) else labels[0])
+        return [float(loss.item())]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        x = inputs[0] if isinstance(inputs, (list, tuple)) else inputs
+        y = labels[0] if isinstance(labels, (list, tuple)) else labels
+        out = self.network(x)
+        return [float(self._loss(out, y).item())]
+
+    def save(self, path, training=True):
+        from ..framework.io import save as _save
+
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            if self._train_step is not None:
+                self._train_step.sync_to_optimizer()
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load as _load
+
+        self.network.set_state_dict(_load(path + ".pdparams"))
+
+    def parameters(self):
+        return self.network.parameters()
+
+
+def summary(net: Layer, input_size=None, dtypes=None):
+    """paddle.summary analog: parameter table + counts."""
+    rows = []
+    total = 0
+    trainable = 0
+    for name, p in net.named_parameters():
+        n = int(np.prod(p.shape)) if p.shape else 1
+        rows.append((name, tuple(p.shape), n))
+        total += n
+        if p.trainable:
+            trainable += n
+    width = max((len(r[0]) for r in rows), default=20) + 2
+    lines = [f"{'Param':<{width}}{'Shape':<24}{'Count':>12}", "-" * (width + 36)]
+    for name, shape, n in rows:
+        lines.append(f"{name:<{width}}{str(shape):<24}{n:>12,}")
+    lines.append("-" * (width + 36))
+    lines.append(f"Total params: {total:,}  (trainable: {trainable:,})")
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}

@@ -1,0 +1,28 @@
+"""Data pipeline (reference: python/paddle/io/ — Dataset/DataLoader/samplers;
+C++ reader ops in paddle/fluid/operators/reader/).
+
+TPU-native: workers are threads feeding a prefetch queue (numpy batching is
+GIL-releasing), with device transfer overlapped via jax.device_put on the
+default device — the host->HBM prefetch the reference does with pinned-memory
+double buffering.
+"""
+from .dataset import (  # noqa: F401
+    ChainDataset,
+    ComposeDataset,
+    ConcatDataset,
+    Dataset,
+    IterableDataset,
+    Subset,
+    TensorDataset,
+    random_split,
+)
+from .dataloader import DataLoader, get_worker_info  # noqa: F401
+from .sampler import (  # noqa: F401
+    BatchSampler,
+    DistributedBatchSampler,
+    RandomSampler,
+    Sampler,
+    SequenceSampler,
+    SubsetRandomSampler,
+    WeightedRandomSampler,
+)

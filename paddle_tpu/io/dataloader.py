@@ -1,0 +1,144 @@
+"""DataLoader (reference: python/paddle/io/dataloader/dataloader_iter.py +
+worker.py — multiprocess workers + shared-memory queues).
+
+TPU-native: thread workers + a bounded prefetch queue. Batches collate to numpy
+(GIL released in np ops) and convert to device arrays lazily. For TPU input
+pipelines the compiled-step path consumes numpy directly via device_put, which
+overlaps H2D with compute through PJRT's async dispatch.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import IterableDataset
+from .sampler import BatchSampler
+
+
+@dataclass
+class WorkerInfo:
+    id: int
+    num_workers: int
+    dataset: object
+
+
+_worker_info = threading.local()
+
+
+def get_worker_info() -> Optional[WorkerInfo]:
+    return getattr(_worker_info, "info", None)
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, (tuple, list)):
+        return tuple(default_collate_fn([b[i] for b in batch]) for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, Tensor):
+        return Tensor(np.stack([np.asarray(s._value) for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, float, np.integer, np.floating)):
+        return Tensor(np.asarray(batch))
+    return batch
+
+
+class DataLoader:
+    def __init__(
+        self, dataset, feed_list=None, places=None, return_list=True,
+        batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
+        collate_fn=None, num_workers=0, use_buffer_reader=True,
+        prefetch_factor=2, use_shared_memory=True, timeout=0, worker_init_fn=None,
+    ):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = int(num_workers)
+        self.prefetch_factor = prefetch_factor
+        self.worker_init_fn = worker_init_fn
+        self._iterable = isinstance(dataset, IterableDataset)
+        if self._iterable:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset=dataset, shuffle=shuffle, batch_size=batch_size, drop_last=drop_last
+            )
+
+    def __len__(self):
+        if self._iterable:
+            raise TypeError("IterableDataset has no len()")
+        return len(self.batch_sampler)
+
+    def __iter__(self):
+        if self._iterable:
+            return self._iter_iterable()
+        if self.num_workers == 0:
+            return self._iter_single()
+        return self._iter_threaded()
+
+    def _iter_single(self):
+        for batch_indices in self.batch_sampler:
+            yield self.collate_fn([self.dataset[i] for i in batch_indices])
+
+    def _iter_iterable(self):
+        batch = []
+        for item in self.dataset:
+            batch.append(item)
+            if len(batch) == self.batch_size:
+                yield self.collate_fn(batch)
+                batch = []
+        if batch and not self.drop_last:
+            yield self.collate_fn(batch)
+
+    def _iter_threaded(self):
+        indices = list(self.batch_sampler)
+        out_q: "queue.Queue" = queue.Queue(maxsize=self.num_workers * self.prefetch_factor)
+        results = {}
+        next_to_yield = [0]
+        lock = threading.Lock()
+        task_q: "queue.Queue" = queue.Queue()
+        for i, b in enumerate(indices):
+            task_q.put((i, b))
+        stop = threading.Event()
+
+        def worker(wid):
+            _worker_info.info = WorkerInfo(wid, self.num_workers, self.dataset)
+            if self.worker_init_fn:
+                self.worker_init_fn(wid)
+            while not stop.is_set():
+                try:
+                    i, batch_indices = task_q.get_nowait()
+                except queue.Empty:
+                    return
+                try:
+                    data = self.collate_fn([self.dataset[j] for j in batch_indices])
+                    out_q.put((i, data))
+                except Exception as e:  # propagate
+                    out_q.put((i, e))
+
+        threads = [threading.Thread(target=worker, args=(w,), daemon=True) for w in range(self.num_workers)]
+        for t in threads:
+            t.start()
+        try:
+            received = 0
+            while received < len(indices):
+                i, data = out_q.get()
+                received += 1
+                if isinstance(data, Exception):
+                    raise data
+                with lock:
+                    results[i] = data
+                while next_to_yield[0] in results:
+                    yield results.pop(next_to_yield[0])
+                    next_to_yield[0] += 1
+        finally:
+            stop.set()

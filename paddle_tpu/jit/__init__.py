@@ -1,0 +1,227 @@
+"""Trace-and-compile executor.
+
+Reference analogs:
+  - @to_static + ProgramTranslator (python/paddle/jit/api.py:233,
+    dy2static/program_translator.py) -> here: functional tracing into ONE XLA
+    program via jax.jit (no AST rewriting: the eager op layer is already pure,
+    so tracing just works — including control flow unrolling, like the
+    reference's program capture).
+  - StandaloneExecutor + program cache (paddle/fluid/framework/new_executor/
+    standalone_executor.cc:29, python/paddle/fluid/executor.py:701
+    _ExecutorCache) -> jax.jit's compiled-program cache keyed on shapes/dtypes,
+    with donated buffers for params/optimizer state.
+  - paddle.jit.save/load (*.pdmodel/*.pdiparams, jit/api.py:793) ->
+    jax.export serialized StableHLO + a .npz of weights.
+"""
+from __future__ import annotations
+
+import functools
+import os
+from typing import Any, Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as _random
+from ..core.tensor import Tensor
+from ..nn.layer import Layer, Parameter
+from .trainer import TrainStep  # noqa: F401
+
+
+def _collect_params(fn, extra_layers=()) -> List[Parameter]:
+    layers = list(extra_layers)
+    owner = getattr(fn, "__self__", None)
+    if isinstance(owner, Layer):
+        layers.append(owner)
+    closure = getattr(fn, "__closure__", None) or ()
+    for cell in closure:
+        try:
+            v = cell.cell_contents
+        except ValueError:
+            continue
+        if isinstance(v, Layer):
+            layers.append(v)
+    params = []
+    seen = set()
+    for layer in layers:
+        for p in layer.parameters():
+            if id(p) not in seen:
+                seen.add(id(p))
+                params.append(p)
+        for b in layer.buffers():
+            if id(b) not in seen:
+                seen.add(id(b))
+                params.append(b)
+    return params
+
+
+class _Functionalized:
+    """Runs `fn` with params/buffers temporarily swapped to traced values —
+    the PartialProgramLayer analog (dy2static/partial_program.py)."""
+
+    def __init__(self, fn, params):
+        self.fn = fn
+        self.params = params
+
+    def __call__(self, param_vals, seed, args, kwargs):
+        saved = [p._value for p in self.params]
+        saved_nodes = [(p._grad_node, p._grad) for p in self.params]
+        prev_seed = _random.default_generator.push_trace_seed(seed)
+        try:
+            for p, v in zip(self.params, param_vals):
+                p._value = v
+                p._grad_node = None
+                p._grad = None
+            out = self.fn(*args, **kwargs)
+            return jax.tree_util.tree_map(
+                lambda x: x._value if isinstance(x, Tensor) else x,
+                out,
+                is_leaf=lambda x: isinstance(x, Tensor),
+            )
+        finally:
+            _random.default_generator.pop_trace_seed(prev_seed)
+            for p, v, (gn, g) in zip(self.params, saved, saved_nodes):
+                p._value = v
+                p._grad_node = gn
+                p._grad = g
+
+
+class StaticFunction:
+    """Result of @to_static: traces on first call per input signature, then
+    replays the compiled XLA program."""
+
+    def __init__(self, fn, input_spec=None, layers=()):
+        self._fn = fn
+        self._input_spec = input_spec
+        self._layers = tuple(layers)
+        self._params: Optional[List[Parameter]] = None
+        self._jitted = None
+        functools.update_wrapper(self, fn, updated=())
+
+    def _build(self):
+        self._params = _collect_params(self._fn, self._layers)
+        runner = _Functionalized(self._fn, self._params)
+
+        def pure(param_vals, seed, args, kwargs):
+            return runner(param_vals, seed, args, kwargs)
+
+        self._jitted = jax.jit(pure, static_argnames=())
+
+    def __call__(self, *args, **kwargs):
+        if self._jitted is None:
+            self._build()
+        arg_vals = jax.tree_util.tree_map(
+            lambda x: x._value if isinstance(x, Tensor) else x,
+            (args, kwargs),
+            is_leaf=lambda x: isinstance(x, Tensor),
+        )
+        param_vals = [p._value for p in self._params]
+        seed = jnp.asarray(np.random.randint(0, 2 ** 31 - 1), jnp.int32)
+        out = self._jitted(param_vals, seed, arg_vals[0], arg_vals[1])
+        return jax.tree_util.tree_map(
+            lambda x: Tensor(x) if isinstance(x, jax.Array) else x, out
+        )
+
+    @property
+    def parameters(self):
+        if self._params is None:
+            self._build()
+        return self._params
+
+    def lower(self, *args, **kwargs):
+        """Return the jax lowering (StableHLO access for save/inspection)."""
+        if self._jitted is None:
+            self._build()
+        arg_vals = jax.tree_util.tree_map(
+            lambda x: x._value if isinstance(x, Tensor) else x,
+            (args, kwargs),
+            is_leaf=lambda x: isinstance(x, Tensor),
+        )
+        param_vals = [p._value for p in self._params]
+        seed = jnp.asarray(0, jnp.int32)
+        return self._jitted.lower(param_vals, seed, arg_vals[0], arg_vals[1])
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):
+    """@paddle.jit.to_static analog. Works on functions, bound methods, and
+    Layers (wraps forward)."""
+
+    def decorate(fn):
+        if isinstance(fn, Layer):
+            layer = fn
+            static = StaticFunction(layer.forward, input_spec, layers=(layer,))
+            layer.forward = static
+            return layer
+        return StaticFunction(fn, input_spec)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+class InputSpec:
+    """paddle.static.InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        from ..core.dtype import convert_dtype
+
+        self.shape = tuple(shape)
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+
+    def to_sds(self):
+        shape = tuple(1 if (s is None or s < 0) else s for s in self.shape)
+        return jax.ShapeDtypeStruct(shape, self.dtype)
+
+
+def save(layer, path, input_spec=None, **config):
+    """paddle.jit.save analog: serializes weights (.pdiparams.npz) and, when
+    input_spec is given, a jax.export StableHLO artifact (.pdmodel) runnable
+    from any process via jit.load."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    if isinstance(layer, Layer):
+        state = layer.state_dict()
+        fwd = layer.forward if isinstance(layer.forward, StaticFunction) else to_static(layer).forward
+    elif isinstance(layer, StaticFunction):
+        state = {f"param_{i}": p for i, p in enumerate(layer.parameters)}
+        fwd = layer
+    else:
+        raise TypeError("jit.save expects a Layer or a to_static function")
+    np.savez(path + ".pdiparams.npz", **{k: np.asarray(v._value) for k, v in state.items()})
+    if input_spec is not None:
+        from jax import export as jexport
+
+        specs = [s.to_sds() if isinstance(s, InputSpec) else s for s in input_spec]
+        param_vals = [p._value for p in fwd._params] if fwd._params else [p._value for p in _collect_params(fwd._fn, fwd._layers)]
+        if fwd._jitted is None:
+            fwd._build()
+            param_vals = [p._value for p in fwd._params]
+
+        def infer(args):
+            runner = _Functionalized(fwd._fn, fwd._params)
+            return runner(param_vals, jnp.asarray(0, jnp.int32), args, {})
+
+        exported = jexport.export(jax.jit(infer))((tuple(specs),))
+        with open(path + ".pdmodel", "wb") as f:
+            f.write(exported.serialize())
+
+
+def load(path, **config):
+    """paddle.jit.load analog: returns a callable running the exported program."""
+    from jax import export as jexport
+
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jexport.deserialize(f.read())
+
+    def run(*args):
+        vals = tuple(a._value if isinstance(a, Tensor) else jnp.asarray(a) for a in args)
+        out = exported.call((vals,))
+        return jax.tree_util.tree_map(lambda x: Tensor(x), out)
+
+    return run
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn

@@ -1,0 +1,136 @@
+"""Compiled training step.
+
+Reference analog: the static-graph training path — Program capture +
+StandaloneExecutor with one fused program per step (SURVEY.md §3.3), plus the
+donation/buffer-reuse the reference gets from its allocator. Here: ONE XLA
+program computes forward + backward + optimizer update; param and optimizer
+state buffers are donated so updates are in-place in HBM.
+
+The autograd inside the trace is the SAME engine as eager (core/autograd.py) —
+the dual-mode property the reference engineers via shared phi kernels.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import autograd as _ag
+from ..core import random as _random
+from ..core.tensor import Tensor
+from ..nn.clip import ClipGradByGlobalNorm
+from ..nn.layer import Layer
+
+
+def _tensor_leaves(x):
+    return jax.tree_util.tree_map(
+        lambda v: v._value if isinstance(v, Tensor) else v,
+        x,
+        is_leaf=lambda v: isinstance(v, Tensor),
+    )
+
+
+class TrainStep:
+    """Compile forward+backward+update into one donated-buffer XLA program.
+
+    Usage:
+        step = TrainStep(model, loss_fn, optimizer)   # loss_fn(*batch)->loss
+        loss = step(x, y)                             # runs the compiled step
+    """
+
+    def __init__(
+        self,
+        model: Layer,
+        loss_fn: Callable[..., Tensor],
+        optimizer,
+        donate: bool = True,
+        in_shardings=None,
+        out_shardings=None,
+        mesh=None,
+    ):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.params = [p for p in model.parameters() if p.trainable]
+        self.buffers = [b for b in model.buffers()]
+        self.opt_state = optimizer.init_state_tree(self.params)
+        self._mesh = mesh
+        self._step_i = 0
+
+        def step(param_vals, buffer_vals, opt_state, lr, seed, batch):
+            saved = [(p._value, p._grad_node, p._grad, p.stop_gradient) for p in self.params]
+            saved_buf = [(b._value,) for b in self.buffers]
+            prev_seed = _random.default_generator.push_trace_seed(seed)
+            try:
+                for p, v in zip(self.params, param_vals):
+                    p._value = v
+                    p._grad_node = None
+                    p._grad = None
+                    p.stop_gradient = False
+                for b, v in zip(self.buffers, buffer_vals):
+                    b._value = v
+                batch_t = jax.tree_util.tree_map(Tensor, batch)
+                loss = self.loss_fn(*batch_t)
+                grads = _ag.grad(loss, self.params, allow_unused=True)
+                g_vals = [
+                    (g._value if g is not None else jnp.zeros_like(p._value))
+                    for g, p in zip(grads, self.params)
+                ]
+                clip = optimizer._grad_clip
+                if isinstance(clip, ClipGradByGlobalNorm):
+                    g_vals = clip.functional_clip(g_vals)
+                elif clip is not None:
+                    pairs = clip([(p, Tensor(g)) for p, g in zip(self.params, g_vals)])
+                    g_vals = [g._value for _, g in pairs]
+                new_p, new_s = optimizer.functional_update(param_vals, g_vals, opt_state, lr)
+                new_buffer_vals = [b._value for b in self.buffers]  # BN stats updated in-place
+                return loss._value, new_p, new_buffer_vals, new_s
+            finally:
+                _random.default_generator.pop_trace_seed(prev_seed)
+                for p, (v, gn, g, sg) in zip(self.params, saved):
+                    p._value, p._grad_node, p._grad, p.stop_gradient = v, gn, g, sg
+                for b, (v,) in zip(self.buffers, saved_buf):
+                    b._value = v
+
+        donate_argnums = (0, 1, 2) if donate else ()
+        self._jitted = jax.jit(
+            step,
+            donate_argnums=donate_argnums,
+            in_shardings=in_shardings,
+            out_shardings=out_shardings,
+        )
+
+    def __call__(self, *batch):
+        batch_vals = _tensor_leaves(batch)
+        param_vals = [p._value for p in self.params]
+        buffer_vals = [b._value for b in self.buffers]
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        seed = jnp.asarray(self._step_i, jnp.int32)
+        self._step_i += 1
+        loss, new_p, new_b, new_s = self._jitted(
+            param_vals, buffer_vals, self.opt_state, lr, seed, batch_vals
+        )
+        for p, v in zip(self.params, new_p):
+            p._value = v
+        for b, v in zip(self.buffers, new_b):
+            b._value = v
+        self.opt_state = new_s
+        sched = self.optimizer._lr_scheduler
+        if sched is not None:
+            sched.step()
+        self.optimizer._step_count += 1
+        return Tensor(loss)
+
+    def sync_to_optimizer(self):
+        """Push compiled-state back so optimizer.state_dict() reflects training."""
+        self.optimizer.sync_state_from(self.params, self.opt_state)
+
+    def lower(self, *batch):
+        batch_vals = _tensor_leaves(batch)
+        param_vals = [p._value for p in self.params]
+        buffer_vals = [b._value for b in self.buffers]
+        lr = jnp.asarray(0.0, jnp.float32)
+        seed = jnp.asarray(0, jnp.int32)
+        return self._jitted.lower(param_vals, buffer_vals, self.opt_state, lr, seed, batch_vals)

@@ -1,0 +1,170 @@
+"""Metrics (reference: python/paddle/metric/metrics.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+class Metric:
+    def __init__(self):
+        pass
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        return self.__class__.__name__.lower()
+
+    def compute(self, *args):
+        return args
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name=None):
+        super().__init__()
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def compute(self, pred, label):
+        pred = np.asarray(pred._value if isinstance(pred, Tensor) else pred)
+        label = np.asarray(label._value if isinstance(label, Tensor) else label)
+        maxk = max(self.topk)
+        idx = np.argsort(-pred, axis=-1)[..., :maxk]
+        if label.ndim == pred.ndim:
+            label = label.squeeze(-1)
+        correct = idx == label[..., None]
+        return correct
+
+    def update(self, correct, *args):
+        correct = np.asarray(correct._value if isinstance(correct, Tensor) else correct)
+        n = correct.shape[0] if correct.ndim else 1
+        for i, k in enumerate(self.topk):
+            self.total[i] += float(correct[..., :k].any(axis=-1).sum())
+            self.count[i] += n
+        acc = self.total[0] / max(self.count[0], 1)
+        return acc
+
+    def accumulate(self):
+        res = [t / max(c, 1) for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        return self._name
+
+
+class Precision(Metric):
+    def __init__(self, name=None):
+        super().__init__()
+        self._name = name or "precision"
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds._value if isinstance(preds, Tensor) else preds)
+        labels = np.asarray(labels._value if isinstance(labels, Tensor) else labels)
+        pred_pos = (preds > 0.5).astype(np.int32).reshape(-1)
+        labels = labels.reshape(-1)
+        self.tp += int(((pred_pos == 1) & (labels == 1)).sum())
+        self.fp += int(((pred_pos == 1) & (labels == 0)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    def __init__(self, name=None):
+        super().__init__()
+        self._name = name or "recall"
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds._value if isinstance(preds, Tensor) else preds)
+        labels = np.asarray(labels._value if isinstance(labels, Tensor) else labels)
+        pred_pos = (preds > 0.5).astype(np.int32).reshape(-1)
+        labels = labels.reshape(-1)
+        self.tp += int(((pred_pos == 1) & (labels == 1)).sum())
+        self.fn += int(((pred_pos == 0) & (labels == 1)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    def __init__(self, curve="ROC", num_thresholds=4095, name=None):
+        super().__init__()
+        self._name = name or "auc"
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1)
+        self._stat_neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds._value if isinstance(preds, Tensor) else preds)
+        labels = np.asarray(labels._value if isinstance(labels, Tensor) else labels)
+        if preds.ndim == 2 and preds.shape[1] == 2:
+            preds = preds[:, 1]
+        preds = preds.reshape(-1)
+        labels = labels.reshape(-1)
+        idx = np.clip((preds * self.num_thresholds).astype(np.int64), 0, self.num_thresholds)
+        for i, l in zip(idx, labels):
+            if l:
+                self._stat_pos[i] += 1
+            else:
+                self._stat_neg[i] += 1
+
+    def accumulate(self):
+        tot_pos = self._stat_pos.sum()
+        tot_neg = self._stat_neg.sum()
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        # trapezoid over thresholds, descending
+        pos_cum = np.cumsum(self._stat_pos[::-1])
+        neg_cum = np.cumsum(self._stat_neg[::-1])
+        tpr = pos_cum / tot_pos
+        fpr = neg_cum / tot_neg
+        return float(np.trapz(tpr, fpr))
+
+    def name(self):
+        return self._name
+
+
+def accuracy(input, label, k=1):
+    """Functional top-k accuracy."""
+    import jax.numpy as jnp
+
+    pred = input._value if isinstance(input, Tensor) else input
+    lbl = label._value if isinstance(label, Tensor) else label
+    if lbl.ndim == pred.ndim:
+        lbl = lbl.squeeze(-1)
+    topk_idx = jnp.argsort(-pred, axis=-1)[..., :k]
+    correct = (topk_idx == lbl[..., None]).any(axis=-1)
+    return Tensor(jnp.mean(correct.astype(jnp.float32)))

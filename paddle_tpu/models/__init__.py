@@ -1,0 +1,2 @@
+from . import gpt  # noqa: F401
+from .gpt import GPTConfig, GPTForCausalLM, GPTModel  # noqa: F401

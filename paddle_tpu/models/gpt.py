@@ -1,0 +1,174 @@
+"""GPT family — the flagship model (BASELINE configs[3]: GPT-3 1.3B hybrid).
+
+Reference model zoo analog: the fleetx/gpt models used by Fleet hybrid
+examples (hybrid_parallel_pp_amp.py payloads, fused_multi_transformer ops in
+paddle/fluid/operators/fused/).
+
+TPU-first design decisions:
+  * pre-LN transformer, bf16-friendly (fp32 softmax/norm statistics inside
+    the kernels);
+  * attention lowers to the Pallas flash kernel on TPU (ops/pallas), else the
+    jnp reference path;
+  * TP is expressed as weight shardings (Column/Row/VocabParallel layers) —
+    GSPMD inserts the collectives; the same module runs single-chip unchanged;
+  * rotary or learned positions; weight-tied LM head.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..distributed.fleet.mp_layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from ..nn import functional as F
+from ..ops import api
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 0  # 0 -> 4*hidden
+    max_position_embeddings: int = 1024
+    hidden_dropout_prob: float = 0.1
+    attention_dropout_prob: float = 0.1
+    initializer_range: float = 0.02
+    use_rotary: bool = False
+    tie_word_embeddings: bool = True
+
+    def __post_init__(self):
+        if not self.intermediate_size:
+            self.intermediate_size = 4 * self.hidden_size
+
+    @staticmethod
+    def gpt3_1p3b():
+        return GPTConfig(hidden_size=2048, num_layers=24, num_heads=16,
+                         max_position_embeddings=2048)
+
+    @staticmethod
+    def tiny():
+        return GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                         num_heads=4, max_position_embeddings=256,
+                         hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+
+
+class CausalSelfAttention(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        c = config
+        self.num_heads = c.num_heads
+        self.head_dim = c.hidden_size // c.num_heads
+        self.hidden_size = c.hidden_size
+        self.qkv_proj = ColumnParallelLinear(c.hidden_size, 3 * c.hidden_size, gather_output=False)
+        self.out_proj = RowParallelLinear(c.hidden_size, c.hidden_size, input_is_parallel=True)
+        self.attn_dropout_p = c.attention_dropout_prob
+        self.resid_dropout = nn.Dropout(c.hidden_dropout_prob)
+
+    def forward(self, x, rope=None):
+        b, s, h = x.shape
+        qkv = self.qkv_proj(x)
+        qkv = api.reshape(qkv, [b, s, self.num_heads, 3 * self.head_dim])
+        q, k, v = api.split(qkv, 3, axis=-1)
+        if rope is not None:
+            q, k = api.rotary_position_embedding(q, k, rope[0], rope[1])
+        out = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True,
+            dropout_p=self.attn_dropout_p if self.training else 0.0,
+            training=self.training,
+        )
+        out = api.reshape(out, [b, s, h])
+        return self.resid_dropout(self.out_proj(out))
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.fc_in = ColumnParallelLinear(config.hidden_size, config.intermediate_size, gather_output=False)
+        self.fc_out = RowParallelLinear(config.intermediate_size, config.hidden_size, input_is_parallel=True)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x):
+        return self.dropout(self.fc_out(F.gelu(self.fc_in(x), approximate=True)))
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln_1 = nn.LayerNorm(config.hidden_size)
+        self.attn = CausalSelfAttention(config)
+        self.ln_2 = nn.LayerNorm(config.hidden_size)
+        self.mlp = GPTMLP(config)
+
+    def forward(self, x, rope=None):
+        x = x + self.attn(self.ln_1(x), rope=rope)
+        x = x + self.mlp(self.ln_2(x))
+        return x
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.wte = VocabParallelEmbedding(config.vocab_size, config.hidden_size)
+        if not config.use_rotary:
+            self.wpe = nn.Embedding(config.max_position_embeddings, config.hidden_size)
+        self.drop = nn.Dropout(config.hidden_dropout_prob)
+        self.blocks = nn.LayerList([GPTBlock(config) for _ in range(config.num_layers)])
+        self.ln_f = nn.LayerNorm(config.hidden_size)
+        self._rope_cache = None
+
+    def _rope(self, seq_len):
+        if self.config.use_rotary:
+            import jax.numpy as jnp
+
+            d = self.config.hidden_size // self.config.num_heads
+            inv = 1.0 / (10000 ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+            t = jnp.arange(seq_len, dtype=jnp.float32)
+            freqs = jnp.outer(t, inv)
+            emb = jnp.concatenate([freqs, freqs], axis=-1)
+            return Tensor(jnp.cos(emb)), Tensor(jnp.sin(emb))
+        return None
+
+    def forward(self, input_ids):
+        b, s = input_ids.shape
+        h = self.wte(input_ids)
+        rope = None
+        if self.config.use_rotary:
+            rope = self._rope(s)
+        else:
+            pos = api.arange(0, s, 1, dtype="int32")
+            h = h + self.wpe(pos)
+        h = self.drop(h)
+        for block in self.blocks:
+            h = block(h, rope=rope)
+        return self.ln_f(h)
+
+
+class GPTForCausalLM(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+        if not config.tie_word_embeddings:
+            self.lm_head = ColumnParallelLinear(config.hidden_size, config.vocab_size,
+                                                has_bias=False, gather_output=True)
+
+    def forward(self, input_ids, labels=None):
+        h = self.gpt(input_ids)
+        if self.config.tie_word_embeddings:
+            logits = api.matmul(h, self.gpt.wte.weight, transpose_y=True)
+        else:
+            logits = self.lm_head(h)
+        if labels is not None:
+            loss = F.cross_entropy(
+                api.reshape(logits, [-1, self.config.vocab_size]),
+                api.reshape(labels, [-1]),
+            )
+            return loss
+        return logits

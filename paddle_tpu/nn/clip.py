@@ -1,0 +1,67 @@
+"""Gradient clipping (reference: python/paddle/nn/clip.py —
+ClipGradByGlobalNorm/Norm/Value; the TP-aware hybrid version lives in
+distributed/fleet)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            gv = g._value if isinstance(g, Tensor) else g
+            out.append((p, Tensor(jnp.clip(gv, self.min, self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            gv = g._value if isinstance(g, Tensor) else g
+            norm = jnp.sqrt(jnp.sum(jnp.square(gv.astype(jnp.float32))))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((p, Tensor((gv.astype(jnp.float32) * scale).astype(gv.dtype))))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        sq = 0.0
+        for _, g in params_grads:
+            gv = g._value if isinstance(g, Tensor) else g
+            sq = sq + jnp.sum(jnp.square(gv.astype(jnp.float32)))
+        global_norm = jnp.sqrt(sq)
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            gv = g._value if isinstance(g, Tensor) else g
+            out.append((p, Tensor((gv.astype(jnp.float32) * scale).astype(gv.dtype))))
+        return out
+
+    def functional_clip(self, g_vals):
+        """Pure-array form for compiled steps."""
+        sq = 0.0
+        for g in g_vals:
+            sq = sq + jnp.sum(jnp.square(g.astype(jnp.float32)))
+        global_norm = jnp.sqrt(sq)
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        return [(g.astype(jnp.float32) * scale).astype(g.dtype) for g in g_vals]

@@ -1,0 +1,115 @@
+"""paddle.nn.functional — thin paddle-signature layer over the op registry.
+
+Reference: python/paddle/nn/functional/*. Most functions ARE the registered
+ops; only signature shims live here.
+"""
+from __future__ import annotations
+
+from ..ops.api import (  # noqa: F401
+    adaptive_avg_pool2d,
+    adaptive_max_pool2d,
+    avg_pool2d,
+    batch_norm,
+    binary_cross_entropy,
+    binary_cross_entropy_with_logits,
+    celu,
+    conv1d,
+    conv2d,
+    conv2d_transpose,
+    cosine_similarity,
+    cross_entropy,
+    dropout,
+    dropout2d,
+    elu,
+    embedding as _embedding_op,
+    gelu,
+    glu,
+    group_norm,
+    gumbel_softmax,
+    hardshrink,
+    hardsigmoid,
+    hardswish,
+    hardtanh,
+    hinge_embedding_loss,
+    instance_norm,
+    interpolate,
+    kl_div,
+    l1_loss,
+    label_smooth,
+    layer_norm as _layer_norm_op,
+    leaky_relu,
+    linear,
+    log_sigmoid,
+    log_softmax,
+    max_pool2d,
+    maxout,
+    mish,
+    mse_loss,
+    nll_loss,
+    normalize,
+    one_hot,
+    pad,
+    pixel_shuffle,
+    prelu,
+    relu,
+    relu6,
+    rms_norm,
+    rrelu,
+    selu,
+    sigmoid,
+    sigmoid_focal_loss,
+    silu,
+    smooth_l1_loss,
+    softmax,
+    softplus,
+    softshrink,
+    swish,
+    tanhshrink,
+    thresholded_relu,
+    unfold,
+    scaled_dot_product_attention,
+)
+from ..ops.api import softmax as softmax_  # noqa: F401
+from ..ops import api as _api
+
+
+def embedding(x, weight, padding_idx=None, sparse=False):
+    return _embedding_op(x, weight, padding_idx=padding_idx)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
+    return _layer_norm_op(x, normalized_shape, weight, bias, epsilon)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False, data_format="NCHW"):
+    return interpolate(x, size, scale_factor, mode, align_corners, data_format)
+
+
+def tanh(x):
+    return _api.tanh(x)
+
+
+def flatten(x, start_axis=0, stop_axis=-1):
+    return _api.flatten(x, start_axis, stop_axis)
+
+
+def square_error_cost(input, label):
+    return _api.square(input - label)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100, axis=-1, return_softmax=False):
+    loss = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index, axis=axis, reduction="none")
+    if loss.ndim == logits.ndim - 1:
+        loss = _api.unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64"):
+    import jax.numpy as jnp
+
+    if maxlen is None:
+        maxlen = int(lengths.max().item())
+    rng = _api.arange(0, maxlen, 1, dtype="int64")
+    return _api.cast(_api.less_than(rng, _api.unsqueeze(lengths, -1)), dtype)

@@ -1,0 +1,332 @@
+"""nn.Layer: module base class.
+
+Reference: python/paddle/nn/layer/layers.py (Layer) + EagerParamBase
+(python/paddle/fluid/framework.py:6967). Parameters are Tensors with
+stop_gradient=False; buffers are non-trainable state (e.g. BN running stats).
+"""
+from __future__ import annotations
+
+import collections
+from typing import Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import convert_dtype, get_default_dtype
+from ..core.tensor import Tensor
+from ..core.autograd import no_grad
+
+
+class Parameter(Tensor):
+    """Trainable parameter (EagerParamBase analog)."""
+
+    def __init__(self, value, name=None, trainable=True):
+        if isinstance(value, Tensor):
+            value = value._value
+        super().__init__(value, stop_gradient=not trainable, name=name or _unique_name("param"))
+        self.persistable = True
+        self.trainable = trainable
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+jax.tree_util.register_pytree_node(
+    Parameter,
+    lambda t: ((t._value,), (t.stop_gradient, t.name)),
+    lambda aux, vals: _unflatten_param(aux, vals),
+)
+
+
+def _unflatten_param(aux, vals):
+    t = Parameter.__new__(Parameter)
+    t._value = vals[0]
+    t.stop_gradient = aux[0]
+    t._grad_node = None
+    t._grad = None
+    t._grad_hooks = []
+    t.name = aux[1]
+    t.persistable = True
+    t.trainable = not aux[0]
+    return t
+
+
+_layer_counter = collections.defaultdict(int)
+
+
+def _unique_name(prefix):
+    _layer_counter[prefix] += 1
+    return f"{prefix}_{_layer_counter[prefix] - 1}"
+
+
+class Layer:
+    def __init__(self, name_scope: Optional[str] = None, dtype=None):
+        object.__setattr__(self, "_parameters", collections.OrderedDict())
+        object.__setattr__(self, "_buffers", collections.OrderedDict())
+        object.__setattr__(self, "_sub_layers", collections.OrderedDict())
+        self.training = True
+        self._dtype = convert_dtype(dtype) if dtype else get_default_dtype()
+        self._full_name = _unique_name(name_scope or self.__class__.__name__.lower())
+        self._forward_pre_hooks = collections.OrderedDict()
+        self._forward_post_hooks = collections.OrderedDict()
+
+    # --- registration ------------------------------------------------------
+    def __setattr__(self, name, value):
+        if isinstance(value, Parameter):
+            self._parameters[name] = value
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            self._sub_layers[name] = value
+            self.__dict__.pop(name, None)
+        else:
+            if name in getattr(self, "_parameters", {}):
+                if value is None or isinstance(value, Tensor):
+                    self._parameters[name] = value
+                    return
+            if name in getattr(self, "_buffers", {}):
+                self._buffers[name] = value
+                return
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"{type(self).__name__!r} object has no attribute {name!r}")
+
+    def __delattr__(self, name):
+        for store in (self._parameters, self._buffers, self._sub_layers):
+            if name in store:
+                del store[name]
+                return
+        object.__delattr__(self, name)
+
+    def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def register_buffer(self, name: str, tensor: Optional[Tensor], persistable=True):
+        if tensor is not None and not isinstance(tensor, Tensor):
+            tensor = Tensor(tensor)
+        if tensor is not None:
+            tensor.persistable = persistable
+            tensor.stop_gradient = True
+        self._buffers[name] = tensor
+        return tensor
+
+    def create_parameter(
+        self, shape, attr=None, dtype=None, is_bias=False, default_initializer=None,
+    ) -> Parameter:
+        from . import initializer as I
+
+        dtype = convert_dtype(dtype) if dtype else self._dtype
+        init = default_initializer
+        if init is None and attr is not None and getattr(attr, "initializer", None) is not None:
+            init = attr.initializer
+        if init is None:
+            init = I.Constant(0.0) if is_bias else I.XavierUniform()
+        value = init(shape, dtype)
+        name = None
+        if attr is not None and getattr(attr, "name", None):
+            name = attr.name
+        p = Parameter(value, name=name)
+        if attr is not None and getattr(attr, "trainable", True) is False:
+            p.stop_gradient = True
+            p.trainable = False
+        return p
+
+    # --- traversal ---------------------------------------------------------
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        for name, layer_prefix, layer in self._walk(prefix, include_sublayers):
+            for pname, p in layer._parameters.items():
+                if p is not None and id(p) not in seen:
+                    seen.add(id(p))
+                    yield (f"{layer_prefix}.{pname}" if layer_prefix else pname), p
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, layer_prefix, layer in self._walk(prefix, include_sublayers):
+            for bname, b in layer._buffers.items():
+                if b is not None and id(b) not in seen:
+                    seen.add(id(b))
+                    yield (f"{layer_prefix}.{bname}" if layer_prefix else bname), b
+
+    def _walk(self, prefix="", include_sublayers=True):
+        yield self._full_name, prefix, self
+        if include_sublayers:
+            for name, sub in self._sub_layers.items():
+                if sub is None:
+                    continue
+                sub_prefix = f"{prefix}.{name}" if prefix else name
+                yield from sub._walk(sub_prefix, True)
+
+    def children(self):
+        return iter(self._sub_layers.values())
+
+    def named_children(self):
+        return iter(self._sub_layers.items())
+
+    def sublayers(self, include_self=False):
+        out = [self] if include_self else []
+        for sub in self._sub_layers.values():
+            if sub is not None:
+                out.extend(sub.sublayers(include_self=True))
+        return out
+
+    def named_sublayers(self, prefix="", include_self=False):
+        if include_self:
+            yield prefix, self
+        for name, sub in self._sub_layers.items():
+            if sub is None:
+                continue
+            p = f"{prefix}.{name}" if prefix else name
+            yield from sub.named_sublayers(p, include_self=True)
+
+    def apply(self, fn):
+        for layer in self.sublayers(include_self=True):
+            fn(layer)
+        return self
+
+    # --- mode --------------------------------------------------------------
+    def train(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = True
+        return self
+
+    def eval(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = False
+        return self
+
+    # --- state dict --------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True, structured_name_prefix=""):
+        out = destination if destination is not None else collections.OrderedDict()
+        for k, p in self.named_parameters(structured_name_prefix, include_sublayers):
+            out[k] = p
+        for k, b in self.named_buffers(structured_name_prefix, include_sublayers):
+            out[k] = b
+        return out
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for k, v in state_dict.items():
+            if k in own:
+                val = v._value if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+                own[k]._value = val.astype(own[k].dtype)
+            else:
+                unexpected.append(k)
+        for k in own:
+            if k not in state_dict:
+                missing.append(k)
+        return missing, unexpected
+
+    set_dict = set_state_dict
+    load_dict = set_state_dict
+
+    # --- dtype / device ----------------------------------------------------
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            dt = convert_dtype(dtype)
+            with no_grad():
+                for p in self.parameters():
+                    if jnp.issubdtype(p.dtype, jnp.floating):
+                        p._value = p._value.astype(dt)
+                for b in self.buffers():
+                    if jnp.issubdtype(b.dtype, jnp.floating):
+                        b._value = b._value.astype(dt)
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    # --- hooks -------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        handle = _RemovableHandle(self._forward_pre_hooks)
+        self._forward_pre_hooks[handle.id] = hook
+        return handle
+
+    def register_forward_post_hook(self, hook):
+        handle = _RemovableHandle(self._forward_post_hooks)
+        self._forward_post_hooks[handle.id] = hook
+        return handle
+
+    # --- call --------------------------------------------------------------
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            result = hook(self, args)
+            if result is not None:
+                args = result if isinstance(result, tuple) else (result,)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            result = hook(self, args, out)
+            if result is not None:
+                out = result
+        return out
+
+    def full_name(self):
+        return self._full_name
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).split("\n")
+            sub_repr = [sub_repr[0]] + ["  " + l for l in sub_repr[1:]]
+            lines.append(f"  ({name}): " + "\n".join(sub_repr))
+        main = f"{type(self).__name__}({extra}"
+        if lines:
+            return main + "\n" + "\n".join(lines) + "\n)"
+        return main + ")"
+
+
+class _RemovableHandle:
+    _next_id = 0
+
+    def __init__(self, container):
+        self._container = container
+        self.id = _RemovableHandle._next_id
+        _RemovableHandle._next_id += 1
+
+    def remove(self):
+        self._container.pop(self.id, None)
+
+
+class ParamAttr:
+    """paddle.ParamAttr — parameter configuration bundle."""
+
+    def __init__(
+        self, name=None, initializer=None, learning_rate=1.0, regularizer=None,
+        trainable=True, need_clip=True,
+    ):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip

@@ -1,0 +1,288 @@
+"""Basic layers (reference: python/paddle/nn/layer/{common,conv,pooling}.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops import api
+from . import functional as F
+from . import initializer as I
+from .layer import Layer, Parameter
+
+
+class Linear(Layer):
+    def __init__(self, in_features, out_features, weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=None if weight_attr is None or getattr(weight_attr, "initializer", None) is None else weight_attr.initializer,
+        )
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter([out_features], attr=None if bias_attr in (None, True) else bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+    def extra_repr(self):
+        return f"in_features={self.in_features}, out_features={self.out_features}"
+
+
+class Embedding(Layer):
+    def __init__(self, num_embeddings, embedding_dim, padding_idx=None, sparse=False, weight_attr=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.padding_idx = padding_idx
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=I.Normal(0.0, 1.0) if weight_attr is None else None,
+        )
+
+    def forward(self, x):
+        return F.embedding(x, self.weight, padding_idx=self.padding_idx)
+
+    def extra_repr(self):
+        return f"{self.num_embeddings}, {self.embedding_dim}"
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, axis=None, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.axis = axis
+        self.mode = mode
+
+    def forward(self, x):
+        return F.dropout(x, p=self.p, training=self.training, mode=self.mode, axis=self.axis)
+
+
+class Dropout2D(Layer):
+    def __init__(self, p=0.5, data_format="NCHW", name=None):
+        super().__init__()
+        self.p = p
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.dropout2d(x, p=self.p, training=self.training, data_format=self.data_format)
+
+
+class Flatten(Layer):
+    def __init__(self, start_axis=1, stop_axis=-1):
+        super().__init__()
+        self.start_axis = start_axis
+        self.stop_axis = stop_axis
+
+    def forward(self, x):
+        return api.flatten(x, self.start_axis, self.stop_axis)
+
+
+class Identity(Layer):
+    def forward(self, x):
+        return x
+
+
+class Upsample(Layer):
+    def __init__(self, size=None, scale_factor=None, mode="nearest", align_corners=False, data_format="NCHW"):
+        super().__init__()
+        self.size, self.scale_factor, self.mode = size, scale_factor, mode
+        self.align_corners, self.data_format = align_corners, data_format
+
+    def forward(self, x):
+        return F.interpolate(x, self.size, self.scale_factor, self.mode, self.align_corners, self.data_format)
+
+
+class Pad2D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0, data_format="NCHW"):
+        super().__init__()
+        self.padding, self.mode, self.value, self.data_format = padding, mode, value, data_format
+
+    def forward(self, x):
+        return F.pad(x, self.padding, self.mode, self.value, self.data_format)
+
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor, data_format="NCHW"):
+        super().__init__()
+        self.upscale_factor = upscale_factor
+
+    def forward(self, x):
+        return F.pixel_shuffle(x, self.upscale_factor)
+
+
+# --- conv ------------------------------------------------------------------
+class _ConvNd(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride, padding, dilation,
+                 groups, weight_attr, bias_attr, data_format, ndim):
+        super().__init__()
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        ks = kernel_size if isinstance(kernel_size, (list, tuple)) else (kernel_size,) * ndim
+        self._kernel_size = tuple(ks)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        self._data_format = data_format
+        fan_in = in_channels // groups
+        for k in self._kernel_size:
+            fan_in *= k
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, *self._kernel_size], attr=weight_attr,
+            default_initializer=I.KaimingUniform(fan_in=fan_in) if weight_attr is None else None,
+        )
+        if bias_attr is False:
+            self.bias = None
+        else:
+            bound = 1.0 / (fan_in ** 0.5)
+            self.bias = self.create_parameter(
+                [out_channels], attr=None if bias_attr in (None, True) else bias_attr,
+                is_bias=True, default_initializer=I.Uniform(-bound, bound),
+            )
+
+
+class Conv2D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NCHW"):
+        super().__init__(in_channels, out_channels, kernel_size, stride, padding,
+                         dilation, groups, weight_attr, bias_attr, data_format, 2)
+
+    def forward(self, x):
+        return F.conv2d(x, self.weight, self.bias, self._stride, self._padding,
+                        self._dilation, self._groups, self._data_format)
+
+    def extra_repr(self):
+        return (f"{self.in_channels}, {self.out_channels}, kernel_size={self._kernel_size}, "
+                f"stride={self._stride}, padding={self._padding}")
+
+
+class Conv1D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NCL"):
+        super().__init__(in_channels, out_channels, kernel_size, stride, padding,
+                         dilation, groups, weight_attr, bias_attr, data_format, 1)
+
+    def forward(self, x):
+        return F.conv1d(x, self.weight, self.bias, self._stride, self._padding,
+                        self._dilation, self._groups)
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, weight_attr=None,
+                 bias_attr=None, data_format="NCHW"):
+        super().__init__()
+        ks = kernel_size if isinstance(kernel_size, (list, tuple)) else (kernel_size,) * 2
+        self._attrs = (stride, padding, output_padding, dilation, groups, data_format)
+        self.weight = self.create_parameter(
+            [in_channels, out_channels // groups, *ks], attr=weight_attr,
+        )
+        self.bias = None if bias_attr is False else self.create_parameter([out_channels], is_bias=True)
+
+    def forward(self, x):
+        s, p, op, d, g, df = self._attrs
+        return F.conv2d_transpose(x, self.weight, self.bias, s, p, op, d, g, df)
+
+
+# --- pooling ---------------------------------------------------------------
+class MaxPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 return_mask=False, data_format="NCHW"):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, ceil_mode, data_format)
+
+    def forward(self, x):
+        k, s, p, c, df = self.args
+        return F.max_pool2d(x, k, s, p, c, df)
+
+
+class AvgPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, data_format="NCHW"):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, ceil_mode, exclusive, data_format)
+
+    def forward(self, x):
+        k, s, p, c, e, df = self.args
+        return F.avg_pool2d(x, k, s, p, c, e, df)
+
+
+class AdaptiveAvgPool2D(Layer):
+    def __init__(self, output_size, data_format="NCHW"):
+        super().__init__()
+        self.output_size = output_size
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.adaptive_avg_pool2d(x, self.output_size, self.data_format)
+
+
+class AdaptiveMaxPool2D(Layer):
+    def __init__(self, output_size, data_format="NCHW"):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_max_pool2d(x, self.output_size)
+
+
+# --- activations as layers --------------------------------------------------
+def _act_layer(name, fn_name=None):
+    fn = getattr(F, fn_name or name.lower())
+
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            self._args = args
+            self._kwargs = kwargs
+
+        def forward(self, x):
+            return fn(x, *self._args, **self._kwargs)
+
+    _Act.__name__ = name
+    return _Act
+
+
+ReLU = _act_layer("ReLU", "relu")
+ReLU6 = _act_layer("ReLU6", "relu6")
+GELU = _act_layer("GELU", "gelu")
+Sigmoid = _act_layer("Sigmoid", "sigmoid")
+LogSigmoid = _act_layer("LogSigmoid", "log_sigmoid")
+Silu = _act_layer("Silu", "silu")
+Swish = _act_layer("Swish", "swish")
+Mish = _act_layer("Mish", "mish")
+LeakyReLU = _act_layer("LeakyReLU", "leaky_relu")
+ELU = _act_layer("ELU", "elu")
+SELU = _act_layer("SELU", "selu")
+CELU = _act_layer("CELU", "celu")
+Softplus = _act_layer("Softplus", "softplus")
+Softshrink = _act_layer("Softshrink", "softshrink")
+Hardshrink = _act_layer("Hardshrink", "hardshrink")
+Hardtanh = _act_layer("Hardtanh", "hardtanh")
+Hardsigmoid = _act_layer("Hardsigmoid", "hardsigmoid")
+Hardswish = _act_layer("Hardswish", "hardswish")
+Tanhshrink = _act_layer("Tanhshrink", "tanhshrink")
+ThresholdedReLU = _act_layer("ThresholdedReLU", "thresholded_relu")
+Softmax = _act_layer("Softmax", "softmax")
+LogSoftmax = _act_layer("LogSoftmax", "log_softmax")
+Maxout = _act_layer("Maxout", "maxout")
+GLU = _act_layer("GLU", "glu")
+
+
+class Tanh(Layer):
+    def forward(self, x):
+        return api.tanh(x)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None, data_format="NCHW"):
+        super().__init__()
+        self.weight = self.create_parameter([num_parameters], default_initializer=I.Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight)

@@ -1,0 +1,166 @@
+"""Normalization layers (reference: python/paddle/nn/layer/norm.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.autograd import no_grad
+from ..ops import api
+from . import functional as F
+from . import initializer as I
+from .layer import Layer
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._normalized_shape = list(normalized_shape)
+        self._epsilon = epsilon
+        if weight_attr is False:
+            self.weight = None
+        else:
+            self.weight = self.create_parameter(self._normalized_shape, attr=None if weight_attr in (None, True) else weight_attr, default_initializer=I.Constant(1.0))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(self._normalized_shape, attr=None if bias_attr in (None, True) else bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.layer_norm(x, self._normalized_shape, self.weight, self.bias, self._epsilon)
+
+    def extra_repr(self):
+        return f"normalized_shape={self._normalized_shape}, epsilon={self._epsilon}"
+
+
+class RMSNorm(Layer):
+    """Reference: python/paddle/incubate/nn/functional/rms_norm.py as a layer."""
+
+    def __init__(self, hidden_size, epsilon=1e-6, weight_attr=None, name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        self.weight = self.create_parameter([hidden_size], default_initializer=I.Constant(1.0))
+
+    def forward(self, x):
+        return F.rms_norm(x, self.weight, self._epsilon)
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", use_global_stats=None, name=None):
+        super().__init__()
+        self._num_features = num_features
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self._use_global_stats = use_global_stats
+        if weight_attr is False:
+            self.weight = None
+        else:
+            self.weight = self.create_parameter([num_features], default_initializer=I.Constant(1.0))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter([num_features], is_bias=True)
+        self.register_buffer("_mean", api.zeros([num_features], "float32"))
+        self.register_buffer("_variance", api.ones([num_features], "float32"))
+
+    def forward(self, x):
+        training = self.training and not (self._use_global_stats is True)
+        y, new_mean, new_var = api.batch_norm(
+            x, self._mean, self._variance, self.weight, self.bias,
+            training=training, momentum=self._momentum, epsilon=self._epsilon,
+            data_format=self._data_format,
+        )
+        if training:
+            with no_grad():
+                self._mean._value = new_mean._value if hasattr(new_mean, "_value") else new_mean
+                self._variance._value = new_var._value if hasattr(new_var, "_value") else new_var
+        return y
+
+    def extra_repr(self):
+        return f"num_features={self._num_features}, momentum={self._momentum}, epsilon={self._epsilon}"
+
+
+class BatchNorm1D(_BatchNormBase):
+    pass
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    pass
+
+
+BatchNorm = _BatchNormBase
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Cross-replica BN. Under jit+mesh the batch axis is sharded and XLA's
+    batch-norm statistics become per-shard; a psum over the 'data' axis is
+    inserted by the collective layer when inside shard_map. Eager single-chip:
+    identical to BatchNorm."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        # recursively swap _BatchNormBase instances
+        for name, sub in list(layer._sub_layers.items()):
+            if isinstance(sub, _BatchNormBase) and not isinstance(sub, SyncBatchNorm):
+                new = SyncBatchNorm(sub._num_features, sub._momentum, sub._epsilon,
+                                    data_format=sub._data_format)
+                new.weight = sub.weight
+                new.bias = sub.bias
+                new._mean = sub._mean
+                new._variance = sub._variance
+                layer._sub_layers[name] = new
+            else:
+                cls.convert_sync_batchnorm(sub)
+        return layer
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self.weight = None if weight_attr is False else self.create_parameter([num_channels], default_initializer=I.Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter([num_channels], is_bias=True)
+
+    def forward(self, x):
+        return F.group_norm(x, self._num_groups, self.weight, self.bias, self._epsilon, self._data_format)
+
+
+class InstanceNorm2D(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        self.weight = None if weight_attr is False else self.create_parameter([num_features], default_initializer=I.Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter([num_features], is_bias=True)
+
+    def forward(self, x):
+        return F.instance_norm(x, self.weight, self.bias, self._epsilon)
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+        super().__init__()
+        self.size, self.alpha, self.beta, self.k = size, alpha, beta, k
+
+    def forward(self, x):
+        div = api.square(x)
+        half = self.size // 2
+        import jax.numpy as jnp
+
+        val = div._value if hasattr(div, "_value") else div
+        pads = [(0, 0), (half, self.size - 1 - half), (0, 0), (0, 0)]
+        padded = jnp.pad(val, pads)
+        window = jnp.stack([padded[:, i : i + val.shape[1]] for i in range(self.size)]).sum(0)
+        from ..core.tensor import Tensor
+
+        denom = Tensor((self.k + self.alpha * window) ** self.beta)
+        return x / denom

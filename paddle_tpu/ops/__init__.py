@@ -1,0 +1,38 @@
+"""Op layer: YAML-declared registry + eager dispatcher + generated API.
+
+`paddle_tpu.ops.api` is the `paddle._C_ops` analog: one callable per op
+declared in ops.yaml, dispatching through registry.dispatch (AMP cast ->
+kernel -> GradNode recording).
+"""
+from __future__ import annotations
+
+import importlib
+import os
+
+import yaml
+
+from . import registry
+from .registry import api, get_op, all_ops, register_op, OpDef  # noqa: F401
+
+_LOADED = False
+
+
+def _load_yaml_ops():
+    global _LOADED
+    if _LOADED:
+        return
+    path = os.path.join(os.path.dirname(__file__), "ops.yaml")
+    with open(path) as f:
+        manifest = yaml.safe_load(f)
+    for module_name, spec in manifest["modules"].items():
+        mod = importlib.import_module(f".kernels.{module_name}", __package__)
+        white = set(spec.get("amp_white", ()))
+        black = set(spec.get("amp_black", ()))
+        for op_name in spec["ops"]:
+            fn = getattr(mod, op_name)
+            amp = "white" if op_name in white else ("black" if op_name in black else None)
+            registry.register_op(op_name, fn, amp=amp)
+    _LOADED = True
+
+
+_load_yaml_ops()

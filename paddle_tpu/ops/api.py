@@ -1,0 +1,5 @@
+"""Generated op API (the paddle._C_ops analog).
+
+This module's attributes are populated by registry.register_op as ops.yaml is
+loaded — one dispatching callable per declared op.
+"""

@@ -1,0 +1,82 @@
+"""Tensor creation kernels (reference: phi full/empty/arange/eye/... kernels)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.dtype import convert_dtype, get_default_dtype
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        return default
+    return convert_dtype(dtype)
+
+
+def zeros(shape, dtype=None):
+    return jnp.zeros(tuple(shape), _dt(dtype, get_default_dtype()))
+
+
+def ones(shape, dtype=None):
+    return jnp.ones(tuple(shape), _dt(dtype, get_default_dtype()))
+
+
+def full(shape, fill_value, dtype=None):
+    return jnp.full(tuple(shape), fill_value, _dt(dtype, get_default_dtype()))
+
+
+def empty(shape, dtype=None):
+    return jnp.zeros(tuple(shape), _dt(dtype, get_default_dtype()))
+
+
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=_dt(dtype))
+
+
+def ones_like(x, dtype=None):
+    return jnp.ones_like(x, dtype=_dt(dtype))
+
+
+def full_like(x, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value, dtype=_dt(dtype))
+
+
+def empty_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=_dt(dtype))
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    return jnp.arange(start, end, step, dtype=_dt(dtype))
+
+
+def linspace(start, stop, num, dtype=None):
+    return jnp.linspace(start, stop, int(num), dtype=_dt(dtype, get_default_dtype()))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None):
+    return jnp.logspace(start, stop, int(num), base=base, dtype=_dt(dtype, get_default_dtype()))
+
+
+def eye(num_rows, num_columns=None, dtype=None):
+    return jnp.eye(int(num_rows), None if num_columns is None else int(num_columns), dtype=_dt(dtype, get_default_dtype()))
+
+
+def meshgrid(*xs, indexing="ij"):
+    return tuple(jnp.meshgrid(*xs, indexing=indexing))
+
+
+def tril_indices(row, col, offset=0):
+    r, c = jnp.tril_indices(row, k=offset, m=col)
+    return jnp.stack([r, c]).astype(jnp.int64)
+
+
+def triu_indices(row, col, offset=0):
+    r, c = jnp.triu_indices(row, k=offset, m=col)
+    return jnp.stack([r, c]).astype(jnp.int64)
+
+
+def complex(real, imag):
+    import jax.lax as lax
+
+    return lax.complex(real, imag)

@@ -1,0 +1,361 @@
+"""Shape/layout manipulation kernels.
+
+Reference: paddle/phi/kernels/*/{reshape,transpose,concat,split,gather,...}
+(declared in paddle/phi/api/yaml/ops.yaml). All are XLA metadata/gather ops —
+free or cheap on TPU when fused.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dtype import convert_dtype
+
+
+def reshape(x, shape):
+    return jnp.reshape(x, tuple(int(s) for s in shape))
+
+
+def transpose(x, perm):
+    return jnp.transpose(x, tuple(int(p) for p in perm))
+
+
+def t(x):
+    if x.ndim <= 1:
+        return x
+    return jnp.swapaxes(x, -1, -2)
+
+
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+def swapaxes(x, axis1, axis2):
+    return jnp.swapaxes(x, axis1, axis2)
+
+
+def concat(xs, axis=0):
+    return jnp.concatenate(list(xs), axis=int(axis))
+
+
+def stack(xs, axis=0):
+    return jnp.stack(list(xs), axis=int(axis))
+
+
+def split(x, num_or_sections, axis=0):
+    axis = int(axis)
+    if isinstance(num_or_sections, int):
+        return tuple(jnp.split(x, num_or_sections, axis=axis))
+    # sections list; -1 means "rest" (paddle semantics)
+    sections = list(num_or_sections)
+    total = x.shape[axis]
+    if -1 in sections:
+        known = sum(s for s in sections if s != -1)
+        sections[sections.index(-1)] = total - known
+    offsets = []
+    acc = 0
+    for s in sections[:-1]:
+        acc += s
+        offsets.append(acc)
+    return tuple(jnp.split(x, offsets, axis=axis))
+
+
+def chunk(x, chunks, axis=0):
+    return tuple(jnp.array_split(x, chunks, axis=int(axis)))
+
+
+def unbind(x, axis=0):
+    axis = int(axis)
+    return tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(x, x.shape[axis], axis=axis))
+
+
+def squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, (list, tuple)):
+        axes = tuple(a for a in axis if x.shape[a] == 1)
+        return jnp.squeeze(x, axis=axes) if axes else x
+    return jnp.squeeze(x, axis=axis) if x.shape[axis] == 1 else x
+
+
+def unsqueeze(x, axis):
+    if isinstance(axis, (list, tuple)):
+        for a in sorted(axis):
+            x = jnp.expand_dims(x, a)
+        return x
+    return jnp.expand_dims(x, int(axis))
+
+
+def flatten(x, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    if nd == 0:
+        return jnp.reshape(x, (1,))
+    start = start_axis % nd
+    stop = stop_axis % nd
+    new_shape = x.shape[:start] + (-1,) + x.shape[stop + 1 :]
+    return jnp.reshape(x, new_shape)
+
+
+def tile(x, repeat_times):
+    return jnp.tile(x, tuple(int(r) for r in repeat_times))
+
+
+def expand(x, shape):
+    shape = list(shape)
+    # paddle: -1 keeps the original dim (only legal for existing trailing dims)
+    ndiff = len(shape) - x.ndim
+    for i, s in enumerate(shape):
+        if s == -1:
+            if i < ndiff:
+                raise ValueError(
+                    f"expand: -1 at new leading dim {i} is invalid "
+                    f"(input ndim {x.ndim}, target {shape})"
+                )
+            shape[i] = x.shape[i - ndiff]
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+def cast(x, dtype):
+    return x.astype(convert_dtype(dtype))
+
+
+def gather(x, index, axis=0):
+    index = index.reshape(-1) if index.ndim > 1 else index
+    return jnp.take(x, index, axis=int(axis))
+
+
+def gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+def scatter(x, index, updates, overwrite=True):
+    index = index.reshape(-1) if index.ndim > 1 else index
+    if overwrite:
+        return x.at[index].set(updates)
+    # paddle overwrite=False: zero the rows then accumulate
+    zeroed = x.at[index].set(jnp.zeros_like(updates))
+    return zeroed.at[index].add(updates)
+
+
+def scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+def index_select(x, index, axis=0):
+    return jnp.take(x, index, axis=int(axis))
+
+
+def index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+def take_along_axis(x, indices, axis):
+    return jnp.take_along_axis(x, indices, axis=int(axis))
+
+
+def put_along_axis(x, indices, values, axis, reduce="assign"):
+    axis = int(axis)
+    if reduce == "assign":
+        return jnp.put_along_axis(x, indices, values, axis=axis, inplace=False)
+    dims = list(range(x.ndim))
+    if reduce == "add":
+        # build scatter via .at
+        idx = [jnp.arange(s).reshape([-1 if i == d else 1 for i in dims]) for d, s in enumerate(indices.shape)]
+        idx[axis] = indices
+        return x.at[tuple(jnp.broadcast_arrays(*idx))].add(values)
+    raise ValueError(f"unsupported reduce {reduce}")
+
+
+def flip(x, axis):
+    if isinstance(axis, int):
+        axis = [axis]
+    return jnp.flip(x, axis=tuple(axis))
+
+
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    """paddle.nn.functional.pad: `pad` is per-axis [before,after] pairs, or the
+    2*(ndim-2) trailing-spatial form when len(pad) < 2*ndim."""
+    pad = list(int(p) for p in pad)
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # trailing spatial dims, torch/paddle style: last dim first
+        n_spatial = len(pad) // 2
+        width = [(0, 0)] * nd
+        if data_format.endswith("C") and nd >= 3:  # NHWC-style: spatial before channel
+            spatial_axes = list(range(1, 1 + n_spatial))
+        else:
+            spatial_axes = list(range(nd - n_spatial, nd))
+        for i, ax in enumerate(reversed(spatial_axes)):
+            width[ax] = (pad[2 * i], pad[2 * i + 1])
+    mode_map = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}
+    if mode == "constant":
+        return jnp.pad(x, width, mode="constant", constant_values=value)
+    return jnp.pad(x, width, mode=mode_map[mode])
+
+
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return jnp.where(condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    res = jnp.nonzero(x)
+    if as_tuple:
+        return tuple(r.astype(jnp.int64) for r in res)
+    return jnp.stack(res, axis=1).astype(jnp.int64)
+
+
+def masked_select(x, mask):
+    return x[mask]
+
+
+def masked_fill(x, mask, value):
+    return jnp.where(mask, value, x)
+
+
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=int(diagonal))
+
+
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=int(diagonal))
+
+
+def diag(x, offset=0, padding_value=0.0):
+    if x.ndim == 1:
+        out = jnp.diag(x, k=int(offset))
+        if padding_value != 0:
+            mask = jnp.diag(jnp.ones_like(x, dtype=bool), k=int(offset))
+            out = jnp.where(mask, out, padding_value)
+        return out
+    return jnp.diagonal(x, offset=int(offset))
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    offset = int(offset)
+    n = x.shape[-1]
+    m = n + abs(offset)
+    rows = jnp.arange(n) + max(-offset, 0)
+    cols = jnp.arange(n) + max(offset, 0)
+    out = jnp.zeros(x.shape[:-1] + (m, m), x.dtype)
+    out = out.at[..., rows, cols].set(x)
+    nd = out.ndim
+    d1, d2 = dim1 % nd, dim2 % nd
+    if (d1, d2) != (nd - 2, nd - 1):
+        out = jnp.moveaxis(out, (nd - 2, nd - 1), (d1, d2))
+    return out
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None):
+    res = jnp.unique(
+        x, return_index=return_index, return_inverse=return_inverse,
+        return_counts=return_counts, axis=axis,
+    )
+    return res
+
+
+def sort(x, axis=-1, descending=False, stable=True):
+    out = jnp.sort(x, axis=axis, stable=stable)
+    if descending:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+def argsort(x, axis=-1, descending=False, stable=True):
+    idx = jnp.argsort(x, axis=axis, stable=stable)
+    if descending:
+        idx = jnp.flip(idx, axis=axis)
+    return idx.astype(jnp.int64)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence, values, side=side)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def getitem(x, idx):
+    return x[idx]
+
+
+def setitem(x, idx, value):
+    return x.at[idx].set(value)
+
+
+def slice(x, axes, starts, ends):
+    slices = [jnp.s_[:]] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        slices[ax] = jnp.s_[st:en]
+    return x[tuple(slices)]
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    slices = [jnp.s_[:]] * x.ndim
+    for ax, st, en, sr in zip(axes, starts, ends, strides):
+        slices[ax] = jnp.s_[st:en:sr]
+    return x[tuple(slices)]
+
+
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+def as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+def atleast_1d(x):
+    return jnp.atleast_1d(x)
+
+
+def atleast_2d(x):
+    return jnp.atleast_2d(x)
+
+
+def atleast_3d(x):
+    return jnp.atleast_3d(x)
+
+
+def assign(x):
+    return jnp.asarray(x)
+
+
+def numel(x):
+    return jnp.asarray(x.size, dtype=jnp.int64)
+
+
+def shard_index(x, index_num, nshards, shard_id, ignore_value=-1):
+    shard_size = (index_num + nshards - 1) // nshards
+    lo = shard_id * shard_size
+    hi = lo + shard_size
+    in_range = (x >= lo) & (x < hi)
+    return jnp.where(in_range, x - lo, ignore_value)

@@ -1,0 +1,333 @@
+"""Elementwise & scalar math kernels.
+
+Reference surface: paddle/phi/kernels/cpu|gpu/{elementwise_*,activation_*,...}
+declared in paddle/phi/api/yaml/ops.yaml. Here each op is ONE pure jax function
+(XLA emits the fused HLO); backward comes from jax.vjp at dispatch time, so the
+reference's ~2x backward-kernel corpus is not needed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.dtype import convert_dtype
+
+
+def _promote_scalar(x, y):
+    # paddle allows python scalars on either side
+    return x, y
+
+
+def add(x, y):
+    return jnp.add(x, y)
+
+
+def subtract(x, y):
+    return jnp.subtract(x, y)
+
+
+def multiply(x, y):
+    return jnp.multiply(x, y)
+
+
+def divide(x, y):
+    return jnp.divide(x, y)
+
+
+def floor_divide(x, y):
+    return jnp.floor_divide(x, y)
+
+
+def remainder(x, y):
+    return jnp.remainder(x, y)
+
+
+mod = remainder
+
+
+def pow(x, y):
+    return jnp.power(x, y)
+
+
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+def minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+def fmax(x, y):
+    return jnp.fmax(x, y)
+
+
+def fmin(x, y):
+    return jnp.fmin(x, y)
+
+
+def atan2(x, y):
+    return jnp.arctan2(x, y)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True):
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+def exp(x):
+    return jnp.exp(x)
+
+
+def expm1(x):
+    return jnp.expm1(x)
+
+
+def log(x):
+    return jnp.log(x)
+
+
+def log2(x):
+    return jnp.log2(x)
+
+
+def log10(x):
+    return jnp.log10(x)
+
+
+def log1p(x):
+    return jnp.log1p(x)
+
+
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+def rsqrt(x):
+    return lax.rsqrt(x)
+
+
+def square(x):
+    return jnp.square(x)
+
+
+def abs(x):
+    return jnp.abs(x)
+
+
+def sign(x):
+    return jnp.sign(x)
+
+
+def neg(x):
+    return jnp.negative(x)
+
+
+def reciprocal(x):
+    return jnp.reciprocal(x)
+
+
+def floor(x):
+    return jnp.floor(x)
+
+
+def ceil(x):
+    return jnp.ceil(x)
+
+
+def round(x):
+    return jnp.round(x)
+
+
+def trunc(x):
+    return jnp.trunc(x)
+
+
+def frac(x):
+    return x - jnp.trunc(x)
+
+
+def sin(x):
+    return jnp.sin(x)
+
+
+def cos(x):
+    return jnp.cos(x)
+
+
+def tan(x):
+    return jnp.tan(x)
+
+
+def asin(x):
+    return jnp.arcsin(x)
+
+
+def acos(x):
+    return jnp.arccos(x)
+
+
+def atan(x):
+    return jnp.arctan(x)
+
+
+def sinh(x):
+    return jnp.sinh(x)
+
+
+def cosh(x):
+    return jnp.cosh(x)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def asinh(x):
+    return jnp.arcsinh(x)
+
+
+def acosh(x):
+    return jnp.arccosh(x)
+
+
+def atanh(x):
+    return jnp.arctanh(x)
+
+
+def erf(x):
+    return jax.scipy.special.erf(x)
+
+
+def erfinv(x):
+    return jax.scipy.special.erfinv(x)
+
+
+def digamma(x):
+    return jax.scipy.special.digamma(x)
+
+
+def lgamma(x):
+    return jax.scipy.special.gammaln(x)
+
+
+def clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+def logit(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+def isnan(x):
+    return jnp.isnan(x)
+
+
+def isinf(x):
+    return jnp.isinf(x)
+
+
+def isfinite(x):
+    return jnp.isfinite(x)
+
+
+def cumsum(x, axis=None, dtype=None):
+    if dtype is not None:
+        dtype = convert_dtype(dtype)
+    if axis is None:
+        return jnp.cumsum(x.reshape(-1), dtype=dtype)
+    return jnp.cumsum(x, axis=axis, dtype=dtype)
+
+
+def cumprod(x, dim=None, dtype=None):
+    if dtype is not None:
+        dtype = convert_dtype(dtype)
+    if dim is None:
+        return jnp.cumprod(x.reshape(-1), dtype=dtype)
+    return jnp.cumprod(x, axis=dim, dtype=dtype)
+
+
+def cummax(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    vals = lax.associative_scan(jnp.maximum, x, axis=axis)
+    return vals
+
+
+def cummin(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return lax.associative_scan(jnp.minimum, x, axis=axis)
+
+
+def logaddexp(x, y):
+    return jnp.logaddexp(x, y)
+
+
+def logcumsumexp(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return lax.cumlogsumexp(x, axis=axis)
+
+
+def heaviside(x, y):
+    return jnp.heaviside(x, y)
+
+
+def gcd(x, y):
+    return jnp.gcd(x, y)
+
+
+def lcm(x, y):
+    return jnp.lcm(x, y)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+def angle(x):
+    return jnp.angle(x)
+
+
+def conj(x):
+    return jnp.conj(x)
+
+
+def real(x):
+    return jnp.real(x)
+
+
+def imag(x):
+    return jnp.imag(x)
+
+
+def rad2deg(x):
+    return jnp.rad2deg(x)
+
+
+def deg2rad(x):
+    return jnp.deg2rad(x)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None):
+    return jnp.diff(x, n=n, axis=axis, prepend=prepend, append=append)
+
+
+def multiply_add(x, y, z):
+    """fma: x * y + z (reference: fused elementwise)."""
+    return x * y + z

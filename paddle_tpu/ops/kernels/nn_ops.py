@@ -1,0 +1,672 @@
+"""NN kernels: activations, norms, conv/pool, embedding, losses, attention.
+
+Reference surface: paddle/phi/kernels/*/{activation,softmax,conv,pool,
+batch_norm,layer_norm,embedding,cross_entropy,...}_kernel plus the fused ops in
+paddle/fluid/operators/fused/. On TPU each is a handful of jnp/lax ops that XLA
+fuses; attention additionally has a Pallas fast path (ops/pallas/flash_attention).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core import random as _random
+from ...core.dtype import convert_dtype
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v), int(v))
+
+
+# ---------------------------------------------------------------- activations
+def relu(x):
+    return jnp.maximum(x, 0)
+
+
+def relu6(x):
+    return jnp.clip(x, 0, 6)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+def gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def silu(x):
+    return jax.nn.silu(x)
+
+
+def swish(x):
+    return jax.nn.silu(x)
+
+
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+def elu(x, alpha=1.0):
+    return jax.nn.elu(x, alpha)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+def celu(x, alpha=1.0):
+    return jax.nn.celu(x, alpha)
+
+
+def softplus(x, beta=1.0, threshold=20.0):
+    return jnp.where(x * beta > threshold, x, (1.0 / beta) * jax.nn.softplus(beta * x))
+
+
+def softshrink(x, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold, jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+def hardshrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+def hardtanh(x, min=-1.0, max=1.0):
+    return jnp.clip(x, min, max)
+
+
+def hardsigmoid(x, slope=1.0 / 6, offset=0.5):
+    return jnp.clip(x * slope + offset, 0.0, 1.0)
+
+
+def hardswish(x):
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+def tanhshrink(x):
+    return x - jnp.tanh(x)
+
+
+def thresholded_relu(x, threshold=1.0):
+    return jnp.where(x > threshold, x, 0.0)
+
+
+def prelu(x, weight):
+    w = weight
+    if w.ndim == 1 and x.ndim > 1 and w.shape[0] > 1:
+        w = w.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return jnp.where(x >= 0, x, w * x)
+
+
+def rrelu(x, lower=1.0 / 8, upper=1.0 / 3, training=True):
+    if training:
+        key = _random.next_key()
+        a = jax.random.uniform(key, x.shape, x.dtype, lower, upper)
+    else:
+        a = (lower + upper) / 2.0
+    return jnp.where(x >= 0, x, a * x)
+
+
+def glu(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+def maxout(x, groups, axis=1):
+    shape = list(x.shape)
+    c = shape[axis]
+    shape[axis : axis + 1] = [c // groups, groups]
+    return jnp.max(jnp.reshape(x, shape), axis=axis + 1)
+
+
+# ----------------------------------------------------------------- softmaxes
+def softmax(x, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1):
+    key = _random.next_key()
+    g = -jnp.log(-jnp.log(jax.random.uniform(key, x.shape, x.dtype, 1e-20, 1.0)))
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        y_hard = jnp.put_along_axis(jnp.zeros_like(y), idx, 1.0, axis=axis, inplace=False)
+        y = lax.stop_gradient(y_hard - y) + y  # straight-through estimator
+    return y
+
+
+# ------------------------------------------------------------------- linear
+def linear(x, weight, bias=None):
+    """paddle: weight is [in, out] (not transposed)."""
+    out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def embedding(x, weight, padding_idx=None, sparse=False):
+    out = jnp.take(weight, x, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (x == padding_idx)[..., None]
+        out = jnp.where(mask, jnp.zeros((), out.dtype), out)
+    return out
+
+
+def one_hot(x, num_classes):
+    return jax.nn.one_hot(x, num_classes, dtype=jnp.float32)
+
+
+# ------------------------------------------------------------------- dropout
+def dropout(x, p=0.5, training=True, mode="upscale_in_train", axis=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return x * (1.0 - p)
+        return x
+    key = _random.next_key()
+    shape = x.shape
+    if axis is not None:
+        axes = (axis,) if isinstance(axis, int) else tuple(axis)
+        shape = tuple(s if i in axes else 1 for i, s in enumerate(x.shape))
+    keep = jax.random.bernoulli(key, 1.0 - p, shape)
+    if mode == "upscale_in_train":
+        return jnp.where(keep, x / (1.0 - p), jnp.zeros((), x.dtype))
+    return jnp.where(keep, x, jnp.zeros((), x.dtype))
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW"):
+    axis = (0, 1) if data_format == "NCHW" else (0, 3)
+    return dropout(x, p, training, axis=axis)
+
+
+# ---------------------------------------------------------------------- norm
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    axes = tuple(range(x.ndim - len(normalized_shape), x.ndim))
+    # TPU numerics: accumulate statistics in fp32 regardless of input dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + epsilon)
+    y = y.astype(x.dtype)
+    if weight is not None:
+        y = y * weight
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def rms_norm(x, weight=None, epsilon=1e-6):
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    y = (xf * lax.rsqrt(ms + epsilon)).astype(x.dtype)
+    if weight is not None:
+        y = y * weight
+    return y
+
+
+def batch_norm(
+    x, running_mean, running_var, weight=None, bias=None,
+    training=False, momentum=0.9, epsilon=1e-5, data_format="NCHW",
+):
+    """Returns (y, new_running_mean, new_running_var)."""
+    c_axis = 1 if data_format == "NCHW" else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    bshape = tuple(x.shape[c_axis] if i == c_axis else 1 for i in range(x.ndim))
+    xf = x.astype(jnp.float32)
+    if training:
+        mean = jnp.mean(xf, axis=axes)
+        var = jnp.var(xf, axis=axes)
+        new_mean = momentum * running_mean + (1.0 - momentum) * mean
+        new_var = momentum * running_var + (1.0 - momentum) * var
+    else:
+        mean, var = running_mean, running_var
+        new_mean, new_var = running_mean, running_var
+    y = (xf - mean.reshape(bshape)) * lax.rsqrt(var.reshape(bshape) + epsilon)
+    y = y.astype(x.dtype)
+    if weight is not None:
+        y = y * weight.reshape(bshape)
+    if bias is not None:
+        y = y + bias.reshape(bshape)
+    return y, new_mean, new_var
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5, data_format="NCHW"):
+    if data_format != "NCHW":
+        x = jnp.moveaxis(x, -1, 1)
+    n, c = x.shape[0], x.shape[1]
+    g = num_groups
+    xr = x.reshape((n, g, c // g) + x.shape[2:])
+    axes = tuple(range(2, xr.ndim))
+    mean = jnp.mean(xr, axis=axes, keepdims=True)
+    var = jnp.var(xr, axis=axes, keepdims=True)
+    y = ((xr - mean) * lax.rsqrt(var + epsilon)).reshape(x.shape)
+    bshape = (1, c) + (1,) * (x.ndim - 2)
+    if weight is not None:
+        y = y * weight.reshape(bshape)
+    if bias is not None:
+        y = y + bias.reshape(bshape)
+    if data_format != "NCHW":
+        y = jnp.moveaxis(y, 1, -1)
+    return y
+
+
+def instance_norm(x, weight=None, bias=None, epsilon=1e-5, data_format="NCHW"):
+    axes = tuple(range(2, x.ndim)) if data_format == "NCHW" else tuple(range(1, x.ndim - 1))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + epsilon)
+    c = x.shape[1] if data_format == "NCHW" else x.shape[-1]
+    bshape = (1, c) + (1,) * (x.ndim - 2) if data_format == "NCHW" else (1,) * (x.ndim - 1) + (c,)
+    if weight is not None:
+        y = y * weight.reshape(bshape)
+    if bias is not None:
+        y = y + bias.reshape(bshape)
+    return y
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12):
+    norm = jnp.linalg.norm(x, ord=p, axis=axis, keepdims=True)
+    return x / jnp.maximum(norm, epsilon)
+
+
+# ---------------------------------------------------------------- conv/pool
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCHW"):
+    stride, dilation = _pair(stride), _pair(dilation)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        p = _pair(padding) if not (isinstance(padding, (list, tuple)) and len(padding) == 4) else padding
+        pad = [(p[0], p[0]), (p[1], p[1])] if len(p) == 2 else [(p[0], p[1]), (p[2], p[3])]
+    dn = lax.conv_dimension_numbers(
+        x.shape, weight.shape,
+        ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else ("NHWC", "HWIO", "NHWC"),
+    )
+    out = lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn, feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.float32 else None,
+    )
+    if bias is not None:
+        bshape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
+        out = out + bias.reshape(bshape)
+    return out
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCL"):
+    x4 = x[..., None]  # NCL -> NCL1
+    w4 = weight[..., None]
+    s = stride if isinstance(stride, int) else stride[0]
+    p = padding if isinstance(padding, (int, str)) else padding[0]
+    d = dilation if isinstance(dilation, int) else dilation[0]
+    pad = p if isinstance(p, str) else (p, 0)
+    out = conv2d(x4, w4, bias, stride=(s, 1), padding=pad if isinstance(pad, str) else [pad[0], 0], dilation=(d, 1), groups=groups)
+    return out[..., 0]
+
+
+def conv2d_transpose(
+    x, weight, bias=None, stride=1, padding=0, output_padding=0, dilation=1, groups=1, data_format="NCHW",
+):
+    stride, dilation = _pair(stride), _pair(dilation)
+    p = _pair(padding)
+    op = _pair(output_padding)
+    # weight layout paddle: [in, out//groups, kh, kw]
+    kh, kw = weight.shape[2], weight.shape[3]
+    pad = [
+        (dilation[0] * (kh - 1) - p[0], dilation[0] * (kh - 1) - p[0] + op[0]),
+        (dilation[1] * (kw - 1) - p[1], dilation[1] * (kw - 1) - p[1] + op[1]),
+    ]
+    w = jnp.flip(weight, axis=(2, 3))
+    w = jnp.swapaxes(w, 0, 1)  # -> [out//groups, in, kh, kw]
+    if groups > 1:
+        w = jnp.concatenate(jnp.split(w, groups, axis=1), axis=0)
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding=pad, lhs_dilation=stride,
+        rhs_dilation=dilation, dimension_numbers=dn, feature_group_count=groups,
+    )
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, data_format="NCHW"):
+    k = _pair(kernel_size)
+    s = _pair(stride) if stride is not None else k
+    p = _pair(padding)
+    if data_format == "NCHW":
+        window = (1, 1, k[0], k[1])
+        strides = (1, 1, s[0], s[1])
+        pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    else:
+        window = (1, k[0], k[1], 1)
+        strides = (1, s[0], s[1], 1)
+        pads = ((0, 0), (p[0], p[0]), (p[1], p[1]), (0, 0))
+    # -inf init keeps this on the reduce_window_max primitive (differentiable)
+    neg = -jnp.inf if jnp.issubdtype(x.dtype, jnp.inexact) else jnp.iinfo(x.dtype).min
+    return lax.reduce_window(x, neg, lax.max, window, strides, pads)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, data_format="NCHW"):
+    k = _pair(kernel_size)
+    s = _pair(stride) if stride is not None else k
+    p = _pair(padding)
+    if data_format == "NCHW":
+        window = (1, 1, k[0], k[1])
+        strides = (1, 1, s[0], s[1])
+        pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    else:
+        window = (1, k[0], k[1], 1)
+        strides = (1, s[0], s[1], 1)
+        pads = ((0, 0), (p[0], p[0]), (p[1], p[1]), (0, 0))
+    summed = lax.reduce_window(x, jnp.zeros((), x.dtype), lax.add, window, strides, pads)
+    if exclusive and (p[0] or p[1]):
+        ones = jnp.ones_like(x)
+        counts = lax.reduce_window(ones, jnp.zeros((), x.dtype), lax.add, window, strides, pads)
+        return summed / counts
+    return summed / (k[0] * k[1])
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    out_h, out_w = _pair(output_size)
+    if data_format == "NCHW":
+        h, w = x.shape[2], x.shape[3]
+    else:
+        h, w = x.shape[1], x.shape[2]
+    if h % out_h == 0 and w % out_w == 0:
+        k = (h // out_h, w // out_w)
+        return avg_pool2d(x, k, stride=k, padding=0, data_format=data_format)
+    # general case: mean over computed bins via resize trick
+    axes = (2, 3) if data_format == "NCHW" else (1, 2)
+    return jnp.mean(x, axis=axes, keepdims=True) if (out_h, out_w) == (1, 1) else _adaptive_pool_general(x, out_h, out_w, axes)
+
+
+def _adaptive_pool_general(x, out_h, out_w, axes, reducer=jnp.mean):
+    import numpy as np
+
+    h, w = x.shape[axes[0]], x.shape[axes[1]]
+    rows = [slice(int(np.floor(i * h / out_h)), int(np.ceil((i + 1) * h / out_h))) for i in range(out_h)]
+    cols = [slice(int(np.floor(j * w / out_w)), int(np.ceil((j + 1) * w / out_w))) for j in range(out_w)]
+    out_rows = []
+    for r in rows:
+        row_cells = []
+        for c in cols:
+            idx = [jnp.s_[:]] * x.ndim
+            idx[axes[0]], idx[axes[1]] = r, c
+            cell = reducer(x[tuple(idx)], axis=axes, keepdims=True)
+            row_cells.append(cell)
+        out_rows.append(jnp.concatenate(row_cells, axis=axes[1]))
+    return jnp.concatenate(out_rows, axis=axes[0])
+
+
+def adaptive_max_pool2d(x, output_size, data_format="NCHW"):
+    out_h, out_w = _pair(output_size)
+    axes = (2, 3) if data_format == "NCHW" else (1, 2)
+    h, w = x.shape[axes[0]], x.shape[axes[1]]
+    if h % out_h == 0 and w % out_w == 0:
+        k = (h // out_h, w // out_w)
+        return max_pool2d(x, k, stride=k, padding=0, data_format=data_format)
+    return _adaptive_pool_general(x, out_h, out_w, axes, reducer=jnp.max)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False, data_format="NCHW"):
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        if size is None:
+            sf = _pair(scale_factor)
+            size = (int(h * sf[0]), int(w * sf[1]))
+        method = {"nearest": "nearest", "bilinear": "bilinear", "bicubic": "bicubic", "area": "linear"}[mode]
+        return jax.image.resize(x, (n, c, size[0], size[1]), method=method)
+    n, h, w, c = x.shape
+    if size is None:
+        sf = _pair(scale_factor)
+        size = (int(h * sf[0]), int(w * sf[1]))
+    return jax.image.resize(x, (n, size[0], size[1], c), method=mode)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    k = _pair(kernel_sizes)
+    s = _pair(strides)
+    p = _pair(paddings)
+    d = _pair(dilations)
+    n, c, h, w = x.shape
+    patches = lax.conv_general_dilated_patches(
+        x, filter_shape=k, window_strides=s, padding=[(p[0], p[0]), (p[1], p[1])],
+        rhs_dilation=d, dimension_numbers=lax.conv_dimension_numbers(x.shape, (1, c, *k), ("NCHW", "OIHW", "NCHW")),
+    )
+    return patches.reshape(n, c * k[0] * k[1], -1)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    r = upscale_factor
+    n, c, h, w = x.shape
+    x = x.reshape(n, c // (r * r), r, r, h, w)
+    x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+    return x.reshape(n, c // (r * r), h * r, w * r)
+
+
+# ------------------------------------------------------------------- losses
+def mse_loss(input, label, reduction="mean"):
+    loss = jnp.square(input - label)
+    return _reduce(loss, reduction)
+
+
+def l1_loss(input, label, reduction="mean"):
+    return _reduce(jnp.abs(input - label), reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):
+    diff = jnp.abs(input - label)
+    loss = jnp.where(diff < delta, 0.5 * diff * diff / delta, diff - 0.5 * delta)
+    return _reduce(loss, reduction)
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def cross_entropy(
+    input, label, weight=None, ignore_index=-100, reduction="mean",
+    soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+):
+    """paddle.nn.functional.cross_entropy (logits in, per reference default)."""
+    if use_softmax:
+        logp = jax.nn.log_softmax(input, axis=axis)
+    else:
+        logp = jnp.log(jnp.clip(input, 1e-12, None))
+    n_classes = input.shape[axis]
+    if soft_label:
+        soft = label
+        if label_smoothing > 0.0:
+            soft = soft * (1.0 - label_smoothing) + label_smoothing / n_classes
+        loss = -jnp.sum(soft * logp, axis=axis)
+        valid = None
+    else:
+        lbl = label
+        if lbl.ndim == input.ndim and lbl.shape[axis] == 1:
+            lbl = jnp.squeeze(lbl, axis=axis)
+        lbl = lbl.astype(jnp.int32)
+        valid = lbl != ignore_index
+        safe = jnp.where(valid, lbl, 0)
+        picked = jnp.take_along_axis(logp, safe[..., None] if axis in (-1, input.ndim - 1) else jnp.expand_dims(safe, axis), axis=axis)
+        picked = jnp.squeeze(picked, axis=axis)
+        if label_smoothing > 0.0:
+            smooth_term = -jnp.mean(logp, axis=axis)
+            loss = (1.0 - label_smoothing) * (-picked) + label_smoothing * smooth_term
+        else:
+            loss = -picked
+        sample_w = jnp.take(weight, safe) if weight is not None else None
+        if sample_w is not None:
+            loss = loss * sample_w
+        loss = jnp.where(valid, loss, 0.0)
+    if reduction == "mean":
+        if valid is not None:
+            if weight is not None:
+                # paddle semantics: weighted mean divides by the weight sum
+                denom = jnp.maximum(jnp.sum(jnp.where(valid, sample_w, 0.0)), 1e-12)
+            else:
+                denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+            return jnp.sum(loss) / denom
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean"):
+    return _nll(input, label, weight, ignore_index, reduction)
+
+
+def _nll(logp, label, weight, ignore_index, reduction):
+    lbl = label.astype(jnp.int32)
+    valid = lbl != ignore_index
+    safe = jnp.where(valid, lbl, 0)
+    picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    loss = -picked
+    if weight is not None:
+        w = jnp.take(weight, safe)
+        loss = loss * w
+    loss = jnp.where(valid, loss, 0.0)
+    if reduction == "mean":
+        if weight is not None:
+            denom = jnp.sum(jnp.where(valid, jnp.take(weight, safe), 0.0))
+        else:
+            denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+        return jnp.sum(loss) / denom
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):
+    eps = 1e-12
+    loss = -(label * jnp.log(jnp.clip(input, eps, None)) + (1 - label) * jnp.log(jnp.clip(1 - input, eps, None)))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy_with_logits(input, label, weight=None, reduction="mean", pos_weight=None):
+    max_val = jnp.maximum(-input, 0.0)
+    if pos_weight is not None:
+        log_w = (pos_weight - 1.0) * label + 1.0
+        loss = (1 - label) * input + log_w * (jnp.log(1 + jnp.exp(-jnp.abs(input))) + max_val)
+    else:
+        loss = (1 - label) * input + max_val + jnp.log(jnp.exp(-max_val) + jnp.exp(-input - max_val))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def kl_div(input, label, reduction="mean", log_target=False):
+    if log_target:
+        loss = jnp.exp(label) * (label - input)
+    else:
+        loss = label * (jnp.log(jnp.clip(label, 1e-12, None)) - input)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    return _reduce(loss, reduction)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1):
+    n = label.shape[-1]
+    if prior_dist is not None:
+        return (1.0 - epsilon) * label + epsilon * prior_dist
+    return (1.0 - epsilon) * label + epsilon / n
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):
+    loss = jnp.where(label == 1.0, input, jnp.maximum(0.0, margin - input))
+    return _reduce(loss, reduction)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.linalg.norm(x1, axis=axis)
+    n2 = jnp.linalg.norm(x2, axis=axis)
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0, reduction="sum"):
+    p = jax.nn.sigmoid(logit)
+    ce = binary_cross_entropy_with_logits(logit, label, reduction="none")
+    p_t = p * label + (1 - p) * (1 - label)
+    loss = ce * ((1 - p_t) ** gamma)
+    if alpha >= 0:
+        alpha_t = alpha * label + (1 - alpha) * (1 - label)
+        loss = alpha_t * loss
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce(loss, reduction)
+
+
+# ----------------------------------------------------------------- attention
+def scaled_dot_product_attention(
+    query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False, training=True, scale=None,
+):
+    """Reference attention (paddle incubate F.scaled_dot_product_attention;
+    fused flash kernel at phi/kernels/gpu/flash_attn_kernel.cu). Layout:
+    [batch, seq, heads, head_dim]. The Pallas flash path (ops/pallas) overrides
+    this for long sequences on real TPU.
+    """
+    b, sq, h, d = query.shape
+    sk = key.shape[1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    q = jnp.einsum("bqhd->bhqd", query)
+    k = jnp.einsum("bkhd->bhkd", key)
+    v = jnp.einsum("bkhd->bhkd", value)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    logits = logits.astype(jnp.float32)
+    if is_causal:
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            logits = jnp.where(attn_mask, logits, jnp.finfo(jnp.float32).min)
+        else:
+            logits = logits + attn_mask.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1).astype(query.dtype)
+    if dropout_p > 0.0 and training:
+        probs = dropout(probs, dropout_p, training=True)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    return jnp.einsum("bhqd->bqhd", out)
+
+
+# ------------------------------------------------------------ rope (fused op)
+def rotary_position_embedding(q, k, cos, sin, rotate_half=True):
+    """Reference: incubate fused_rotary_position_embedding.
+    q,k: [b, s, h, d]; cos,sin: [s, d] or broadcastable."""
+
+    def rot(x):
+        if rotate_half:
+            x1, x2 = jnp.split(x, 2, axis=-1)
+            return jnp.concatenate([-x2, x1], axis=-1)
+        x1 = x[..., 0::2]
+        x2 = x[..., 1::2]
+        return jnp.stack([-x2, x1], axis=-1).reshape(x.shape)
+
+    cos = cos[None, :, None, :] if cos.ndim == 2 else cos
+    sin = sin[None, :, None, :] if sin.ndim == 2 else sin
+    q_out = q * cos + rot(q) * sin
+    k_out = k * cos + rot(k) * sin
+    return q_out, k_out

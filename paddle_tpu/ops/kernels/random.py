@@ -1,0 +1,95 @@
+"""Random sampling kernels.
+
+Reference: phi uniform/gaussian/randint/bernoulli/... kernels over the Philox
+Generator (paddle/phi/core/generator.h). Keys come from the process generator
+(core/random.py) so eager sampling is stateful-looking while compiled steps can
+thread a traced seed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import random as _random
+from ...core.dtype import convert_dtype, get_default_dtype
+
+
+def _dt(dtype):
+    return convert_dtype(dtype) if dtype is not None else get_default_dtype()
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0):
+    key = jax.random.PRNGKey(seed) if seed else _random.next_key()
+    return jax.random.uniform(key, tuple(shape), _dt(dtype), min, max)
+
+
+def gaussian(shape, mean=0.0, std=1.0, dtype=None, seed=0):
+    key = jax.random.PRNGKey(seed) if seed else _random.next_key()
+    return mean + std * jax.random.normal(key, tuple(shape), _dt(dtype))
+
+
+def randn(shape, dtype=None):
+    return gaussian(shape, 0.0, 1.0, dtype)
+
+
+def rand(shape, dtype=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64"):
+    if high is None:
+        low, high = 0, low
+    key = _random.next_key()
+    return jax.random.randint(key, tuple(shape), low, high, convert_dtype(dtype))
+
+
+def randperm(n, dtype="int64"):
+    key = _random.next_key()
+    return jax.random.permutation(key, n).astype(convert_dtype(dtype))
+
+
+def bernoulli(x):
+    key = _random.next_key()
+    return jax.random.bernoulli(key, x).astype(x.dtype)
+
+
+def poisson(x):
+    key = _random.next_key()
+    return jax.random.poisson(key, x).astype(x.dtype)
+
+
+def multinomial(x, num_samples=1, replacement=False):
+    key = _random.next_key()
+    logits = jnp.log(jnp.clip(x, 1e-30, None))
+    if replacement:
+        return jax.random.categorical(key, logits, axis=-1, shape=x.shape[:-1] + (num_samples,)).astype(jnp.int64)
+    # without replacement: Gumbel top-k trick
+    g = -jnp.log(-jnp.log(jax.random.uniform(key, x.shape, jnp.float32, 1e-20, 1.0)))
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return idx.astype(jnp.int64)
+
+
+def normal(mean=0.0, std=1.0, shape=None):
+    if shape is None:
+        if hasattr(mean, "shape") and getattr(mean, "shape", ()) != ():
+            shape = mean.shape
+        elif hasattr(std, "shape") and getattr(std, "shape", ()) != ():
+            shape = std.shape
+        else:
+            shape = ()
+    key = _random.next_key()
+    return mean + std * jax.random.normal(key, tuple(shape), get_default_dtype())
+
+
+def standard_normal(shape, dtype=None):
+    return gaussian(shape, 0.0, 1.0, dtype)
+
+
+def uniform_(x, min=-1.0, max=1.0):
+    key = _random.next_key()
+    return jax.random.uniform(key, x.shape, x.dtype, min, max)
+
+
+def exponential(x, lam=1.0):
+    key = _random.next_key()
+    return jax.random.exponential(key, x.shape, x.dtype) / lam

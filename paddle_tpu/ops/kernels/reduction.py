@@ -1,0 +1,133 @@
+"""Reduction kernels (reference: paddle/phi/kernels/*/reduce_*, arg_min_max, ...).
+
+All reductions map to single XLA reduce ops; keepdim/axis semantics follow the
+paddle API (axis=None reduces all dims).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.dtype import convert_dtype
+
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):
+    if dtype is not None:
+        dtype = convert_dtype(dtype)
+    return jnp.sum(x, axis=_norm_axis(axis), dtype=dtype, keepdims=keepdim)
+
+
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def max(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def min(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None):
+    if dtype is not None:
+        dtype = convert_dtype(dtype)
+    return jnp.prod(x, axis=_norm_axis(axis), dtype=dtype, keepdims=keepdim)
+
+
+def amax(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def amin(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def all(x, axis=None, keepdim=False):
+    return jnp.all(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def any(x, axis=None, keepdim=False):
+    return jnp.any(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(convert_dtype(dtype))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(convert_dtype(dtype))
+
+
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=_norm_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=_norm_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def quantile(x, q, axis=None, keepdim=False):
+    return jnp.quantile(x, q, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False):
+    if dtype is not None:
+        dtype = convert_dtype(dtype)
+    return jnp.nansum(x, axis=_norm_axis(axis), dtype=dtype, keepdims=keepdim)
+
+
+def nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False):
+    from jax.scipy.special import logsumexp as _lse
+
+    return _lse(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+import jax  # noqa: E402
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    axis = int(axis)
+    moved = axis not in (-1, x.ndim - 1)
+    xm = jnp.moveaxis(x, axis, -1) if moved else x
+    if largest:
+        vals, idx = jax.lax.top_k(xm, k)
+    else:
+        vals, idx = jax.lax.top_k(-xm, k)
+        vals = -vals
+    if moved:
+        vals = jnp.moveaxis(vals, -1, axis)
+        idx = jnp.moveaxis(idx, -1, axis)
+    return vals, idx.astype(jnp.int64)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False):
+    vals = jnp.sort(x, axis=axis)
+    idxs = jnp.argsort(x, axis=axis)
+    taken = jnp.take(vals, k - 1, axis=axis)
+    taken_idx = jnp.take(idxs, k - 1, axis=axis)
+    if keepdim:
+        taken = jnp.expand_dims(taken, axis)
+        taken_idx = jnp.expand_dims(taken_idx, axis)
+    return taken, taken_idx

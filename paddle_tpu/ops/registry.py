@@ -1,0 +1,202 @@
+"""Op registry + eager dispatcher.
+
+Reference: KernelFactory/KernelKey (paddle/phi/core/kernel_factory.h:62,168),
+the YAML op declarations (paddle/phi/api/yaml/ops.yaml) and the generated
+dispatch bodies (paddle/phi/api/yaml/generator/api_base.py), plus the generated
+*_ad_func autograd wrappers (paddle/fluid/eager/auto_code_generator/generator/
+eager_gen.py:214).
+
+TPU-native design: there is exactly one "backend" — XLA. A kernel is a pure
+function of jax arrays; its backward is its jax.vjp, recorded at dispatch time
+as a GradNode (see core/autograd.py). Shape/dtype inference (the reference's
+InferMeta layer, paddle/phi/infermeta/) falls out of jax.eval_shape on the same
+kernel — exposed as OpDef.infer_meta so eager, traced, and static paths share
+one definition, exactly the property the reference engineered by hand.
+
+Dispatch sequence per call (mirrors call stack SURVEY.md §3.1):
+  AMP auto-cast -> unwrap Tensors -> [no grad needed] run kernel
+                                  -> [grad needed] jax.vjp(kernel), build
+                                     GradNode with edges into producers,
+                                     wrap outputs.
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+import threading
+import types
+from typing import Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import flags
+from ..core.autograd import GradNode, is_grad_enabled
+from ..core.tensor import Tensor
+
+_float_kinds = ("f", "V")  # V covers bfloat16 (numpy void-backed ml_dtypes kind is 'V')
+
+
+def _is_inexact(dtype) -> bool:
+    return jnp.issubdtype(dtype, jnp.inexact)
+
+
+class OpDef:
+    __slots__ = ("name", "fn", "sig", "n_outputs", "amp", "doc", "inplace_of")
+
+    def __init__(self, name: str, fn: Callable, amp: Optional[str] = None, doc: str = ""):
+        self.name = name
+        self.fn = fn
+        self.sig = inspect.signature(fn)
+        self.amp = amp  # None | 'white' (run in low precision) | 'black' (keep fp32)
+        self.doc = doc or fn.__doc__ or ""
+
+    def infer_meta(self, *args, **kwargs):
+        """Shape/dtype inference without execution (InferMeta equivalent)."""
+
+        def to_spec(x):
+            if isinstance(x, Tensor):
+                return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+            return x
+
+        args = jax.tree_util.tree_map(to_spec, args, is_leaf=lambda x: isinstance(x, Tensor))
+        kwargs = jax.tree_util.tree_map(to_spec, kwargs, is_leaf=lambda x: isinstance(x, Tensor))
+        return jax.eval_shape(self.fn, *args, **kwargs)
+
+    def __repr__(self):
+        return f"<OpDef {self.name}>"
+
+
+_REGISTRY: Dict[str, OpDef] = {}
+
+# Generated-API namespace: the `paddle._C_ops` analog (a real module so that
+# `from paddle_tpu.ops.api import matmul` works).
+from . import api  # noqa: E402
+
+
+def register_op(name: str, fn: Callable = None, *, amp: Optional[str] = None):
+    """Register a kernel function under an op name (PD_REGISTER_KERNEL analog)."""
+
+    def _register(fn):
+        opdef = OpDef(name, fn, amp=amp)
+        _REGISTRY[name] = opdef
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return dispatch(opdef, args, kwargs)
+
+        wrapper.opdef = opdef
+        setattr(api, name, wrapper)
+        return fn
+
+    if fn is not None:
+        return _register(fn)
+    return _register
+
+
+def get_op(name: str) -> OpDef:
+    return _REGISTRY[name]
+
+
+def all_ops():
+    return dict(_REGISTRY)
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def dispatch(opdef: OpDef, args, kwargs):
+    # --- AMP auto-cast (eager_gen.py AMP hook analog) ---
+    from ..amp.state import amp_state  # local import: amp depends on ops
+
+    st = amp_state()
+    if st.enabled and opdef.amp is not None:
+        args, kwargs = st.cast_args(opdef, args, kwargs)
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor)
+    tensor_idx = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
+    tensors = [leaves[i] for i in tensor_idx]
+
+    grad_on = is_grad_enabled()
+    # primals: tensors that can carry gradient through this op
+    primal_pos = [
+        k
+        for k, t in enumerate(tensors)
+        if grad_on and not t.stop_gradient and _is_inexact(t.dtype)
+    ]
+    requires_grad = bool(primal_pos)
+
+    def run_with(tensor_vals):
+        vals = list(leaves)
+        for i, v in zip(tensor_idx, tensor_vals):
+            vals[i] = v
+        a, k = jax.tree_util.tree_unflatten(treedef, vals)
+        return opdef.fn(*a, **k)
+
+    if not requires_grad:
+        out = run_with([t._value for t in tensors])
+        return _wrap_outputs(opdef, out, node=None)
+
+    primal_set = set(primal_pos)
+    const_vals = [t._value for k, t in enumerate(tensors) if k not in primal_set]
+
+    def pure(*primals):
+        it_p = iter(primals)
+        it_c = iter(const_vals)
+        tensor_vals = [next(it_p) if k in primal_set else next(it_c) for k in range(len(tensors))]
+        return run_with(tensor_vals)
+
+    out, vjp_fn = jax.vjp(pure, *[tensors[k]._value for k in primal_pos])
+
+    edges = []
+    for k in primal_pos:
+        t = tensors[k]
+        if t._grad_node is not None:
+            node, idx = t._grad_node
+            edges.append(("node", node, idx))
+        else:
+            edges.append(("leaf", t))
+
+    out_list = out if isinstance(out, (tuple, list)) else [out]
+    out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in out_list]
+    node = GradNode(opdef.name, vjp_fn, edges, out_avals)
+    return _wrap_outputs(opdef, out, node=node)
+
+
+def _wrap_outputs(opdef, out, node):
+    single = not isinstance(out, (tuple, list))
+    out_list = [out] if single else list(out)
+
+    if flags.get_flag("check_nan_inf"):
+        for o in out_list:
+            if _is_inexact(o.dtype) and not _in_trace(o):
+                if bool(jnp.any(~jnp.isfinite(o))):
+                    raise FloatingPointError(
+                        f"Op '{opdef.name}' produced NaN/Inf "
+                        f"(FLAGS_check_nan_inf is on)."
+                    )
+
+    wrapped = []
+    for i, o in enumerate(out_list):
+        t = Tensor.__new__(Tensor)
+        t._value = o
+        t._grad = None
+        t._grad_hooks = []
+        t.name = None
+        t.persistable = False
+        if node is not None and _is_inexact(o.dtype):
+            t.stop_gradient = False
+            t.trainable = False
+            t._grad_node = (node, i)
+        else:
+            t.stop_gradient = True
+            t.trainable = False
+            t._grad_node = None
+        wrapped.append(t)
+    return wrapped[0] if single else tuple(wrapped)
+
+
+def _in_trace(x) -> bool:
+    return not isinstance(x, (jax.Array, np.ndarray)) or isinstance(x, jax.core.Tracer)

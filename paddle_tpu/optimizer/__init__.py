@@ -1,0 +1,11 @@
+from . import lr  # noqa: F401
+from .optimizer import Optimizer  # noqa: F401
+from .optimizers import (  # noqa: F401
+    SGD,
+    Adagrad,
+    Adam,
+    AdamW,
+    Lamb,
+    Momentum,
+    RMSProp,
+)

@@ -1,0 +1,174 @@
+"""Optimizer base.
+
+Reference: python/paddle/optimizer/optimizer.py (param list, grad clip,
+regularization, accumulators). TPU-native: the per-param update rule is a PURE
+function `_update(p, g, state, lr) -> (new_p, new_state)` over jax arrays, so
+the same rule runs eagerly (Optimizer.step) and inside a compiled train step
+(jit/trainer.py) — the analog of the reference sharing phi kernels between
+eager and the StandaloneExecutor.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+
+from ..core.autograd import no_grad
+from ..core.tensor import Tensor
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        if parameters is None:
+            raise ValueError("parameters must be provided in dygraph mode")
+        self._parameter_list: List[Tensor] = list(parameters)
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        if isinstance(weight_decay, (int, float)):
+            self._coupled_wd = float(weight_decay)  # L2 regularizer folded into grad
+        elif weight_decay is not None and hasattr(weight_decay, "coeff"):
+            self._coupled_wd = float(weight_decay.coeff)
+        else:
+            self._coupled_wd = 0.0
+        # state: param-id -> {slot-name -> jax array}
+        self._state: Dict[int, Dict[str, object]] = {}
+        self._step_count = 0
+
+    # ---- lr ----------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value: float):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("set_lr is not allowed when lr is a scheduler")
+        self._learning_rate = float(value)
+
+    @property
+    def _lr_scheduler(self) -> Optional[LRScheduler]:
+        return self._learning_rate if isinstance(self._learning_rate, LRScheduler) else None
+
+    # ---- update rule (override) -------------------------------------------
+    def _init_state(self, p_value) -> Dict[str, object]:
+        return {}
+
+    def _update(self, p, g, state, lr):
+        raise NotImplementedError
+
+    def _get_state(self, p: Tensor):
+        s = self._state.get(id(p))
+        if s is None:
+            s = self._init_state(p._value)
+            if self._multi_precision and p.dtype in (jnp.bfloat16, jnp.float16):
+                s["master"] = p._value.astype(jnp.float32)
+            self._post_init_state(p, s)
+            self._state[id(p)] = s
+        return s
+
+    def _post_init_state(self, p: Tensor, state):
+        """Hook for subclasses needing the param identity (e.g. AdamW's
+        apply_decay_param_fun consults p.name)."""
+
+    # ---- step --------------------------------------------------------------
+    @no_grad()
+    def step(self):
+        lr = self.get_lr()
+        params_grads = [(p, p.grad) for p in self._parameter_list if p.grad is not None and p.trainable]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        for p, g in params_grads:
+            gv = g._value if isinstance(g, Tensor) else g
+            state = self._get_state(p)
+            if self._coupled_wd:
+                gv = gv + self._coupled_wd * p._value.astype(gv.dtype)
+            if "master" in state:
+                new_master, new_state = self._update(state["master"], gv.astype(jnp.float32), state, lr)
+                new_state["master"] = new_master
+                p._value = new_master.astype(p.dtype)
+            else:
+                new_p, new_state = self._update(p._value, gv, state, lr)
+                p._value = new_p
+            self._state[id(p)] = new_state
+        self._step_count += 1
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list:
+            p._grad = None
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self._parameter_list]
+
+    # ---- state dict --------------------------------------------------------
+    def state_dict(self):
+        out = {"LR_Scheduler": {}, "master_weights": {}}
+        sched = self._lr_scheduler
+        if sched is not None:
+            out["LR_Scheduler"] = sched.state_dict()
+        for i, p in enumerate(self._parameter_list):
+            name = p.name or f"param_{i}"
+            s = self._state.get(id(p))
+            if s:
+                for k, v in s.items():
+                    if k == "master":
+                        out["master_weights"][name] = Tensor(v)
+                    else:
+                        out[f"{name}.{k}"] = Tensor(v) if not isinstance(v, Tensor) else v
+        out["step"] = self._step_count
+        return out
+
+    def set_state_dict(self, state):
+        import numpy as np
+
+        sched = self._lr_scheduler
+        if sched is not None and state.get("LR_Scheduler"):
+            sched.set_state_dict(state["LR_Scheduler"])
+        self._step_count = int(state.get("step", 0))
+        for i, p in enumerate(self._parameter_list):
+            name = p.name or f"param_{i}"
+            s = self._get_state(p)
+            for k in list(s.keys()):
+                key = f"{name}.{k}"
+                if key in state:
+                    v = state[key]
+                    s[k] = v._value if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+            if name in state.get("master_weights", {}):
+                v = state["master_weights"][name]
+                s["master"] = v._value if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+
+    # ---- functional interface for the compiled executor --------------------
+    def init_state_tree(self, params: List[Tensor]):
+        """Build (and cache) state for `params`, returning it as a list of dicts
+        aligned with `params` (pytree-compatible, used by jit/trainer)."""
+        return [dict(self._get_state(p)) for p in params]
+
+    def functional_update(self, p_vals, g_vals, states, lr):
+        """Pure: lists of arrays + state dicts -> (new_p_vals, new_states)."""
+        new_ps, new_ss = [], []
+        for p, g, s in zip(p_vals, g_vals, states):
+            s = dict(s)
+            wd_g = g
+            if self._coupled_wd:
+                wd_g = g + self._coupled_wd * p.astype(g.dtype)
+            if "master" in s:
+                master, ns = self._update(s["master"], wd_g.astype(jnp.float32), s, lr)
+                ns["master"] = master
+                new_ps.append(master.astype(p.dtype))
+                new_ss.append(ns)
+            else:
+                np_, ns = self._update(p, wd_g, s, lr)
+                new_ps.append(np_)
+                new_ss.append(ns)
+        return new_ps, new_ss
+
+    def sync_state_from(self, params: List[Tensor], states):
+        """Write functional states back into the eager accumulator store."""
+        for p, s in zip(params, states):
+            self._state[id(p)] = dict(s)

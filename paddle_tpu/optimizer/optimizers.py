@@ -1,0 +1,182 @@
+"""Concrete optimizers (reference: python/paddle/optimizer/{sgd,momentum,adam,
+adamw,lamb,adagrad,rmsprop}.py; fused GPU kernels like fused_adam_kernel.cu
+become single fused XLA update expressions here)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+
+    def _update(self, p, g, state, lr):
+        return p - lr * g.astype(p.dtype), {}
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_state(self, p_value):
+        return {"velocity": jnp.zeros_like(p_value, dtype=jnp.float32)}
+
+    def _update(self, p, g, state, lr):
+        v = self._momentum * state["velocity"] + g.astype(jnp.float32)
+        if self._nesterov:
+            step = g.astype(jnp.float32) + self._momentum * v
+        else:
+            step = v
+        return (p - lr * step.astype(p.dtype)), {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _init_state(self, p_value):
+        return {
+            "moment1": jnp.zeros_like(p_value, dtype=jnp.float32),
+            "moment2": jnp.zeros_like(p_value, dtype=jnp.float32),
+            "beta1_pow": jnp.ones((), jnp.float32),
+            "beta2_pow": jnp.ones((), jnp.float32),
+        }
+
+    def _adam_step(self, p, g, state, lr, decoupled_wd=0.0):
+        g32 = g.astype(jnp.float32)
+        b1p = state["beta1_pow"] * self._beta1
+        b2p = state["beta2_pow"] * self._beta2
+        m1 = self._beta1 * state["moment1"] + (1 - self._beta1) * g32
+        m2 = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g32)
+        m1_hat = m1 / (1 - b1p)
+        m2_hat = m2 / (1 - b2p)
+        p32 = p.astype(jnp.float32)
+        # decoupled_wd may be a traced scalar (0.0 when off) — no Python branch
+        p32 = p32 * (1.0 - lr * decoupled_wd)
+        new_p = p32 - lr * m1_hat / (jnp.sqrt(m2_hat) + self._eps)
+        return new_p.astype(p.dtype), {"moment1": m1, "moment2": m2, "beta1_pow": b1p, "beta2_pow": b2p}
+
+    def _update(self, p, g, state, lr):
+        return self._adam_step(p, g, state, lr)
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters, None,
+                         grad_clip, lazy_mode, multi_precision, name)
+        self._decoupled_wd = float(weight_decay) if isinstance(weight_decay, (int, float)) else 0.01
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _post_init_state(self, p, state):
+        apply_decay = True
+        if self._apply_decay_param_fun is not None:
+            apply_decay = bool(self._apply_decay_param_fun(p.name or ""))
+        state["wd_on"] = 1.0 if apply_decay else 0.0
+
+    def _update(self, p, g, state, lr):
+        wd = self._decoupled_wd * state.get("wd_on", 1.0)
+        new_p, ns = self._adam_step(p, g, state, lr, decoupled_wd=wd)
+        ns["wd_on"] = state.get("wd_on", 1.0)
+        return new_p, ns
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, False, name)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_state(self, p_value):
+        return {"moment": jnp.full_like(p_value, self._init_acc, dtype=jnp.float32)}
+
+    def _update(self, p, g, state, lr):
+        g32 = g.astype(jnp.float32)
+        acc = state["moment"] + jnp.square(g32)
+        new_p = p.astype(jnp.float32) - lr * g32 / (jnp.sqrt(acc) + self._eps)
+        return new_p.astype(p.dtype), {"moment": acc}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, False, name)
+        self._rho, self._eps, self._momentum, self._centered = rho, epsilon, momentum, centered
+
+    def _init_state(self, p_value):
+        s = {
+            "mean_square": jnp.zeros_like(p_value, dtype=jnp.float32),
+            "velocity": jnp.zeros_like(p_value, dtype=jnp.float32),
+        }
+        if self._centered:
+            s["mean_grad"] = jnp.zeros_like(p_value, dtype=jnp.float32)
+        return s
+
+    def _update(self, p, g, state, lr):
+        g32 = g.astype(jnp.float32)
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * jnp.square(g32)
+        new_state = {"mean_square": ms}
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * g32
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._eps)
+            new_state["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + self._eps)
+        v = self._momentum * state["velocity"] + lr * g32 / denom
+        new_state["velocity"] = v
+        return (p.astype(jnp.float32) - v).astype(p.dtype), new_state
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, multi_precision, name)
+        self._wd = lamb_weight_decay
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _post_init_state(self, p, state):
+        excluded = self._exclude_fn is not None and bool(self._exclude_fn(p))
+        state["wd_on"] = 0.0 if excluded else 1.0
+
+    def _init_state(self, p_value):
+        return {
+            "moment1": jnp.zeros_like(p_value, dtype=jnp.float32),
+            "moment2": jnp.zeros_like(p_value, dtype=jnp.float32),
+            "beta1_pow": jnp.ones((), jnp.float32),
+            "beta2_pow": jnp.ones((), jnp.float32),
+        }
+
+    def _update(self, p, g, state, lr):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        b1p = state["beta1_pow"] * self._beta1
+        b2p = state["beta2_pow"] * self._beta2
+        m1 = self._beta1 * state["moment1"] + (1 - self._beta1) * g32
+        m2 = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g32)
+        m1_hat = m1 / (1 - b1p)
+        m2_hat = m2 / (1 - b2p)
+        r = m1_hat / (jnp.sqrt(m2_hat) + self._eps) + self._wd * state.get("wd_on", 1.0) * p32
+        p_norm = jnp.linalg.norm(p32)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+        new_p = p32 - lr * trust * r
+        return new_p.astype(p.dtype), {
+            "moment1": m1, "moment2": m2, "beta1_pow": b1p, "beta2_pow": b2p,
+            "wd_on": state.get("wd_on", 1.0),
+        }

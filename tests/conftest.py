@@ -1,0 +1,35 @@
+"""Test configuration: CPU backend with 8 virtual devices, so distributed
+tests exercise real mesh sharding without TPU hardware (the reference's
+custom_cpu fake-device trick, SURVEY.md §4)."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the driver env may preset 'axon'
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    # Fail loudly if jax initialized before our env override took effect
+    # (e.g. a sitecustomize that eagerly creates a backend).
+    import jax
+
+    backend = jax.default_backend()
+    if backend != "cpu" or jax.device_count() < 8:
+        raise RuntimeError(
+            f"tests need the 8-device CPU mesh but jax initialized as "
+            f"{backend!r} with {jax.device_count()} device(s); jax was likely "
+            "imported before tests/conftest.py set JAX_PLATFORMS/XLA_FLAGS."
+        )
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as paddle
+
+    np.random.seed(0)
+    paddle.seed(0)
+    yield

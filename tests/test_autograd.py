@@ -1,0 +1,171 @@
+"""Autograd engine tests: numeric-grad checks (OpTest check_grad pattern),
+hooks, no_grad, partial-graph grad, retain_graph."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops import api
+
+from op_test import check_grad
+
+
+def _f32(*shape):
+    return np.random.randn(*shape).astype(np.float32)
+
+
+@pytest.mark.parametrize("op,inputs", [
+    (api.add, [_f32(3, 4), _f32(3, 4)]),
+    (api.subtract, [_f32(3, 4), _f32(3, 4)]),
+    (api.multiply, [_f32(3, 4), _f32(3, 4)]),
+    (api.divide, [_f32(3, 4), np.abs(_f32(3, 4)) + 1.0]),
+    (api.matmul, [_f32(3, 4), _f32(4, 5)]),
+    (api.exp, [_f32(3, 4) * 0.5]),
+    (api.tanh, [_f32(3, 4)]),
+    (api.sigmoid, [_f32(3, 4)]),
+    (api.relu, [_f32(3, 4) + 0.1]),
+    (api.gelu, [_f32(3, 4)]),
+    (api.softmax, [_f32(3, 4)]),
+    (api.square, [_f32(3, 4)]),
+    (api.sqrt, [np.abs(_f32(3, 4)) + 0.5]),
+    (api.mean, [_f32(3, 4)]),
+    (api.abs, [_f32(3, 4) + 0.2]),
+], ids=lambda p: getattr(p, "__name__", "x"))
+def test_numeric_grad(op, inputs):
+    check_grad(op, inputs)
+
+
+def test_grad_broadcast():
+    # broadcasting reduces correctly on backward
+    x = paddle.to_tensor(_f32(3, 4), stop_gradient=False)
+    b = paddle.to_tensor(_f32(4), stop_gradient=False)
+    (x + b).sum().backward()
+    np.testing.assert_allclose(b.grad.numpy(), np.full(4, 3.0), atol=1e-5)
+
+
+def test_grad_accumulation_multi_use():
+    x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    y = x * x + x * 3.0  # dy/dx = 2x + 3 = 7
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [7.0], atol=1e-5)
+
+
+def test_chain_through_layers():
+    check_grad(lambda a, w1, w2: api.matmul(api.tanh(api.matmul(a, w1)), w2),
+               [_f32(2, 3), _f32(3, 4), _f32(4, 2)], atol=5e-3, rtol=5e-3)
+
+
+def test_cross_entropy_grad():
+    logits = _f32(4, 5)
+    labels = np.array([1, 0, 4, 2])
+
+    def ce(x):
+        return api.cross_entropy(x, paddle.to_tensor(labels))
+
+    check_grad(ce, [logits], atol=5e-3, rtol=5e-3)
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor(_f32(2, 2), stop_gradient=False)
+    y = paddle.to_tensor(_f32(2, 2), stop_gradient=True)
+    (x * y).sum().backward()
+    assert x.grad is not None
+    assert y.grad is None
+
+
+def test_detach():
+    x = paddle.to_tensor(_f32(2, 2), stop_gradient=False)
+    d = (x * 2).detach()
+    assert d.stop_gradient
+    out = (x * 2 + d).sum()
+    out.backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.full((2, 2), 2.0))
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor(_f32(2, 2), stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 3
+    assert y.stop_gradient
+    assert y._grad_node is None
+
+
+def test_register_hook_scales_grad():
+    x = paddle.to_tensor(np.ones((2,), np.float32), stop_gradient=False)
+    y = x * 2
+    h = y.register_hook(lambda g: g * 10)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [20.0, 20.0])
+    h.remove()
+
+
+def test_leaf_hook():
+    x = paddle.to_tensor(np.ones((2,), np.float32), stop_gradient=False)
+    x.register_hook(lambda g: g * 5)
+    (x * 2).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [10.0, 10.0])
+
+
+def test_paddle_grad_partial():
+    x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    w = paddle.to_tensor(np.array([3.0], np.float32), stop_gradient=False)
+    y = x * w
+    (gx,) = paddle.grad(y, [x], retain_graph=True)
+    np.testing.assert_allclose(gx.numpy(), [3.0])
+    assert x.grad is None and w.grad is None  # no pollution
+    (gw,) = paddle.grad(y, [w])
+    np.testing.assert_allclose(gw.numpy(), [2.0])
+
+
+def test_grad_allow_unused():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    z = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        paddle.grad(y, [z], retain_graph=True)
+    gs = paddle.grad(y, [z], allow_unused=True)
+    assert gs[0] is None
+
+
+def test_retain_graph_and_double_backward_error():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])
+    with pytest.raises(RuntimeError, match="freed"):
+        y.backward()
+
+
+def test_multi_output_op_grad():
+    x = _f32(4, 6)
+
+    def take_first_of_split(a):
+        parts = api.split(a, 2, axis=1)
+        return parts[0]
+
+    check_grad(take_first_of_split, [x])
+
+
+def test_backward_with_grad_tensor():
+    x = paddle.to_tensor(np.ones((2, 2), np.float32), stop_gradient=False)
+    y = x * 3
+    y.backward(paddle.to_tensor(np.full((2, 2), 2.0, np.float32)))
+    np.testing.assert_allclose(x.grad.numpy(), np.full((2, 2), 6.0))
+
+
+def test_getitem_grad():
+    x = _f32(4, 4)
+
+    def slice_op(a):
+        return a[1:3, :2]
+
+    check_grad(slice_op, [x])
+
+
+def test_int_output_in_graph():
+    # argmax output must not break backward of float outputs
+    x = paddle.to_tensor(_f32(3, 4), stop_gradient=False)
+    vals, idx = api.topk(x, 2)
+    vals.sum().backward()
+    assert x.grad is not None
+    assert idx.stop_gradient

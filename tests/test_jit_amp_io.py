@@ -1,0 +1,195 @@
+"""jit (to_static/TrainStep), amp, io, save/load tests."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import amp, nn, optimizer
+from paddle_tpu.io import DataLoader, TensorDataset
+from paddle_tpu.jit.trainer import TrainStep
+
+
+def _f32(*shape):
+    return np.random.randn(*shape).astype(np.float32)
+
+
+# --------------------------------------------------------------------- jit
+def test_to_static_matches_eager():
+    model = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+    x = paddle.to_tensor(_f32(3, 4))
+    eager_out = model(x)
+    static_fn = paddle.jit.to_static(model.forward)
+    static_out = static_fn(x)
+    np.testing.assert_allclose(static_out.numpy(), eager_out.numpy(), atol=1e-5)
+
+
+def test_to_static_respects_weight_updates():
+    model = nn.Linear(2, 2)
+    fn = paddle.jit.to_static(model.forward)
+    x = paddle.to_tensor(_f32(1, 2))
+    out1 = fn(x).numpy()
+    with paddle.no_grad():
+        model.weight.set_value(model.weight.numpy() * 2)
+    out2 = fn(x).numpy()
+    assert not np.allclose(out1, out2)  # params are inputs, not baked constants
+
+
+def test_train_step_matches_eager_sgd():
+    def build():
+        paddle.seed(7)
+        return nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+
+    x, y = _f32(16, 4), _f32(16, 1)
+    loss_fn = nn.MSELoss()
+
+    m1 = build()
+    o1 = optimizer.SGD(0.1, parameters=m1.parameters())
+    eager_losses = []
+    for _ in range(5):
+        loss = loss_fn(m1(paddle.to_tensor(x)), paddle.to_tensor(y))
+        loss.backward()
+        o1.step()
+        o1.clear_grad()
+        eager_losses.append(float(loss.item()))
+
+    m2 = build()
+    o2 = optimizer.SGD(0.1, parameters=m2.parameters())
+    step = TrainStep(m2, lambda a, b: loss_fn(m2(a), b), o2)
+    compiled_losses = [float(step(paddle.to_tensor(x), paddle.to_tensor(y)).item()) for _ in range(5)]
+
+    np.testing.assert_allclose(eager_losses, compiled_losses, rtol=1e-4, atol=1e-5)
+    for p1, p2 in zip(m1.parameters(), m2.parameters()):
+        np.testing.assert_allclose(p1.numpy(), p2.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_train_step_with_adamw_and_clip():
+    model = nn.Linear(4, 2)
+    opt = optimizer.AdamW(1e-2, parameters=model.parameters(),
+                          grad_clip=nn.ClipGradByGlobalNorm(1.0))
+    loss_fn = nn.CrossEntropyLoss()
+    step = TrainStep(model, lambda a, b: loss_fn(model(a), b), opt)
+    x = paddle.to_tensor(_f32(8, 4))
+    y = paddle.to_tensor(np.random.randint(0, 2, 8))
+    losses = [float(step(x, y).item()) for _ in range(10)]
+    assert losses[-1] < losses[0]
+
+
+# --------------------------------------------------------------------- amp
+def test_auto_cast_white_black():
+    x = paddle.to_tensor(_f32(4, 4))
+    w = paddle.to_tensor(_f32(4, 4))
+    with amp.auto_cast(level="O1"):
+        mm = paddle.matmul(x, w)
+        sm = paddle.nn.functional.softmax(mm)
+    assert str(np.dtype(mm.dtype)) == "bfloat16"
+    assert sm.dtype == np.float32  # black list keeps fp32
+
+
+def test_auto_cast_disabled_outside():
+    x = paddle.to_tensor(_f32(2, 2))
+    out = paddle.matmul(x, x)
+    assert out.dtype == np.float32
+
+
+def test_grad_scaler_fp16_skips_inf():
+    w = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+    w.trainable = True
+    opt = optimizer.SGD(1.0, parameters=[w])
+    scaler = amp.GradScaler(init_loss_scaling=4.0)
+    w.grad = paddle.to_tensor(np.array([np.inf, 1.0], np.float32))
+    before = w.numpy().copy()
+    scaler.step(opt)
+    np.testing.assert_array_equal(w.numpy(), before)  # step skipped
+    assert scaler.get_loss_scaling() < 4.0  # scale backed off
+
+
+def test_grad_scaler_scale():
+    scaler = amp.GradScaler(init_loss_scaling=8.0)
+    loss = paddle.to_tensor([2.0])
+    np.testing.assert_allclose(scaler.scale(loss).numpy(), [16.0])
+
+
+# ---------------------------------------------------------------------- io
+def test_dataloader_batching():
+    xs = np.arange(10, dtype=np.float32).reshape(10, 1)
+    ys = np.arange(10)
+    ds = TensorDataset([paddle.to_tensor(xs), paddle.to_tensor(ys)])
+    loader = DataLoader(ds, batch_size=3, shuffle=False, drop_last=False)
+    batches = list(loader)
+    assert len(batches) == 4
+    assert batches[0][0].shape == [3, 1]
+    assert batches[-1][0].shape == [1, 1]
+    np.testing.assert_allclose(batches[0][0].numpy().reshape(-1), [0, 1, 2])
+
+
+def test_dataloader_shuffle_differs():
+    xs = np.arange(100, dtype=np.float32).reshape(100, 1)
+    ds = TensorDataset([paddle.to_tensor(xs)])
+    loader = DataLoader(ds, batch_size=100, shuffle=True)
+    a = next(iter(loader))[0].numpy().reshape(-1)
+    assert not np.array_equal(a, np.arange(100))
+    assert np.array_equal(np.sort(a), np.arange(100))
+
+
+def test_dataloader_threaded_workers_order():
+    class SlowDS(paddle.io.Dataset if hasattr(paddle, "io") else object):
+        def __getitem__(self, i):
+            return np.float32(i)
+
+        def __len__(self):
+            return 20
+
+    from paddle_tpu.io import Dataset
+
+    class DS(Dataset):
+        def __getitem__(self, i):
+            return np.float32(i)
+
+        def __len__(self):
+            return 20
+
+    loader = DataLoader(DS(), batch_size=4, shuffle=False, num_workers=3)
+    got = np.concatenate([b.numpy() for b in loader])
+    np.testing.assert_allclose(got, np.arange(20, dtype=np.float32))
+
+
+def test_distributed_batch_sampler_shards():
+    from paddle_tpu.io import DistributedBatchSampler
+
+    class DS:
+        def __len__(self):
+            return 10
+
+    s0 = DistributedBatchSampler(DS(), batch_size=2, num_replicas=2, rank=0)
+    s1 = DistributedBatchSampler(DS(), batch_size=2, num_replicas=2, rank=1)
+    i0 = [i for b in s0 for i in b]
+    i1 = [i for b in s1 for i in b]
+    assert len(i0) == len(i1) == 5
+    assert set(i0).isdisjoint(set(i1) - {0})  # padded wraparound may duplicate idx 0
+
+
+# ------------------------------------------------------------- save / load
+def test_paddle_save_load(tmp_path):
+    model = nn.Linear(3, 3)
+    opt = optimizer.Adam(1e-3, parameters=model.parameters())
+    path = str(tmp_path / "ckpt.pdparams")
+    paddle.save({"model": model.state_dict(), "opt": opt.state_dict()}, path)
+    loaded = paddle.load(path)
+    m2 = nn.Linear(3, 3)
+    m2.set_state_dict(loaded["model"])
+    x = paddle.to_tensor(_f32(2, 3))
+    np.testing.assert_allclose(model(x).numpy(), m2(x).numpy(), atol=1e-6)
+
+
+def test_hapi_model_fit_evaluate():
+    xs = _f32(64, 4)
+    w_true = np.array([[1.0], [2.0], [-1.0], [0.5]], np.float32)
+    ys = xs @ w_true
+    ds = TensorDataset([paddle.to_tensor(xs), paddle.to_tensor(ys)])
+    model = paddle.Model(nn.Linear(4, 1))
+    model.prepare(optimizer=optimizer.Adam(0.05, parameters=model.parameters()),
+                  loss=nn.MSELoss())
+    hist = model.fit(ds, batch_size=16, epochs=40, verbose=0)
+    assert hist["loss"][-1] < hist["loss"][0]
+    assert hist["loss"][-1] < 0.1

@@ -1,0 +1,239 @@
+"""Op output parity vs numpy across both execution paths (OpTest pattern)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops import api
+
+from op_test import check_output
+
+
+def _f32(*shape):
+    return np.random.randn(*shape).astype(np.float32)
+
+
+BINARY = [
+    (api.add, np.add), (api.subtract, np.subtract), (api.multiply, np.multiply),
+    (api.maximum, np.maximum), (api.minimum, np.minimum),
+    (api.atan2, np.arctan2), (api.logaddexp, np.logaddexp),
+    (api.heaviside, np.heaviside),
+]
+
+UNARY = [
+    (api.exp, np.exp), (api.log1p, np.log1p), (api.sqrt, None), (api.square, np.square),
+    (api.abs, np.abs), (api.sign, np.sign), (api.floor, np.floor), (api.ceil, np.ceil),
+    (api.sin, np.sin), (api.cos, np.cos), (api.tanh, np.tanh),
+    (api.sinh, np.sinh), (api.cosh, np.cosh), (api.expm1, np.expm1),
+    (api.rad2deg, np.rad2deg), (api.deg2rad, np.deg2rad), (api.trunc, np.trunc),
+]
+
+
+@pytest.mark.parametrize("op,ref", BINARY, ids=lambda p: getattr(p, "__name__", "ref"))
+def test_binary_elementwise(op, ref):
+    x, y = _f32(3, 4), _f32(3, 4)
+    check_output(op, lambda a, b: ref(a, b), [x, y])
+
+
+@pytest.mark.parametrize("op,ref", UNARY, ids=lambda p: getattr(p, "__name__", "ref"))
+def test_unary_elementwise(op, ref):
+    x = np.abs(_f32(3, 4)) + 0.5
+    check_output(op, ref or (lambda a: np.sqrt(a)), [x])
+
+
+def test_broadcasting():
+    check_output(api.add, np.add, [_f32(3, 1, 4), _f32(2, 4)])
+    check_output(api.multiply, np.multiply, [_f32(5, 1), _f32(1, 7)])
+
+
+def test_divide_int_promotes():
+    x = np.array([4, 9], dtype=np.int32)
+    y = np.array([2, 2], dtype=np.int32)
+    out = api.divide(paddle.to_tensor(x), paddle.to_tensor(y))
+    np.testing.assert_allclose(out.numpy(), [2.0, 4.5])
+
+
+def test_matmul_variants():
+    check_output(api.matmul, np.matmul, [_f32(3, 4), _f32(4, 5)], atol=1e-4, rtol=1e-4)
+    check_output(lambda a, b: api.matmul(a, b, transpose_y=True),
+                 lambda a, b: a @ b.T, [_f32(3, 4), _f32(5, 4)], atol=1e-4, rtol=1e-4)
+    check_output(api.bmm, np.matmul, [_f32(2, 3, 4), _f32(2, 4, 5)], atol=1e-4, rtol=1e-4)
+
+
+def test_reductions():
+    x = _f32(3, 4, 5)
+    check_output(lambda a: api.sum(a), lambda a: np.sum(a), [x], atol=1e-4)
+    check_output(lambda a: api.sum(a, axis=1), lambda a: np.sum(a, 1), [x], atol=1e-4)
+    check_output(lambda a: api.mean(a, axis=[0, 2], keepdim=True),
+                 lambda a: np.mean(a, (0, 2), keepdims=True), [x])
+    check_output(lambda a: api.max(a, axis=-1), lambda a: np.max(a, -1), [x])
+    check_output(lambda a: api.prod(a, axis=0), lambda a: np.prod(a, 0), [x])
+    check_output(lambda a: api.std(a, axis=1), lambda a: np.std(a, 1, ddof=1), [x])
+    check_output(lambda a: api.logsumexp(a, axis=1),
+                 lambda a: np.log(np.sum(np.exp(a), 1)), [x])
+
+
+def test_argmax_argmin():
+    x = _f32(4, 7)
+    out = api.argmax(paddle.to_tensor(x), axis=1)
+    np.testing.assert_array_equal(out.numpy(), np.argmax(x, 1))
+    out = api.argmin(paddle.to_tensor(x))
+    assert int(out.item()) == int(np.argmin(x))
+
+
+def test_topk():
+    x = _f32(3, 10)
+    vals, idx = api.topk(paddle.to_tensor(x), 4)
+    np.testing.assert_allclose(vals.numpy(), -np.sort(-x, axis=-1)[:, :4], atol=1e-6)
+
+
+def test_manipulation():
+    x = _f32(2, 3, 4)
+    check_output(lambda a: api.reshape(a, [6, 4]), lambda a: a.reshape(6, 4), [x])
+    check_output(lambda a: api.transpose(a, [2, 0, 1]), lambda a: a.transpose(2, 0, 1), [x])
+    check_output(lambda a: api.flatten(a, 1), lambda a: a.reshape(2, 12), [x])
+    check_output(lambda a: api.squeeze(a, 1), lambda a: a.squeeze(1), [_f32(2, 1, 4)])
+    check_output(lambda a: api.unsqueeze(a, 0), lambda a: a[None], [x])
+    check_output(lambda a: api.tile(a, [2, 1, 1]), lambda a: np.tile(a, (2, 1, 1)), [x])
+    check_output(lambda a: api.flip(a, [0]), lambda a: np.flip(a, 0), [x])
+    check_output(lambda a: api.roll(a, 1, 0), lambda a: np.roll(a, 1, 0), [x])
+    check_output(lambda a, b: api.concat([a, b], axis=1),
+                 lambda a, b: np.concatenate([a, b], 1), [x, _f32(2, 2, 4)])
+    check_output(lambda a, b: api.stack([a, b]), lambda a, b: np.stack([a, b]), [x, _f32(2, 3, 4)])
+
+
+def test_split_chunk():
+    x = _f32(6, 4)
+    parts = api.split(paddle.to_tensor(x), 3)
+    assert len(parts) == 3 and parts[0].shape == [2, 4]
+    parts = api.split(paddle.to_tensor(x), [1, 2, -1])
+    assert [p.shape[0] for p in parts] == [1, 2, 3]
+
+
+def test_gather_scatter():
+    x = _f32(5, 3)
+    idx = np.array([0, 2, 4])
+    out = api.gather(paddle.to_tensor(x), paddle.to_tensor(idx))
+    np.testing.assert_allclose(out.numpy(), x[idx])
+    upd = _f32(3, 3)
+    out = api.scatter(paddle.to_tensor(x), paddle.to_tensor(idx), paddle.to_tensor(upd))
+    ref = x.copy()
+    ref[idx] = upd
+    np.testing.assert_allclose(out.numpy(), ref)
+
+
+def test_where_masking():
+    x, y = _f32(3, 4), _f32(3, 4)
+    cond = x > 0
+    check_output(lambda a, b: api.where(paddle.to_tensor(cond), a, b),
+                 lambda a, b: np.where(cond, a, b), [x, y])
+    out = api.masked_fill(paddle.to_tensor(x), paddle.to_tensor(cond), 0.0)
+    np.testing.assert_allclose(out.numpy(), np.where(cond, 0.0, x))
+
+
+def test_tril_triu_diag():
+    x = _f32(4, 4)
+    check_output(lambda a: api.tril(a), np.tril, [x])
+    check_output(lambda a: api.triu(a, 1), lambda a: np.triu(a, 1), [x])
+    v = _f32(3)
+    d = api.diag_embed(paddle.to_tensor(v), offset=-1)
+    assert d.shape == [4, 4]
+    np.testing.assert_allclose(np.diagonal(d.numpy(), -1), v, atol=1e-6)
+
+
+def test_sort_argsort_unique():
+    x = _f32(3, 6)
+    check_output(lambda a: api.sort(a, axis=1), lambda a: np.sort(a, 1), [x])
+    idx = api.argsort(paddle.to_tensor(x), axis=1)
+    np.testing.assert_array_equal(idx.numpy(), np.argsort(x, 1, kind="stable"))
+
+
+def test_cumsum_cumprod():
+    x = _f32(3, 4)
+    check_output(lambda a: api.cumsum(a, axis=1), lambda a: np.cumsum(a, 1), [x], atol=1e-5)
+    check_output(lambda a: api.cumprod(a, dim=0), lambda a: np.cumprod(a, 0), [x], atol=1e-5)
+
+
+def test_logic_ops():
+    x, y = _f32(3, 4), _f32(3, 4)
+    check_output(api.equal, np.equal, [x, x.copy()])
+    check_output(api.less_than, np.less, [x, y])
+    check_output(lambda a, b: api.logical_and(a > 0, b > 0),
+                 lambda a, b: (a > 0) & (b > 0), [x, y])
+    assert bool(api.allclose(paddle.to_tensor(x), paddle.to_tensor(x + 1e-9)).item())
+
+
+def test_creation():
+    assert api.zeros([2, 3]).shape == [2, 3]
+    assert str(api.ones([2], dtype="int32").numpy().dtype) == "int32"
+    np.testing.assert_array_equal(api.arange(0, 10, 2).numpy(), np.arange(0, 10, 2))
+    np.testing.assert_allclose(api.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5))
+    np.testing.assert_allclose(api.eye(3).numpy(), np.eye(3))
+    assert api.full([2, 2], 7.0).numpy().tolist() == [[7.0, 7.0], [7.0, 7.0]]
+
+
+def test_linalg():
+    a = _f32(4, 4)
+    spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    chol = api.cholesky(paddle.to_tensor(spd))
+    np.testing.assert_allclose(chol.numpy() @ chol.numpy().T, spd, atol=1e-4)
+    inv = api.inverse(paddle.to_tensor(spd))
+    np.testing.assert_allclose(inv.numpy() @ spd, np.eye(4), atol=1e-4)
+    check_output(lambda x: api.trace(x), np.trace, [a])
+    check_output(lambda x: api.norm(x), lambda x: np.linalg.norm(x), [a], atol=1e-5)
+    out = api.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(a))
+    np.testing.assert_allclose(out.numpy(), a @ a, atol=1e-4)
+
+
+def test_one_hot_embedding():
+    idx = np.array([0, 2, 1])
+    oh = api.one_hot(paddle.to_tensor(idx), 4)
+    np.testing.assert_allclose(oh.numpy(), np.eye(4, dtype=np.float32)[idx])
+    w = _f32(10, 5)
+    emb = api.embedding(paddle.to_tensor(idx), paddle.to_tensor(w))
+    np.testing.assert_allclose(emb.numpy(), w[idx])
+
+
+def test_softmax_family():
+    x = _f32(3, 5)
+    sm = api.softmax(paddle.to_tensor(x), axis=-1)
+    e = np.exp(x - x.max(-1, keepdims=True))
+    np.testing.assert_allclose(sm.numpy(), e / e.sum(-1, keepdims=True), atol=1e-5)
+    np.testing.assert_allclose(sm.numpy().sum(-1), np.ones(3), atol=1e-5)
+    ls = api.log_softmax(paddle.to_tensor(x), axis=-1)
+    np.testing.assert_allclose(np.exp(ls.numpy()), sm.numpy(), atol=1e-5)
+
+
+def test_tensor_methods_and_operators():
+    x = paddle.to_tensor(_f32(3, 3))
+    y = paddle.to_tensor(_f32(3, 3))
+    np.testing.assert_allclose((x + y).numpy(), x.numpy() + y.numpy(), atol=1e-6)
+    np.testing.assert_allclose((x - 2.0).numpy(), x.numpy() - 2.0, atol=1e-6)
+    np.testing.assert_allclose((x * y).numpy(), x.numpy() * y.numpy(), atol=1e-6)
+    np.testing.assert_allclose((x @ y).numpy(), x.numpy() @ y.numpy(), atol=1e-5)
+    np.testing.assert_allclose((-x).numpy(), -x.numpy())
+    np.testing.assert_allclose(x.t().numpy(), x.numpy().T)
+    np.testing.assert_allclose(x.astype("float64").numpy().astype(np.float32), x.numpy())
+    assert x[0].shape == [3]
+    assert x[:, 1].shape == [3]
+    x2 = paddle.to_tensor(np.zeros((3, 3), np.float32))
+    x2[0, 0] = 5.0
+    assert x2.numpy()[0, 0] == 5.0
+
+
+def test_inplace_ops():
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    x.add_(paddle.to_tensor(np.ones((2, 2), np.float32)))
+    np.testing.assert_allclose(x.numpy(), 2 * np.ones((2, 2)))
+    x.scale_(0.5)
+    np.testing.assert_allclose(x.numpy(), np.ones((2, 2)))
+    x.zero_()
+    np.testing.assert_allclose(x.numpy(), np.zeros((2, 2)))
+
+
+def test_infer_meta():
+    from paddle_tpu.ops import get_op
+
+    meta = get_op("matmul").infer_meta(
+        paddle.to_tensor(_f32(3, 4)), paddle.to_tensor(_f32(4, 7)))
+    assert tuple(meta.shape) == (3, 7)
+    assert meta.dtype == np.float32

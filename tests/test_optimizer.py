@@ -1,0 +1,151 @@
+"""Optimizer + LR scheduler tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def _quadratic_problem():
+    target = np.array([3.0, -2.0, 1.0], np.float32)
+    w = paddle.to_tensor(np.zeros(3, np.float32), stop_gradient=False)
+    w.trainable = True
+
+    def loss_fn():
+        return ((w - paddle.to_tensor(target)) ** 2).sum()
+
+    return w, target, loss_fn
+
+
+@pytest.mark.parametrize("opt_cls,kwargs", [
+    (optimizer.SGD, dict(learning_rate=0.1)),
+    (optimizer.Momentum, dict(learning_rate=0.05, momentum=0.9)),
+    (optimizer.Adam, dict(learning_rate=0.3)),
+    (optimizer.AdamW, dict(learning_rate=0.3, weight_decay=0.0)),
+    (optimizer.Adagrad, dict(learning_rate=1.0)),
+    (optimizer.RMSProp, dict(learning_rate=0.05)),
+    (optimizer.Lamb, dict(learning_rate=0.02, lamb_weight_decay=0.0)),
+])
+def test_optimizers_converge_quadratic(opt_cls, kwargs):
+    w, target, loss_fn = _quadratic_problem()
+    opt = opt_cls(parameters=[w], **kwargs)
+    steps = 400 if opt_cls is optimizer.Lamb else 100  # trust-ratio needs a gentler schedule
+    for _ in range(steps):
+        loss = loss_fn()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    np.testing.assert_allclose(w.numpy(), target, atol=0.15)
+
+
+def test_adam_matches_reference_formula():
+    w = paddle.to_tensor(np.array([1.0], np.float32), stop_gradient=False)
+    w.trainable = True
+    opt = optimizer.Adam(learning_rate=0.1, parameters=[w], beta1=0.9, beta2=0.999, epsilon=1e-8)
+    w.grad = paddle.to_tensor(np.array([0.5], np.float32))
+    opt.step()
+    # bias-corrected first step: update = lr * g/|g| -> exactly lr for adam
+    m = 0.1 * 0.5
+    v = 0.001 * 0.25
+    m_hat = m / 0.1
+    v_hat = v / 0.001
+    exp = 1.0 - 0.1 * m_hat / (np.sqrt(v_hat) + 1e-8)
+    np.testing.assert_allclose(w.numpy(), [exp], atol=1e-6)
+
+
+def test_adamw_decoupled_decay():
+    w = paddle.to_tensor(np.array([1.0], np.float32), stop_gradient=False)
+    w.trainable = True
+    opt = optimizer.AdamW(learning_rate=0.1, parameters=[w], weight_decay=0.5)
+    w.grad = paddle.to_tensor(np.array([0.0], np.float32))
+    opt.step()
+    # zero grad: only decay applies -> w *= (1 - lr*wd)
+    np.testing.assert_allclose(w.numpy(), [1.0 * (1 - 0.1 * 0.5)], atol=1e-6)
+
+
+def test_weight_decay_coupled_sgd():
+    w = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    w.trainable = True
+    opt = optimizer.SGD(learning_rate=0.1, parameters=[w], weight_decay=0.1)
+    w.grad = paddle.to_tensor(np.array([0.0], np.float32))
+    opt.step()
+    np.testing.assert_allclose(w.numpy(), [2.0 - 0.1 * (0.1 * 2.0)], atol=1e-6)
+
+
+def test_grad_clip_in_optimizer():
+    w = paddle.to_tensor(np.zeros(4, np.float32), stop_gradient=False)
+    w.trainable = True
+    opt = optimizer.SGD(learning_rate=1.0, parameters=[w],
+                        grad_clip=nn.ClipGradByGlobalNorm(1.0))
+    w.grad = paddle.to_tensor(np.full(4, 10.0, np.float32))
+    opt.step()
+    np.testing.assert_allclose(np.linalg.norm(w.numpy()), 1.0, atol=1e-5)
+
+
+def test_optimizer_state_dict_roundtrip():
+    w = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    w.trainable = True
+    w.name = "w"
+    opt = optimizer.Adam(learning_rate=0.1, parameters=[w])
+    w.grad = paddle.to_tensor(np.ones(3, np.float32))
+    opt.step()
+    sd = opt.state_dict()
+    w2 = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    w2.trainable = True
+    w2.name = "w"
+    opt2 = optimizer.Adam(learning_rate=0.1, parameters=[w2])
+    opt2.set_state_dict(sd)
+    np.testing.assert_allclose(
+        np.asarray(opt2._get_state(w2)["moment1"]),
+        np.asarray(opt._get_state(w)["moment1"]))
+
+
+def test_lr_scheduler_basic():
+    lr = optimizer.lr.StepDecay(learning_rate=1.0, step_size=2, gamma=0.1)
+    vals = []
+    for _ in range(5):
+        vals.append(lr())
+        lr.step()
+    np.testing.assert_allclose(vals, [1.0, 1.0, 0.1, 0.1, 0.01], atol=1e-9)
+
+
+def test_lr_warmup():
+    sched = optimizer.lr.LinearWarmup(learning_rate=1.0, warmup_steps=4, start_lr=0.0, end_lr=1.0)
+    vals = [sched() for _ in range(1)]
+    for _ in range(4):
+        sched.step()
+        vals.append(sched())
+    np.testing.assert_allclose(vals, [0.0, 0.25, 0.5, 0.75, 1.0], atol=1e-6)
+
+
+def test_cosine_decay():
+    sched = optimizer.lr.CosineAnnealingDecay(learning_rate=1.0, T_max=10)
+    assert abs(sched() - 1.0) < 1e-6
+    for _ in range(10):
+        sched.step()
+    assert abs(sched()) < 1e-6
+
+
+def test_optimizer_with_scheduler_in_loop():
+    w = paddle.to_tensor(np.zeros(1, np.float32), stop_gradient=False)
+    w.trainable = True
+    sched = optimizer.lr.ExponentialDecay(learning_rate=0.5, gamma=0.5)
+    opt = optimizer.SGD(learning_rate=sched, parameters=[w])
+    w.grad = paddle.to_tensor(np.ones(1, np.float32))
+    opt.step()  # lr = 0.5
+    sched.step()
+    w.grad = paddle.to_tensor(np.ones(1, np.float32))
+    opt.step()  # lr = 0.25
+    np.testing.assert_allclose(w.numpy(), [-0.75], atol=1e-6)
+
+
+def test_multi_precision_master_weights():
+    w = paddle.to_tensor(np.ones(4, np.float32).astype(np.float32), stop_gradient=False)
+    w._value = w._value.astype("bfloat16")
+    w.trainable = True
+    opt = optimizer.Adam(learning_rate=1e-3, parameters=[w], multi_precision=True)
+    w.grad = paddle.to_tensor(np.full(4, 0.1, np.float32))
+    opt.step()
+    state = opt._get_state(w)
+    assert "master" in state
+    assert str(np.asarray(state["master"]).dtype) == "float32"

@@ -1,0 +1,105 @@
+"""Benchmark: GPT pretrain step throughput on the local accelerator.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+
+Baseline: BASELINE.md north star is >=0.40 MFU for GPT hybrid pretrain;
+vs_baseline = achieved_MFU / 0.40 (the reference repo publishes no numbers,
+see BASELINE.md). Runs the full compiled train step (forward+backward+AdamW,
+donated buffers) with bf16 matmuls via amp auto_cast.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+
+    backend = jax.default_backend()
+    on_accel = backend not in ("cpu",)
+    log(f"backend={backend} devices={jax.devices()}")
+
+    import paddle_tpu as paddle
+    from paddle_tpu import amp, nn, optimizer
+    from paddle_tpu.jit.trainer import TrainStep
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    if on_accel:
+        cfg = GPTConfig(
+            vocab_size=50304, hidden_size=768, num_layers=12, num_heads=12,
+            max_position_embeddings=1024,
+            hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+        )
+        batch, seq = 8, 512
+        timed_steps = 10
+    else:  # CPU smoke fallback so the driver always gets a line
+        cfg = GPTConfig.tiny()
+        batch, seq = 2, 64
+        timed_steps = 3
+
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    log(f"params: {n_params / 1e6:.1f}M  batch={batch} seq={seq}")
+
+    opt = optimizer.AdamW(1e-4, parameters=model.parameters(), weight_decay=0.01)
+
+    def loss_fn(ids):
+        with amp.auto_cast(level="O1", dtype="bfloat16"):
+            return model(ids, labels=ids)
+
+    step = TrainStep(model, loss_fn, opt)
+
+    ids = paddle.to_tensor(
+        np.random.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+
+    t0 = time.time()
+    loss = step(ids)
+    loss.block_until_ready()
+    log(f"compile+first step: {time.time() - t0:.1f}s loss={float(loss.item()):.3f}")
+    step(ids).block_until_ready()  # warm
+
+    t0 = time.time()
+    for _ in range(timed_steps):
+        loss = step(ids)
+    loss.block_until_ready()
+    dt = time.time() - t0
+    sps = timed_steps / dt
+    tokens_per_sec = sps * batch * seq
+
+    # FLOPs/token for a decoder: 6*N (fwd+bwd matmuls) + 12*L*h*s attention term
+    flops_per_token = 6.0 * n_params + 12.0 * cfg.num_layers * cfg.hidden_size * seq
+    achieved_flops = tokens_per_sec * flops_per_token
+
+    peak = float(os.environ.get("BENCH_PEAK_FLOPS", 0)) or (
+        197e12 if on_accel else 1e12)  # v5e bf16 peak; override for v5p (459e12)
+    mfu = achieved_flops / peak
+
+    result = {
+        "metric": "gpt_pretrain_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "mfu": round(mfu, 4),
+        "params_millions": round(n_params / 1e6, 1),
+        "batch": batch,
+        "seq": seq,
+        "steps_per_sec": round(sps, 3),
+        "backend": backend,
+        "final_loss": round(float(loss.item()), 4),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

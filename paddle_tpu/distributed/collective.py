@@ -131,8 +131,12 @@ def _bound_axis(group: Optional[Group]) -> Optional[str]:
 
 
 def _axis_size(axis_name: str, group: Optional[Group]) -> int:
-    """Size of a bound mesh axis, resolved at collective time (the mesh may
-    have been (re)built after the group was created)."""
+    """Size of a bound mesh axis, resolved INSIDE the trace (the binding mesh
+    may differ from the global one, and groups may predate the mesh)."""
+    try:
+        return int(lax.axis_size(axis_name))
+    except Exception:
+        pass
     from .mesh import get_mesh
 
     mesh = get_mesh()

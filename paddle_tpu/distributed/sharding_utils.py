@@ -1,0 +1,82 @@
+"""Parameter/optimizer sharding placement.
+
+Reference analogs: sharding stage 1-3 param/state partitioning
+(fleet/meta_parallel/sharding/group_sharded_*.py) and the DP/TP layout logic
+in HybridParallelOptimizer. TPU-native: placement = NamedSharding on the
+param's jax.Array; XLA GSPMD derives gradient/optimizer-state layouts and the
+matching collectives (reduce-scatter for ZeRO, all-reduce for pure DP).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+
+def _compose_zero(spec: PartitionSpec, shape, mesh: Mesh, axis: str) -> PartitionSpec:
+    """Add ZeRO-style sharding over `axis` on the first dim not already sharded
+    and divisible by the axis size."""
+    n = mesh.shape[axis]
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    used = set()
+    for e in entries:
+        if e is None:
+            continue
+        for a in (e if isinstance(e, tuple) else (e,)):
+            used.add(a)
+    if axis in used:
+        return PartitionSpec(*entries)
+    for i, (e, s) in enumerate(zip(entries, shape)):
+        if s % n != 0 or s // n == 0:
+            continue
+        if e is None:
+            entries[i] = axis
+            return PartitionSpec(*entries)
+        prev = e if isinstance(e, tuple) else (e,)
+        covered = 1
+        for a in prev:
+            covered *= mesh.shape[a]
+        if s % (covered * n) == 0:
+            entries[i] = tuple(prev) + (axis,)
+            return PartitionSpec(*entries)
+    return PartitionSpec(*entries)
+
+
+def shard_model_parameters(
+    model: Layer,
+    mesh: Mesh,
+    zero_axis: Optional[str] = None,
+):
+    """Place every param/buffer on `mesh`: TP layers carry `_pspec` annotations
+    (Column/Row/VocabParallel); everything else replicates, optionally
+    ZeRO-sharded over `zero_axis` (stage-3 style param partitioning)."""
+    for p in list(model.parameters()) + list(model.buffers()):
+        spec = getattr(p, "_pspec", None) or PartitionSpec()
+        if zero_axis is not None and zero_axis in mesh.axis_names and mesh.shape[zero_axis] > 1:
+            spec = _compose_zero(spec, p._value.shape, mesh, zero_axis)
+        try:
+            p._value = jax.device_put(p._value, NamedSharding(mesh, spec))
+        except Exception:
+            p._value = jax.device_put(p._value, NamedSharding(mesh, PartitionSpec()))
+    return model
+
+
+def shard_batch(batch, mesh: Mesh, axes=("dp",)):
+    """Shard leading batch dim over the data axes."""
+    names = tuple(a for a in axes if a in mesh.axis_names and mesh.shape[a] > 1)
+    spec = PartitionSpec(names if len(names) > 1 else (names[0] if names else None))
+    sharding = NamedSharding(mesh, spec)
+
+    def place(x):
+        v = x._value if isinstance(x, Tensor) else x
+        out = jax.device_put(v, sharding)
+        if isinstance(x, Tensor):
+            x._value = out
+            return x
+        return Tensor(out)
+
+    return jax.tree_util.tree_map(place, batch, is_leaf=lambda v: isinstance(v, Tensor))

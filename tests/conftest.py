@@ -1,28 +1,42 @@
 """Test configuration: CPU backend with 8 virtual devices, so distributed
 tests exercise real mesh sharding without TPU hardware (the reference's
-custom_cpu fake-device trick, SURVEY.md §4)."""
+custom_cpu fake-device trick, SURVEY.md §4).
+
+The driver environment pre-imports jax via a sitecustomize that registers the
+TPU tunnel ('axon') — env vars alone are read too early to help, so we also
+reconfigure via jax.config and clear any already-initialized backends.
+"""
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # force: the driver env may preset 'axon'
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+if _xb.backends_are_initialized():
+    import jax.extend.backend as _jeb
+
+    _jeb.clear_backends()
+    jax.config.update("jax_platforms", "cpu")  # plugin config hooks may rewrite it
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
 def pytest_configure(config):
-    # Fail loudly if jax initialized before our env override took effect
-    # (e.g. a sitecustomize that eagerly creates a backend).
-    import jax
-
     backend = jax.default_backend()
     if backend != "cpu" or jax.device_count() < 8:
         raise RuntimeError(
             f"tests need the 8-device CPU mesh but jax initialized as "
-            f"{backend!r} with {jax.device_count()} device(s); jax was likely "
-            "imported before tests/conftest.py set JAX_PLATFORMS/XLA_FLAGS."
+            f"{backend!r} with {jax.device_count()} device(s); the conftest "
+            "backend reset failed — run with JAX_PLATFORMS=cpu "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8 and "
+            "PYTHONPATH=/root/repo."
         )
 
 

@@ -133,13 +133,6 @@ def test_dataloader_shuffle_differs():
 
 
 def test_dataloader_threaded_workers_order():
-    class SlowDS(paddle.io.Dataset if hasattr(paddle, "io") else object):
-        def __getitem__(self, i):
-            return np.float32(i)
-
-        def __len__(self):
-            return 20
-
     from paddle_tpu.io import Dataset
 
     class DS(Dataset):

@@ -74,3 +74,16 @@ define_flag("low_precision_op_list", False, "Record ops executed in low precisio
 define_flag("benchmark", False, "Synchronize after every op (timing mode).")
 define_flag("use_donated_buffers", True, "Donate param/opt-state buffers in compiled steps.")
 define_flag("default_seed", 0, "Global RNG seed when none set explicitly.")
+define_flag(
+    "use_flash_attention", True,
+    "Use the Pallas flash-attention kernel on TPU when shapes allow.",
+)
+define_flag(
+    "pallas_interpret", False,
+    "Run Pallas kernels in interpreter mode (CPU debugging/CI only — the "
+    "interpreter is orders of magnitude slower than the XLA fallback).",
+)
+define_flag(
+    "use_fused_adamw", True,
+    "Use the fused Pallas AdamW update on TPU (one kernel over all params).",
+)

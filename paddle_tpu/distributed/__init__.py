@@ -52,5 +52,14 @@ from .mesh import (  # noqa: F401
     get_mesh,
     set_mesh,
 )
+from .context_parallel import (  # noqa: F401
+    RingAttention,
+    all_gather_seq,
+    gather_seq,
+    reduce_scatter_seq,
+    ring_attention,
+    scatter_seq,
+    ulysses_attention,
+)
 from .parallel import DataParallel  # noqa: F401
 from .sharded import shard_map, shard_tensor_to, sharded_fn  # noqa: F401

@@ -1,0 +1,185 @@
+"""Context (sequence) parallelism: ring attention + Ulysses (DeepSpeed-style).
+
+The reference has NO context parallelism (SURVEY.md §5.7 — ring_attention /
+ulysses / context_parallel: absent); only Megatron SP utilities
+(fleet/utils/sequence_parallel_utils.py) exist. This module is the fresh
+TPU-first design the survey calls for: the sequence dimension is a first-class
+mesh axis ("sep"), attention over it runs as
+
+  - ring_attention: K/V chunks rotate around the ICI ring via
+    lax.ppermute; partial softmax results merge with the online-softmax
+    (logsumexp) combine. O(s_local * s_global) compute per device,
+    O(s_local) memory — arbitrary context length scales linearly with the
+    ring size.
+  - ulysses_attention: all-to-all swaps the sharded dim from sequence to
+    heads, runs dense (flash) attention on full sequences for h/n heads,
+    and swaps back. Cheaper when heads >= ring size; exact same math.
+
+These are functions of *local shards*, designed to be called inside
+shard_map/jit over the mesh — the idiom everything in paddle_tpu.jit compiles
+through. All softmax statistics are fp32 regardless of input dtype.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _chunk_attention(q, k, v, scale, extra_mask):
+    """Dense attention on one KV chunk returning per-row logsumexp.
+
+    q: [b, sq, h, d]; k, v: [b, sk, h, d]; extra_mask: [sq, sk] additive fp32
+    (0 or NEG_INF) or None. Returns (o [b,sq,h,d] fp32, lse [b,h,sq] fp32).
+    """
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    if extra_mask is not None:
+        s = s + extra_mask[None, None, :, :]
+    m = jnp.max(s, axis=-1)  # [b,h,sq]
+    m = jnp.maximum(m, NEG_INF)  # keep finite when a row is fully masked
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)  # [b,h,sq]
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+    # normalized chunk output
+    o = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return o, lse
+
+
+def _combine(o, lse, o_i, lse_i):
+    """Merge two normalized partial attentions by their logsumexps."""
+    new_lse = jnp.logaddexp(lse, lse_i)
+    w = jnp.exp(lse - new_lse).transpose(0, 2, 1)[..., None]  # [b,sq,h,1]
+    w_i = jnp.exp(lse_i - new_lse).transpose(0, 2, 1)[..., None]
+    return o * w + o_i * w_i, new_lse
+
+
+def ring_attention(q, k, v, axis_name, causal=False, scale=None):
+    """Ring attention over the `axis_name` mesh axis (call inside shard_map).
+
+    q, k, v: LOCAL sequence shards [b, s_local, h, d]; global sequence is the
+    concatenation over the axis in rank order. Returns the local output shard.
+
+    Causal handling: the incoming chunk index src = (rank - step) mod n; a
+    chunk strictly in the future (src > rank) is fully masked, the diagonal
+    chunk (src == rank) gets the causal mask, past chunks are unmasked. The
+    masked-chunk compute is wasted work (~2x for causal) — the zigzag
+    load-balanced layout is a follow-up optimization.
+    """
+    n = lax.axis_size(axis_name)
+    r = lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+
+    causal_mask = None
+    if causal:
+        ids = jnp.arange(sq)
+        causal_mask = jnp.where(
+            ids[:, None] >= ids[None, :], 0.0, NEG_INF
+        ).astype(jnp.float32)
+
+    o = jnp.zeros((b, sq, h, d), jnp.float32)
+    lse = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    kc, vc = k, v
+    for step in range(n):
+        src = (r - step) % n
+        if causal:
+            # additive mask selected by traced comparison, single code path
+            full_neg = jnp.full((sq, sq), NEG_INF, jnp.float32)
+            zero = jnp.zeros((sq, sq), jnp.float32)
+            extra = jnp.where(
+                src == r, causal_mask, jnp.where(src > r, full_neg, zero)
+            )
+        else:
+            extra = None
+        o_i, lse_i = _chunk_attention(q, kc, vc, scale, extra)
+        o, lse = _combine(o, lse, o_i, lse_i)
+        if step != n - 1:
+            kc, vc = lax.ppermute((kc, vc), axis_name, perm)
+    return o.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name, causal=False, scale=None,
+                      dense_fn=None):
+    """Ulysses/all-to-all sequence parallelism (call inside shard_map).
+
+    Swaps the sharded dimension seq<->heads with two all-to-alls, runs dense
+    attention on the full sequence for h/n heads. Requires h % axis_size == 0.
+    """
+    n = lax.axis_size(axis_name)
+    b, sq, h, d = q.shape
+    if h % n != 0:
+        raise ValueError(f"ulysses needs heads ({h}) divisible by axis size ({n})")
+
+    def to_full_seq(x):
+        # [b, s/n, h, d] -> [b, s, h/n, d]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def to_shard_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    qf, kf, vf = to_full_seq(q), to_full_seq(k), to_full_seq(v)
+    if dense_fn is None:
+        if scale is None:
+            scale = 1.0 / math.sqrt(d)
+        s_full = qf.shape[1]
+        extra = None
+        if causal:
+            ids = jnp.arange(s_full)
+            extra = jnp.where(ids[:, None] >= ids[None, :], 0.0, NEG_INF).astype(jnp.float32)
+        of, _ = _chunk_attention(qf, kf, vf, scale, extra)
+        of = of.astype(q.dtype)
+    else:
+        of = dense_fn(qf, kf, vf)
+    return to_shard_seq(of)
+
+
+# ------------------------------------------------------------------ SP utils
+# Reference: fleet/utils/sequence_parallel_utils.py (ScatterOp:83, GatherOp,
+# AllGatherOp, ReduceScatterOp, :83-135) — Megatron sequence parallelism
+# around TP blocks. Same semantics as local-shard functions.
+def scatter_seq(x, axis_name):
+    """Keep this rank's 1/n slice of the sequence dim (ScatterOp)."""
+    n = lax.axis_size(axis_name)
+    r = lax.axis_index(axis_name)
+    chunk = x.shape[1] // n if x.ndim > 2 else x.shape[0] // n
+    dim = 1 if x.ndim > 2 else 0
+    return lax.dynamic_slice_in_dim(x, r * chunk, chunk, axis=dim)
+
+
+def all_gather_seq(x, axis_name, seq_axis=1):
+    """Gather sequence shards to the full sequence (AllGatherOp)."""
+    return lax.all_gather(x, axis_name, axis=seq_axis, tiled=True)
+
+
+def reduce_scatter_seq(x, axis_name, seq_axis=1):
+    """Sum partial activations and keep this rank's sequence slice
+    (ReduceScatterOp)."""
+    return lax.psum_scatter(x, axis_name, scatter_dimension=seq_axis, tiled=True)
+
+
+def gather_seq(x, axis_name, seq_axis=1):
+    """Alias of all_gather_seq (reference GatherOp gathers to all)."""
+    return all_gather_seq(x, axis_name, seq_axis)
+
+
+class RingAttention:
+    """Layer-style wrapper matching nn.functional.scaled_dot_product_attention
+    signature for sequence-sharded inputs (used by models under sep>1)."""
+
+    def __init__(self, axis_name="sep", causal=False):
+        self.axis_name = axis_name
+        self.causal = causal
+
+    def __call__(self, q, k, v):
+        return ring_attention(q, k, v, self.axis_name, causal=self.causal)

@@ -1,0 +1,8 @@
+"""paddle.incubate — experimental/fused API surface.
+
+Reference: python/paddle/incubate/ (fused transformer functional ops, MoE,
+ASP sparsity, LookAhead/ModelAverage optimizers).
+"""
+from __future__ import annotations
+
+from . import nn  # noqa: F401

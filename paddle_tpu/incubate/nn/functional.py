@@ -1,0 +1,62 @@
+"""paddle.incubate.nn.functional — fused ops.
+
+Reference: python/paddle/incubate/nn/functional/ (fused_rotary_position_
+embedding.py, rms_norm.py, memory_efficient_attention.py, fused_transformer.py).
+On TPU these route to the Pallas kernels in ops/pallas/ with the XLA
+composition as fallback.
+"""
+from __future__ import annotations
+
+from ...ops.api import (  # noqa: F401
+    rms_norm,
+    rotary_position_embedding as fused_rotary_position_embedding,
+    scaled_dot_product_attention,
+)
+
+# memory_efficient_attention: on TPU, flash attention IS the memory-efficient
+# attention (reference keeps two CUDA code paths; here they are one kernel).
+memory_efficient_attention = scaled_dot_product_attention
+
+
+def fused_multi_head_attention(x, qkv_weight, qkv_bias, linear_weight,
+                               linear_bias, num_heads, dropout_p=0.0,
+                               is_causal=False, training=True):
+    """Reference: incubate.nn.functional.fused_multi_head_attention
+    (fused_attention_op.cu). QKV projection + SDPA + out projection; XLA fuses
+    the projections into the attention kernel's neighborhood."""
+    from ...ops import api
+
+    b, s, d = x.shape
+    head_dim = d // num_heads
+    qkv = api.matmul(x, qkv_weight)
+    if qkv_bias is not None:
+        qkv = api.add(qkv, qkv_bias)
+    qkv = api.reshape(qkv, [b, s, 3, num_heads, head_dim])
+    q = api.squeeze(api.slice(qkv, axes=[2], starts=[0], ends=[1]), axis=[2])
+    k = api.squeeze(api.slice(qkv, axes=[2], starts=[1], ends=[2]), axis=[2])
+    v = api.squeeze(api.slice(qkv, axes=[2], starts=[2], ends=[3]), axis=[2])
+    out = api.scaled_dot_product_attention(
+        q, k, v, dropout_p=dropout_p, is_causal=is_causal, training=training
+    )
+    out = api.reshape(out, [b, s, d])
+    out = api.matmul(out, linear_weight)
+    if linear_bias is not None:
+        out = api.add(out, linear_bias)
+    return out
+
+
+def fused_feedforward(x, w1, b1, w2, b2, activation="gelu", dropout_p=0.0,
+                      training=True):
+    """Reference: incubate.nn.functional.fused_feedforward."""
+    from ...ops import api
+
+    h = api.matmul(x, w1)
+    if b1 is not None:
+        h = api.add(h, b1)
+    h = getattr(api, activation)(h)
+    if dropout_p > 0.0 and training:
+        h = api.dropout(h, dropout_p, training=True)
+    h = api.matmul(h, w2)
+    if b2 is not None:
+        h = api.add(h, b2)
+    return h

@@ -212,6 +212,17 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
 
 
 def rms_norm(x, weight=None, epsilon=1e-6):
+    from ...core import flags as _flags
+
+    if (
+        weight is not None
+        and weight.ndim == 1
+        and jax.default_backend() == "tpu"
+        and not _flags.get_flag("pallas_interpret")
+    ):
+        from ..pallas.fused_norm import fused_rms_norm as _fused
+
+        return _fused(x, weight, epsilon)
     xf = x.astype(jnp.float32)
     ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
     y = (xf * lax.rsqrt(ms + epsilon)).astype(x.dtype)
@@ -632,6 +643,26 @@ def scaled_dot_product_attention(
     sk = key.shape[1]
     if scale is None:
         scale = 1.0 / (d ** 0.5)
+
+    from ...core import flags as _flags
+    from .. import pallas as _pallas
+    from ..pallas.flash_attention import supports as _flash_supports
+
+    if (
+        _flags.get_flag("use_flash_attention")
+        and _pallas.pallas_enabled()
+        and _flash_supports(
+            query.shape, key.shape, attn_mask,
+            dropout_p if training else 0.0, is_causal,
+        )
+    ):
+        from ..pallas.flash_attention import flash_attention as _flash
+
+        return _flash(
+            query, key, value, scale, is_causal,
+            interpret=_pallas.interpret_mode(),
+        )
+
     q = jnp.einsum("bqhd->bhqd", query)
     k = jnp.einsum("bkhd->bhkd", key)
     v = jnp.einsum("bkhd->bhkd", value)
@@ -656,6 +687,26 @@ def scaled_dot_product_attention(
 def rotary_position_embedding(q, k, cos, sin, rotate_half=True):
     """Reference: incubate fused_rotary_position_embedding.
     q,k: [b, s, h, d]; cos,sin: [s, d] or broadcastable."""
+    from ...core import flags as _flags
+
+    # fused path accepts cos/sin as [s, d] or the canonical broadcast layout
+    # [1, s, 1, d] (seq at axis 1); anything else uses the XLA composition
+    def _seq_major(c):
+        return c.ndim == 2 or (
+            c.ndim == 4 and c.shape[0] == 1 and c.shape[2] == 1
+        )
+
+    if (
+        rotate_half
+        and _seq_major(cos)
+        and _seq_major(sin)
+        and q.shape[1] == (cos.shape[1] if cos.ndim == 4 else cos.shape[0])
+        and jax.default_backend() == "tpu"
+        and not _flags.get_flag("pallas_interpret")
+    ):
+        from ..pallas.rope import fused_rope as _fused
+
+        return _fused(q, k, cos, sin)
 
     def rot(x):
         if rotate_half:

@@ -1,0 +1,41 @@
+"""Pallas TPU kernels — the fused-op layer.
+
+Reference analog: the hand-written CUDA fusions the reference keeps in
+paddle/fluid/operators/fused/ (fused_attention_op.cu,
+fused_multi_transformer_op.cu) and phi/kernels/gpu/flash_attn_kernel.cu
+(dynloaded flashattn library), phi/kernels/fusion/. On TPU, XLA already fuses
+elementwise chains into matmuls, so the only kernels worth hand-writing are the
+ones XLA cannot produce: flash attention (online-softmax tiling), fused
+optimizer updates, and fused RoPE/RMSNorm when they sit on the HBM-bandwidth
+critical path.
+
+Every kernel here has:
+  - a Pallas TPU implementation (MXU-tiled, VMEM-resident blocks),
+  - an `interpret=True` mode so the same kernel runs on CPU CI,
+  - a jax.custom_vjp with a Pallas backward where it matters (attention).
+
+Selection is by flag (FLAGS_use_flash_attention etc.) + backend check; the
+plain-XLA composition in ops/kernels/ is always available as fallback.
+"""
+from __future__ import annotations
+
+import jax
+
+from ...core import flags
+
+
+def interpret_mode() -> bool:
+    """Interpreter mode is opt-in ONLY (FLAGS_pallas_interpret): the Pallas
+    interpreter runs block-by-block in Python and must never be auto-selected
+    over the XLA fallback just because the backend is CPU."""
+    return bool(flags.get_flag("pallas_interpret"))
+
+
+def pallas_enabled() -> bool:
+    return jax.default_backend() == "tpu" or interpret_mode()
+
+
+from .flash_attention import flash_attention  # noqa: E402,F401
+from .fused_adamw import fused_adamw_update  # noqa: E402,F401
+from .fused_norm import fused_rms_norm  # noqa: E402,F401
+from .rope import fused_rope  # noqa: E402,F401

@@ -1,0 +1,312 @@
+"""Flash attention (forward + backward) as Pallas TPU kernels.
+
+Reference behavior: phi/kernels/gpu/flash_attn_kernel.cu (+ flash_attn_grad)
+which dynloads the flash-attention CUDA library; Python surface
+paddle.nn.functional.scaled_dot_product_attention. Here the kernel is written
+for the TPU memory hierarchy instead: Q/K/V blocks staged in VMEM, online
+softmax carried in fp32, logsumexp residual saved for a recompute backward.
+
+Layout: inputs are [batch, seq, heads, head_dim] (the reference layout); the
+kernel internally processes one (batch*head) slice per grid row.
+
+Algorithm (standard two-pass-free online softmax):
+  fwd:  for each q block, stream k/v blocks, carry (m, l, acc); save
+        lse = m + log(l) per row.
+  bwd:  D = rowsum(dO * O); two kernels — dQ streams K/V per q block,
+        dK/dV stream Q/dO per k block — both recompute P from Q,K,lse.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _causal_mask(q_ids, k_ids):
+    return q_ids[:, None] >= k_ids[None, :]
+
+
+# ------------------------------------------------------------------- forward
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k, sk):
+    # q_ref: [block_q, d]; k_ref/v_ref: [sk, d]; o_ref: [block_q, d];
+    # lse_ref: [block_q]
+    qi = pl.program_id(1)
+    block_q = q_ref.shape[0]
+    d = q_ref.shape[1]
+    q = q_ref[:].astype(jnp.float32) * scale
+
+    nk = sk // block_k
+    if causal:
+        # only k blocks whose start is <= this q block's end participate
+        q_end = (qi + 1) * block_q
+        nk_live = jax.lax.div(q_end + block_k - 1, block_k)
+        nk_live = jnp.minimum(nk_live, nk)
+    else:
+        nk_live = nk
+
+    def body(j, carry):
+        m_prev, l_prev, acc = carry
+        k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [block_q, block_k]
+        if causal:
+            q_ids = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_ids = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+        m_cur = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l_prev * alpha + jnp.sum(p, axis=1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc
+
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, nk_live, body, (m0, l0, acc0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[:] = (acc / l[:, None]).astype(o_ref.dtype)
+    lse_ref[:] = m + jnp.log(l)
+
+
+def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    b, sq, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    sk = k.shape[1]
+    bh = b * h
+    # [b, s, h, d] -> [b*h, s, d]
+    qr = q.transpose(0, 2, 1, 3).reshape(bh, sq, d)
+    kr = k.transpose(0, 2, 1, 3).reshape(bh, sk, d)
+    vr = v.transpose(0, 2, 1, 3).reshape(bh, sk, d)
+
+    grid = (bh, sq // block_q)
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, scale=scale, causal=causal, block_k=block_k, sk=sk
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_q), lambda i, j: (i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    o = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    return o, (qr, kr, vr, out, lse)
+
+
+# ------------------------------------------------------------------ backward
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               *, scale, causal, block_k, sk):
+    qi = pl.program_id(1)
+    block_q = q_ref.shape[0]
+    d = q_ref.shape[1]
+    q = q_ref[:].astype(jnp.float32) * scale
+    do = do_ref[:].astype(jnp.float32)
+    lse = lse_ref[:]
+    delta = delta_ref[:]
+
+    nk = sk // block_k
+    if causal:
+        q_end = (qi + 1) * block_q
+        nk_live = jnp.minimum(jax.lax.div(q_end + block_k - 1, block_k), nk)
+    else:
+        nk_live = nk
+
+    def body(j, dq):
+        k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if causal:
+            q_ids = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_ids = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta[:, None])
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    dq = jax.lax.fori_loop(0, nk_live, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[:] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+                *, scale, causal, block_q, sq):
+    ki = pl.program_id(1)
+    block_k = k_ref.shape[0]
+    d = k_ref.shape[1]
+    k = k_ref[:].astype(jnp.float32)
+    v = v_ref[:].astype(jnp.float32)
+
+    nq = sq // block_q
+    if causal:
+        # only q blocks whose end is past this k block's start participate
+        k_start = ki * block_k
+        j0 = jax.lax.div(k_start, block_q)
+    else:
+        j0 = 0
+
+    def body(j, carry):
+        dk, dv = carry
+        q = q_ref[pl.ds(j * block_q, block_q), :].astype(jnp.float32) * scale
+        do = do_ref[pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(j * block_q, block_q)]
+        delta = delta_ref[pl.ds(j * block_q, block_q)]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [block_q, block_k]
+        if causal:
+            q_ids = j * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_ids = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta[:, None])
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk, dv
+
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(j0, nq, body, (dk0, dv0))
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(scale, causal, block_q, block_k, interpret, res, g):
+    qr, kr, vr, outr, lse = res
+    bh, sq, d = qr.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    sk = kr.shape[1]
+    do = g.transpose(0, 2, 1, 3).reshape(bh, sq, d)
+    # delta = rowsum(dO * O), fp32
+    delta = jnp.sum(do.astype(jnp.float32) * outr.astype(jnp.float32), axis=-1)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, scale=scale, causal=causal, block_k=block_k, sk=sk
+        ),
+        grid=(bh, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((None, block_q), lambda i, j: (i, j)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), qr.dtype),
+        interpret=interpret,
+    )(qr, kr, vr, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, scale=scale, causal=causal, block_q=block_q, sq=sq
+        ),
+        grid=(bh, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((None, sq, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, sq, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, sq), lambda i, j: (i, 0)),
+            pl.BlockSpec((None, sq), lambda i, j: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), kr.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), vr.dtype),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr, do, lse, delta)
+
+    b = g.shape[0]
+    h = g.shape[2]
+    un = lambda x, s: x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    return un(dq, sq), un(dk, sk), un(dv, sk)
+
+
+# ---------------------------------------------------------------- public API
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(
+    q, k, v, scale=None, causal=False,
+    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K, interpret=False,
+):
+    """Flash attention on [b, s, h, d] inputs. Differentiable (custom VJP with
+    Pallas backward). Requires seq lengths divisible by the block sizes."""
+    o, _ = _fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return o
+
+
+def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, res = _fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return o, res
+
+
+def _flash_bwd_rule(scale, causal, block_q, block_k, interpret, res, g):
+    return _bwd(scale, causal, block_q, block_k, interpret, res, g)
+
+
+flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def supports(q_shape, k_shape, attn_mask, dropout_p, is_causal=False,
+             block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K) -> bool:
+    """Shape gate: fall back to the XLA composition otherwise.
+
+    Causal with sq != sk is rejected: this kernel's mask is top-left aligned
+    (absolute q_id >= k_id) while the sdpa fallback is bottom-right aligned
+    (query i sees keys j <= i + sk - sq, the KV-cache decode convention).
+    """
+    b, sq, h, d = q_shape
+    sk = k_shape[1]
+    return (
+        attn_mask is None
+        and dropout_p == 0.0
+        and sq % block_q == 0
+        and sk % block_k == 0
+        and sq >= block_q
+        and sk >= block_k
+        and d <= 256
+        and not (is_causal and sq != sk)
+    )

@@ -93,6 +93,71 @@ class AdamW(Adam):
         ns["wd_on"] = state.get("wd_on", 1.0)
         return new_p, ns
 
+    def step(self):
+        """Eager step with the fused Pallas path on TPU: all params of one
+        (dtype, wd) group update in ONE kernel over a flat buffer
+        (reference: fused_adam_kernel.cu multi-tensor Adam) instead of one
+        program dispatch per parameter."""
+        import jax
+
+        from ..core import flags as _flags
+
+        if not (
+            _flags.get_flag("use_fused_adamw")
+            and jax.default_backend() == "tpu"
+            and not self._multi_precision
+        ):
+            return super().step()
+
+        from ..core.autograd import no_grad
+        from ..core.tensor import Tensor
+        from ..ops.pallas import interpret_mode
+        from ..ops.pallas.fused_adamw import fused_adamw_update
+
+        interp = interpret_mode()
+
+        with no_grad():
+            lr = self.get_lr()
+            params_grads = [
+                (p, p.grad) for p in self._parameter_list
+                if p.grad is not None and p.trainable
+            ]
+            if self._grad_clip is not None:
+                params_grads = self._grad_clip(params_grads)
+            groups = {}
+            for p, g in params_grads:
+                state = self._get_state(p)
+                key = (str(p.dtype), state.get("wd_on", 1.0))
+                groups.setdefault(key, []).append((p, g, state))
+            t = self._step_count + 1
+            for (_, wd_on), items in groups.items():
+                sizes = [p._value.size for p, _, _ in items]
+                flat = lambda x: x.reshape(-1)
+                pbuf = jnp.concatenate([flat(p._value) for p, _, _ in items])
+                gbuf = jnp.concatenate([
+                    flat((g._value if isinstance(g, Tensor) else g)).astype(pbuf.dtype)
+                    for p, g, _ in items
+                ])
+                mbuf = jnp.concatenate([flat(s["moment1"]) for _, _, s in items])
+                vbuf = jnp.concatenate([flat(s["moment2"]) for _, _, s in items])
+                po, mo, vo = fused_adamw_update(
+                    pbuf, gbuf, mbuf, vbuf, lr=lr, beta1=self._beta1,
+                    beta2=self._beta2, eps=self._eps,
+                    weight_decay=self._decoupled_wd * wd_on, step=t,
+                    interpret=interp,
+                )
+                off = 0
+                for (p, _, s), n in zip(items, sizes):
+                    shape = p._value.shape
+                    p._value = po[off:off + n].reshape(shape)
+                    s["moment1"] = mo[off:off + n].reshape(shape)
+                    s["moment2"] = vo[off:off + n].reshape(shape)
+                    s["beta1_pow"] = s["beta1_pow"] * self._beta1
+                    s["beta2_pow"] = s["beta2_pow"] * self._beta2
+                    self._state[id(p)] = s
+                    off += n
+            self._step_count += 1
+
 
 class Adagrad(Optimizer):
     def __init__(self, learning_rate, epsilon=1e-6, parameters=None,

@@ -1,0 +1,116 @@
+"""Context-parallel (ring attention / Ulysses) tests on the 8-device CPU mesh.
+
+No reference test exists for these (the reference lacks context parallelism,
+SURVEY.md §5.7); correctness oracle = dense single-device attention on the
+full sequence.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from paddle_tpu.distributed.context_parallel import (
+    all_gather_seq,
+    reduce_scatter_seq,
+    ring_attention,
+    scatter_seq,
+    ulysses_attention,
+)
+
+B, S, H, D = 2, 64, 8, 16
+N = 4  # ring size
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:N]), ("sep",))
+
+
+def _qkv(seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+    return mk(), mk(), mk()
+
+
+def _dense(q, k, v, causal):
+    scale = 1.0 / np.sqrt(D)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        m = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(m, s, -1e30)
+    p = jax.nn.softmax(s, -1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention(causal):
+    q, k, v = _qkv()
+    mesh = _mesh()
+    spec = P(None, "sep", None, None)
+
+    fn = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, "sep", causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )
+    out = jax.jit(fn)(q, k, v)
+    ref = _dense(q, k, v, causal)
+    np.testing.assert_allclose(out, ref, atol=2e-4, rtol=1e-3)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention(causal):
+    q, k, v = _qkv(1)
+    mesh = _mesh()
+    spec = P(None, "sep", None, None)
+
+    fn = shard_map(
+        lambda q, k, v: ulysses_attention(q, k, v, "sep", causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )
+    out = jax.jit(fn)(q, k, v)
+    ref = _dense(q, k, v, causal)
+    np.testing.assert_allclose(out, ref, atol=2e-4, rtol=1e-3)
+
+
+def test_ring_attention_grads():
+    q, k, v = _qkv(2)
+    mesh = _mesh()
+    spec = P(None, "sep", None, None)
+
+    ring = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, "sep", causal=True),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )
+    g1 = jax.grad(lambda q: (ring(q, k, v) ** 2).sum())(q)
+    g2 = jax.grad(lambda q: (_dense(q, k, v, True) ** 2).sum())(q)
+    np.testing.assert_allclose(g1, g2, atol=5e-3, rtol=1e-2)
+
+
+def test_sp_utils_roundtrip():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((B, S, 32)), jnp.float32)
+    mesh = _mesh()
+    shard = P(None, "sep", None)
+    rep = P(None, None, None)
+
+    # all_gather(shard) == identity on the full array
+    gat = shard_map(
+        lambda x: all_gather_seq(x, "sep"),
+        mesh=mesh, in_specs=(shard,), out_specs=rep, check_rep=False,
+    )
+    np.testing.assert_allclose(gat(x), x, atol=1e-6)
+
+    # scatter(full) == shard
+    sc = shard_map(
+        lambda x: scatter_seq(x, "sep"),
+        mesh=mesh, in_specs=(rep,), out_specs=shard, check_rep=False,
+    )
+    np.testing.assert_allclose(sc(x), x, atol=1e-6)
+
+    # reduce_scatter(replicated) == N * shard
+    rs = shard_map(
+        lambda x: reduce_scatter_seq(x, "sep"),
+        mesh=mesh, in_specs=(rep,), out_specs=shard, check_rep=False,
+    )
+    np.testing.assert_allclose(rs(x), N * x, atol=1e-5)

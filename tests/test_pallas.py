@@ -1,0 +1,203 @@
+"""Pallas kernel tests (interpret mode on the CPU mesh).
+
+Reference test model: the flash_attn op tests in test/legacy_test/ compare the
+fused kernel against the unfused composition for fwd values and analytic
+grads; same structure here (SURVEY.md §4).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.pallas.flash_attention import flash_attention
+from paddle_tpu.ops.pallas.fused_adamw import fused_adamw_update
+from paddle_tpu.ops.pallas.fused_norm import fused_rms_norm
+from paddle_tpu.ops.pallas.rope import fused_rope
+
+B, S, H, D = 2, 256, 4, 64
+
+
+def _qkv(seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+    return mk(), mk(), mk()
+
+
+def _ref_attn(q, k, v, causal):
+    scale = 1.0 / np.sqrt(D)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        m = jnp.tril(jnp.ones((S, S), bool))
+        logits = jnp.where(m, logits, -1e30)
+    p = jax.nn.softmax(logits, -1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_forward(causal):
+    q, k, v = _qkv()
+    out = flash_attention(q, k, v, None, causal, 128, 128, True)
+    ref = _ref_attn(q, k, v, causal)
+    np.testing.assert_allclose(out, ref, atol=2e-2, rtol=2e-2)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_grads(causal):
+    q, k, v = _qkv(1)
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, None, causal, 128, 128, True) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (_ref_attn(q, k, v, causal) ** 2).sum()
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(a, b, atol=0.15, rtol=5e-2)
+
+
+def test_flash_attention_bf16():
+    q, k, v = _qkv(2)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    out = flash_attention(qb, kb, vb, None, True, 128, 128, True)
+    ref = _ref_attn(q, k, v, True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        out.astype(jnp.float32), ref, atol=5e-2, rtol=5e-2
+    )
+
+
+def test_flash_via_sdpa_op():
+    """The registered op routes to the pallas kernel under the flag."""
+    import paddle_tpu as paddle
+
+    paddle.set_flags({"pallas_interpret": True, "use_flash_attention": True})
+    try:
+        q, k, v = _qkv(3)
+        tq, tk, tv = (paddle.to_tensor(np.asarray(x)) for x in (q, k, v))
+        tq.stop_gradient = False
+        out = paddle.nn.functional.scaled_dot_product_attention(
+            tq, tk, tv, is_causal=True
+        )
+        ref = _ref_attn(q, k, v, True)
+        np.testing.assert_allclose(out.numpy(), ref, atol=2e-2, rtol=2e-2)
+        out.sum().backward()
+        assert tq.grad is not None and tq.grad.shape == list(q.shape)
+    finally:
+        paddle.set_flags({"pallas_interpret": False})
+
+
+def test_fused_rms_norm():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((8, 33, 128)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((128,)), jnp.float32)
+    y = fused_rms_norm(x, w, 1e-6, 256, True)
+    ref = x * jax.lax.rsqrt(jnp.mean(x * x, -1, keepdims=True) + 1e-6) * w
+    np.testing.assert_allclose(y, ref, atol=1e-5)
+
+    g1 = jax.grad(
+        lambda x, w: (fused_rms_norm(x, w, 1e-6, 256, True) ** 2).sum(),
+        argnums=(0, 1),
+    )(x, w)
+    g2 = jax.grad(
+        lambda x, w: (
+            (x * jax.lax.rsqrt(jnp.mean(x * x, -1, keepdims=True) + 1e-6) * w)
+            ** 2
+        ).sum(),
+        argnums=(0, 1),
+    )(x, w)
+    np.testing.assert_allclose(g1[0], g2[0], atol=1e-4)
+    np.testing.assert_allclose(g1[1], g2[1], atol=1e-3)
+
+
+def test_fused_rope():
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+    inv = 1.0 / (10000 ** (jnp.arange(0, D, 2) / D))
+    fr = jnp.einsum("s,f->sf", jnp.arange(S).astype(jnp.float32), inv)
+    cos = jnp.concatenate([jnp.cos(fr)] * 2, -1)
+    sin = jnp.concatenate([jnp.sin(fr)] * 2, -1)
+
+    def ref(x):
+        x1, x2 = jnp.split(x, 2, -1)
+        rot = jnp.concatenate([-x2, x1], -1)
+        return x * cos[None, :, None, :] + rot * sin[None, :, None, :]
+
+    qo, ko = fused_rope(q, k, cos, sin, True)
+    np.testing.assert_allclose(qo, ref(q), atol=1e-5)
+    np.testing.assert_allclose(ko, ref(k), atol=1e-5)
+
+    gq = jax.grad(lambda q: (fused_rope(q, k, cos, sin, True)[0] ** 2).sum())(q)
+    gq2 = jax.grad(lambda q: (ref(q) ** 2).sum())(q)
+    np.testing.assert_allclose(gq, gq2, atol=1e-4)
+
+
+def test_fused_adamw():
+    rng = np.random.default_rng(0)
+    n = 1000
+    p = jnp.asarray(rng.standard_normal(n), jnp.float32)
+    g = jnp.asarray(rng.standard_normal(n), jnp.float32)
+    m = jnp.zeros(n)
+    v = jnp.zeros(n)
+    po, mo, vo = fused_adamw_update(
+        p, g, m, v, lr=1e-3, weight_decay=0.01, step=1, interpret=True
+    )
+    m2 = 0.1 * g
+    v2 = 0.001 * g * g
+    mh = m2 / (1 - 0.9)
+    vh = v2 / (1 - 0.999)
+    p2 = p - 1e-3 * (mh / (jnp.sqrt(vh) + 1e-8) + 0.01 * p)
+    np.testing.assert_allclose(po, p2, atol=1e-6)
+    np.testing.assert_allclose(mo, m2, atol=1e-7)
+    np.testing.assert_allclose(vo, v2, rtol=1e-4, atol=1e-7)
+
+
+def test_incubate_namespace():
+    import paddle_tpu as paddle
+
+    f = paddle.incubate.nn.functional
+    assert callable(f.fused_rotary_position_embedding)
+    assert callable(f.rms_norm)
+    assert callable(f.memory_efficient_attention)
+
+
+def test_fused_adamw_wiring(monkeypatch):
+    """AdamW.step routes through the fused kernel (forced via monkeypatched
+    backend + interpret mode) and matches the per-param path."""
+    import paddle_tpu as paddle
+
+    np.random.seed(0)
+    x = np.random.randn(4, 8).astype(np.float32)
+
+    def build():
+        paddle.seed(0)
+        lin = paddle.nn.Linear(8, 8)
+        opt = paddle.optimizer.AdamW(
+            learning_rate=1e-2, parameters=lin.parameters(), weight_decay=0.01
+        )
+        return lin, opt
+
+    def run_steps(lin, opt, n=3):
+        for _ in range(n):
+            loss = (lin(paddle.to_tensor(x)) ** 2).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return [p.numpy().copy() for p in lin.parameters()]
+
+    lin1, opt1 = build()
+    ref = run_steps(lin1, opt1)
+
+    lin2, opt2 = build()
+    paddle.set_flags({"pallas_interpret": True})
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    try:
+        fused = run_steps(lin2, opt2)
+    finally:
+        monkeypatch.undo()
+        paddle.set_flags({"pallas_interpret": False})
+
+    for a, b in zip(ref, fused):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5)

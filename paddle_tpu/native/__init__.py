@@ -1,0 +1,392 @@
+"""Native C++ runtime layer for paddle_tpu.
+
+Components (see src/):
+  - TCPStore   : TCP rendezvous KV store (set/get/add/wait/barrier) used to
+                 bootstrap multi-host jobs. Parity target: the reference's
+                 TCPStore (paddle/phi/core/distributed/store/tcp_store.h:120).
+  - HostTracer : per-thread span recording + chrome-trace export. Parity
+                 target: host profiler (paddle/fluid/platform/profiler/).
+  - HostArena  : best-fit coalescing host staging allocator with stats.
+                 Parity target: AutoGrowthBestFitAllocator
+                 (paddle/fluid/memory/allocation/).
+
+The C++ sources are compiled on first import with g++ into a cached .so
+and bound via ctypes (this image has no pybind11; ctypes is the contract).
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC_DIR = os.path.join(_HERE, "src")
+_LIB_DIR = os.path.join(_HERE, "_lib")
+_SOURCES = ("tcp_store.cc", "tracer.cc", "arena.cc")
+
+_lib = None
+_lib_err: str | None = None
+_build_lock = threading.Lock()
+
+
+def _source_hash() -> str:
+    h = hashlib.sha256()
+    for name in _SOURCES:
+        with open(os.path.join(_SRC_DIR, name), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def _build() -> str:
+    os.makedirs(_LIB_DIR, exist_ok=True)
+    so_path = os.path.join(_LIB_DIR, f"libpt_native_{_source_hash()}.so")
+    if os.path.exists(so_path):
+        return so_path
+    srcs = [os.path.join(_SRC_DIR, s) for s in _SOURCES]
+    tmp = so_path + ".tmp"
+    cmd = [
+        "g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-pthread",
+        "-Wall", *srcs, "-o", tmp,
+    ]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    os.replace(tmp, so_path)
+    return so_path
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    c = ctypes
+    # store
+    lib.pt_store_server_start.argtypes = [c.c_int, c.POINTER(c.c_int)]
+    lib.pt_store_server_start.restype = c.c_void_p
+    lib.pt_store_server_stop.argtypes = [c.c_void_p]
+    lib.pt_store_client_connect.argtypes = [c.c_char_p, c.c_int, c.c_int]
+    lib.pt_store_client_connect.restype = c.c_void_p
+    lib.pt_store_client_close.argtypes = [c.c_void_p]
+    lib.pt_store_set.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p, c.c_uint32]
+    lib.pt_store_set.restype = c.c_int
+    lib.pt_store_get.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_void_p, c.c_uint32, c.c_int,
+    ]
+    lib.pt_store_get.restype = c.c_long
+    lib.pt_store_add.argtypes = [c.c_void_p, c.c_char_p, c.c_long]
+    lib.pt_store_add.restype = c.c_long
+    lib.pt_store_wait_ge.argtypes = [c.c_void_p, c.c_char_p, c.c_long]
+    lib.pt_store_wait_ge.restype = c.c_long
+    lib.pt_store_delete.argtypes = [c.c_void_p, c.c_char_p]
+    lib.pt_store_delete.restype = c.c_int
+    lib.pt_store_num_keys.argtypes = [c.c_void_p]
+    lib.pt_store_num_keys.restype = c.c_long
+    # tracer
+    lib.pt_trace_enable.argtypes = [c.c_int]
+    lib.pt_trace_enabled.restype = c.c_int
+    lib.pt_trace_push.argtypes = [c.c_char_p]
+    lib.pt_trace_pop.argtypes = []
+    lib.pt_trace_span.argtypes = [c.c_char_p, c.c_uint64, c.c_uint64]
+    lib.pt_trace_counter.argtypes = [c.c_char_p, c.c_double]
+    lib.pt_trace_now_ns.restype = c.c_uint64
+    lib.pt_trace_num_spans.restype = c.c_long
+    lib.pt_trace_dump.argtypes = [c.c_char_p]
+    lib.pt_trace_dump.restype = c.c_int
+    lib.pt_trace_get_span.argtypes = [
+        c.c_long, c.c_char_p, c.c_int, c.POINTER(c.c_uint64),
+        c.POINTER(c.c_uint64), c.POINTER(c.c_int64),
+    ]
+    lib.pt_trace_get_span.restype = c.c_int
+    # arena
+    lib.pt_arena_create.argtypes = [c.c_uint64]
+    lib.pt_arena_create.restype = c.c_void_p
+    lib.pt_arena_destroy.argtypes = [c.c_void_p]
+    lib.pt_arena_alloc.argtypes = [c.c_void_p, c.c_uint64]
+    lib.pt_arena_alloc.restype = c.c_void_p
+    lib.pt_arena_free.argtypes = [c.c_void_p, c.c_void_p]
+    lib.pt_arena_free.restype = c.c_int
+    lib.pt_arena_stat.argtypes = [c.c_void_p, c.c_int]
+    lib.pt_arena_stat.restype = c.c_uint64
+
+
+def get_lib() -> ctypes.CDLL:
+    """Build (once) and return the native library, raising on failure."""
+    global _lib, _lib_err
+    if _lib is not None:
+        return _lib
+    if _lib_err is not None:
+        raise RuntimeError(f"paddle_tpu native library unavailable: {_lib_err}")
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        try:
+            so = _build()
+            lib = ctypes.CDLL(so)
+            _bind(lib)
+            _lib = lib
+        except Exception as e:  # noqa: BLE001 — record and surface once
+            _lib_err = repr(e)
+            raise RuntimeError(
+                f"paddle_tpu native library unavailable: {_lib_err}"
+            ) from e
+    return _lib
+
+
+def available() -> bool:
+    try:
+        get_lib()
+        return True
+    except RuntimeError:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# TCPStore
+
+
+class TCPStore:
+    """TCP rendezvous store. The master rank hosts the server; every rank
+    (master included) talks to it through a client connection.
+
+    API mirrors the reference store semantics: set/get are byte-valued,
+    add() is an atomic counter, wait() blocks until a key exists, and
+    barrier() is an add + wait-ge rendezvous.
+    """
+
+    def __init__(self, host: str, port: int, *, is_master: bool = False,
+                 world_size: int = 1, timeout_s: float = 60.0):
+        lib = get_lib()
+        self._lib = lib
+        self._server = None
+        self.world_size = world_size
+        if is_master:
+            bound = ctypes.c_int(0)
+            self._server = lib.pt_store_server_start(port, ctypes.byref(bound))
+            if not self._server:
+                raise RuntimeError(f"TCPStore: cannot bind port {port}")
+            port = bound.value
+        self.host, self.port = host, port
+        connect_host = "127.0.0.1" if is_master else host
+        self._client = lib.pt_store_client_connect(
+            connect_host.encode(), port, int(timeout_s * 1000))
+        if not self._client:
+            if self._server:
+                lib.pt_store_server_stop(self._server)
+            raise RuntimeError(f"TCPStore: cannot connect to {host}:{port}")
+        self._barrier_gen = 0
+        self._named_barrier_gen: dict[str, int] = {}
+
+    def set(self, key: str, value: bytes | str) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        rc = self._lib.pt_store_set(self._client, key.encode(), value,
+                                    len(value))
+        if rc != 0:
+            raise RuntimeError("TCPStore.set failed")
+
+    def get(self, key: str, *, blocking: bool = True) -> bytes | None:
+        cap = 1 << 20
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.pt_store_get(self._client, key.encode(), buf, cap,
+                                       1 if blocking else 0)
+            if n == -2:
+                return None
+            if n < 0:
+                raise RuntimeError("TCPStore.get failed")
+            if n <= cap:
+                return buf.raw[: int(n)]
+            # value larger than the buffer: refetch non-blocking (the key
+            # exists now) with an exactly-sized buffer
+            cap = int(n)
+            blocking = False
+
+    def add(self, key: str, delta: int = 1) -> int:
+        v = self._lib.pt_store_add(self._client, key.encode(), delta)
+        if v == -1:
+            raise RuntimeError("TCPStore.add failed")
+        return int(v)
+
+    def wait_ge(self, key: str, target: int) -> int:
+        v = self._lib.pt_store_wait_ge(self._client, key.encode(), target)
+        if v == -1:
+            raise RuntimeError("TCPStore.wait_ge failed")
+        return int(v)
+
+    def delete(self, key: str) -> None:
+        self._lib.pt_store_delete(self._client, key.encode())
+
+    def num_keys(self) -> int:
+        return int(self._lib.pt_store_num_keys(self._client))
+
+    def barrier(self, name: str | None = None,
+                world_size: int | None = None) -> None:
+        world = world_size or self.world_size
+        if name is None:
+            name = f"__anon_{self._barrier_gen}"
+            self._barrier_gen += 1
+        # A reused name must rendezvous again: every rank tracks how many
+        # times it has hit this barrier and waits for world * generation.
+        gen = self._named_barrier_gen.get(name, 0) + 1
+        self._named_barrier_gen[name] = gen
+        key = f"/barrier/{name}"
+        self.add(key, 1)
+        self.wait_ge(key, world * gen)
+
+    def close(self) -> None:
+        if getattr(self, "_client", None):
+            self._lib.pt_store_client_close(self._client)
+            self._client = None
+        if getattr(self, "_server", None):
+            self._lib.pt_store_server_stop(self._server)
+            self._server = None
+
+    def __del__(self):  # noqa: D105
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001,S110 — interpreter teardown
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Host tracer
+
+
+def trace_enable(on: bool = True) -> None:
+    get_lib().pt_trace_enable(1 if on else 0)
+
+
+def trace_enabled() -> bool:
+    return bool(get_lib().pt_trace_enabled())
+
+
+def trace_push(name: str) -> None:
+    get_lib().pt_trace_push(name.encode())
+
+
+def trace_pop() -> None:
+    get_lib().pt_trace_pop()
+
+
+def trace_span(name: str, begin_ns: int, end_ns: int) -> None:
+    get_lib().pt_trace_span(name.encode(), begin_ns, end_ns)
+
+
+def trace_counter(name: str, value: float) -> None:
+    get_lib().pt_trace_counter(name.encode(), float(value))
+
+
+def trace_now_ns() -> int:
+    return int(get_lib().pt_trace_now_ns())
+
+
+def trace_clear() -> None:
+    get_lib().pt_trace_clear()
+
+
+def trace_num_spans() -> int:
+    return int(get_lib().pt_trace_num_spans())
+
+
+def trace_dump(path: str) -> None:
+    rc = get_lib().pt_trace_dump(path.encode())
+    if rc != 0:
+        raise RuntimeError(f"trace_dump({path}) failed")
+
+
+def trace_spans() -> list[dict]:
+    """Return all recorded spans as dicts (name/begin_ns/end_ns/tid)."""
+    lib = get_lib()
+    out = []
+    name = ctypes.create_string_buffer(256)
+    b = ctypes.c_uint64()
+    e = ctypes.c_uint64()
+    t = ctypes.c_int64()
+    for i in range(trace_num_spans()):
+        if lib.pt_trace_get_span(i, name, 256, ctypes.byref(b),
+                                 ctypes.byref(e), ctypes.byref(t)) == 0:
+            out.append({
+                "name": name.value.decode(errors="replace"),
+                "begin_ns": b.value, "end_ns": e.value, "tid": t.value,
+            })
+    return out
+
+
+class TraceScope:
+    """Context manager recording one host span, usable from Python."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self):
+        trace_push(self.name)
+        return self
+
+    def __exit__(self, *exc):
+        trace_pop()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Host arena allocator
+
+
+class HostArena:
+    """Best-fit coalescing arena over malloc'd chunks, for staging buffers.
+
+    stats(): in_use / peak / reserved / num_allocs / num_chunks (bytes).
+    numpy(shape, dtype) hands out a numpy array backed by arena memory;
+    call free(arr) when the batch has been shipped to device.
+    """
+
+    _STATS = ("in_use", "peak", "reserved", "num_allocs", "num_chunks")
+
+    def __init__(self, chunk_size: int = 64 << 20):
+        self._lib = get_lib()
+        self._h = self._lib.pt_arena_create(chunk_size)
+        if not self._h:
+            raise MemoryError("HostArena: create failed")
+        self._owned: dict[int, int] = {}  # array data ptr -> raw ptr
+
+    def alloc(self, size: int) -> int:
+        p = self._lib.pt_arena_alloc(self._h, size)
+        if not p:
+            raise MemoryError(f"HostArena: alloc({size}) failed")
+        return p
+
+    def free(self, obj) -> None:
+        import numpy as np
+
+        if isinstance(obj, np.ndarray):
+            ptr = obj.ctypes.data
+            raw = self._owned.pop(ptr, ptr)
+        else:
+            raw = int(obj)
+        if self._lib.pt_arena_free(self._h, raw) != 0:
+            raise ValueError("HostArena.free: unknown pointer")
+
+    def numpy(self, shape, dtype):
+        import numpy as np
+
+        dtype = np.dtype(dtype)
+        n = int(np.prod(shape)) * dtype.itemsize
+        ptr = self.alloc(max(n, 1))
+        ctype_arr = (ctypes.c_char * max(n, 1)).from_address(ptr)
+        arr = np.frombuffer(ctype_arr, dtype=dtype, count=int(np.prod(shape)))
+        arr = arr.reshape(shape)
+        self._owned[arr.ctypes.data] = ptr
+        return arr
+
+    def stats(self) -> dict[str, int]:
+        return {
+            name: int(self._lib.pt_arena_stat(self._h, i))
+            for i, name in enumerate(self._STATS)
+        }
+
+    def close(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.pt_arena_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # noqa: D105
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001,S110 — interpreter teardown
+            pass

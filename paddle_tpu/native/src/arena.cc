@@ -1,0 +1,180 @@
+// Host arena allocator: best-fit-with-coalescing allocator over
+// malloc'd chunks, with stats. Used for host staging buffers
+// (dataloader batches headed for device transfer).
+//
+// Capability parity target: the reference's auto-growth best-fit
+// allocator (paddle/fluid/memory/allocation/
+// auto_growth_best_fit_allocator.h:30) and the AllocatorFacade stats
+// (allocator_facade.h:45, stat_allocator.h). On TPU, HBM is managed by
+// PJRT/XLA, so the native allocator obligation lands on the host side:
+// reusable aligned staging memory without per-batch malloc/free churn.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <new>
+#include <set>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr size_t kAlign = 256;  // device-transfer friendly alignment
+
+inline size_t align_up(size_t n) { return (n + kAlign - 1) & ~(kAlign - 1); }
+
+struct Block {
+  char* ptr;
+  size_t size;
+  bool free;
+  Block* prev;  // address-ordered neighbors within the same chunk
+  Block* next;
+};
+
+struct FreeKey {
+  size_t size;
+  char* ptr;
+  bool operator<(const FreeKey& o) const {
+    return size != o.size ? size < o.size : ptr < o.ptr;
+  }
+};
+
+class Arena {
+ public:
+  explicit Arena(size_t chunk_size)
+      : chunk_size_(chunk_size < (1 << 20) ? (1 << 20) : chunk_size) {}
+
+  ~Arena() {
+    for (char* c : chunks_) std::free(c);
+    for (Block* b : all_blocks_) delete b;
+  }
+
+  void* Alloc(size_t size) {
+    std::lock_guard<std::mutex> lk(mu_);
+    size = align_up(size ? size : kAlign);
+    auto it = free_blocks_.lower_bound(FreeKey{size, nullptr});
+    if (it == free_blocks_.end()) {
+      if (!Grow(size)) return nullptr;
+      it = free_blocks_.lower_bound(FreeKey{size, nullptr});
+      if (it == free_blocks_.end()) return nullptr;
+    }
+    Block* b = block_at_[it->ptr];
+    free_blocks_.erase(it);
+    b->free = false;
+    if (b->size >= size + kAlign) {  // split the tail into a free block
+      Block* tail = NewBlock(b->ptr + size, b->size - size, true, b, b->next);
+      if (b->next) b->next->prev = tail;
+      b->next = tail;
+      b->size = size;
+      free_blocks_.insert({tail->size, tail->ptr});
+      block_at_[tail->ptr] = tail;
+    }
+    in_use_ += b->size;
+    if (in_use_ > peak_) peak_ = in_use_;
+    ++num_allocs_;
+    live_[b->ptr] = b;
+    return b->ptr;
+  }
+
+  // Returns 0 on success, -1 if ptr unknown.
+  int Free(void* p) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = live_.find(static_cast<char*>(p));
+    if (it == live_.end()) return -1;
+    Block* b = it->second;
+    live_.erase(it);
+    in_use_ -= b->size;
+    b->free = true;
+    // Coalesce with address-adjacent free neighbors.
+    if (b->next && b->next->free) Merge(b, b->next);
+    if (b->prev && b->prev->free) {
+      b = b->prev;
+      Merge(b, b->next);
+    }
+    free_blocks_.insert({b->size, b->ptr});
+    block_at_[b->ptr] = b;
+    return 0;
+  }
+
+  // stat ids: 0=in_use 1=peak 2=reserved 3=num_allocs 4=num_chunks
+  uint64_t Stat(int id) {
+    std::lock_guard<std::mutex> lk(mu_);
+    switch (id) {
+      case 0: return in_use_;
+      case 1: return peak_;
+      case 2: return reserved_;
+      case 3: return num_allocs_;
+      case 4: return chunks_.size();
+      default: return 0;
+    }
+  }
+
+ private:
+  Block* NewBlock(char* ptr, size_t size, bool free, Block* prev,
+                  Block* next) {
+    Block* b = new Block{ptr, size, free, prev, next};
+    all_blocks_.push_back(b);
+    return b;
+  }
+
+  // Merge b and its next neighbor (both must be in the same chunk).
+  void Merge(Block* b, Block* n) {
+    free_blocks_.erase({n->size, n->ptr});
+    block_at_.erase(n->ptr);
+    // If b is currently indexed as free, drop its stale size entry.
+    free_blocks_.erase({b->size, b->ptr});
+    b->size += n->size;
+    b->next = n->next;
+    if (n->next) n->next->prev = b;
+    // n leaks into all_blocks_ until arena destruction; mark dead.
+    n->ptr = nullptr;
+    n->size = 0;
+  }
+
+  bool Grow(size_t min_size) {
+    size_t sz = min_size > chunk_size_ ? align_up(min_size) : chunk_size_;
+    char* mem = static_cast<char*>(std::aligned_alloc(kAlign, sz));
+    if (!mem) return false;
+    chunks_.push_back(mem);
+    reserved_ += sz;
+    Block* b = NewBlock(mem, sz, true, nullptr, nullptr);
+    free_blocks_.insert({sz, mem});
+    block_at_[mem] = b;
+    return true;
+  }
+
+  std::mutex mu_;
+  size_t chunk_size_;
+  std::vector<char*> chunks_;
+  std::vector<Block*> all_blocks_;
+  std::set<FreeKey> free_blocks_;
+  std::unordered_map<char*, Block*> block_at_;  // block start -> Block
+  std::unordered_map<char*, Block*> live_;      // outstanding allocs
+  uint64_t in_use_ = 0, peak_ = 0, reserved_ = 0, num_allocs_ = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pt_arena_create(uint64_t chunk_size) {
+  return new (std::nothrow) Arena(static_cast<size_t>(chunk_size));
+}
+
+void pt_arena_destroy(void* h) { delete static_cast<Arena*>(h); }
+
+void* pt_arena_alloc(void* h, uint64_t size) {
+  return static_cast<Arena*>(h)->Alloc(static_cast<size_t>(size));
+}
+
+int pt_arena_free(void* h, void* p) {
+  return static_cast<Arena*>(h)->Free(p);
+}
+
+uint64_t pt_arena_stat(void* h, int id) {
+  return static_cast<Arena*>(h)->Stat(id);
+}
+
+}  // extern "C"

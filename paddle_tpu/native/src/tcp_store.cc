@@ -1,0 +1,439 @@
+// TCPStore: a minimal TCP key-value rendezvous store for multi-host
+// bootstrap (set/get/add/wait/barrier), C++ with a C API for ctypes.
+//
+// Capability parity target: the reference framework's TCPStore
+// (paddle/phi/core/distributed/store/tcp_store.h:120, tcp_utils.cc) —
+// master rank hosts the store, workers connect over TCP, collective
+// bootstrap does set/get of unique ids and add-based barriers.
+// This is a fresh TPU-framework implementation (single-threaded
+// poll()-based server with parked blocking reads), not a translation.
+//
+// Wire protocol (little-endian):
+//   request : [u8 cmd][u32 klen][key bytes][u32 vlen][value bytes]
+//   response: [u8 status][u32 vlen][value bytes]
+// cmds: SET=1 GET=2(block until key exists) ADD=3(value=i64 delta,
+//       returns new counter) WAITGE=4(value=i64 target; blocks until
+//       counter>=target) DEL=5 NUMKEYS=6 GETNB=7(non-blocking get)
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+enum Cmd : uint8_t {
+  kSet = 1,
+  kGet = 2,
+  kAdd = 3,
+  kWaitGe = 4,
+  kDel = 5,
+  kNumKeys = 6,
+  kGetNb = 7,
+};
+
+enum Status : uint8_t { kOk = 0, kMissing = 1, kError = 2 };
+
+struct PendingWait {
+  int fd;
+  uint8_t cmd;  // kGet or kWaitGe
+  std::string key;
+  int64_t target;  // for kWaitGe
+};
+
+bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) {
+      if (r < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_resp(int fd, uint8_t status, const void* val, uint32_t vlen) {
+  std::vector<char> out(1 + 4 + vlen);
+  out[0] = static_cast<char>(status);
+  std::memcpy(out.data() + 1, &vlen, 4);
+  if (vlen) std::memcpy(out.data() + 5, val, vlen);
+  return send_all(fd, out.data(), out.size());
+}
+
+class StoreServer {
+ public:
+  explicit StoreServer(int port) : port_(port) {}
+
+  // Returns the bound port (useful when port==0), or -1 on failure.
+  int Start() {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return -1;
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port_));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
+        0) {
+      ::close(listen_fd_);
+      return -1;
+    }
+    if (::listen(listen_fd_, 128) < 0) {
+      ::close(listen_fd_);
+      return -1;
+    }
+    socklen_t len = sizeof(addr);
+    ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+    port_ = ntohs(addr.sin_port);
+    running_.store(true);
+    thread_ = std::thread([this] { Loop(); });
+    return port_;
+  }
+
+  void Stop() {
+    running_.store(false);
+    if (listen_fd_ >= 0) ::shutdown(listen_fd_, SHUT_RDWR);
+    if (thread_.joinable()) thread_.join();
+    if (listen_fd_ >= 0) ::close(listen_fd_);
+    for (int fd : clients_) ::close(fd);
+    clients_.clear();
+  }
+
+  ~StoreServer() { Stop(); }
+
+ private:
+  void Loop() {
+    while (running_.load()) {
+      std::vector<pollfd> fds;
+      fds.push_back({listen_fd_, POLLIN, 0});
+      for (int fd : clients_) fds.push_back({fd, POLLIN, 0});
+      int rc = ::poll(fds.data(), fds.size(), 200 /*ms*/);
+      if (rc < 0) {
+        if (errno == EINTR) continue;
+        break;
+      }
+      if (fds[0].revents & POLLIN) {
+        int cfd = ::accept(listen_fd_, nullptr, nullptr);
+        if (cfd >= 0) {
+          int one = 1;
+          ::setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          clients_.push_back(cfd);
+        }
+      }
+      // Iterate over a copy; HandleRequest may close/remove fds.
+      std::vector<int> ready;
+      for (size_t i = 1; i < fds.size(); ++i) {
+        if (fds[i].revents & (POLLIN | POLLHUP | POLLERR)) {
+          ready.push_back(fds[i].fd);
+        }
+      }
+      for (int fd : ready) {
+        if (!HandleRequest(fd)) DropClient(fd);
+      }
+    }
+  }
+
+  void DropClient(int fd) {
+    ::close(fd);
+    for (size_t i = 0; i < clients_.size(); ++i) {
+      if (clients_[i] == fd) {
+        clients_.erase(clients_.begin() + i);
+        break;
+      }
+    }
+    for (size_t i = 0; i < pending_.size();) {
+      if (pending_[i].fd == fd) {
+        pending_.erase(pending_.begin() + i);
+      } else {
+        ++i;
+      }
+    }
+  }
+
+  bool HandleRequest(int fd) {
+    uint8_t cmd;
+    uint32_t klen, vlen;
+    if (!recv_all(fd, &cmd, 1) || !recv_all(fd, &klen, 4)) return false;
+    if (klen > (1u << 20)) return false;
+    std::string key(klen, '\0');
+    if (klen && !recv_all(fd, key.data(), klen)) return false;
+    if (!recv_all(fd, &vlen, 4)) return false;
+    if (vlen > (64u << 20)) return false;
+    std::string val(vlen, '\0');
+    if (vlen && !recv_all(fd, val.data(), vlen)) return false;
+
+    switch (cmd) {
+      case kSet: {
+        kv_[key] = val;
+        WakeWaiters(key);
+        return send_resp(fd, kOk, nullptr, 0);
+      }
+      case kGetNb: {
+        auto it = kv_.find(key);
+        if (it == kv_.end()) return send_resp(fd, kMissing, nullptr, 0);
+        return send_resp(fd, kOk, it->second.data(),
+                         static_cast<uint32_t>(it->second.size()));
+      }
+      case kGet: {
+        auto it = kv_.find(key);
+        if (it == kv_.end()) {
+          pending_.push_back({fd, kGet, key, 0});
+          return true;  // parked; reply comes on SET
+        }
+        return send_resp(fd, kOk, it->second.data(),
+                         static_cast<uint32_t>(it->second.size()));
+      }
+      case kAdd: {
+        int64_t delta = 0;
+        if (val.size() == 8) std::memcpy(&delta, val.data(), 8);
+        int64_t cur = 0;
+        auto it = kv_.find(key);
+        if (it != kv_.end() && it->second.size() == 8) {
+          std::memcpy(&cur, it->second.data(), 8);
+        }
+        cur += delta;
+        std::string packed(8, '\0');
+        std::memcpy(packed.data(), &cur, 8);
+        kv_[key] = packed;
+        WakeWaiters(key);
+        return send_resp(fd, kOk, &cur, 8);
+      }
+      case kWaitGe: {
+        int64_t target = 0;
+        if (val.size() == 8) std::memcpy(&target, val.data(), 8);
+        int64_t cur = Counter(key);
+        if (cur >= target) return send_resp(fd, kOk, &cur, 8);
+        pending_.push_back({fd, kWaitGe, key, target});
+        return true;  // parked
+      }
+      case kDel: {
+        kv_.erase(key);
+        return send_resp(fd, kOk, nullptr, 0);
+      }
+      case kNumKeys: {
+        int64_t n = static_cast<int64_t>(kv_.size());
+        return send_resp(fd, kOk, &n, 8);
+      }
+      default:
+        return send_resp(fd, kError, nullptr, 0);
+    }
+  }
+
+  int64_t Counter(const std::string& key) {
+    auto it = kv_.find(key);
+    int64_t cur = 0;
+    if (it != kv_.end() && it->second.size() == 8) {
+      std::memcpy(&cur, it->second.data(), 8);
+    }
+    return cur;
+  }
+
+  void WakeWaiters(const std::string& key) {
+    for (size_t i = 0; i < pending_.size();) {
+      PendingWait& w = pending_[i];
+      bool done = false;
+      if (w.key == key) {
+        if (w.cmd == kGet) {
+          const std::string& v = kv_[key];
+          send_resp(w.fd, kOk, v.data(), static_cast<uint32_t>(v.size()));
+          done = true;
+        } else if (w.cmd == kWaitGe) {
+          int64_t cur = Counter(key);
+          if (cur >= w.target) {
+            send_resp(w.fd, kOk, &cur, 8);
+            done = true;
+          }
+        }
+      }
+      if (done) {
+        pending_.erase(pending_.begin() + i);
+      } else {
+        ++i;
+      }
+    }
+  }
+
+  int port_;
+  int listen_fd_ = -1;
+  std::atomic<bool> running_{false};
+  std::thread thread_;
+  std::vector<int> clients_;
+  std::vector<PendingWait> pending_;
+  std::map<std::string, std::string> kv_;
+};
+
+struct StoreClient {
+  int fd = -1;
+  std::mutex mu;  // one outstanding request at a time per client
+};
+
+int connect_with_timeout(const char* host, int port, int timeout_ms) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return -1;
+  }
+  // Retry loop: the server may not be up yet (rendezvous races).
+  int waited = 0;
+  while (true) {
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      return fd;
+    }
+    ::close(fd);
+    if (waited >= timeout_ms) return -1;
+    ::usleep(50 * 1000);
+    waited += 50;
+    fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+  }
+}
+
+// status<0 on transport error; else resp status. *out resized to payload.
+int client_rpc(StoreClient* c, uint8_t cmd, const std::string& key,
+               const void* val, uint32_t vlen, std::string* out) {
+  std::lock_guard<std::mutex> lk(c->mu);
+  uint32_t klen = static_cast<uint32_t>(key.size());
+  std::vector<char> req(1 + 4 + klen + 4 + vlen);
+  req[0] = static_cast<char>(cmd);
+  std::memcpy(req.data() + 1, &klen, 4);
+  std::memcpy(req.data() + 5, key.data(), klen);
+  std::memcpy(req.data() + 5 + klen, &vlen, 4);
+  if (vlen) std::memcpy(req.data() + 9 + klen, val, vlen);
+  if (!send_all(c->fd, req.data(), req.size())) return -1;
+  uint8_t status;
+  uint32_t rlen;
+  if (!recv_all(c->fd, &status, 1) || !recv_all(c->fd, &rlen, 4)) return -1;
+  out->resize(rlen);
+  if (rlen && !recv_all(c->fd, out->data(), rlen)) return -1;
+  return status;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pt_store_server_start(int port, int* bound_port) {
+  auto* s = new StoreServer(port);
+  int p = s->Start();
+  if (p < 0) {
+    delete s;
+    return nullptr;
+  }
+  if (bound_port) *bound_port = p;
+  return s;
+}
+
+void pt_store_server_stop(void* h) { delete static_cast<StoreServer*>(h); }
+
+void* pt_store_client_connect(const char* host, int port, int timeout_ms) {
+  int fd = connect_with_timeout(host, port, timeout_ms);
+  if (fd < 0) return nullptr;
+  auto* c = new StoreClient();
+  c->fd = fd;
+  return c;
+}
+
+void pt_store_client_close(void* h) {
+  auto* c = static_cast<StoreClient*>(h);
+  if (c->fd >= 0) ::close(c->fd);
+  delete c;
+}
+
+int pt_store_set(void* h, const char* key, const void* val, uint32_t vlen) {
+  std::string out;
+  return client_rpc(static_cast<StoreClient*>(h), kSet, key, val, vlen, &out);
+}
+
+// Blocking get. Returns the full payload length (which may exceed cap),
+// or -1 on transport error, -2 if missing (non-blocking mode). Copies
+// min(len, cap) bytes; callers re-issue with a larger buffer when the
+// return value exceeds cap.
+long pt_store_get(void* h, const char* key, void* buf, uint32_t cap,
+                  int blocking) {
+  std::string out;
+  int st = client_rpc(static_cast<StoreClient*>(h),
+                      blocking ? kGet : kGetNb, key, nullptr, 0, &out);
+  if (st < 0 || st == kError) return -1;
+  if (st == kMissing) return -2;
+  uint32_t n = static_cast<uint32_t>(out.size());
+  std::memcpy(buf, out.data(), n < cap ? n : cap);
+  return static_cast<long>(n);
+}
+
+long pt_store_add(void* h, const char* key, long delta) {
+  int64_t d = delta;
+  std::string out;
+  int st =
+      client_rpc(static_cast<StoreClient*>(h), kAdd, key, &d, 8, &out);
+  if (st != kOk || out.size() != 8) return -1;
+  int64_t v;
+  std::memcpy(&v, out.data(), 8);
+  return static_cast<long>(v);
+}
+
+// Blocks until counter(key) >= target. Returns counter value or -1.
+long pt_store_wait_ge(void* h, const char* key, long target) {
+  int64_t t = target;
+  std::string out;
+  int st =
+      client_rpc(static_cast<StoreClient*>(h), kWaitGe, key, &t, 8, &out);
+  if (st != kOk || out.size() != 8) return -1;
+  int64_t v;
+  std::memcpy(&v, out.data(), 8);
+  return static_cast<long>(v);
+}
+
+int pt_store_delete(void* h, const char* key) {
+  std::string out;
+  int st = client_rpc(static_cast<StoreClient*>(h), kDel, key, nullptr, 0,
+                      &out);
+  return st == kOk ? 0 : -1;
+}
+
+long pt_store_num_keys(void* h) {
+  std::string out;
+  int st = client_rpc(static_cast<StoreClient*>(h), kNumKeys, "", nullptr, 0,
+                      &out);
+  if (st != kOk || out.size() != 8) return -1;
+  int64_t v;
+  std::memcpy(&v, out.data(), 8);
+  return static_cast<long>(v);
+}
+
+}  // extern "C"

@@ -1,0 +1,203 @@
+// Host tracer: low-overhead span recording with chrome-trace export.
+//
+// Capability parity target: the reference's host-side profiler —
+// RecordEvent ranges collected into per-thread ring buffers
+// (paddle/fluid/platform/profiler/host_tracer.h:26,
+//  host_event_recorder.h) and exported as chrome-trace JSON
+// (chrometracing_logger.cc). Device timelines on TPU come from XLA/xprof,
+// so the native work is exactly this host-span layer.
+//
+// Design: per-thread span buffers (no lock on the hot path except a
+// one-time registration), steady_clock nanosecond timestamps, nested
+// spans via a thread-local open-span stack.
+
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+inline uint64_t now_ns() {
+  return static_cast<uint64_t>(
+      std::chrono::duration_cast<std::chrono::nanoseconds>(
+          std::chrono::steady_clock::now().time_since_epoch())
+          .count());
+}
+
+struct Span {
+  std::string name;
+  uint64_t begin_ns;
+  uint64_t end_ns;
+  int64_t tid;
+};
+
+struct Counter {
+  std::string name;
+  uint64_t ts_ns;
+  double value;
+};
+
+struct ThreadBuffer {
+  std::mutex mu;  // guards spans/open: owner thread appends, readers dump
+  std::vector<Span> spans;
+  std::vector<std::pair<std::string, uint64_t>> open;  // name, begin
+  int64_t tid;
+};
+
+std::mutex g_mu;
+std::vector<ThreadBuffer*> g_buffers;
+std::vector<Counter> g_counters;
+std::atomic<bool> g_enabled{false};
+
+ThreadBuffer* tls_buffer() {
+  thread_local ThreadBuffer* buf = [] {
+    auto* b = new ThreadBuffer();
+    b->tid = static_cast<int64_t>(::syscall(SYS_gettid));
+    std::lock_guard<std::mutex> lk(g_mu);
+    g_buffers.push_back(b);
+    return b;
+  }();
+  return buf;
+}
+
+void json_escape(const std::string& in, std::string* out) {
+  for (char c : in) {
+    switch (c) {
+      case '"': *out += "\\\""; break;
+      case '\\': *out += "\\\\"; break;
+      case '\n': *out += "\\n"; break;
+      case '\t': *out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) >= 0x20) out->push_back(c);
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void pt_trace_enable(int on) { g_enabled.store(on != 0); }
+
+int pt_trace_enabled() { return g_enabled.load() ? 1 : 0; }
+
+void pt_trace_push(const char* name) {
+  if (!g_enabled.load(std::memory_order_relaxed)) return;
+  ThreadBuffer* b = tls_buffer();
+  std::lock_guard<std::mutex> lk(b->mu);
+  b->open.emplace_back(name, now_ns());
+}
+
+void pt_trace_pop() {
+  if (!g_enabled.load(std::memory_order_relaxed)) return;
+  ThreadBuffer* b = tls_buffer();
+  std::lock_guard<std::mutex> lk(b->mu);
+  if (b->open.empty()) return;
+  auto [name, begin] = std::move(b->open.back());
+  b->open.pop_back();
+  b->spans.push_back({std::move(name), begin, now_ns(), b->tid});
+}
+
+// Record a fully-formed span (for Python-side timestamps).
+void pt_trace_span(const char* name, uint64_t begin_ns, uint64_t end_ns) {
+  if (!g_enabled.load(std::memory_order_relaxed)) return;
+  ThreadBuffer* b = tls_buffer();
+  std::lock_guard<std::mutex> lk(b->mu);
+  b->spans.push_back({name, begin_ns, end_ns, b->tid});
+}
+
+void pt_trace_counter(const char* name, double value) {
+  if (!g_enabled.load(std::memory_order_relaxed)) return;
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_counters.push_back({name, now_ns(), value});
+}
+
+uint64_t pt_trace_now_ns() { return now_ns(); }
+
+void pt_trace_clear() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  for (auto* b : g_buffers) {
+    std::lock_guard<std::mutex> blk(b->mu);
+    b->spans.clear();
+    b->open.clear();
+  }
+  g_counters.clear();
+}
+
+long pt_trace_num_spans() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  long n = 0;
+  for (auto* b : g_buffers) {
+    std::lock_guard<std::mutex> blk(b->mu);
+    n += static_cast<long>(b->spans.size());
+  }
+  return n;
+}
+
+// Writes a chrome://tracing JSON file. Returns 0 on success.
+int pt_trace_dump(const char* path) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  FILE* f = std::fopen(path, "w");
+  if (!f) return -1;
+  std::fputs("{\"traceEvents\":[\n", f);
+  bool first = true;
+  int pid = static_cast<int>(::getpid());
+  for (auto* b : g_buffers) {
+    std::lock_guard<std::mutex> blk(b->mu);
+    for (const Span& s : b->spans) {
+      std::string esc;
+      json_escape(s.name, &esc);
+      std::fprintf(
+          f, "%s{\"name\":\"%s\",\"ph\":\"X\",\"pid\":%d,\"tid\":%lld,"
+             "\"ts\":%.3f,\"dur\":%.3f}",
+          first ? "" : ",\n", esc.c_str(), pid,
+          static_cast<long long>(s.tid), s.begin_ns / 1e3,
+          (s.end_ns - s.begin_ns) / 1e3);
+      first = false;
+    }
+  }
+  for (const Counter& c : g_counters) {
+    std::string esc;
+    json_escape(c.name, &esc);
+    std::fprintf(f,
+                 "%s{\"name\":\"%s\",\"ph\":\"C\",\"pid\":%d,\"ts\":%.3f,"
+                 "\"args\":{\"value\":%g}}",
+                 first ? "" : ",\n", esc.c_str(), pid, c.ts_ns / 1e3,
+                 c.value);
+    first = false;
+  }
+  std::fputs("\n]}\n", f);
+  std::fclose(f);
+  return 0;
+}
+
+// Copy span i (global index across threads) into out fields. Returns 0
+// on success, -1 if out of range. name is truncated to cap.
+int pt_trace_get_span(long i, char* name, int cap, uint64_t* begin_ns,
+                      uint64_t* end_ns, int64_t* tid) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  long k = 0;
+  for (auto* b : g_buffers) {
+    std::lock_guard<std::mutex> blk(b->mu);
+    if (i < k + static_cast<long>(b->spans.size())) {
+      const Span& s = b->spans[static_cast<size_t>(i - k)];
+      std::snprintf(name, static_cast<size_t>(cap), "%s", s.name.c_str());
+      *begin_ns = s.begin_ns;
+      *end_ns = s.end_ns;
+      *tid = s.tid;
+      return 0;
+    }
+    k += static_cast<long>(b->spans.size());
+  }
+  return -1;
+}
+
+}  // extern "C"

@@ -212,17 +212,17 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
 
 
 def rms_norm(x, weight=None, epsilon=1e-6):
-    from ...core import flags as _flags
+    from .. import pallas as _pallas
 
     if (
         weight is not None
         and weight.ndim == 1
-        and jax.default_backend() == "tpu"
-        and not _flags.get_flag("pallas_interpret")
+        and _pallas.pallas_enabled()
     ):
         from ..pallas.fused_norm import fused_rms_norm as _fused
 
-        return _fused(x, weight, epsilon)
+        return _fused(x, weight, epsilon,
+                      interpret=_pallas.interpret_mode())
     xf = x.astype(jnp.float32)
     ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
     y = (xf * lax.rsqrt(ms + epsilon)).astype(x.dtype)
@@ -687,7 +687,7 @@ def scaled_dot_product_attention(
 def rotary_position_embedding(q, k, cos, sin, rotate_half=True):
     """Reference: incubate fused_rotary_position_embedding.
     q,k: [b, s, h, d]; cos,sin: [s, d] or broadcastable."""
-    from ...core import flags as _flags
+    from .. import pallas as _pallas
 
     # fused path accepts cos/sin as [s, d] or the canonical broadcast layout
     # [1, s, 1, d] (seq at axis 1); anything else uses the XLA composition
@@ -701,12 +701,11 @@ def rotary_position_embedding(q, k, cos, sin, rotate_half=True):
         and _seq_major(cos)
         and _seq_major(sin)
         and q.shape[1] == (cos.shape[1] if cos.ndim == 4 else cos.shape[0])
-        and jax.default_backend() == "tpu"
-        and not _flags.get_flag("pallas_interpret")
+        and _pallas.pallas_enabled()
     ):
         from ..pallas.rope import fused_rope as _fused
 
-        return _fused(q, k, cos, sin)
+        return _fused(q, k, cos, sin, interpret=_pallas.interpret_mode())
 
     def rot(x):
         if rotate_half:

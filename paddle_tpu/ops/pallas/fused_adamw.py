@@ -48,8 +48,14 @@ def _adamw_kernel(p_ref, g_ref, m_ref, v_ref, sc_ref,
 
 def fused_adamw_update(p, g, m, v, *, lr, beta1=0.9, beta2=0.999, eps=1e-8,
                        weight_decay=0.0, step=1, grad_scale=1.0,
+                       bias_correction1=None, bias_correction2=None,
                        chunk=DEFAULT_CHUNK, interpret=False):
-    """One AdamW step on flat 1-D buffers. Returns (p, m, v) updated."""
+    """One AdamW step on flat 1-D buffers. Returns (p, m, v) updated.
+
+    bias_correction1/2 override the step-derived 1-beta**t factors so the
+    caller can use per-parameter-group beta_pow state (params that skipped
+    steps must not use the global step count).
+    """
     n = p.shape[0]
     c = min(chunk, n)
     pad = (-n) % c
@@ -60,14 +66,20 @@ def fused_adamw_update(p, g, m, v, *, lr, beta1=0.9, beta2=0.999, eps=1e-8,
     nt = p_.shape[0] // c
 
     step_f = jnp.asarray(step, jnp.float32)
+    bc1 = (jnp.asarray(bias_correction1, jnp.float32)
+           if bias_correction1 is not None
+           else 1.0 - jnp.asarray(beta1, jnp.float32) ** step_f)
+    bc2 = (jnp.asarray(bias_correction2, jnp.float32)
+           if bias_correction2 is not None
+           else 1.0 - jnp.asarray(beta2, jnp.float32) ** step_f)
     sc = jnp.stack([
         jnp.asarray(lr, jnp.float32),
         jnp.asarray(beta1, jnp.float32),
         jnp.asarray(beta2, jnp.float32),
         jnp.asarray(eps, jnp.float32),
         jnp.asarray(weight_decay, jnp.float32),
-        1.0 - jnp.asarray(beta1, jnp.float32) ** step_f,
-        1.0 - jnp.asarray(beta2, jnp.float32) ** step_f,
+        bc1,
+        bc2,
         jnp.asarray(grad_scale, jnp.float32),
     ])
 

@@ -45,12 +45,14 @@ def _run_fwd(x, w, eps, block_rows, interpret):
     n = x.size // d
     xr = x.reshape(n, d)
     rows = min(block_rows, n)
-    while n % rows:
-        rows -= 1
-    grid = (n // rows,)
+    # Pad the row dim to a block multiple (padded rows compute rsqrt(eps),
+    # sliced away below) rather than shrinking the block to a divisor.
+    pad = (-n) % rows
+    xp = jnp.pad(xr, ((0, pad), (0, 0))) if pad else xr
+    np_ = n + pad
     y, rstd = pl.pallas_call(
         functools.partial(_fwd_kernel, eps=eps),
-        grid=grid,
+        grid=(np_ // rows,),
         in_specs=[
             pl.BlockSpec((rows, d), lambda i: (i, 0)),
             pl.BlockSpec((d,), lambda i: (0,)),
@@ -60,11 +62,13 @@ def _run_fwd(x, w, eps, block_rows, interpret):
             pl.BlockSpec((rows,), lambda i: (i,)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((n, d), x.dtype),
-            jax.ShapeDtypeStruct((n,), jnp.float32),
+            jax.ShapeDtypeStruct((np_, d), x.dtype),
+            jax.ShapeDtypeStruct((np_,), jnp.float32),
         ],
         interpret=interpret,
-    )(xr, w)
+    )(xp, w)
+    if pad:
+        y, rstd = y[:n], rstd[:n]
     return y.reshape(orig_shape), (xr, w, rstd, orig_shape)
 
 
@@ -84,10 +88,18 @@ def _bwd_rule(epsilon, block_rows, interpret, res, g):
     xr, w, rstd, orig_shape = res
     n, d = xr.shape
     rows = min(block_rows, n)
-    while n % rows:
-        rows -= 1
-    nblocks = n // rows
+    pad = (-n) % rows
     gr = g.reshape(n, d)
+    if pad:
+        # Padded rows carry zero upstream grad, so their dw contribution
+        # is zero and their dx rows are sliced away.
+        xr_p = jnp.pad(xr, ((0, pad), (0, 0)))
+        gr_p = jnp.pad(gr, ((0, pad), (0, 0)))
+        rstd_p = jnp.pad(rstd, (0, pad))
+    else:
+        xr_p, gr_p, rstd_p = xr, gr, rstd
+    np_ = n + pad
+    nblocks = np_ // rows
     dx, dw_parts = pl.pallas_call(
         _bwd_kernel,
         grid=(nblocks,),
@@ -102,13 +114,13 @@ def _bwd_rule(epsilon, block_rows, interpret, res, g):
             pl.BlockSpec((1, d), lambda i: (i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((n, d), xr.dtype),
+            jax.ShapeDtypeStruct((np_, d), xr.dtype),
             jax.ShapeDtypeStruct((nblocks, d), jnp.float32),
         ],
         interpret=interpret,
-    )(xr, w, rstd, gr)
+    )(xr_p, w, rstd_p, gr_p)
     dw = jnp.sum(dw_parts, axis=0).astype(w.dtype)
-    return dx.reshape(orig_shape), dw
+    return dx[:n].reshape(orig_shape), dw
 
 
 fused_rms_norm.defvjp(_fwd_rule, _bwd_rule)

@@ -127,15 +127,19 @@ class AdamW(Adam):
             groups = {}
             for p, g in params_grads:
                 state = self._get_state(p)
-                key = (str(p.dtype), state.get("wd_on", 1.0))
+                # beta_pow is per-parameter state (params may skip steps);
+                # group only params sharing the same correction factors.
+                key = (str(p.dtype), state.get("wd_on", 1.0),
+                       float(state["beta1_pow"]), float(state["beta2_pow"]))
                 groups.setdefault(key, []).append((p, g, state))
-            t = self._step_count + 1
-            for (_, wd_on), items in groups.items():
+            for (_, wd_on, b1p, b2p), items in groups.items():
                 sizes = [p._value.size for p, _, _ in items]
                 flat = lambda x: x.reshape(-1)
                 pbuf = jnp.concatenate([flat(p._value) for p, _, _ in items])
+                # grads go to the kernel in fp32 (it computes fp32 math);
+                # casting to a bf16 param dtype would truncate them first.
                 gbuf = jnp.concatenate([
-                    flat((g._value if isinstance(g, Tensor) else g)).astype(pbuf.dtype)
+                    flat((g._value if isinstance(g, Tensor) else g)).astype(jnp.float32)
                     for p, g, _ in items
                 ])
                 mbuf = jnp.concatenate([flat(s["moment1"]) for _, _, s in items])
@@ -143,7 +147,9 @@ class AdamW(Adam):
                 po, mo, vo = fused_adamw_update(
                     pbuf, gbuf, mbuf, vbuf, lr=lr, beta1=self._beta1,
                     beta2=self._beta2, eps=self._eps,
-                    weight_decay=self._decoupled_wd * wd_on, step=t,
+                    weight_decay=self._decoupled_wd * wd_on,
+                    bias_correction1=1.0 - b1p * self._beta1,
+                    bias_correction2=1.0 - b2p * self._beta2,
                     interpret=interp,
                 )
                 off = 0

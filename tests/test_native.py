@@ -1,0 +1,249 @@
+"""Tests for the native C++ runtime layer (TCPStore, tracer, arena)."""
+from __future__ import annotations
+
+import json
+import multiprocessing as mp
+import os
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import native
+
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library failed to build")
+
+
+# ---------------------------------------------------------------------------
+# TCPStore
+
+
+def test_store_set_get_roundtrip():
+    s = native.TCPStore("127.0.0.1", 0, is_master=True)
+    try:
+        s.set("hello", b"world")
+        assert s.get("hello") == b"world"
+        assert s.get("missing", blocking=False) is None
+        s.set("hello", b"world2")
+        assert s.get("hello") == b"world2"
+        assert s.num_keys() >= 1
+        s.delete("hello")
+        assert s.get("hello", blocking=False) is None
+    finally:
+        s.close()
+
+
+def test_store_add_counter():
+    s = native.TCPStore("127.0.0.1", 0, is_master=True)
+    try:
+        assert s.add("cnt", 1) == 1
+        assert s.add("cnt", 5) == 6
+        assert s.add("cnt", -2) == 4
+        assert s.wait_ge("cnt", 4) == 4
+    finally:
+        s.close()
+
+
+def _worker_rank(host, port, rank, world, q):
+    from paddle_tpu import native as nat
+
+    c = nat.TCPStore(host, port, world_size=world, timeout_s=30)
+    c.set(f"rank/{rank}", str(rank).encode())
+    c.barrier("init", world)
+    # after barrier, every rank's key must be visible
+    vals = sorted(int(c.get(f"rank/{r}")) for r in range(world))
+    q.put((rank, vals))
+    c.close()
+
+
+def test_store_multiprocess_rendezvous():
+    world = 4
+    server = native.TCPStore("127.0.0.1", 0, is_master=True, world_size=world)
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [
+        ctx.Process(target=_worker_rank,
+                    args=("127.0.0.1", server.port, r, world, q))
+        for r in range(world)
+    ]
+    for p in procs:
+        p.start()
+    results = [q.get(timeout=60) for _ in range(world)]
+    for p in procs:
+        p.join(timeout=30)
+    assert sorted(r for r, _ in results) == list(range(world))
+    for _, vals in results:
+        assert vals == [0, 1, 2, 3]
+    server.close()
+
+
+def test_store_blocking_get_unblocks_on_set():
+    s = native.TCPStore("127.0.0.1", 0, is_master=True)
+    c2 = native.TCPStore("127.0.0.1", s.port)
+    try:
+        import threading
+
+        got = {}
+
+        def getter():
+            got["v"] = c2.get("late_key")  # blocks until set
+
+        t = threading.Thread(target=getter)
+        t.start()
+        time.sleep(0.2)
+        assert t.is_alive()
+        s.set("late_key", b"now")
+        t.join(timeout=10)
+        assert got["v"] == b"now"
+    finally:
+        c2.close()
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# Tracer
+
+
+def test_tracer_spans_and_chrome_dump(tmp_path):
+    native.trace_clear()
+    native.trace_enable(True)
+    with native.TraceScope("outer"):
+        with native.TraceScope("inner"):
+            time.sleep(0.01)
+    native.trace_counter("loss", 1.5)
+    native.trace_enable(False)
+    spans = native.trace_spans()
+    names = [s["name"] for s in spans]
+    assert "outer" in names and "inner" in names
+    outer = next(s for s in spans if s["name"] == "outer")
+    inner = next(s for s in spans if s["name"] == "inner")
+    assert outer["begin_ns"] <= inner["begin_ns"]
+    assert inner["end_ns"] <= outer["end_ns"]
+    assert inner["end_ns"] - inner["begin_ns"] >= 5_000_000  # >=5ms
+
+    path = str(tmp_path / "trace.json")
+    native.trace_dump(path)
+    with open(path) as f:
+        data = json.load(f)
+    evs = data["traceEvents"]
+    assert any(e["name"] == "outer" and e["ph"] == "X" for e in evs)
+    assert any(e["name"] == "loss" and e["ph"] == "C" for e in evs)
+    native.trace_clear()
+    assert native.trace_num_spans() == 0
+
+
+def test_tracer_disabled_is_noop():
+    native.trace_clear()
+    native.trace_enable(False)
+    native.trace_push("nope")
+    native.trace_pop()
+    assert native.trace_num_spans() == 0
+
+
+# ---------------------------------------------------------------------------
+# Arena
+
+
+def test_arena_alloc_free_stats():
+    a = native.HostArena(chunk_size=1 << 20)
+    try:
+        p1 = a.alloc(1000)
+        p2 = a.alloc(2000)
+        st = a.stats()
+        assert st["num_chunks"] == 1
+        assert st["in_use"] >= 3000
+        assert st["peak"] >= st["in_use"]
+        a.free(p1)
+        a.free(p2)
+        assert a.stats()["in_use"] == 0
+        # coalescing: after freeing everything a full-chunk alloc fits
+        p3 = a.alloc((1 << 20) - 512)
+        a.free(p3)
+        assert a.stats()["num_chunks"] == 1  # no growth needed
+    finally:
+        a.close()
+
+
+def test_arena_grows_beyond_chunk():
+    a = native.HostArena(chunk_size=1 << 20)
+    try:
+        p1 = a.alloc(700 << 10)
+        p2 = a.alloc(700 << 10)  # doesn't fit in the first 1MB chunk
+        assert a.stats()["num_chunks"] == 2
+        big = a.alloc(3 << 20)  # oversized alloc gets its own chunk
+        assert big
+        assert a.stats()["num_chunks"] == 3
+        a.free(p1)
+        a.free(p2)
+        a.free(big)
+    finally:
+        a.close()
+
+
+def test_arena_numpy_buffers():
+    a = native.HostArena(chunk_size=1 << 20)
+    try:
+        arr = a.numpy((128, 32), np.float32)
+        arr[:] = 1.5
+        assert arr.sum() == pytest.approx(128 * 32 * 1.5)
+        st = a.stats()
+        assert st["in_use"] >= 128 * 32 * 4
+        a.free(arr)
+        assert a.stats()["in_use"] == 0
+    finally:
+        a.close()
+
+
+def test_arena_double_free_detected():
+    a = native.HostArena(chunk_size=1 << 20)
+    try:
+        p = a.alloc(64)
+        a.free(p)
+        with pytest.raises(ValueError):
+            a.free(p)
+    finally:
+        a.close()
+
+
+def test_store_large_value_roundtrip():
+    s = native.TCPStore("127.0.0.1", 0, is_master=True)
+    try:
+        big = os.urandom(3 << 20)  # larger than the 1MB first-try buffer
+        s.set("big", big)
+        assert s.get("big") == big
+    finally:
+        s.close()
+
+
+def _barrier_loop_worker(port, rank, q):
+    from paddle_tpu import native as nat
+
+    c = nat.TCPStore("127.0.0.1", port, world_size=2, timeout_s=30)
+    for it in range(3):  # same barrier name every iteration
+        c.set(f"it{it}/r{rank}", b"x")
+        c.barrier("loop")
+        # after each barrier, the peer's key for THIS iteration exists
+        other = 1 - rank
+        assert c.get(f"it{it}/r{other}", blocking=False) is not None
+    q.put(rank)
+    c.close()
+
+
+def test_store_barrier_reused_name():
+    world = 2
+    server = native.TCPStore("127.0.0.1", 0, is_master=True, world_size=world)
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+
+    procs = [ctx.Process(target=_barrier_loop_worker, args=(server.port, r, q))
+             for r in range(world)]
+    for p in procs:
+        p.start()
+    done = [q.get(timeout=60) for _ in range(world)]
+    for p in procs:
+        p.join(timeout=30)
+        assert p.exitcode == 0
+    assert sorted(done) == [0, 1]
+    server.close()

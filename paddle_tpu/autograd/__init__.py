@@ -1,0 +1,360 @@
+"""paddle.autograd analog.
+
+Reference: python/paddle/autograd/ — py_layer.py (PyLayer/PyLayerContext),
+saved_tensors_hooks.py, backward(), plus the functional jvp/vjp/Jacobian/
+Hessian API from python/paddle/incubate/autograd/functional.py.
+
+TPU-native: PyLayer plugs a user-defined backward into the same GradNode graph
+the op registry builds (core/autograd.py), so custom autograd composes with
+generated vjps; the functional API lowers to jax.jvp/jacrev/hessian over a
+functionalized view of the user callable, which is exactly the reference's
+"double-backward via graph re-tracing" collapsed into compiler transforms.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import (  # noqa: F401
+    GradNode,
+    enable_grad,
+    grad,
+    is_grad_enabled,
+    no_grad,
+    run_backward,
+    set_grad_enabled,
+)
+from ..core.tensor import Tensor
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward (reference: python/paddle/autograd/autograd.py)."""
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is not None and not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+    run_backward(tensors, grad_tensors, retain_graph=retain_graph)
+
+
+# --------------------------------------------------------------------------
+# PyLayer (reference: python/paddle/autograd/py_layer.py + fluid/eager/pylayer/)
+# --------------------------------------------------------------------------
+
+_hooks_state = threading.local()
+
+
+class PyLayerContext:
+    """Context handed to forward/backward (reference: py_layer.py:35)."""
+
+    def __init__(self):
+        self._saved = ()
+        self._unpack = None
+        self.materialize_grads = True
+        self._non_differentiable = set()
+
+    def save_for_backward(self, *tensors):
+        pack = getattr(_hooks_state, "pack", None)
+        if pack is not None:
+            self._saved = tuple(pack(t) if isinstance(t, Tensor) else t for t in tensors)
+            self._unpack = getattr(_hooks_state, "unpack", None)
+        else:
+            self._saved = tensors
+            self._unpack = None
+
+    def saved_tensor(self):
+        if self._unpack is not None:
+            out = tuple(self._unpack(t) for t in self._saved)
+        else:
+            out = self._saved
+        return list(out)
+
+    def mark_non_differentiable(self, *tensors):
+        self._non_differentiable.update(id(t) for t in tensors)
+
+    def set_materialize_grads(self, value: bool):
+        self.materialize_grads = bool(value)
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """User-defined autograd op (reference: py_layer.py:93 class PyLayer).
+
+    Subclass with @staticmethod forward(ctx, *args) and backward(ctx, *grads);
+    call via MyLayer.apply(...). The backward is recorded as a GradNode so it
+    interoperates with every registry op's vjp.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+
+        tensor_inputs: List[Tensor] = []
+        for a in args:
+            if isinstance(a, Tensor):
+                tensor_inputs.append(a)
+        for v in kwargs.values():
+            if isinstance(v, Tensor):
+                tensor_inputs.append(v)
+
+        grad_needed = is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs
+        )
+
+        with no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+
+        single = not isinstance(out, (tuple, list))
+        out_list = [out] if single else list(out)
+
+        if not grad_needed:
+            return out
+
+        edges = []
+        for t in tensor_inputs:
+            if t.stop_gradient:
+                edges.append(None)
+            elif t._grad_node is not None:
+                node, idx = t._grad_node
+                edges.append(("node", node, idx))
+            else:
+                edges.append(("leaf", t))
+
+        out_avals = [jax.ShapeDtypeStruct(tuple(o.shape), o.dtype) for o in out_list]
+        n_outputs = len(out_list)
+
+        def vjp_fn(cotangents):
+            cots = (cotangents,) if n_outputs == 1 else tuple(cotangents)
+            grad_ts = []
+            for c, aval in zip(cots, out_avals):
+                gt = Tensor(c if not hasattr(c, "dtype") or c.dtype != jax.dtypes.float0 else jnp.zeros(aval.shape, aval.dtype))
+                gt.stop_gradient = True
+                grad_ts.append(gt)
+            with no_grad():
+                in_grads = cls.backward(ctx, *grad_ts)
+            if not isinstance(in_grads, (tuple, list)):
+                in_grads = (in_grads,)
+            vals = []
+            for g in in_grads:
+                if g is None:
+                    vals.append(None)
+                else:
+                    vals.append(g._value if isinstance(g, Tensor) else jnp.asarray(g))
+            # pad in case backward returned fewer grads than tensor inputs
+            while len(vals) < len(edges):
+                vals.append(None)
+            return tuple(vals)
+
+        node = GradNode(f"PyLayer[{cls.__name__}]", vjp_fn, edges, out_avals)
+
+        wrapped = []
+        for i, o in enumerate(out_list):
+            if id(o) in ctx._non_differentiable or not jnp.issubdtype(o.dtype, jnp.inexact):
+                wrapped.append(o)
+                continue
+            t = Tensor(o._value)
+            t.stop_gradient = False
+            t._grad_node = (node, i)
+            wrapped.append(t)
+        return wrapped[0] if single else tuple(wrapped)
+
+
+LegacyPyLayer = PyLayer  # reference keeps an alias for the pre-eager API
+
+
+class saved_tensors_hooks:
+    """Reference: python/paddle/autograd/saved_tensors_hooks.py.
+
+    Registers pack/unpack hooks applied to tensors stashed via
+    PyLayerContext.save_for_backward. (Registry-op residuals live inside XLA
+    programs and are managed by the compiler, so — unlike the CUDA reference —
+    there is no host-visible stash to intercept for built-in ops.)
+    """
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        self._prev = (
+            getattr(_hooks_state, "pack", None),
+            getattr(_hooks_state, "unpack", None),
+        )
+        _hooks_state.pack = self.pack_hook
+        _hooks_state.unpack = self.unpack_hook
+        return self
+
+    def __exit__(self, *exc):
+        _hooks_state.pack, _hooks_state.unpack = self._prev
+        return False
+
+
+# --------------------------------------------------------------------------
+# Functional transforms (reference: incubate/autograd/functional.py)
+# --------------------------------------------------------------------------
+
+
+def _functionalize(func: Callable):
+    """Lift a Tensor->Tensor callable to a jax value->value function."""
+
+    def fn(*vals):
+        ts = [Tensor(v) for v in vals]
+        with no_grad():
+            out = func(*ts)
+        if isinstance(out, (tuple, list)):
+            return tuple(o._value for o in out)
+        return out._value
+
+    return fn
+
+
+def _tensorize(vals):
+    if isinstance(vals, (tuple, list)):
+        return tuple(Tensor(v) for v in vals)
+    return Tensor(vals)
+
+
+def _values(xs):
+    if isinstance(xs, (tuple, list)):
+        return [x._value if isinstance(x, Tensor) else jnp.asarray(x) for x in xs]
+    return [xs._value if isinstance(xs, Tensor) else jnp.asarray(xs)]
+
+
+def vjp(func, xs, v=None):
+    """paddle.incubate.autograd.vjp(func, xs, v) -> (out, vjp_result)."""
+    vals = _values(xs)
+    fn = _functionalize(func)
+    out, pullback = jax.vjp(fn, *vals)
+    if v is None:
+        cot = jnp.ones_like(out) if not isinstance(out, tuple) else tuple(jnp.ones_like(o) for o in out)
+    else:
+        cot_vals = _values(v)
+        cot = cot_vals[0] if not isinstance(out, tuple) else tuple(cot_vals)
+    grads = pullback(cot)
+    grads_t = tuple(Tensor(g) for g in grads)
+    out_t = _tensorize(out)
+    return out_t, grads_t if isinstance(xs, (tuple, list)) else grads_t[0]
+
+
+def jvp(func, xs, v=None):
+    """paddle.incubate.autograd.jvp(func, xs, v) -> (out, jvp_result)."""
+    vals = _values(xs)
+    fn = _functionalize(func)
+    if v is None:
+        tangents = tuple(jnp.ones_like(x) for x in vals)
+    else:
+        tangents = tuple(_values(v))
+    out, jv = jax.jvp(fn, tuple(vals), tangents)
+    return _tensorize(out), _tensorize(jv)
+
+
+class Jacobian:
+    """Lazy Jacobian (reference: incubate/autograd/functional.py:Jacobian).
+
+    Index with [i, j] blocks or materialize via .numpy()/tensor conversion.
+    """
+
+    def __init__(self, func, xs, is_batched=False):
+        self._vals = _values(xs)
+        self._multi = isinstance(xs, (tuple, list))
+        fn = _functionalize(func)
+        jac = jax.jacrev(fn, argnums=tuple(range(len(self._vals))))(*self._vals)
+        # jac: per-output tree of per-input jacobians; normalize to Tensor(s)
+        if isinstance(jac, tuple) and self._multi:
+            self._jac = tuple(Tensor(j) for j in jac)
+        else:
+            self._jac = Tensor(jac[0] if isinstance(jac, tuple) and len(jac) == 1 else jac)
+
+    def __getitem__(self, idx):
+        if isinstance(self._jac, tuple):
+            return self._jac[idx]
+        return Tensor(self._jac._value[idx])
+
+    @property
+    def shape(self):
+        if isinstance(self._jac, tuple):
+            return [j.shape for j in self._jac]
+        return self._jac.shape
+
+    def numpy(self):
+        if isinstance(self._jac, tuple):
+            return tuple(j.numpy() for j in self._jac)
+        return self._jac.numpy()
+
+    def tensor(self):
+        return self._jac
+
+
+class Hessian:
+    """Lazy Hessian of a scalar-valued function."""
+
+    def __init__(self, func, xs, is_batched=False):
+        self._vals = _values(xs)
+        fn = _functionalize(func)
+        hes = jax.hessian(fn, argnums=tuple(range(len(self._vals))))(*self._vals)
+        if len(self._vals) == 1:
+            self._hes = Tensor(hes[0][0] if isinstance(hes, tuple) else hes)
+        else:
+            self._hes = tuple(tuple(Tensor(b) for b in row) for row in hes)
+
+    def __getitem__(self, idx):
+        if isinstance(self._hes, tuple):
+            return self._hes[idx]
+        return Tensor(self._hes._value[idx])
+
+    @property
+    def shape(self):
+        if isinstance(self._hes, tuple):
+            return [[b.shape for b in row] for row in self._hes]
+        return self._hes.shape
+
+    def numpy(self):
+        if isinstance(self._hes, tuple):
+            return tuple(tuple(b.numpy() for b in row) for row in self._hes)
+        return self._hes.numpy()
+
+    def tensor(self):
+        return self._hes
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False):
+    """Dense Jacobian convenience wrapper returning Tensor(s)."""
+    return Jacobian(func, xs).tensor()
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False):
+    """Dense Hessian convenience wrapper returning Tensor(s)."""
+    return Hessian(func, xs).tensor()
+
+
+__all__ = [
+    "backward",
+    "grad",
+    "no_grad",
+    "enable_grad",
+    "set_grad_enabled",
+    "is_grad_enabled",
+    "PyLayer",
+    "PyLayerContext",
+    "LegacyPyLayer",
+    "saved_tensors_hooks",
+    "vjp",
+    "jvp",
+    "Jacobian",
+    "Hessian",
+    "jacobian",
+    "hessian",
+]

@@ -21,16 +21,19 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 _current_mesh: Optional[Mesh] = None
 
 # Canonical axis order (outer->inner): dp outermost (DCN-friendly), then pp,
-# sharding, sep, mp innermost (mp needs the fastest ICI links).
-AXIS_ORDER = ("dp", "pp", "sharding", "sep", "mp")
+# sharding, sep, ep, mp innermost (mp needs the fastest ICI links). "ep"
+# (expert parallel) shards MoE expert stacks; in the reference it is a
+# process group carved out of the hybrid topology (moe_group), here a mesh
+# axis so the dispatch all-to-all compiles onto ICI.
+AXIS_ORDER = ("dp", "pp", "sharding", "sep", "ep", "mp")
 
 
 def build_mesh(
     dp: int = 1, mp: int = 1, pp: int = 1, sharding: int = 1, sep: int = 1,
-    devices=None,
+    ep: int = 1, devices=None,
 ) -> Mesh:
     devices = list(devices) if devices is not None else jax.devices()
-    sizes = {"dp": dp, "pp": pp, "sharding": sharding, "sep": sep, "mp": mp}
+    sizes = {"dp": dp, "pp": pp, "sharding": sharding, "sep": sep, "ep": ep, "mp": mp}
     total = int(np.prod(list(sizes.values())))
     if total > len(devices):
         raise ValueError(f"mesh needs {total} devices, have {len(devices)}")

@@ -1,0 +1,71 @@
+"""paddle.distribution analog (reference: python/paddle/distribution/).
+
+Probability distributions, bijective transforms, and a KL-divergence
+double-dispatch registry, all built on Tensor arithmetic so densities are
+autograd-differentiable and jit-traceable end to end.
+"""
+from .distribution import Distribution, ExponentialFamily  # noqa: F401
+from .distributions import (  # noqa: F401
+    Bernoulli,
+    Beta,
+    Binomial,
+    Categorical,
+    Cauchy,
+    Dirichlet,
+    Exponential,
+    Gamma,
+    Geometric,
+    Gumbel,
+    Independent,
+    Laplace,
+    LogNormal,
+    Multinomial,
+    Normal,
+    Poisson,
+    StudentT,
+    TransformedDistribution,
+    Uniform,
+)
+from .kl import kl_divergence, register_kl  # noqa: F401
+from .transform import (  # noqa: F401
+    AbsTransform,
+    AffineTransform,
+    ChainTransform,
+    ExpTransform,
+    IndependentTransform,
+    PowerTransform,
+    ReshapeTransform,
+    SigmoidTransform,
+    SoftmaxTransform,
+    StackTransform,
+    StickBreakingTransform,
+    TanhTransform,
+    Transform,
+    Type,
+)
+
+__all__ = [
+    "Distribution",
+    "ExponentialFamily",
+    "Bernoulli",
+    "Beta",
+    "Binomial",
+    "Categorical",
+    "Cauchy",
+    "Dirichlet",
+    "Exponential",
+    "Gamma",
+    "Geometric",
+    "Gumbel",
+    "Independent",
+    "Laplace",
+    "LogNormal",
+    "Multinomial",
+    "Normal",
+    "Poisson",
+    "StudentT",
+    "TransformedDistribution",
+    "Uniform",
+    "kl_divergence",
+    "register_kl",
+]

@@ -1,0 +1,131 @@
+"""Distribution base class.
+
+Reference: python/paddle/distribution/distribution.py (class Distribution),
+python/paddle/distribution/exponential_family.py. TPU-native: parameters are
+framework Tensors so log_prob/entropy are differentiable through the autograd
+engine; sampling folds the global Philox generator (core/random.py) into
+jax.random draws and re-enters Tensor arithmetic for reparameterized rsample.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.random import next_key as _gen_next_key
+from ..core.tensor import Tensor
+
+
+def _param(x, dtype=None):
+    """Convert a distribution parameter to a Tensor (keeping autograd links)."""
+    if isinstance(x, Tensor):
+        return x
+    arr = jnp.asarray(x, dtype=dtype or jnp.float32)
+    if arr.dtype in (jnp.int32, jnp.int64) and dtype is None:
+        arr = arr.astype(jnp.float32)
+    return Tensor(arr)
+
+
+def _value(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _next_key():
+    return _gen_next_key()
+
+
+def _extend_shape(sample_shape, batch_shape, event_shape=()):
+    return tuple(sample_shape) + tuple(batch_shape) + tuple(event_shape)
+
+
+class Distribution:
+    """Base class (reference: distribution.py:40 class Distribution)."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self) -> tuple:
+        return self._batch_shape
+
+    @property
+    def event_shape(self) -> tuple:
+        return self._event_shape
+
+    @property
+    def mean(self) -> Tensor:
+        raise NotImplementedError
+
+    @property
+    def variance(self) -> Tensor:
+        raise NotImplementedError
+
+    @property
+    def stddev(self) -> Tensor:
+        from ..ops import api as F
+
+        return F.sqrt(self.variance)
+
+    def sample(self, shape: Sequence[int] = ()) -> Tensor:
+        """Draw a (detached) sample of shape `shape + batch_shape + event_shape`."""
+        s = self.rsample(shape)
+        out = Tensor(s._value)
+        out.stop_gradient = True
+        return out
+
+    def rsample(self, shape: Sequence[int] = ()) -> Tensor:
+        raise NotImplementedError
+
+    def log_prob(self, value) -> Tensor:
+        raise NotImplementedError
+
+    def prob(self, value) -> Tensor:
+        from ..ops import api as F
+
+        return F.exp(self.log_prob(value))
+
+    def entropy(self) -> Tensor:
+        raise NotImplementedError
+
+    def cdf(self, value) -> Tensor:
+        raise NotImplementedError
+
+    def icdf(self, value) -> Tensor:
+        raise NotImplementedError
+
+    def kl_divergence(self, other: "Distribution") -> Tensor:
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)
+
+    def _broadcast_params(self, *params):
+        vals = [_value(p) for p in params]
+        shape = jnp.broadcast_shapes(*[v.shape for v in vals])
+        return shape
+
+    def __repr__(self):
+        return f"{type(self).__name__}(batch_shape={self._batch_shape}, event_shape={self._event_shape})"
+
+
+class ExponentialFamily(Distribution):
+    """Reference: python/paddle/distribution/exponential_family.py.
+
+    Subclasses expose natural parameters + log normalizer; entropy can be
+    derived via the Bregman divergence of the log normalizer (the reference's
+    `_entropy` fallback). Concrete subclasses here override entropy directly
+    with closed forms, so this base only fixes the interface.
+    """
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        raise NotImplementedError

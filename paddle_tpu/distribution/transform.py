@@ -1,0 +1,297 @@
+"""Bijective transforms for TransformedDistribution.
+
+Reference: python/paddle/distribution/transform.py (Transform + Abs/Affine/
+Chain/Exp/Independent/Power/Reshape/Sigmoid/Softmax/Stack/StickBreaking/Tanh
+transforms). Pure Tensor arithmetic — every transform is traceable/jittable.
+"""
+from __future__ import annotations
+
+import enum
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops import api as F
+
+
+def _as_tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x, dtype=jnp.float32))
+
+
+class Type(enum.Enum):
+    BIJECTION = "bijection"
+    INJECTION = "injection"
+    SURJECTION = "surjection"
+    OTHER = "other"
+
+
+class Transform:
+    _type = Type.INJECTION
+
+    @classmethod
+    def _is_injective(cls):
+        return cls._type in (Type.BIJECTION, Type.INJECTION)
+
+    def __call__(self, x):
+        return self.forward(x)
+
+    def forward(self, x):
+        return self._forward(_as_tensor(x))
+
+    def inverse(self, y):
+        return self._inverse(_as_tensor(y))
+
+    def forward_log_det_jacobian(self, x):
+        x = _as_tensor(x)
+        if hasattr(self, "_forward_log_det_jacobian"):
+            return self._forward_log_det_jacobian(x)
+        if hasattr(self, "_inverse_log_det_jacobian"):
+            return -self._inverse_log_det_jacobian(self.forward(x))
+        raise NotImplementedError(
+            f"{type(self).__name__} defines neither _forward_log_det_jacobian "
+            "nor _inverse_log_det_jacobian"
+        )
+
+    def inverse_log_det_jacobian(self, y):
+        y = _as_tensor(y)
+        if hasattr(self, "_inverse_log_det_jacobian"):
+            return self._inverse_log_det_jacobian(y)
+        if hasattr(self, "_forward_log_det_jacobian"):
+            return -self.forward_log_det_jacobian(self.inverse(y))
+        raise NotImplementedError(
+            f"{type(self).__name__} defines neither _forward_log_det_jacobian "
+            "nor _inverse_log_det_jacobian"
+        )
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+
+class AbsTransform(Transform):
+    _type = Type.SURJECTION
+
+    def _forward(self, x):
+        return F.abs(x)
+
+    def _inverse(self, y):
+        return y  # principal branch
+
+
+class AffineTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, loc, scale):
+        self.loc = _as_tensor(loc)
+        self.scale = _as_tensor(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _forward_log_det_jacobian(self, x):
+        return F.log(F.abs(self.scale)) + F.zeros_like(x)
+
+
+class ExpTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return F.exp(x)
+
+    def _inverse(self, y):
+        return F.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, power):
+        self.power = _as_tensor(power)
+
+    def _forward(self, x):
+        return x**self.power
+
+    def _inverse(self, y):
+        return y ** (1.0 / self.power)
+
+    def _forward_log_det_jacobian(self, x):
+        return F.log(F.abs(self.power * x ** (self.power - 1.0)))
+
+
+class SigmoidTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return F.sigmoid(x)
+
+    def _inverse(self, y):
+        return F.log(y) - F.log(1.0 - y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -F.softplus(-x) - F.softplus(x)
+
+
+class TanhTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return F.tanh(x)
+
+    def _inverse(self, y):
+        return 0.5 * (F.log(1.0 + y) - F.log(1.0 - y))
+
+    def _forward_log_det_jacobian(self, x):
+        return 2.0 * (math.log(2.0) - x - F.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    _type = Type.OTHER
+
+    def _forward(self, x):
+        return F.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return F.log(y)
+
+
+class StickBreakingTransform(Transform):
+    """R^{K-1} -> simplex^K (reference: transform.py StickBreakingTransform).
+
+    y_k = z_k * prod_{j<k}(1 - z_j) with z_k = sigmoid(x_k - log(K-1-k)); the
+    Jacobian is triangular, so log|det J| = sum_k [log y_k + log(1 - z_k)].
+    """
+
+    _type = Type.BIJECTION
+
+    def _sticks(self, xv):
+        offset = xv.shape[-1] - jnp.arange(xv.shape[-1], dtype=xv.dtype)
+        return 1.0 / (1.0 + jnp.exp(-(xv - jnp.log(offset))))
+
+    def _forward(self, x):
+        xv = x._value
+        z = self._sticks(xv)
+        z_cumprod = jnp.cumprod(1.0 - z, axis=-1)
+        pad_last = [(0, 0)] * (xv.ndim - 1)
+        z_padded = jnp.pad(z, pad_last + [(0, 1)], constant_values=1.0)
+        cum_padded = jnp.pad(z_cumprod, pad_last + [(1, 0)], constant_values=1.0)
+        return Tensor(z_padded * cum_padded)
+
+    def _inverse(self, y):
+        yv = y._value
+        y_crop = yv[..., :-1]
+        offset = yv.shape[-1] - 1 - jnp.arange(y_crop.shape[-1], dtype=yv.dtype)
+        sf = 1.0 - jnp.cumsum(y_crop, axis=-1)
+        x = jnp.log(y_crop) - jnp.log(sf) + jnp.log(offset)
+        return Tensor(x)
+
+    def _forward_log_det_jacobian(self, x):
+        xv = x._value
+        z = self._sticks(xv)
+        y = self.forward(x)._value[..., :-1]
+        ld = jnp.sum(jnp.log(y + 1e-30) + jnp.log1p(-z + 1e-30), axis=-1)
+        return Tensor(ld)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+class ReshapeTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        if int(np.prod(self.in_event_shape)) != int(np.prod(self.out_event_shape)):
+            raise ValueError("in/out event shapes must have equal sizes")
+
+    def _forward(self, x):
+        batch = x.shape[: len(x.shape) - len(self.in_event_shape)]
+        return F.reshape(x, list(batch) + list(self.out_event_shape))
+
+    def _inverse(self, y):
+        batch = y.shape[: len(y.shape) - len(self.out_event_shape)]
+        return F.reshape(y, list(batch) + list(self.in_event_shape))
+
+    def _forward_log_det_jacobian(self, x):
+        batch = x.shape[: len(x.shape) - len(self.in_event_shape)]
+        return F.zeros(list(batch) or [1])
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        x = _as_tensor(x)
+        total = None
+        for t in self.transforms:
+            ld = t.forward_log_det_jacobian(x)
+            total = ld if total is None else total + ld
+            x = t.forward(x)
+        return total
+
+
+class IndependentTransform(Transform):
+    """Sums the log-det over trailing `reinterpreted_batch_ndims` dims."""
+
+    def __init__(self, base, reinterpreted_batch_ndims):
+        self.base = base
+        self.reinterpreted_batch_ndims = int(reinterpreted_batch_ndims)
+
+    def _forward(self, x):
+        return self.base.forward(x)
+
+    def _inverse(self, y):
+        return self.base.inverse(y)
+
+    def forward_log_det_jacobian(self, x):
+        ld = self.base.forward_log_det_jacobian(_as_tensor(x))
+        for _ in range(self.reinterpreted_batch_ndims):
+            ld = F.sum(ld, axis=-1)
+        return ld
+
+
+class StackTransform(Transform):
+    """Applies a list of transforms along an axis."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+
+    def _forward(self, x):
+        parts = F.unbind(x, axis=self.axis)
+        outs = [t.forward(p) for t, p in zip(self.transforms, parts)]
+        return F.stack(outs, axis=self.axis)
+
+    def _inverse(self, y):
+        parts = F.unbind(y, axis=self.axis)
+        outs = [t.inverse(p) for t, p in zip(self.transforms, parts)]
+        return F.stack(outs, axis=self.axis)
+
+    def forward_log_det_jacobian(self, x):
+        parts = F.unbind(_as_tensor(x), axis=self.axis)
+        lds = [t.forward_log_det_jacobian(p) for t, p in zip(self.transforms, parts)]
+        return F.stack(lds, axis=self.axis)

@@ -6,3 +6,4 @@ ASP sparsity, LookAhead/ModelAverage optimizers).
 from __future__ import annotations
 
 from . import nn  # noqa: F401
+from . import distributed  # noqa: F401, E402

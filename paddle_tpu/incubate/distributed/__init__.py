@@ -1,0 +1,1 @@
+"""Namespace package (reference: python/paddle/incubate/distributed/)."""

@@ -1,0 +1,18 @@
+"""Mixture-of-Experts with expert parallelism.
+
+Reference: python/paddle/incubate/distributed/models/moe/moe_layer.py:99,149
+(MoEScatter/MoEGather PyLayers over global_scatter/global_gather CUDA
+collectives) and moe/gate/{naive,switch,gshard}_gate.py.
+
+TPU-native redesign: routing is DENSE and static-shaped — a GShard-style
+dispatch tensor [tokens, experts, capacity] built with one-hot positions, so
+the whole layer is three einsums (dispatch, expert MLP, combine) that XLA maps
+onto the MXU with no data-dependent shapes. Expert weights are *stacked*
+([E, d_model, d_hidden]) and sharded over the 'ep' mesh axis; under GSPMD the
+dispatch einsum's expert-dim sharding makes XLA emit the same all-to-all the
+reference issues by hand through global_scatter/global_gather.
+"""
+from .layer import ExpertMLP, MoELayer  # noqa: F401
+from .gates import BaseGate, GShardGate, NaiveGate, SwitchGate  # noqa: F401
+
+__all__ = ["MoELayer", "ExpertMLP", "BaseGate", "NaiveGate", "SwitchGate", "GShardGate"]

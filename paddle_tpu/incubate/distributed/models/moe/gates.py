@@ -1,0 +1,188 @@
+"""MoE gates (reference: incubate/distributed/models/moe/gate/*.py).
+
+Each gate maps token features [T, d_model] -> routing decisions:
+  (combine_weights [T, E, C], dispatch_mask [T, E, C], aux_loss scalar)
+with static shapes only (GShard dense-dispatch formulation).
+
+Differentiable quantities (router probabilities, combine weights, aux loss)
+flow through registry ops so eager autograd reaches the gate weight; integer
+routing decisions (argmax/positions/capacity keep-masks) are computed on
+detached values — they carry no gradient by construction.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.random import next_key
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.ops import api as F
+
+
+def _const(v):
+    t = Tensor(v)
+    t.stop_gradient = True
+    return t
+
+
+def _positions_in_expert(expert_oh):
+    """expert_oh: [T, E] int32 one-hot routing. Returns [T] 0-based position
+    of each token in its expert queue (-1 where unrouted)."""
+    pos = jnp.cumsum(expert_oh, axis=0) * expert_oh
+    return jnp.sum(pos, axis=-1) - 1
+
+
+def _dispatch_tensor(idx, pos, keep, num_experts, capacity):
+    """[T,E,C] float one-hot dispatch for tokens with keep=True (detached)."""
+    safe = jnp.clip(pos, 0, capacity - 1)
+    d = (
+        jax.nn.one_hot(idx, num_experts, dtype=jnp.float32)[:, :, None]
+        * jax.nn.one_hot(safe, capacity, dtype=jnp.float32)[:, None, :]
+    ) * keep[:, None, None].astype(jnp.float32)
+    return d
+
+
+class BaseGate(Layer):
+    def __init__(self, d_model, num_experts, capacity):
+        super().__init__()
+        self.d_model = d_model
+        self.num_experts = num_experts
+        self.capacity = int(capacity)
+        self.weight = self.create_parameter(
+            [d_model, num_experts], default_initializer=I.XavierUniform()
+        )
+
+    def _gates(self, x: Tensor) -> Tensor:
+        logits = F.matmul(F.cast(x, "float32"), F.cast(self.weight, "float32"))
+        return F.softmax(logits, axis=-1)
+
+    def _aux_loss(self, gates: Tensor, idx1) -> Tensor:
+        """GShard/Switch load-balancing loss: E * sum_e f_e * P_e."""
+        ce = _const(
+            jnp.mean(jax.nn.one_hot(idx1, self.num_experts, dtype=jnp.float32), axis=0)
+        )
+        me = F.mean(gates, axis=0)
+        return F.sum(me * ce) * float(self.num_experts)
+
+    def _selected_weight(self, gates: Tensor, idx) -> Tensor:
+        """Differentiable router prob of the chosen expert per token. [T]"""
+        oh = _const(jax.nn.one_hot(idx, self.num_experts, dtype=jnp.float32))
+        return F.sum(gates * oh, axis=-1)
+
+    def routing(self, x: Tensor):
+        """-> (combine [T,E,C] Tensor, dispatch [T,E,C] const Tensor, aux Tensor)."""
+        raise NotImplementedError
+
+
+class NaiveGate(BaseGate):
+    """Top-k softmax routing, no aux loss (reference: gate/naive_gate.py)."""
+
+    def __init__(self, d_model, num_experts, capacity, top_k=2):
+        super().__init__(d_model, num_experts, capacity)
+        self.top_k = top_k
+
+    def routing(self, x: Tensor):
+        gates = self._gates(x)
+        gv = gates._value
+        tokens = gv.shape[0]
+
+        combine = None
+        dispatch = jnp.zeros((tokens, self.num_experts, self.capacity), jnp.float32)
+        occupancy = jnp.zeros((self.num_experts,), jnp.int32)
+        remaining = gv
+        for _ in range(self.top_k):
+            idx = jnp.argmax(remaining, axis=-1)
+            remaining = remaining * (
+                1.0 - jax.nn.one_hot(idx, self.num_experts, dtype=gv.dtype)
+            )
+            oh = jax.nn.one_hot(idx, self.num_experts, dtype=jnp.int32)
+            pos = jnp.sum((jnp.cumsum(oh, axis=0) + occupancy[None, :]) * oh, -1) - 1
+            keep = (pos >= 0) & (pos < self.capacity)
+            d = _dispatch_tensor(idx, pos, keep, self.num_experts, self.capacity)
+            w = self._selected_weight(gates, idx)  # differentiable [T]
+            part = _const(d) * F.reshape(w, [tokens, 1, 1])
+            combine = part if combine is None else combine + part
+            dispatch = dispatch + d
+            occupancy = occupancy + jnp.sum(oh * keep[:, None], axis=0)
+        return combine, _const(dispatch > 0), F.zeros([])
+
+
+class SwitchGate(BaseGate):
+    """Top-1 routing with jitter noise + load-balancing loss
+    (reference: gate/switch_gate.py)."""
+
+    def __init__(self, d_model, num_experts, capacity, jitter=1e-2):
+        super().__init__(d_model, num_experts, capacity)
+        self.jitter = jitter
+
+    def routing(self, x: Tensor):
+        if self.jitter > 0.0 and self.training:
+            noise = _const(
+                jax.random.uniform(
+                    next_key(),
+                    (x.shape[0], 1),
+                    minval=1.0 - self.jitter,
+                    maxval=1.0 + self.jitter,
+                )
+            )
+            x = x * noise
+        gates = self._gates(x)
+        gv = gates._value
+        tokens = gv.shape[0]
+        idx = jnp.argmax(gv, axis=-1)
+        oh = jax.nn.one_hot(idx, self.num_experts, dtype=jnp.int32)
+        pos = _positions_in_expert(oh)
+        keep = (pos >= 0) & (pos < self.capacity)
+        d = _dispatch_tensor(idx, pos, keep, self.num_experts, self.capacity)
+        w = self._selected_weight(gates, idx)
+        combine = _const(d) * F.reshape(w, [tokens, 1, 1])
+        return combine, _const(d > 0), self._aux_loss(gates, idx)
+
+
+class GShardGate(BaseGate):
+    """Top-2 routing with probabilistic second-expert dropping + aux loss
+    (reference: gate/gshard_gate.py)."""
+
+    def __init__(self, d_model, num_experts, capacity, second_policy="random"):
+        super().__init__(d_model, num_experts, capacity)
+        self.second_policy = second_policy
+
+    def routing(self, x: Tensor):
+        gates = self._gates(x)
+        gv = gates._value
+        tokens = gv.shape[0]
+
+        idx1 = jnp.argmax(gv, axis=-1)
+        masked = gv * (1.0 - jax.nn.one_hot(idx1, self.num_experts, dtype=gv.dtype))
+        idx2 = jnp.argmax(masked, axis=-1)
+        w1v = jnp.take_along_axis(gv, idx1[:, None], axis=-1)[:, 0]
+        w2v = jnp.take_along_axis(gv, idx2[:, None], axis=-1)[:, 0]
+        if self.second_policy == "random" and self.training:
+            u = jax.random.uniform(next_key(), w2v.shape)
+            keep2_gate = u < (2.0 * w2v / jnp.maximum(w1v + w2v, 1e-9))
+        else:
+            keep2_gate = jnp.ones_like(w2v, dtype=bool)
+
+        oh1 = jax.nn.one_hot(idx1, self.num_experts, dtype=jnp.int32)
+        pos1 = _positions_in_expert(oh1)
+        keep1 = (pos1 >= 0) & (pos1 < self.capacity)
+        count1 = jnp.sum(oh1 * keep1[:, None], axis=0)  # [E]
+
+        oh2 = jax.nn.one_hot(idx2, self.num_experts, dtype=jnp.int32) * keep2_gate[:, None]
+        pos2 = jnp.sum((jnp.cumsum(oh2, axis=0) + count1[None, :]) * oh2, -1) - 1
+        keep2 = (pos2 >= 0) & (pos2 < self.capacity) & keep2_gate
+
+        d1 = _dispatch_tensor(idx1, pos1, keep1, self.num_experts, self.capacity)
+        d2 = _dispatch_tensor(idx2, pos2, keep2, self.num_experts, self.capacity)
+
+        w1 = self._selected_weight(gates, idx1)
+        w2 = self._selected_weight(gates, idx2)
+        k1 = _const(keep1.astype(jnp.float32))
+        k2 = _const(keep2.astype(jnp.float32))
+        denom = F.maximum(w1 * k1 + w2 * k2, F.full_like(w1, 1e-9))
+        combine = _const(d1) * F.reshape(w1 * k1 / denom, [tokens, 1, 1]) + _const(
+            d2
+        ) * F.reshape(w2 * k2 / denom, [tokens, 1, 1])
+        return combine, _const((d1 + d2) > 0), self._aux_loss(gates, idx1)

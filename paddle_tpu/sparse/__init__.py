@@ -1,0 +1,394 @@
+"""paddle.sparse analog (reference: python/paddle/sparse/ + COO/CSR tensor
+types at paddle/phi/core/sparse_coo_tensor.h, sparse_csr_tensor.h and kernels
+in paddle/phi/kernels/sparse/).
+
+TPU-native: XLA has no native sparse formats, so COO rides
+jax.experimental.sparse.BCOO (matmul lowers to gather/segment-sum, which XLA
+maps onto the VPU) and CSR is kept as (crows, cols, values) host metadata with
+conversions. Elementwise ops act on the values array directly — zero-preserving
+ops never touch the dense shape.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+from . import nn  # noqa: F401
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class SparseTensor:
+    def __init__(self, shape, dtype):
+        self._shape = tuple(int(s) for s in shape)
+        self._dtype = dtype
+        self.stop_gradient = True
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    def numpy(self):
+        return np.asarray(self.to_dense().numpy())
+
+    def is_sparse(self):
+        return True
+
+
+class SparseCooTensor(SparseTensor):
+    """COO tensor: indices [sparse_dim, nnz], values [nnz, *dense_dims]."""
+
+    def __init__(self, indices, values, shape, coalesced=False):
+        values = _val(values)
+        super().__init__(shape, values.dtype)
+        self._indices = _val(indices).astype(jnp.int32)
+        self._values = values
+        self._coalesced = coalesced
+
+    def indices(self):
+        return Tensor(self._indices)
+
+    def values(self):
+        return Tensor(self._values)
+
+    def nnz(self):
+        return int(self._indices.shape[1])
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def is_coalesced(self):
+        return self._coalesced
+
+    def _bcoo(self):
+        return jsparse.BCOO(
+            (self._values, self._indices.T), shape=self._shape
+        )
+
+    @staticmethod
+    def _from_bcoo(m, coalesced=False):
+        return SparseCooTensor(m.indices.T, m.data, m.shape, coalesced=coalesced)
+
+    def to_dense(self):
+        return Tensor(self._bcoo().todense())
+
+    def to_sparse_csr(self):
+        if len(self._shape) != 2:
+            raise ValueError("to_sparse_csr supports 2-D tensors")
+        coo = coalesce(self)
+        rows = coo._indices[0]
+        cols = coo._indices[1]
+        crows = jnp.zeros(self._shape[0] + 1, jnp.int32).at[rows + 1].add(1)
+        crows = jnp.cumsum(crows)
+        return SparseCsrTensor(crows, cols, coo._values, self._shape)
+
+    def transpose(self, perm):
+        new_indices = self._indices[jnp.asarray(perm)]
+        new_shape = tuple(self._shape[p] for p in perm)
+        return SparseCooTensor(new_indices, self._values, new_shape)
+
+    def __repr__(self):
+        return (
+            f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+            f"dtype={self._dtype})"
+        )
+
+
+class SparseCsrTensor(SparseTensor):
+    """CSR tensor: crows [rows+1], cols [nnz], values [nnz]."""
+
+    def __init__(self, crows, cols, values, shape):
+        values = _val(values)
+        super().__init__(shape, values.dtype)
+        self._crows = _val(crows).astype(jnp.int32)
+        self._cols = _val(cols).astype(jnp.int32)
+        self._values = values
+
+    def crows(self):
+        return Tensor(self._crows)
+
+    def cols(self):
+        return Tensor(self._cols)
+
+    def values(self):
+        return Tensor(self._values)
+
+    def nnz(self):
+        return int(self._cols.shape[0])
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def _row_indices(self):
+        counts = jnp.diff(self._crows)
+        return jnp.repeat(
+            jnp.arange(self._shape[0], dtype=jnp.int32),
+            counts,
+            total_repeat_length=self.nnz(),
+        )
+
+    def to_sparse_coo(self, sparse_dim=2):
+        rows = self._row_indices()
+        indices = jnp.stack([rows, self._cols])
+        return SparseCooTensor(indices, self._values, self._shape, coalesced=True)
+
+    def to_dense(self):
+        return self.to_sparse_coo().to_dense()
+
+    def __repr__(self):
+        return (
+            f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, "
+            f"dtype={self._dtype})"
+        )
+
+
+# ---------------------------------------------------------------------------
+# construction (reference: python/paddle/sparse/creation.py)
+# ---------------------------------------------------------------------------
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None, stop_gradient=True):
+    indices = _val(indices)
+    values = _val(values)
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+
+        values = values.astype(convert_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in indices.max(axis=1))
+    return SparseCooTensor(indices, values, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None, stop_gradient=True):
+    values = _val(values)
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+
+        values = values.astype(convert_dtype(dtype))
+    return SparseCsrTensor(crows, cols, values, shape)
+
+
+def to_sparse_coo(x: Tensor, sparse_dim: int):
+    v = _val(x)
+    if sparse_dim != v.ndim:
+        raise NotImplementedError("only full-sparse conversion supported")
+    m = jsparse.BCOO.fromdense(v)
+    return SparseCooTensor._from_bcoo(m, coalesced=True)
+
+
+def to_sparse_csr(x: Tensor):
+    return to_sparse_coo(x, len(x.shape)).to_sparse_csr()
+
+
+def coalesce(x: SparseCooTensor):
+    """Merge duplicate indices (reference: sparse/unary.py coalesce).
+
+    nse is recomputed on host (eager-only op, like the reference's coalesce
+    kernel) — pinning it would leave phantom out-of-bounds padding entries.
+    """
+    m = x._bcoo().sum_duplicates()
+    return SparseCooTensor._from_bcoo(m, coalesced=True)
+
+
+# ---------------------------------------------------------------------------
+# unary ops on values (reference: python/paddle/sparse/unary.py)
+# ---------------------------------------------------------------------------
+
+
+def _unary(fn):
+    def op(x):
+        if isinstance(x, SparseCooTensor):
+            return SparseCooTensor(x._indices, fn(x._values), x._shape, x._coalesced)
+        if isinstance(x, SparseCsrTensor):
+            return SparseCsrTensor(x._crows, x._cols, fn(x._values), x._shape)
+        return Tensor(fn(_val(x)))
+
+    return op
+
+
+sin = _unary(jnp.sin)
+tan = _unary(jnp.tan)
+asin = _unary(jnp.arcsin)
+atan = _unary(jnp.arctan)
+sinh = _unary(jnp.sinh)
+tanh = _unary(jnp.tanh)
+asinh = _unary(jnp.arcsinh)
+atanh = _unary(jnp.arctanh)
+sqrt = _unary(jnp.sqrt)
+square = _unary(jnp.square)
+log1p = _unary(jnp.log1p)
+abs = _unary(jnp.abs)
+expm1 = _unary(jnp.expm1)
+relu = _unary(jax.nn.relu)
+relu6 = _unary(lambda v: jnp.clip(v, 0.0, 6.0))
+leaky_relu = lambda x, negative_slope=0.01: _unary(  # noqa: E731
+    lambda v: jnp.where(v >= 0, v, v * negative_slope)
+)(x)
+neg = _unary(jnp.negative)
+pow = lambda x, factor: _unary(lambda v: jnp.power(v, factor))(x)  # noqa: E731
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    from ..core.dtype import convert_dtype
+
+    vdt = convert_dtype(value_dtype) if value_dtype is not None else None
+    idt = convert_dtype(index_dtype) if index_dtype is not None else None
+    if isinstance(x, SparseCooTensor):
+        ind = x._indices.astype(idt) if idt else x._indices
+        val = x._values.astype(vdt) if vdt else x._values
+        return SparseCooTensor(ind, val, x._shape, x._coalesced)
+    crows = x._crows.astype(idt) if idt else x._crows
+    cols = x._cols.astype(idt) if idt else x._cols
+    val = x._values.astype(vdt) if vdt else x._values
+    return SparseCsrTensor(crows, cols, val, x._shape)
+
+
+def deg2rad(x):
+    return _unary(jnp.deg2rad)(x)
+
+
+def rad2deg(x):
+    return _unary(jnp.rad2deg)(x)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):
+    d = x.to_dense()._value.sum(axis=axis, keepdims=keepdim)
+    return Tensor(d)
+
+
+def transpose(x, perm):
+    return x.transpose(perm)
+
+
+# ---------------------------------------------------------------------------
+# binary ops (reference: python/paddle/sparse/binary.py)
+# ---------------------------------------------------------------------------
+
+
+def _ensure_same_pattern(x, y):
+    cx, cy = coalesce(x), coalesce(y)
+    if cx.nnz() == cy.nnz() and bool(jnp.all(cx._indices == cy._indices)):
+        return cx, cy
+    return None
+
+
+def _binary(fn):
+    def op(x, y):
+        if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+            same = _ensure_same_pattern(x, y)
+            if same is not None:
+                cx, cy = same
+                return SparseCooTensor(cx._indices, fn(cx._values, cy._values), cx._shape, True)
+            return to_sparse_coo(Tensor(fn(x.to_dense()._value, y.to_dense()._value)), len(x._shape))
+        if isinstance(x, SparseCsrTensor) and isinstance(y, SparseCsrTensor):
+            cooed = op(x.to_sparse_coo(), y.to_sparse_coo())
+            return cooed.to_sparse_csr()
+        raise TypeError("sparse binary ops need two sparse tensors of the same format")
+
+    return op
+
+
+add = _binary(jnp.add)
+subtract = _binary(jnp.subtract)
+multiply = _binary(jnp.multiply)
+divide = _binary(jnp.divide)
+
+
+def matmul(x, y):
+    """sparse @ dense (reference: sparse/binary.py matmul → phi sparse kernels)."""
+    yv = _val(y)
+    if isinstance(x, SparseCsrTensor):
+        x = x.to_sparse_coo()
+    if isinstance(x, SparseCooTensor):
+        out = x._bcoo() @ yv
+        return Tensor(out)
+    raise TypeError("matmul expects a sparse lhs")
+
+
+def masked_matmul(x, y, mask):
+    """Dense@dense with sparse output pattern (reference: masked_matmul).
+
+    mask is a SparseCooTensor/SparseCsrTensor giving the output sparsity.
+    Computes only the masked entries: out[i,j] = x[i,:] @ y[:,j].
+    """
+    xv, yv = _val(x), _val(y)
+    if isinstance(mask, SparseCsrTensor):
+        coo = mask.to_sparse_coo()
+        rows, cols = coo._indices[0], coo._indices[1]
+        vals = jnp.einsum("nk,nk->n", xv[rows, :], yv[:, cols].T)
+        return SparseCsrTensor(mask._crows, mask._cols, vals, mask._shape)
+    rows, cols = mask._indices[0], mask._indices[1]
+    vals = jnp.einsum("nk,nk->n", xv[rows, :], yv[:, cols].T)
+    return SparseCooTensor(mask._indices, vals, mask._shape, mask._coalesced)
+
+
+def mv(x, vec):
+    return matmul(x, vec)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return Tensor(beta * _val(input) + alpha * _val(matmul(x, y)))
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
+__all__ = [
+    "SparseCooTensor",
+    "SparseCsrTensor",
+    "sparse_coo_tensor",
+    "sparse_csr_tensor",
+    "to_sparse_coo",
+    "to_sparse_csr",
+    "coalesce",
+    "matmul",
+    "masked_matmul",
+    "mv",
+    "addmm",
+    "add",
+    "subtract",
+    "multiply",
+    "divide",
+    "sum",
+    "transpose",
+    "cast",
+    "relu",
+    "relu6",
+    "leaky_relu",
+    "sin",
+    "tan",
+    "asin",
+    "atan",
+    "sinh",
+    "tanh",
+    "asinh",
+    "atanh",
+    "sqrt",
+    "square",
+    "log1p",
+    "abs",
+    "expm1",
+    "neg",
+    "pow",
+    "deg2rad",
+    "rad2deg",
+    "nn",
+]

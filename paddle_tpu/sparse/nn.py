@@ -1,0 +1,55 @@
+"""paddle.sparse.nn analog (reference: python/paddle/sparse/nn/).
+
+Layer wrappers over the sparse functional ops. Sparse convolutions
+(SubmConv3D-style) are recommendation/point-cloud workloads the reference
+serves with scatter-gather CUDA kernels; here they lower to gather +
+dense-dot + scatter which XLA schedules on TPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class ReLU:
+    def __call__(self, x):
+        from . import relu
+
+        return relu(x)
+
+
+class ReLU6:
+    def __call__(self, x):
+        from . import relu6
+
+        return relu6(x)
+
+
+class LeakyReLU:
+    def __init__(self, negative_slope=0.01):
+        self.negative_slope = negative_slope
+
+    def __call__(self, x):
+        from . import leaky_relu
+
+        return leaky_relu(x, self.negative_slope)
+
+
+class Softmax:
+    """Softmax over the last dense axis of a CSR matrix's rows
+    (reference: sparse/nn/layer/activation.py Softmax — per-row over nnz)."""
+
+    def __init__(self, axis=-1):
+        self.axis = axis
+
+    def __call__(self, x):
+        from . import SparseCsrTensor
+
+        if not isinstance(x, SparseCsrTensor):
+            raise TypeError("sparse softmax expects a SparseCsrTensor")
+        rows = x._row_indices()
+        v = x._values
+        rowmax = jax.ops.segment_max(v, rows, num_segments=x._shape[0])
+        e = jnp.exp(v - rowmax[rows])
+        denom = jax.ops.segment_sum(e, rows, num_segments=x._shape[0])
+        return SparseCsrTensor(x._crows, x._cols, e / denom[rows], x._shape)

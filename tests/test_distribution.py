@@ -1,0 +1,229 @@
+"""Tests for paddle_tpu.distribution.
+
+Mirrors the reference's test strategy (test/distribution/): compare densities
+/ moments / entropies against scipy.stats, check sampling statistics, KL
+registry dispatch, reparameterized gradients, and TransformedDistribution
+change-of-variables.
+"""
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+def _np(t):
+    return np.asarray(t.numpy(), dtype=np.float64)
+
+
+class TestDensities:
+    def test_normal_logprob_entropy_cdf(self):
+        d = D.Normal(loc=1.5, scale=2.0)
+        x = np.array([-1.0, 0.0, 2.5], np.float32)
+        ref = st.norm(1.5, 2.0)
+        np.testing.assert_allclose(_np(d.log_prob(paddle.to_tensor(x))), ref.logpdf(x), rtol=1e-5)
+        np.testing.assert_allclose(float(_np(d.entropy())), ref.entropy(), rtol=1e-5)
+        np.testing.assert_allclose(_np(d.cdf(paddle.to_tensor(x))), ref.cdf(x), rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(
+            _np(d.icdf(paddle.to_tensor(np.array([0.2, 0.8], np.float32)))),
+            ref.ppf([0.2, 0.8]),
+            rtol=1e-4,
+        )
+
+    def test_uniform(self):
+        d = D.Uniform(low=-1.0, high=3.0)
+        x = np.array([0.0, 2.0], np.float32)
+        ref = st.uniform(-1.0, 4.0)
+        np.testing.assert_allclose(_np(d.log_prob(paddle.to_tensor(x))), ref.logpdf(x), rtol=1e-6)
+        assert np.isneginf(_np(d.log_prob(paddle.to_tensor(np.array([5.0], np.float32)))))[0]
+        np.testing.assert_allclose(float(_np(d.entropy())), ref.entropy(), rtol=1e-6)
+
+    @pytest.mark.parametrize(
+        "dist,ref,x",
+        [
+            (lambda: D.Beta(2.0, 3.0), st.beta(2.0, 3.0), [0.2, 0.7]),
+            (lambda: D.Gamma(2.0, 0.5), st.gamma(2.0, scale=2.0), [0.5, 4.0]),
+            (lambda: D.Exponential(1.5), st.expon(scale=1 / 1.5), [0.3, 2.0]),
+            (lambda: D.Laplace(0.5, 1.2), st.laplace(0.5, 1.2), [-1.0, 2.0]),
+            (lambda: D.Gumbel(0.0, 1.0), st.gumbel_r(0.0, 1.0), [-0.5, 1.5]),
+            (lambda: D.Cauchy(0.0, 1.0), st.cauchy(0.0, 1.0), [-2.0, 0.5]),
+            (lambda: D.LogNormal(0.0, 1.0), st.lognorm(1.0), [0.5, 2.0]),
+            (lambda: D.StudentT(4.0, 0.0, 1.0), st.t(4.0), [-1.0, 0.7]),
+        ],
+    )
+    def test_logpdf_matches_scipy(self, dist, ref, x):
+        d = dist()
+        xv = np.asarray(x, np.float32)
+        np.testing.assert_allclose(
+            _np(d.log_prob(paddle.to_tensor(xv))), ref.logpdf(xv), rtol=2e-4, atol=1e-5
+        )
+        np.testing.assert_allclose(float(_np(d.entropy())), ref.entropy(), rtol=2e-4)
+
+    def test_discrete_pmfs(self):
+        b = D.Bernoulli(0.3)
+        np.testing.assert_allclose(
+            _np(b.log_prob(paddle.to_tensor(np.array([0.0, 1.0], np.float32)))),
+            st.bernoulli(0.3).logpmf([0, 1]),
+            rtol=1e-4,
+        )
+        po = D.Poisson(3.0)
+        np.testing.assert_allclose(
+            _np(po.log_prob(paddle.to_tensor(np.array([0.0, 2.0, 5.0], np.float32)))),
+            st.poisson(3.0).logpmf([0, 2, 5]),
+            rtol=1e-5,
+        )
+        g = D.Geometric(0.25)
+        np.testing.assert_allclose(
+            _np(g.log_prob(paddle.to_tensor(np.array([1.0, 3.0], np.float32)))),
+            st.geom(0.25).logpmf([1, 3]),
+            rtol=1e-5,
+        )
+        bi = D.Binomial(10, 0.4)
+        np.testing.assert_allclose(
+            _np(bi.log_prob(paddle.to_tensor(np.array([0.0, 4.0, 10.0], np.float32)))),
+            st.binom(10, 0.4).logpmf([0, 4, 10]),
+            rtol=1e-4,
+        )
+
+    def test_categorical_and_multinomial(self):
+        logits = np.log(np.array([0.2, 0.3, 0.5], np.float32))
+        c = D.Categorical(logits)
+        np.testing.assert_allclose(
+            _np(c.log_prob(paddle.to_tensor(np.array([0, 2], np.int64)))),
+            np.log([0.2, 0.5]),
+            rtol=1e-5,
+        )
+        np.testing.assert_allclose(
+            float(_np(c.entropy())), st.entropy([0.2, 0.3, 0.5]), rtol=1e-5
+        )
+        m = D.Multinomial(5, np.array([0.2, 0.3, 0.5], np.float32))
+        x = np.array([1.0, 1.0, 3.0], np.float32)
+        np.testing.assert_allclose(
+            float(_np(m.log_prob(paddle.to_tensor(x)))),
+            st.multinomial(5, [0.2, 0.3, 0.5]).logpmf([1, 1, 3]),
+            rtol=1e-5,
+        )
+
+    def test_dirichlet(self):
+        conc = np.array([1.0, 2.0, 3.0], np.float32)
+        d = D.Dirichlet(conc)
+        x = np.array([0.2, 0.3, 0.5], np.float32)
+        np.testing.assert_allclose(
+            float(_np(d.log_prob(paddle.to_tensor(x)))),
+            st.dirichlet(conc).logpdf(x),
+            rtol=1e-5,
+        )
+        np.testing.assert_allclose(float(_np(d.entropy())), st.dirichlet(conc).entropy(), rtol=1e-5)
+
+
+class TestSampling:
+    def test_sample_shapes(self):
+        d = D.Normal(np.zeros((2, 3), np.float32), np.ones((2, 3), np.float32))
+        assert d.sample((5,)).shape == [5, 2, 3]
+        assert D.Dirichlet(np.ones((4,), np.float32)).sample((7,)).shape == [7, 4]
+        assert D.Categorical(np.zeros((3, 5), np.float32)).sample((2,)).shape == [2, 3]
+        assert D.Multinomial(6, np.full((4,), 0.25, np.float32)).sample((3,)).shape == [3, 4]
+
+    def test_sample_moments(self):
+        paddle.seed(7)
+        s = _np(D.Gamma(3.0, 2.0).sample((4000,)))
+        np.testing.assert_allclose(s.mean(), 1.5, rtol=0.1)
+        s = _np(D.Beta(2.0, 5.0).sample((4000,)))
+        np.testing.assert_allclose(s.mean(), 2.0 / 7.0, rtol=0.1)
+        s = _np(D.Poisson(4.0).sample((4000,)))
+        np.testing.assert_allclose(s.mean(), 4.0, rtol=0.1)
+        s = _np(D.Bernoulli(0.3).sample((4000,)))
+        np.testing.assert_allclose(s.mean(), 0.3, rtol=0.15)
+
+    def test_rsample_reparameterized_grad(self):
+        paddle.seed(0)
+        loc = paddle.to_tensor(np.float32(0.5), stop_gradient=False)
+        scale = paddle.to_tensor(np.float32(1.0), stop_gradient=False)
+        d = D.Normal(loc, scale)
+        s = d.rsample((64,))
+        loss = paddle.mean(s)
+        loss.backward()
+        np.testing.assert_allclose(float(loc.grad.numpy()), 1.0, rtol=1e-5)
+
+
+class TestKL:
+    def test_normal_normal(self):
+        p = D.Normal(0.0, 1.0)
+        q = D.Normal(1.0, 2.0)
+        got = float(_np(D.kl_divergence(p, q)))
+        want = np.log(2.0) + (1.0 + 1.0) / (2 * 4.0) - 0.5
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_kl_matches_monte_carlo(self):
+        paddle.seed(3)
+        for p, q in [
+            (D.Beta(2.0, 3.0), D.Beta(3.0, 2.0)),
+            (D.Gamma(2.0, 1.0), D.Gamma(3.0, 1.5)),
+            (D.Exponential(1.0), D.Exponential(2.0)),
+            (D.Categorical(np.log(np.array([0.2, 0.8], np.float32))),
+             D.Categorical(np.log(np.array([0.5, 0.5], np.float32)))),
+        ]:
+            kl = float(_np(D.kl_divergence(p, q)))
+            s = p.sample((8000,))
+            mc = float(_np(paddle.mean(p.log_prob(s) - q.log_prob(s))))
+            np.testing.assert_allclose(kl, mc, rtol=0.2, atol=0.02)
+
+    def test_register_kl_custom(self):
+        class MyDist(D.Normal):
+            pass
+
+        @D.register_kl(MyDist, MyDist)
+        def _kl(p, q):
+            return paddle.to_tensor(np.float32(42.0))
+
+        assert float(_np(D.kl_divergence(MyDist(0.0, 1.0), MyDist(0.0, 1.0)))) == 42.0
+
+
+class TestTransforms:
+    def test_affine_exp_roundtrip(self):
+        t = D.ChainTransform([D.AffineTransform(1.0, 2.0), D.ExpTransform()])
+        x = paddle.to_tensor(np.array([0.1, 0.5], np.float32))
+        y = t.forward(x)
+        np.testing.assert_allclose(_np(t.inverse(y)), _np(x), rtol=1e-5)
+        np.testing.assert_allclose(
+            _np(t.forward_log_det_jacobian(x)),
+            np.log(2.0) + (1.0 + 2.0 * np.array([0.1, 0.5])),
+            rtol=1e-5,
+        )
+
+    def test_tanh_sigmoid_logdet(self):
+        for t, ref_ld in [
+            (D.TanhTransform(), lambda x: np.log(1 - np.tanh(x) ** 2)),
+            (D.SigmoidTransform(), lambda x: np.log(st.logistic.pdf(x))),
+        ]:
+            x = np.array([-1.0, 0.3], np.float32)
+            got = _np(t.forward_log_det_jacobian(paddle.to_tensor(x)))
+            np.testing.assert_allclose(got, ref_ld(x.astype(np.float64)), rtol=1e-4, atol=1e-5)
+
+    def test_stickbreaking(self):
+        t = D.StickBreakingTransform()
+        x = paddle.to_tensor(np.array([0.2, -0.5, 1.0], np.float32))
+        y = t.forward(x)
+        yv = _np(y)
+        assert yv.shape == (4,)
+        np.testing.assert_allclose(yv.sum(), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(_np(t.inverse(y)), _np(x), rtol=1e-4, atol=1e-5)
+
+    def test_transformed_distribution_lognormal(self):
+        td = D.TransformedDistribution(D.Normal(0.0, 1.0), [D.ExpTransform()])
+        x = np.array([0.5, 2.0], np.float32)
+        np.testing.assert_allclose(
+            _np(td.log_prob(paddle.to_tensor(x))),
+            st.lognorm(1.0).logpdf(x),
+            rtol=1e-4,
+        )
+
+    def test_independent(self):
+        base = D.Normal(np.zeros((3, 4), np.float32), np.ones((3, 4), np.float32))
+        ind = D.Independent(base, 1)
+        assert ind.batch_shape == (3,)
+        assert ind.event_shape == (4,)
+        x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        lp = _np(ind.log_prob(paddle.to_tensor(x)))
+        np.testing.assert_allclose(lp, st.norm(0, 1).logpdf(x).sum(-1), rtol=1e-4)

@@ -1,0 +1,162 @@
+"""Tests for paddle_tpu.autograd: PyLayer, saved_tensors_hooks, functional
+jvp/vjp/Jacobian/Hessian (reference: test/legacy_test/test_pylayer_op.py,
+test/autograd/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import (
+    Hessian,
+    Jacobian,
+    PyLayer,
+    hessian,
+    jacobian,
+    jvp,
+    saved_tensors_hooks,
+    vjp,
+)
+
+
+class TestPyLayer:
+    def test_forward_backward(self):
+        class CubePlus(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return paddle.to_tensor(x.numpy() ** 3)
+
+            @staticmethod
+            def backward(ctx, dy):
+                (x,) = ctx.saved_tensor()
+                return dy * 3.0 * x * x
+
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32), stop_gradient=False)
+        y = CubePlus.apply(x)
+        np.testing.assert_allclose(y.numpy(), [1.0, 8.0])
+        loss = paddle.sum(y)
+        loss.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [3.0, 12.0], rtol=1e-6)
+
+    def test_composes_with_registry_ops(self):
+        class Double(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                return x * 2.0
+
+            @staticmethod
+            def backward(ctx, dy):
+                return dy * 2.0
+
+        x = paddle.to_tensor(np.array([3.0], np.float32), stop_gradient=False)
+        y = paddle.exp(Double.apply(paddle.log(x)))  # = x^2... exp(2 log x)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0 * 3.0], rtol=1e-5)
+
+    def test_multiple_inputs_outputs(self):
+        class MulAdd(PyLayer):
+            @staticmethod
+            def forward(ctx, a, b):
+                ctx.save_for_backward(a, b)
+                return a * b, a + b
+
+            @staticmethod
+            def backward(ctx, d_mul, d_add):
+                a, b = ctx.saved_tensor()
+                return d_mul * b + d_add, d_mul * a + d_add
+
+        a = paddle.to_tensor(np.float32(2.0), stop_gradient=False)
+        b = paddle.to_tensor(np.float32(5.0), stop_gradient=False)
+        m, s = MulAdd.apply(a, b)
+        (m + s).backward()
+        np.testing.assert_allclose(float(a.grad.numpy()), 5.0 + 1.0)
+        np.testing.assert_allclose(float(b.grad.numpy()), 2.0 + 1.0)
+
+    def test_stop_gradient_input_gets_no_grad(self):
+        class Scale(PyLayer):
+            @staticmethod
+            def forward(ctx, x, w):
+                ctx.save_for_backward(w)
+                return x * w
+
+            @staticmethod
+            def backward(ctx, dy):
+                (w,) = ctx.saved_tensor()
+                return dy * w, None
+
+        x = paddle.to_tensor(np.float32(1.0), stop_gradient=True)
+        w = paddle.to_tensor(np.float32(4.0), stop_gradient=False)
+        y = Scale.apply(x, w)
+        assert y.stop_gradient is False
+
+    def test_saved_tensors_hooks(self):
+        packed = []
+
+        def pack(t):
+            packed.append(t.shape)
+            return t.numpy()
+
+        def unpack(v):
+            return paddle.to_tensor(v)
+
+        class Square(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x
+
+            @staticmethod
+            def backward(ctx, dy):
+                (x,) = ctx.saved_tensor()
+                return dy * 2.0 * x
+
+        x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+        with saved_tensors_hooks(pack, unpack):
+            y = Square.apply(x)
+        y.backward()
+        assert packed == [[1]]
+        np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+
+class TestFunctional:
+    def test_vjp(self):
+        def f(x):
+            return paddle.sum(x * x)
+
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        out, g = vjp(f, x)
+        np.testing.assert_allclose(float(out.numpy()), 14.0)
+        np.testing.assert_allclose(g.numpy(), [2.0, 4.0, 6.0])
+
+    def test_jvp(self):
+        def f(x):
+            return x * x
+
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        v = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+        out, tang = jvp(f, x, v)
+        np.testing.assert_allclose(tang.numpy(), [2.0, 0.0])
+
+    def test_jacobian(self):
+        def f(x):
+            return paddle.matmul(paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)), x)
+
+        x = paddle.to_tensor(np.array([1.0, 1.0], np.float32))
+        J = jacobian(f, x)
+        np.testing.assert_allclose(J.numpy(), [[1.0, 2.0], [3.0, 4.0]], rtol=1e-6)
+
+    def test_hessian(self):
+        def f(x):
+            return paddle.sum(x * x * x)
+
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        H = hessian(f, x)
+        np.testing.assert_allclose(H.numpy(), np.diag([6.0, 12.0]), rtol=1e-6)
+
+    def test_lazy_jacobian_indexing(self):
+        def f(x):
+            return x * 2.0
+
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        J = Jacobian(f, x)
+        assert J.shape == [3, 3]
+        np.testing.assert_allclose(J[0].numpy(), [2.0, 0.0, 0.0])

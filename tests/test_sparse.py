@@ -1,0 +1,132 @@
+"""Tests for paddle_tpu.sparse (reference: test/legacy_test/test_sparse_*.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _dense_coo():
+    d = np.array(
+        [[0.0, 2.0, 0.0, 4.0],
+         [1.0, 0.0, 0.0, 0.0],
+         [0.0, 0.0, 3.0, 0.0]],
+        np.float32,
+    )
+    return d
+
+
+class TestCreation:
+    def test_coo_roundtrip(self):
+        d = _dense_coo()
+        s = sparse.to_sparse_coo(paddle.to_tensor(d), 2)
+        assert s.is_sparse_coo()
+        assert s.nnz() == 4
+        np.testing.assert_allclose(s.to_dense().numpy(), d)
+
+    def test_coo_from_indices(self):
+        s = sparse.sparse_coo_tensor(
+            indices=np.array([[0, 1, 2], [1, 0, 2]]),
+            values=np.array([2.0, 1.0, 3.0], np.float32),
+            shape=[3, 4],
+        )
+        d = s.to_dense().numpy()
+        assert d[0, 1] == 2.0 and d[1, 0] == 1.0 and d[2, 2] == 3.0
+
+    def test_csr_roundtrip(self):
+        d = _dense_coo()
+        s = sparse.to_sparse_csr(paddle.to_tensor(d))
+        assert s.is_sparse_csr()
+        np.testing.assert_allclose(s.to_dense().numpy(), d)
+        np.testing.assert_array_equal(s.crows().numpy(), [0, 2, 3, 4])
+
+    def test_csr_from_parts(self):
+        s = sparse.sparse_csr_tensor(
+            crows=[0, 2, 3, 4],
+            cols=[1, 3, 0, 2],
+            values=np.array([2.0, 4.0, 1.0, 3.0], np.float32),
+            shape=[3, 4],
+        )
+        np.testing.assert_allclose(s.to_dense().numpy(), _dense_coo())
+
+    def test_coo_csr_conversion(self):
+        d = _dense_coo()
+        coo = sparse.to_sparse_coo(paddle.to_tensor(d), 2)
+        csr = coo.to_sparse_csr()
+        np.testing.assert_allclose(csr.to_dense().numpy(), d)
+        back = csr.to_sparse_coo()
+        np.testing.assert_allclose(back.to_dense().numpy(), d)
+
+    def test_coalesce(self):
+        s = sparse.sparse_coo_tensor(
+            indices=np.array([[0, 0], [1, 1]]),
+            values=np.array([1.0, 2.0], np.float32),
+            shape=[2, 2],
+        )
+        c = sparse.coalesce(s)
+        assert c.is_coalesced()
+        assert c.to_dense().numpy()[0, 1] == 3.0
+
+
+class TestOps:
+    def test_unary_values_only(self):
+        d = _dense_coo()
+        s = sparse.to_sparse_coo(paddle.to_tensor(d), 2)
+        np.testing.assert_allclose(sparse.relu(s).to_dense().numpy(), np.maximum(d, 0))
+        np.testing.assert_allclose(
+            sparse.sqrt(s).to_dense().numpy(), np.sqrt(d), rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            sparse.square(s).to_dense().numpy(), d * d, rtol=1e-6
+        )
+
+    def test_binary_same_pattern(self):
+        d = _dense_coo()
+        a = sparse.to_sparse_coo(paddle.to_tensor(d), 2)
+        b = sparse.to_sparse_coo(paddle.to_tensor(d * 2), 2)
+        np.testing.assert_allclose(sparse.add(a, b).to_dense().numpy(), d * 3)
+        np.testing.assert_allclose(sparse.multiply(a, b).to_dense().numpy(), d * d * 2)
+
+    def test_matmul(self):
+        d = _dense_coo()
+        rng = np.random.RandomState(0)
+        y = rng.randn(4, 5).astype(np.float32)
+        s = sparse.to_sparse_coo(paddle.to_tensor(d), 2)
+        np.testing.assert_allclose(
+            sparse.matmul(s, paddle.to_tensor(y)).numpy(), d @ y, rtol=1e-5
+        )
+        csr = sparse.to_sparse_csr(paddle.to_tensor(d))
+        np.testing.assert_allclose(
+            sparse.matmul(csr, paddle.to_tensor(y)).numpy(), d @ y, rtol=1e-5
+        )
+
+    def test_masked_matmul(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(3, 4).astype(np.float32)
+        y = rng.randn(4, 3).astype(np.float32)
+        mask_dense = (np.array([[1, 0, 1], [0, 1, 0], [1, 1, 0]]) > 0)
+        mask = sparse.to_sparse_coo(paddle.to_tensor(mask_dense.astype(np.float32)), 2)
+        out = sparse.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y), mask)
+        full = x @ y
+        np.testing.assert_allclose(
+            out.to_dense().numpy(), np.where(mask_dense, full, 0.0), rtol=1e-5
+        )
+
+    def test_sparse_softmax(self):
+        d = _dense_coo()
+        csr = sparse.to_sparse_csr(paddle.to_tensor(d))
+        sm = sparse.nn.Softmax()(csr)
+        out = sm.to_dense().numpy()
+        # each row's nonzero entries sum to 1
+        for i in range(3):
+            row_mask = d[i] != 0
+            np.testing.assert_allclose(out[i][row_mask].sum(), 1.0, rtol=1e-5)
+
+    def test_sum_transpose_cast(self):
+        d = _dense_coo()
+        s = sparse.to_sparse_coo(paddle.to_tensor(d), 2)
+        np.testing.assert_allclose(float(sparse.sum(s).numpy()), d.sum(), rtol=1e-6)
+        t = sparse.transpose(s, [1, 0])
+        np.testing.assert_allclose(t.to_dense().numpy(), d.T)
+        c = sparse.cast(s, value_dtype="float16")
+        assert str(c.values().dtype) in ("float16", "paddle.float16")

@@ -84,6 +84,9 @@ from . import models  # noqa: F401, E402
 from . import distribution  # noqa: F401, E402
 from . import autograd  # noqa: F401, E402
 from . import sparse  # noqa: F401, E402
+from . import profiler  # noqa: F401, E402
+from . import geometric  # noqa: F401, E402
+from . import quantization  # noqa: F401, E402
 from . import incubate  # noqa: F401, E402
 from .framework.io import load, save  # noqa: F401, E402
 from .hapi.model import Model, summary  # noqa: F401, E402

@@ -1,0 +1,101 @@
+"""Elastic training manager.
+
+Reference: python/paddle/distributed/fleet/elastic/manager.py:124 — etcd node
+registry under lease TTL (:251-264), membership watch, scale in/out within
+[min_np, max_np], restart of training processes.
+
+TPU-native: the registry lives in the native TCPStore (DCN-side host state;
+SURVEY.md §5.3 calls for rendezvous + health on DCN with preemption-aware
+restart). Nodes heartbeat `node/<rank>` keys; the manager detects stale
+members, decides scale in/out, and signals the launcher (controller.py
+elastic_level) to rebuild the pod. TPU preemption (maintenance events) shows
+up as a vanished heartbeat exactly like a dead etcd lease.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ... import native
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """Heartbeat registry + membership watcher over TCPStore."""
+
+    def __init__(self, store=None, *, host: str = "127.0.0.1", port: int = 0,
+                 rank: Optional[int] = None, np_range=(1, 1),
+                 heartbeat_interval: float = 1.0, ttl: float = 5.0):
+        self.rank = rank if rank is not None else int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        if store is None:
+            if not native.available():
+                raise RuntimeError("elastic needs the native TCPStore")
+            store = native.TCPStore(host, port, is_master=(self.rank == 0))
+        self.store = store
+        self.min_np, self.max_np = np_range
+        self.heartbeat_interval = heartbeat_interval
+        self.ttl = ttl
+        self._stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._watch_cbs: List[Callable[[Dict[int, float]], None]] = []
+
+    # -- node registry (reference: manager.py:251 lease keepalive) ---------
+    def register(self):
+        self._beat()
+        self._hb_thread = threading.Thread(target=self._hb_loop, daemon=True)
+        self._hb_thread.start()
+
+    def _beat(self):
+        self.store.set(f"elastic/node/{self.rank}", str(time.time()))
+
+    def _hb_loop(self):
+        while not self._stop.is_set():
+            self._beat()
+            self._stop.wait(self.heartbeat_interval)
+
+    def alive_nodes(self) -> Dict[int, float]:
+        """Scan heartbeat keys; a node is alive if its beat is within ttl."""
+        now = time.time()
+        alive = {}
+        for r in range(self.max_np):
+            v = self.store.get(f"elastic/node/{r}", blocking=False)
+            if v is not None:
+                try:
+                    ts = float(v.decode())
+                except ValueError:
+                    continue
+                if now - ts <= self.ttl:
+                    alive[r] = ts
+        return alive
+
+    def watch(self, expected_np: int) -> str:
+        """One membership check (reference: manager.py watch:120)."""
+        alive = self.alive_nodes()
+        n = len(alive)
+        for cb in self._watch_cbs:
+            cb(alive)
+        if n == expected_np:
+            return ElasticStatus.HOLD
+        if n < self.min_np:
+            return ElasticStatus.ERROR
+        # scale-in (lost nodes but still viable) or scale-out (new nodes)
+        return ElasticStatus.RESTART
+
+    def add_watch_callback(self, cb: Callable[[Dict[int, float]], None]):
+        self._watch_cbs.append(cb)
+
+    def exit(self, completed: bool = True):
+        self._stop.set()
+        if self._hb_thread:
+            self._hb_thread.join(timeout=2.0)
+        self.store.set(f"elastic/exit/{self.rank}",
+                       ElasticStatus.COMPLETED if completed else ElasticStatus.ERROR)

@@ -1,0 +1,15 @@
+"""Distributed launcher (reference: python/paddle/distributed/launch/).
+
+`python -m paddle_tpu.distributed.launch [--nnodes N] [--nproc_per_node P]
+ [--master host:port] script.py args...`
+
+Reference architecture (SURVEY.md §3.5): main.py:18 launch() -> controller ->
+Master (HTTP/ETCD) sync_peers -> Pod of Container subprocesses with crafted
+PADDLE_* env -> watcher loop. Here the Master is the native C++ TCPStore
+(paddle_tpu/native/src/tcp_store.cc) — no etcd dependency — and each
+Container is a subprocess wired for the single-controller JAX model (one
+process per host; intra-host chips all belong to that process).
+"""
+from .context import Context  # noqa: F401
+from .controller import CollectiveController, Container, Pod  # noqa: F401
+from .main import launch  # noqa: F401

@@ -1,0 +1,68 @@
+"""Launch context: argument/env parsing.
+
+Reference: python/paddle/distributed/launch/context/ (args + env -> Context).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+def free_port() -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@dataclass
+class Context:
+    master: Optional[str] = None
+    nnodes: int = 1
+    node_rank: int = 0
+    nproc_per_node: int = 1
+    log_dir: str = "log"
+    job_id: str = "default"
+    devices: Optional[str] = None
+    training_script: str = ""
+    training_script_args: List[str] = field(default_factory=list)
+    run_mode: str = "collective"
+    elastic_level: int = 0
+    max_restarts: int = 3
+
+    @staticmethod
+    def parse(argv=None) -> "Context":
+        p = argparse.ArgumentParser(
+            prog="paddle_tpu.distributed.launch",
+            description="Launch distributed training (reference: launch/main.py)",
+        )
+        p.add_argument("--master", default=os.environ.get("PADDLE_MASTER"),
+                       help="rendezvous endpoint host:port (TCPStore master)")
+        p.add_argument("--nnodes", type=int,
+                       default=int(os.environ.get("PADDLE_NNODES", "1")))
+        p.add_argument("--node_rank", type=int,
+                       default=int(os.environ.get("PADDLE_NODE_RANK", "0")))
+        p.add_argument("--nproc_per_node", type=int,
+                       default=int(os.environ.get("PADDLE_NPROC_PER_NODE", "1")))
+        p.add_argument("--log_dir", default="log")
+        p.add_argument("--job_id", default="default")
+        p.add_argument("--devices", default=None,
+                       help="comma list of device ids for this node")
+        p.add_argument("--run_mode", default="collective",
+                       choices=["collective", "ps"])
+        p.add_argument("--elastic_level", type=int, default=0)
+        p.add_argument("--max_restarts", type=int, default=3)
+        p.add_argument("training_script")
+        p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+        a = p.parse_args(argv)
+        return Context(
+            master=a.master, nnodes=a.nnodes, node_rank=a.node_rank,
+            nproc_per_node=a.nproc_per_node, log_dir=a.log_dir, job_id=a.job_id,
+            devices=a.devices, training_script=a.training_script,
+            training_script_args=a.training_script_args, run_mode=a.run_mode,
+            elastic_level=a.elastic_level, max_restarts=a.max_restarts,
+        )

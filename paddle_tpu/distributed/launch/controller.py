@@ -1,0 +1,196 @@
+"""Pod/Container process management + TCPStore rendezvous.
+
+Reference: launch/controllers/collective.py (CollectiveController),
+launch/job/pod.py, job/container.py, controllers/master.py:73 (sync_peers),
+controllers/watcher.py. The HTTP/ETCD master is replaced by the native C++
+TCPStore; elastic restart hooks mirror fleet/elastic/manager.py:124.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+from .context import Context, free_port
+
+
+class Container:
+    """One worker subprocess (reference: launch/job/container.py)."""
+
+    def __init__(self, rank: int, cmd: List[str], env: dict, log_path: str):
+        self.rank = rank
+        self.cmd = cmd
+        self.env = env
+        self.log_path = log_path
+        self.proc: Optional[subprocess.Popen] = None
+        self._log_f = None
+
+    def start(self):
+        os.makedirs(os.path.dirname(self.log_path) or ".", exist_ok=True)
+        self._log_f = open(self.log_path, "w")
+        self.proc = subprocess.Popen(
+            self.cmd, env=self.env, stdout=self._log_f, stderr=subprocess.STDOUT
+        )
+
+    def poll(self):
+        return self.proc.poll() if self.proc else None
+
+    def terminate(self, force=False):
+        if self.proc and self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGKILL if force else signal.SIGTERM)
+        if self._log_f:
+            self._log_f.close()
+            self._log_f = None
+
+    def wait(self, timeout=None):
+        return self.proc.wait(timeout=timeout) if self.proc else None
+
+    @property
+    def erred(self):
+        rc = self.poll()
+        return rc is not None and rc != 0
+
+    def logs(self, tail: int = 50) -> str:
+        try:
+            with open(self.log_path) as f:
+                return "".join(f.readlines()[-tail:])
+        except OSError:
+            return ""
+
+
+class Pod:
+    """All containers on this node (reference: launch/job/pod.py)."""
+
+    def __init__(self):
+        self.containers: List[Container] = []
+        self.restarts = 0
+
+    def deploy(self):
+        for c in self.containers:
+            c.start()
+
+    def join(self, poll_interval=1.0):
+        """Watch loop (reference: controllers/watcher.py): returns 0 when all
+        exit cleanly; on any failure tears the pod down and returns that rc."""
+        while True:
+            rcs = [c.poll() for c in self.containers]
+            if any(rc not in (None, 0) for rc in rcs):
+                bad = next(c for c, rc in zip(self.containers, rcs)
+                           if rc not in (None, 0))
+                sys.stderr.write(
+                    f"[launch] rank {bad.rank} failed (rc={bad.poll()}); "
+                    f"last log lines:\n{bad.logs()}\n"
+                )
+                self.stop(force=True)
+                return bad.poll()
+            if all(rc == 0 for rc in rcs):
+                self.stop()
+                return 0
+            time.sleep(poll_interval)
+
+    def stop(self, force=False):
+        for c in self.containers:
+            c.terminate(force=force)
+
+
+class CollectiveController:
+    """Reference: launch/controllers/collective.py.
+
+    Builds per-rank env:
+      PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_LOCAL_RANK /
+      PADDLE_MASTER / PADDLE_TRAINER_ENDPOINTS / PADDLE_CURRENT_ENDPOINT
+    and (multi-node) the jax.distributed coordinator address.
+    """
+
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+        self.pod = Pod()
+
+    def _sync_peers(self, attempt: int = 0):
+        """Multi-node endpoint exchange through the TCPStore master
+        (reference: master.py sync_peers). Single-node is trivial.
+
+        Keys are namespaced by restart attempt so an elastic rebuild never
+        reads stale endpoints from the previous generation; the previous
+        store is closed first so node 0 can re-bind the master port.
+        """
+        ctx = self.ctx
+        if ctx.nnodes <= 1:
+            return [f"127.0.0.1:{free_port()}" for _ in range(ctx.nproc_per_node)]
+        from ... import native
+
+        if getattr(self, "_store", None) is not None:
+            self._store.close()
+            self._store = None
+        host, port = ctx.master.split(":")
+        store = native.TCPStore(host, int(port), is_master=(ctx.node_rank == 0),
+                                world_size=ctx.nnodes)
+        me = f"{_node_ip()}:{free_port()}"
+        store.set(f"peer/{attempt}/{ctx.node_rank}", me)
+        store.add(f"peers_ready/{attempt}", 1)
+        store.wait_ge(f"peers_ready/{attempt}", ctx.nnodes)
+        peers = [store.get(f"peer/{attempt}/{i}").decode() for i in range(ctx.nnodes)]
+        self._store = store  # keep master alive for the job's lifetime
+        return peers
+
+    def build_pod(self, attempt: int = 0):
+        ctx = self.ctx
+        endpoints = self._sync_peers(attempt)
+        world = ctx.nnodes * ctx.nproc_per_node
+        for local_rank in range(ctx.nproc_per_node):
+            rank = ctx.node_rank * ctx.nproc_per_node + local_rank
+            env = dict(os.environ)
+            env.update({
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(world),
+                "PADDLE_LOCAL_RANK": str(local_rank),
+                "PADDLE_NNODES": str(ctx.nnodes),
+                "PADDLE_JOB_ID": ctx.job_id,
+            })
+            if ctx.master:
+                env["PADDLE_MASTER"] = ctx.master
+            if ctx.nnodes > 1:
+                env["PADDLE_TRAINER_ENDPOINTS"] = ",".join(endpoints)
+                env["PADDLE_CURRENT_ENDPOINT"] = endpoints[ctx.node_rank]
+                # single-controller JAX: coordinator = node 0's endpoint
+                env["JAX_COORDINATOR_ADDRESS"] = endpoints[0]
+                env["JAX_NUM_PROCESSES"] = str(ctx.nnodes)
+                env["JAX_PROCESS_ID"] = str(ctx.node_rank)
+            if ctx.devices is not None:
+                devs = ctx.devices.split(",")
+                per = max(1, len(devs) // ctx.nproc_per_node)
+                mine = devs[local_rank * per:(local_rank + 1) * per]
+                env["TPU_VISIBLE_DEVICES"] = ",".join(mine)
+                env["CUDA_VISIBLE_DEVICES"] = ",".join(mine)
+            cmd = [sys.executable, ctx.training_script, *ctx.training_script_args]
+            log = os.path.join(ctx.log_dir, f"workerlog.{rank}")
+            self.pod.containers.append(Container(rank, cmd, env, log))
+
+    def run(self) -> int:
+        ctx = self.ctx
+        attempt = 0
+        while True:
+            self.build_pod(attempt)
+            self.pod.deploy()
+            rc = self.pod.join()
+            if rc == 0 or ctx.elastic_level <= 0 or attempt >= ctx.max_restarts:
+                return rc or 0
+            attempt += 1
+            self.pod = Pod()
+            sys.stderr.write(f"[launch] elastic restart {attempt}/{ctx.max_restarts}\n")
+
+
+def _node_ip() -> str:
+    import socket
+
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("8.8.8.8", 80))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"

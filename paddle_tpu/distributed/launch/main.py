@@ -1,0 +1,20 @@
+"""Launcher entry point (reference: python/paddle/distributed/launch/main.py:18).
+
+Usage: python -m paddle_tpu.distributed.launch --nproc_per_node 2 train.py
+"""
+from __future__ import annotations
+
+import sys
+
+from .context import Context
+from .controller import CollectiveController
+
+
+def launch(argv=None) -> int:
+    ctx = Context.parse(argv)
+    controller = CollectiveController(ctx)
+    return controller.run()
+
+
+if __name__ == "__main__":
+    sys.exit(launch())

@@ -334,7 +334,9 @@ class Beta(ExponentialFamily):
         tot = self.alpha + self.beta
         return self.alpha * self.beta / (tot * tot * (tot + 1.0))
 
-    def rsample(self, shape=()):
+    def sample(self, shape=()):
+        # no rsample: the gamma-ratio draw is not pathwise-differentiable here
+        # (the reference raises the same way for non-reparameterizable cases)
         out_shape = _extend_shape(shape, self.batch_shape)
         a = jnp.broadcast_to(_value(self.alpha), out_shape)
         b = jnp.broadcast_to(_value(self.beta), out_shape)
@@ -344,9 +346,6 @@ class Beta(ExponentialFamily):
         out = Tensor(ga / (ga + gb))
         out.stop_gradient = True
         return out
-
-    def sample(self, shape=()):
-        return self.rsample(shape)
 
     def _log_beta(self):
         return F.lgamma(self.alpha) + F.lgamma(self.beta) - F.lgamma(self.alpha + self.beta)
@@ -436,15 +435,13 @@ class Dirichlet(ExponentialFamily):
         return m * (1.0 - m) / (a0 + 1.0)
 
     def sample(self, shape=()):
+        # no rsample (see Beta.sample)
         out_shape = _extend_shape(shape, self.batch_shape, self.event_shape)
         a = jnp.broadcast_to(_value(self.concentration), out_shape)
         g = jax.random.gamma(_next_key(), a)
         out = Tensor(g / g.sum(-1, keepdims=True))
         out.stop_gradient = True
         return out
-
-    def rsample(self, shape=()):
-        return self.sample(shape)
 
     def log_prob(self, value):
         value = _as_tensor(value)
@@ -529,9 +526,6 @@ class Geometric(Distribution):
         out = Tensor(k)
         out.stop_gradient = True
         return out
-
-    def rsample(self, shape=()):
-        return self.sample(shape)
 
     def log_prob(self, value):
         value = _as_tensor(value)
@@ -700,6 +694,8 @@ class StudentT(Distribution):
         return self.loc + self.scale * noise
 
     def rsample(self, shape=()):
+        """Pathwise gradients flow to loc/scale; df has no pathwise gradient
+        (the t-noise is detached, as in the location-scale reparameterization)."""
         return self.sample(shape)
 
     def log_prob(self, value):
@@ -749,17 +745,19 @@ class Poisson(Distribution):
         out.stop_gradient = True
         return out
 
-    def rsample(self, shape=()):
-        return self.sample(shape)
-
     def log_prob(self, value):
         value = _as_tensor(value)
         return value * F.log(self.rate) - self.rate - F.lgamma(value + 1.0)
 
     def entropy(self):
-        # Series approximation used by the reference for moderate rates; exact
-        # enumeration over a truncated support keeps it simple + compilable.
-        ks = Tensor(jnp.arange(0.0, 64.0))
+        # Exact enumeration over an adaptive truncated support (covers
+        # rate + 12*sqrt(rate)); beyond 1e4 the Gaussian limit
+        # 0.5*log(2*pi*e*rate) is exact to <1e-5 nats.
+        rmax = float(jnp.max(_value(self.rate)))
+        if rmax > 1e4:
+            return 0.5 * F.log(2.0 * math.pi * math.e * self.rate)
+        k_hi = int(rmax + 12.0 * math.sqrt(max(rmax, 1.0)) + 20.0)
+        ks = Tensor(jnp.arange(0.0, float(k_hi)))
         rate = F.unsqueeze(F.broadcast_to(self.rate, list(self.batch_shape) or [1]), -1)
         lp = ks * F.log(rate) - rate - F.lgamma(ks + 1.0)
         p = F.exp(lp)
@@ -796,9 +794,6 @@ class Binomial(Distribution):
         out = Tensor(s)
         out.stop_gradient = True
         return out
-
-    def rsample(self, shape=()):
-        return self.sample(shape)
 
     def log_prob(self, value):
         value = _as_tensor(value)

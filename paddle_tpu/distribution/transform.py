@@ -43,6 +43,9 @@ class Transform:
     def inverse(self, y):
         return self._inverse(_as_tensor(y))
 
+    def _overrides_public_fldj(self):
+        return type(self).forward_log_det_jacobian is not Transform.forward_log_det_jacobian
+
     def forward_log_det_jacobian(self, x):
         x = _as_tensor(x)
         if hasattr(self, "_forward_log_det_jacobian"):
@@ -58,7 +61,9 @@ class Transform:
         y = _as_tensor(y)
         if hasattr(self, "_inverse_log_det_jacobian"):
             return self._inverse_log_det_jacobian(y)
-        if hasattr(self, "_forward_log_det_jacobian"):
+        # composite transforms (Chain/Independent/Stack) override the public
+        # forward method instead of the underscore hook
+        if hasattr(self, "_forward_log_det_jacobian") or self._overrides_public_fldj():
             return -self.forward_log_det_jacobian(self.inverse(y))
         raise NotImplementedError(
             f"{type(self).__name__} defines neither _forward_log_det_jacobian "

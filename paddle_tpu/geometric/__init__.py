@@ -1,0 +1,174 @@
+"""paddle.geometric analog (reference: python/paddle/geometric/ +
+phi graph_send_recv / graph_send_ue_recv kernels).
+
+GNN message passing on TPU: gather (take) + segment-reduce, which XLA lowers
+to vectorized scatter-adds — the same dataflow the reference's CUDA kernels
+hand-fuse. All ops take static out_size (pad the node dim) to stay
+jit-friendly.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops.registry import register_op
+from ..ops import api as F
+
+
+def _seg_reduce(data, segment_ids, num_segments, pool_type):
+    pool_type = pool_type.lower()
+    if pool_type == "sum":
+        return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+    if pool_type == "mean":
+        s = jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+        cnt = jax.ops.segment_sum(
+            jnp.ones((data.shape[0],), data.dtype), segment_ids, num_segments=num_segments
+        )
+        return s / jnp.maximum(cnt, 1.0).reshape((-1,) + (1,) * (data.ndim - 1))
+    if pool_type == "max":
+        out = jax.ops.segment_max(data, segment_ids, num_segments=num_segments)
+        return jnp.where(jnp.isneginf(out), 0.0, out)
+    if pool_type == "min":
+        out = jax.ops.segment_min(data, segment_ids, num_segments=num_segments)
+        return jnp.where(jnp.isposinf(out), 0.0, out)
+    raise ValueError(f"unknown reduce_op {pool_type}")
+
+
+@register_op("graph_send_recv")
+def _graph_send_recv(x, src_index, dst_index, reduce_op="sum", out_size=None):
+    n = int(out_size) if out_size else x.shape[0]
+    msgs = jnp.take(x, src_index, axis=0)
+    return _seg_reduce(msgs, dst_index, n, reduce_op)
+
+
+@register_op("graph_send_ue_recv")
+def _graph_send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                        reduce_op="sum", out_size=None):
+    n = int(out_size) if out_size else x.shape[0]
+    xs = jnp.take(x, src_index, axis=0)
+    ye = jnp.asarray(y)
+    if ye.ndim < xs.ndim:
+        ye = ye.reshape(ye.shape + (1,) * (xs.ndim - ye.ndim))
+    msgs = xs + ye if message_op.lower() == "add" else xs * ye
+    return _seg_reduce(msgs, dst_index, n, reduce_op)
+
+
+@register_op("graph_send_uv")
+def _graph_send_uv(x, y, src_index, dst_index, message_op="add"):
+    xs = jnp.take(x, src_index, axis=0)
+    yd = jnp.take(y, dst_index, axis=0)
+    return xs + yd if message_op.lower() == "add" else xs * yd
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None, name=None):
+    """Gather x[src] and reduce into dst (reference: message_passing.py send_u_recv)."""
+    return F.graph_send_recv(x, src_index, dst_index, reduce_op=reduce_op,
+                             out_size=out_size)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add", reduce_op="sum",
+                 out_size=None, name=None):
+    """x[src] (+|*) edge feature y, reduced into dst."""
+    return F.graph_send_ue_recv(x, y, src_index, dst_index, message_op=message_op,
+                                reduce_op=reduce_op, out_size=out_size)
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message x[src] (+|*) y[dst]."""
+    return F.graph_send_uv(x, y, src_index, dst_index, message_op=message_op)
+
+
+# -- segment math (reference: python/paddle/geometric/math.py) -------------
+
+
+def _segment(fn_name):
+    def op(data, segment_ids, name=None):
+        d = data._value if isinstance(data, Tensor) else jnp.asarray(data)
+        s = segment_ids._value if isinstance(segment_ids, Tensor) else jnp.asarray(segment_ids)
+        n = int(s.max()) + 1 if s.size else 0
+        return Tensor(_seg_reduce(d, s, n, fn_name))
+
+    return op
+
+
+segment_sum = _segment("sum")
+segment_mean = _segment("mean")
+segment_max = _segment("max")
+segment_min = _segment("min")
+
+
+# -- sampling/reindex (reference: python/paddle/geometric/sampling/,
+#    reindex.py) — host-side graph preprocessing, eager-only by design ------
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                     eids=None, return_eids=False, perm_buffer=None, name=None):
+    """Uniformly sample up to sample_size in-neighbors per input node from a
+    CSC graph (reference: sampling/neighbors.py). Host-side (numpy) — graph
+    prep feeds the device pipeline, like the reference's CPU sampler."""
+    rown = np.asarray(row.numpy() if isinstance(row, Tensor) else row)
+    colp = np.asarray(colptr.numpy() if isinstance(colptr, Tensor) else colptr)
+    nodes = np.asarray(input_nodes.numpy() if isinstance(input_nodes, Tensor) else input_nodes)
+    eid = np.asarray(eids.numpy() if isinstance(eids, Tensor) else eids) if eids is not None else None
+
+    out_nb, out_cnt, out_eids = [], [], []
+    rng = np.random.default_rng()
+    for nd in nodes.reshape(-1):
+        beg, end = int(colp[nd]), int(colp[nd + 1])
+        nbrs = rown[beg:end]
+        ids = np.arange(beg, end)
+        if sample_size >= 0 and len(nbrs) > sample_size:
+            pick = rng.choice(len(nbrs), size=sample_size, replace=False)
+            nbrs, ids = nbrs[pick], ids[pick]
+        out_nb.append(nbrs)
+        out_cnt.append(len(nbrs))
+        if eid is not None:
+            out_eids.append(eid[ids])
+    neighbors = Tensor(np.concatenate(out_nb) if out_nb else np.array([], rown.dtype))
+    counts = Tensor(np.asarray(out_cnt, np.int32))
+    if return_eids:
+        if eid is None:
+            raise ValueError("return_eids=True needs eids")
+        return neighbors, counts, Tensor(np.concatenate(out_eids))
+    return neighbors, counts
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None, name=None):
+    """Compact global node ids to local ids (reference: reindex.py).
+
+    Returns (reindexed_src, reindexed_dst, out_nodes): out_nodes is x then
+    first-seen new neighbor ids; edges (neighbors -> repeated x) re-expressed
+    in local ids.
+    """
+    xv = np.asarray(x.numpy() if isinstance(x, Tensor) else x).reshape(-1)
+    nb = np.asarray(neighbors.numpy() if isinstance(neighbors, Tensor) else neighbors).reshape(-1)
+    cnt = np.asarray(count.numpy() if isinstance(count, Tensor) else count).reshape(-1)
+
+    mapping = {int(v): i for i, v in enumerate(xv)}
+    out_nodes = list(xv)
+    src = np.empty(len(nb), np.int64)
+    for i, v in enumerate(nb):
+        v = int(v)
+        if v not in mapping:
+            mapping[v] = len(out_nodes)
+            out_nodes.append(v)
+        src[i] = mapping[v]
+    dst = np.repeat(np.arange(len(xv), dtype=np.int64), cnt)
+    return Tensor(src), Tensor(dst), Tensor(np.asarray(out_nodes, xv.dtype))
+
+
+__all__ = [
+    "send_u_recv",
+    "send_ue_recv",
+    "send_uv",
+    "segment_sum",
+    "segment_mean",
+    "segment_max",
+    "segment_min",
+    "sample_neighbors",
+    "reindex_graph",
+]

@@ -142,12 +142,23 @@ class MoELayer(Layer):
         self.add_sublayer("gate", self.gate)
         self.gate.training = self.training  # lazy build must inherit train/eval mode
 
+    def _routing_fanout(self) -> int:
+        """Tokens-per-slot multiplier: top-k of the routing scheme."""
+        if isinstance(self._gate_kind, str):
+            return {"naive": self._top_k, "switch": 1, "gshard": 2}[self._gate_kind]
+        g = self._gate_kind
+        if isinstance(g, SwitchGate):
+            return 1
+        if isinstance(g, GShardGate):
+            return 2
+        return getattr(g, "top_k", 2)
+
     def forward(self, x: Tensor) -> Tensor:
         orig_shape = list(x.shape)
         d = orig_shape[-1]
         x2d = F.reshape(x, [-1, d])
         tokens = x2d.shape[0]
-        k = self._top_k if self._gate_kind == "naive" else 2
+        k = self._routing_fanout()
         capacity = max(1, int(self.capacity_factor * k * tokens / self.num_experts))
         if self.gate is None:
             self._build_gate(capacity)

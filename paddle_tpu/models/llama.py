@@ -1,0 +1,180 @@
+"""LLaMA family (BASELINE configs[3-4]: LLaMA-2 70B-class sharding-3).
+
+Reference analog: the llama models driven through Paddle's fleet/DistTensor
+examples (semi-auto LLaMA in python/paddle/distributed/auto_parallel docs,
+fused rope/rms_norm ops at python/paddle/incubate/nn/functional/
+fused_rotary_position_embedding.py, rms_norm.py).
+
+TPU-first: pre-norm RMSNorm + SwiGLU + rotary, grouped-query attention
+(num_key_value_heads < num_heads repeats K/V — keeps KV cache and HBM traffic
+small), bf16-friendly throughout, attention via the Pallas flash kernel path
+of F.scaled_dot_product_attention. TP = Column/Row/Vocab parallel shardings;
+long context composes with the 'sep' mesh axis (distributed/context_parallel).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..distributed.fleet.mp_layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from ..nn import functional as F
+from ..ops import api
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_key_value_heads: int = 0  # 0 -> num_heads (MHA); < num_heads -> GQA
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = False
+
+    def __post_init__(self):
+        if not self.num_key_value_heads:
+            self.num_key_value_heads = self.num_heads
+
+    @staticmethod
+    def llama2_7b():
+        return LlamaConfig()
+
+    @staticmethod
+    def llama2_70b():
+        return LlamaConfig(hidden_size=8192, intermediate_size=28672,
+                           num_layers=80, num_heads=64, num_key_value_heads=8)
+
+    @staticmethod
+    def tiny():
+        return LlamaConfig(vocab_size=512, hidden_size=128, intermediate_size=256,
+                           num_layers=2, num_heads=4, num_key_value_heads=2,
+                           max_position_embeddings=128)
+
+
+def _rope_tables(head_dim, max_len, theta, dtype=jnp.float32):
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)  # [S, D/2]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)
+    return Tensor(jnp.cos(emb).astype(dtype)), Tensor(jnp.sin(emb).astype(dtype))
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        c = config
+        self.num_heads = c.num_heads
+        self.num_kv_heads = c.num_key_value_heads
+        self.head_dim = c.hidden_size // c.num_heads
+        self.q_proj = ColumnParallelLinear(c.hidden_size, c.num_heads * self.head_dim,
+                                           has_bias=False, gather_output=False)
+        self.k_proj = ColumnParallelLinear(c.hidden_size, self.num_kv_heads * self.head_dim,
+                                           has_bias=False, gather_output=False)
+        self.v_proj = ColumnParallelLinear(c.hidden_size, self.num_kv_heads * self.head_dim,
+                                           has_bias=False, gather_output=False)
+        self.o_proj = RowParallelLinear(c.num_heads * self.head_dim, c.hidden_size,
+                                        has_bias=False, input_is_parallel=True)
+
+    def forward(self, x, rope):
+        b, s, h = x.shape
+        q = api.reshape(self.q_proj(x), [b, s, self.num_heads, self.head_dim])
+        k = api.reshape(self.k_proj(x), [b, s, self.num_kv_heads, self.head_dim])
+        v = api.reshape(self.v_proj(x), [b, s, self.num_kv_heads, self.head_dim])
+        q, k = api.rotary_position_embedding(q, k, rope[0], rope[1])
+        if self.num_kv_heads != self.num_heads:
+            rep = self.num_heads // self.num_kv_heads
+            k = api.repeat_interleave(k, rep, axis=2)
+            v = api.repeat_interleave(v, rep, axis=2)
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        out = api.reshape(out, [b, s, self.num_heads * self.head_dim])
+        return self.o_proj(out)
+
+
+class LlamaMLP(nn.Layer):
+    """SwiGLU: down(silu(gate(x)) * up(x))."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        c = config
+        self.gate_proj = ColumnParallelLinear(c.hidden_size, c.intermediate_size,
+                                              has_bias=False, gather_output=False)
+        self.up_proj = ColumnParallelLinear(c.hidden_size, c.intermediate_size,
+                                            has_bias=False, gather_output=False)
+        self.down_proj = RowParallelLinear(c.intermediate_size, c.hidden_size,
+                                           has_bias=False, input_is_parallel=True)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = nn.RMSNorm(config.hidden_size,
+                                                   epsilon=config.rms_norm_eps)
+        self.mlp = LlamaMLP(config)
+
+    def forward(self, x, rope):
+        x = x + self.self_attn(self.input_layernorm(x), rope)
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = VocabParallelEmbedding(config.vocab_size, config.hidden_size)
+        self.layers = nn.LayerList([LlamaDecoderLayer(config)
+                                    for _ in range(config.num_layers)])
+        self.norm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        head_dim = config.hidden_size // config.num_heads
+        self._rope = _rope_tables(head_dim, config.max_position_embeddings,
+                                  config.rope_theta)
+
+    def forward(self, input_ids):
+        s = input_ids.shape[1]
+        cos = Tensor(self._rope[0]._value[:s])
+        sin = Tensor(self._rope[1]._value[:s])
+        h = self.embed_tokens(input_ids)
+        for layer in self.layers:
+            h = layer(h, (cos, sin))
+        return self.norm(h)
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.model = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = ColumnParallelLinear(config.hidden_size, config.vocab_size,
+                                                has_bias=False)
+
+    def forward(self, input_ids, labels=None):
+        h = self.model(input_ids)
+        if self.lm_head is None:
+            logits = api.matmul(h, api.t(self.model.embed_tokens.weight))
+        else:
+            logits = self.lm_head(h)
+        if labels is not None:
+            b, s, v = logits.shape
+            shift_logits = api.reshape(logits[:, :-1, :], [-1, v])
+            shift_labels = api.reshape(labels[:, 1:], [-1])
+            return F.cross_entropy(shift_logits, shift_labels)
+        return logits

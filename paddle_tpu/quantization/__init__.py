@@ -1,0 +1,28 @@
+"""paddle.quantization analog (reference: python/paddle/quantization/ —
+QuantConfig, QAT/PTQ entry points, observers, quanters; backed by
+quantize_linear/dequantize_linear phi kernels).
+
+TPU-native: fake-quant is simulated in bf16/fp32 arithmetic (quantize ->
+round -> dequantize stays inside the compiled graph, so XLA folds it into the
+surrounding matmuls); int8 *execution* is an XLA lowering concern
+(int8 dot_general on MXU), reached through the same scale metadata this
+module produces.
+"""
+from .config import QuantConfig  # noqa: F401
+from .observers import AbsmaxObserver, BaseObserver, EMAObserver  # noqa: F401
+from .qat import QAT  # noqa: F401
+from .ptq import PTQ  # noqa: F401
+from .quanters import FakeQuanterWithAbsMax  # noqa: F401
+from .layers import QuantedLinear, QuantedConv2D  # noqa: F401
+
+__all__ = [
+    "QuantConfig",
+    "QAT",
+    "PTQ",
+    "BaseObserver",
+    "AbsmaxObserver",
+    "EMAObserver",
+    "FakeQuanterWithAbsMax",
+    "QuantedLinear",
+    "QuantedConv2D",
+]

@@ -1,0 +1,54 @@
+"""QuantConfig (reference: python/paddle/quantization/config.py).
+
+Maps layer types / names / full layers to (activation, weight) quantizer
+factories, with the same precedence the reference uses: by-layer > by-name >
+by-type > global default.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Type
+
+from ..nn.layer import Layer
+
+
+class QuantConfig:
+    def __init__(self, activation=None, weight=None):
+        self._global = (activation, weight)
+        self._by_type: Dict[type, tuple] = {}
+        self._by_name: Dict[str, tuple] = {}
+        self._by_layer: Dict[int, tuple] = {}
+        self._customized_leaves = []
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._by_layer[id(l)] = (activation, weight)
+
+    def add_name_config(self, layer_name, activation=None, weight=None):
+        names = layer_name if isinstance(layer_name, (list, tuple)) else [layer_name]
+        for n in names:
+            self._by_name[n] = (activation, weight)
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) else [layer_type]
+        for t in types:
+            self._by_type[t] = (activation, weight)
+
+    def add_qat_layer_mapping(self, source: Type[Layer], target: Type[Layer]):
+        self._qat_mapping = getattr(self, "_qat_mapping", {})
+        self._qat_mapping[source] = target
+
+    def get_config(self, layer: Layer, name: Optional[str] = None):
+        """Resolve (activation_factory, weight_factory) for a layer."""
+        if id(layer) in self._by_layer:
+            return self._by_layer[id(layer)]
+        if name is not None and name in self._by_name:
+            return self._by_name[name]
+        for t, cfg in self._by_type.items():
+            if isinstance(layer, t):
+                return cfg
+        return self._global
+
+    def needs_quant(self, layer: Layer, name: Optional[str] = None) -> bool:
+        a, w = self.get_config(layer, name)
+        return a is not None or w is not None

@@ -1,0 +1,42 @@
+"""Quantized layer wrappers (reference: paddle/nn/quant/ QuantedLinear etc.)."""
+from __future__ import annotations
+
+from ..nn import functional as NF
+from ..nn.layer import Layer
+from .quanters import FakeQuanterWithAbsMax
+
+
+class QuantedLinear(Layer):
+    """Linear with fake-quant on activation + weight."""
+
+    def __init__(self, source, activation_quanter=None, weight_quanter=None):
+        super().__init__()
+        self.weight = source.weight
+        self.bias = getattr(source, "bias", None)
+        self.activation_quanter = activation_quanter or FakeQuanterWithAbsMax()
+        self.weight_quanter = weight_quanter or FakeQuanterWithAbsMax()
+
+    def forward(self, x):
+        x = self.activation_quanter(x)
+        w = self.weight_quanter(self.weight)
+        return NF.linear(x, w, self.bias)
+
+
+class QuantedConv2D(Layer):
+    def __init__(self, source, activation_quanter=None, weight_quanter=None):
+        super().__init__()
+        self._source = source
+        self.weight = source.weight
+        self.bias = getattr(source, "bias", None)
+        self.activation_quanter = activation_quanter or FakeQuanterWithAbsMax()
+        self.weight_quanter = weight_quanter or FakeQuanterWithAbsMax()
+
+    def forward(self, x):
+        from ..ops import api
+
+        x = self.activation_quanter(x)
+        w = self.weight_quanter(self.weight)
+        s = self._source
+        return api.conv2d(x, w, bias=self.bias, stride=s._stride,
+                          padding=s._padding, dilation=s._dilation,
+                          groups=s._groups)

@@ -1,0 +1,51 @@
+"""Observers: collect activation/weight ranges (reference:
+python/paddle/quantization/observers/abs_max.py etc.)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class BaseObserver:
+    def __init__(self, quant_bits: int = 8):
+        self.quant_bits = quant_bits
+        self._scale = None
+
+    def observe(self, x: Tensor):
+        raise NotImplementedError
+
+    def scales(self):
+        return self._scale
+
+    def bound(self):
+        return float(2 ** (self.quant_bits - 1) - 1)
+
+    def quant_axis(self):
+        return -1
+
+
+class AbsmaxObserver(BaseObserver):
+    """Running max of |x| (reference: observers/abs_max.py)."""
+
+    def observe(self, x: Tensor):
+        m = float(jnp.max(jnp.abs(x._value)))
+        self._scale = m if self._scale is None else max(self._scale, m)
+        return x
+
+
+class EMAObserver(BaseObserver):
+    """Exponential moving average of per-batch absmax (the reference's
+    moving_average_abs_max)."""
+
+    def __init__(self, quant_bits: int = 8, moving_rate: float = 0.9):
+        super().__init__(quant_bits)
+        self.moving_rate = moving_rate
+
+    def observe(self, x: Tensor):
+        m = float(jnp.max(jnp.abs(x._value)))
+        if self._scale is None:
+            self._scale = m
+        else:
+            self._scale = self.moving_rate * self._scale + (1 - self.moving_rate) * m
+        return x

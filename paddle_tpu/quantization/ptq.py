@@ -1,0 +1,49 @@
+"""Post-training quantization (reference: python/paddle/quantization/ptq.py).
+
+PTQ.quantize installs observers via forward-post hooks; after calibration
+batches run, convert() computes scales and leaves them on the layers.
+"""
+from __future__ import annotations
+
+from ..nn.layer import Layer
+from ..nn.layers import Conv2D, Linear
+from .config import QuantConfig
+from .observers import AbsmaxObserver
+
+
+class PTQ:
+    def __init__(self, config: QuantConfig):
+        self.config = config
+        self._observers = []
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+        for name, sub in model.named_sublayers():
+            if isinstance(sub, (Linear, Conv2D)) and self.config.needs_quant(sub, name):
+                a, w = self.config.get_config(sub, name)
+                obs = (a or AbsmaxObserver)()
+                sub._ptq_observer = obs
+                self._observers.append((sub, obs))
+                hook = self._make_hook(obs)
+                sub.register_forward_post_hook(hook)
+        return model
+
+    @staticmethod
+    def _make_hook(obs):
+        def hook(layer, inputs, outputs):
+            obs.observe(outputs if not isinstance(outputs, tuple) else outputs[0])
+            return outputs
+
+        return hook
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        for sub, obs in self._observers:
+            sub.activation_scale = obs.scales()
+            if getattr(sub, "weight", None) is not None:
+                w_obs = AbsmaxObserver()
+                w_obs.observe(sub.weight)
+                sub.weight_scale = w_obs.scales()
+        return model

@@ -1,0 +1,54 @@
+"""Quantization-aware training (reference: python/paddle/quantization/qat.py).
+
+QAT.quantize(model) swaps quantizable sublayers for their Quanted*
+counterparts in place (the reference rewrites the layer tree the same way);
+convert() strips quanters for export, leaving collected scales on the layer.
+"""
+from __future__ import annotations
+
+from ..nn.layer import Layer
+from ..nn.layers import Conv2D, Linear
+from .config import QuantConfig
+from .layers import QuantedConv2D, QuantedLinear
+
+_DEFAULT_MAPPING = {Linear: QuantedLinear, Conv2D: QuantedConv2D}
+
+
+class QAT:
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def _wrap(self, layer, name=None):
+        a, w = self.config.get_config(layer, name)
+        for src, dst in {**_DEFAULT_MAPPING,
+                         **getattr(self.config, "_qat_mapping", {})}.items():
+            if isinstance(layer, src):
+                return dst(layer,
+                           activation_quanter=a() if a else None,
+                           weight_quanter=w() if w else None)
+        return None
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+        for name, sub in list(model._sub_layers.items()):
+            if self.config.needs_quant(sub, name):
+                wrapped = self._wrap(sub, name)
+                if wrapped is not None:
+                    model._sub_layers[name] = wrapped
+                    continue
+            self.quantize(sub, inplace=True)
+        return model
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        """Fold quanters away for inference export; scales stay as attrs."""
+        for name, sub in list(model._sub_layers.items()):
+            if isinstance(sub, (QuantedLinear, QuantedConv2D)):
+                # keep the quantized wrapper but freeze its quanters' scales
+                sub.weight_scale = sub.weight_quanter.scales()
+                sub.activation_scale = sub.activation_quanter.scales()
+            else:
+                self.convert(sub, inplace=True)
+        return model

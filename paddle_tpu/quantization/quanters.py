@@ -1,0 +1,42 @@
+"""Fake quanters: simulated quantization inside the training graph
+(reference: python/paddle/quantization/quanters/abs_max.py
+FakeQuanterWithAbsMaxObserver).
+
+Straight-through estimator: rounding happens on detached values; the
+quantize-dequantize delta is re-applied as an additive constant so gradients
+flow through unchanged (the reference implements the same STE inside the
+fake_quantize CUDA kernels).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops import api as F
+
+
+def fake_quant_dequant(x: Tensor, scale: float, bits: int = 8) -> Tensor:
+    bound = float(2 ** (bits - 1) - 1)
+    s = max(scale, 1e-8) / bound
+    q = jnp.clip(jnp.round(x._value / s), -bound, bound) * s
+    delta = Tensor(q - x._value)  # detached STE correction
+    delta.stop_gradient = True
+    return x + delta
+
+
+class FakeQuanterWithAbsMax:
+    def __init__(self, quant_bits: int = 8, moving_rate: float = 0.9):
+        self.quant_bits = quant_bits
+        self.moving_rate = moving_rate
+        self._scale = None
+
+    def __call__(self, x: Tensor) -> Tensor:
+        m = float(jnp.max(jnp.abs(x._value)))
+        if self._scale is None:
+            self._scale = m
+        else:
+            self._scale = self.moving_rate * self._scale + (1 - self.moving_rate) * m
+        return fake_quant_dequant(x, self._scale, self.quant_bits)
+
+    def scales(self):
+        return self._scale

@@ -1,0 +1,104 @@
+"""Tests for the launcher + elastic manager (reference: the
+TestMultipleGpus.run_mnist_2gpu pattern, SURVEY.md §4 — shell out to the
+launcher with a payload script and check rank outputs)."""
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu import native
+from paddle_tpu.distributed.launch.context import Context, free_port
+from paddle_tpu.distributed.launch.controller import CollectiveController
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PAYLOAD = """
+import os, sys
+rank = os.environ["PADDLE_TRAINER_ID"]
+world = os.environ["PADDLE_TRAINERS_NUM"]
+print(f"rank={rank} world={world} arg={sys.argv[1]}")
+"""
+
+FAIL_PAYLOAD = """
+import os, sys
+sys.exit(3 if os.environ["PADDLE_TRAINER_ID"] == "1" else 0)
+"""
+
+
+def _run_launch(tmp_path, payload, nproc=2, extra=None, script_args=("hello",)):
+    script = tmp_path / "payload.py"
+    script.write_text(payload)
+    log_dir = tmp_path / "logs"
+    argv = ["--nproc_per_node", str(nproc), "--log_dir", str(log_dir),
+            *(extra or []), str(script), *script_args]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    p = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch", *argv],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    return p, log_dir
+
+
+class TestLauncher:
+    def test_two_ranks_env(self, tmp_path):
+        p, log_dir = _run_launch(tmp_path, PAYLOAD, nproc=2)
+        assert p.returncode == 0, p.stderr
+        logs = sorted(log_dir.glob("workerlog.*"))
+        assert len(logs) == 2
+        contents = [f.read_text() for f in logs]
+        assert any("rank=0 world=2 arg=hello" in c for c in contents)
+        assert any("rank=1 world=2 arg=hello" in c for c in contents)
+
+    def test_failure_propagates(self, tmp_path):
+        p, _ = _run_launch(tmp_path, FAIL_PAYLOAD, nproc=2)
+        assert p.returncode == 3
+
+    def test_context_parse(self):
+        ctx = Context.parse(["--nproc_per_node", "4", "--nnodes", "2",
+                             "--node_rank", "1", "--master", "h:1234",
+                             "train.py", "--lr", "0.1"])
+        assert ctx.nproc_per_node == 4
+        assert ctx.nnodes == 2
+        assert ctx.node_rank == 1
+        assert ctx.master == "h:1234"
+        assert ctx.training_script == "train.py"
+        assert ctx.training_script_args == ["--lr", "0.1"]
+
+
+@pytest.mark.skipif(not native.available(), reason="needs native TCPStore")
+class TestElastic:
+    def test_heartbeat_and_watch(self):
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager, ElasticStatus
+
+        port = free_port()
+        m0 = ElasticManager(host="127.0.0.1", port=port, rank=0, np_range=(1, 4),
+                            heartbeat_interval=0.2, ttl=2.0)
+        m0.register()
+        store1 = native.TCPStore("127.0.0.1", port, is_master=False)
+        m1 = ElasticManager(store1, rank=1, np_range=(1, 4),
+                            heartbeat_interval=0.2, ttl=2.0)
+        m1.register()
+        time.sleep(0.5)
+        assert set(m0.alive_nodes()) == {0, 1}
+        assert m0.watch(expected_np=2) == ElasticStatus.HOLD
+        # membership change -> RESTART
+        assert m0.watch(expected_np=3) == ElasticStatus.RESTART
+        m1.exit()
+        m0.exit()
+
+    def test_stale_node_detected(self):
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager
+
+        port = free_port()
+        m = ElasticManager(host="127.0.0.1", port=port, rank=0, np_range=(1, 2),
+                           heartbeat_interval=10.0, ttl=0.3)
+        m.store.set("elastic/node/1", str(time.time() - 100))  # stale peer
+        m._beat()
+        assert set(m.alive_nodes()) == {0}
+        m.exit()

@@ -1,0 +1,190 @@
+"""Tests: LLaMA/BERT model families, profiler, geometric ops."""
+import math
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import geometric, profiler
+from paddle_tpu.models import (
+    BertConfig,
+    BertForPretraining,
+    BertForSequenceClassification,
+    LlamaConfig,
+    LlamaForCausalLM,
+)
+
+
+class TestLlama:
+    def test_forward_loss_near_uniform(self):
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny()
+        m = LlamaForCausalLM(cfg)
+        ids = paddle.to_tensor(np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 16)))
+        loss = m(ids, labels=ids)
+        assert abs(float(loss.numpy()) - math.log(cfg.vocab_size)) < 1.0
+
+    def test_gqa_kv_heads(self):
+        cfg = LlamaConfig.tiny()
+        assert cfg.num_key_value_heads == 2
+        m = LlamaForCausalLM(cfg)
+        # k_proj output dim = kv_heads * head_dim = 2*32 = 64 (half of q)
+        assert m.model.layers[0].self_attn.k_proj.weight.shape[1] == 64
+        assert m.model.layers[0].self_attn.q_proj.weight.shape[1] == 128
+
+    def test_backward_and_train_step(self):
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny()
+        m = LlamaForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+        ids = paddle.to_tensor(np.random.RandomState(1).randint(0, cfg.vocab_size, (2, 16)))
+        l0 = m(ids, labels=ids)
+        l0.backward()
+        opt.step()
+        opt.clear_grad()
+        l1 = m(ids, labels=ids)
+        assert float(l1.numpy()) < float(l0.numpy())
+
+
+class TestBert:
+    def test_pretraining_loss(self):
+        paddle.seed(0)
+        cfg = BertConfig.tiny()
+        m = BertForPretraining(cfg)
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (2, 16)))
+        lbl = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (2, 16)))
+        nsp = paddle.to_tensor(np.array([0, 1], np.int32))
+        loss = m(ids, masked_lm_labels=lbl, next_sentence_labels=nsp)
+        # mlm ~ ln(V) + nsp ~ ln(2)
+        assert abs(float(loss.numpy()) - (math.log(cfg.vocab_size) + math.log(2))) < 1.5
+
+    def test_attention_mask(self):
+        paddle.seed(0)
+        cfg = BertConfig.tiny()
+        m = BertForSequenceClassification(cfg, num_classes=2)
+        m.eval()
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, cfg.vocab_size, (1, 8))
+        # padding beyond position 4 must not change the masked output
+        ids_pad = ids.copy()
+        ids_pad[:, 4:] = 0
+        mask = np.zeros((1, 8), np.float32)
+        mask[:, :4] = 1.0
+        a = m(paddle.to_tensor(ids_pad), attention_mask=paddle.to_tensor(mask)).numpy()
+        ids_pad2 = ids_pad.copy()
+        ids_pad2[:, 4:] = 7  # different padding content
+        b = m(paddle.to_tensor(ids_pad2), attention_mask=paddle.to_tensor(mask)).numpy()
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+    def test_classification_backward(self):
+        paddle.seed(0)
+        cfg = BertConfig.tiny()
+        m = BertForSequenceClassification(cfg, num_classes=3)
+        ids = paddle.to_tensor(np.random.RandomState(2).randint(0, cfg.vocab_size, (2, 8)))
+        y = paddle.to_tensor(np.array([0, 2], np.int32))
+        loss = m(ids, labels=y)
+        loss.backward()
+        assert np.abs(m.classifier.weight.grad.numpy()).sum() > 0
+
+
+class TestProfiler:
+    def test_record_event_and_summary(self):
+        prof = profiler.Profiler(targets=[profiler.ProfilerTarget.CPU])
+        prof.start()
+        with profiler.RecordEvent("my_span"):
+            _ = paddle.to_tensor(np.ones((4, 4), np.float32)) * 2.0
+        with profiler.RecordEvent("my_span"):
+            pass
+        prof.stop()
+        from paddle_tpu import native
+
+        if native.available():
+            names = [s["name"] for s in prof.events()]
+            assert names.count("my_span") == 2
+            summary = prof.summary()
+            assert "my_span" in summary
+
+    def test_chrome_export(self, tmp_path):
+        handler = profiler.export_chrome_tracing(str(tmp_path))
+        prof = profiler.Profiler(on_trace_ready=handler)
+        prof.start()
+        with profiler.RecordEvent("step0"):
+            pass
+        prof.stop()
+        from paddle_tpu import native
+
+        if native.available():
+            assert prof.last_export_path and os.path.exists(prof.last_export_path)
+            data = profiler.load_profiler_result(prof.last_export_path)
+            assert any(e["name"] == "step0" for e in data["traceEvents"])
+
+    def test_scheduler_state_machine(self):
+        sched = profiler.make_scheduler(closed=1, ready=1, record=2, repeat=1)
+        states = [sched(i) for i in range(5)]
+        S = profiler.ProfilerState
+        assert states == [S.CLOSED, S.READY, S.RECORD, S.RECORD_AND_RETURN, S.CLOSED]
+
+    def test_benchmark_timer(self):
+        prof = profiler.Profiler(timer_only=True)
+        prof.start()
+        for _ in range(3):
+            prof.step(num_samples=8)
+        info = prof.step_info()
+        assert "avg_step_time" in info and "ips" in info
+        prof.stop()
+
+
+class TestGeometric:
+    def test_send_u_recv(self):
+        x = paddle.to_tensor(np.array([[1.0], [2.0], [3.0]], np.float32))
+        src = paddle.to_tensor(np.array([0, 1, 2, 0], np.int32))
+        dst = paddle.to_tensor(np.array([1, 2, 1, 0], np.int32))
+        out = geometric.send_u_recv(x, src, dst, reduce_op="sum")
+        np.testing.assert_allclose(out.numpy(), [[1.0], [4.0], [2.0]])
+        out = geometric.send_u_recv(x, src, dst, reduce_op="max")
+        np.testing.assert_allclose(out.numpy(), [[1.0], [3.0], [2.0]])
+        out = geometric.send_u_recv(x, src, dst, reduce_op="mean")
+        np.testing.assert_allclose(out.numpy(), [[1.0], [2.0], [2.0]])
+
+    def test_send_ue_recv_and_uv(self):
+        x = paddle.to_tensor(np.array([[1.0], [2.0]], np.float32))
+        y = paddle.to_tensor(np.array([10.0, 20.0], np.float32))
+        src = paddle.to_tensor(np.array([0, 1], np.int32))
+        dst = paddle.to_tensor(np.array([1, 0], np.int32))
+        out = geometric.send_ue_recv(x, y, src, dst, message_op="add", reduce_op="sum")
+        np.testing.assert_allclose(out.numpy(), [[22.0], [11.0]])
+        uv = geometric.send_uv(x, x, src, dst, message_op="mul")
+        np.testing.assert_allclose(uv.numpy(), [[2.0], [2.0]])
+
+    def test_send_u_recv_differentiable(self):
+        x = paddle.to_tensor(np.array([[1.0], [2.0], [3.0]], np.float32),
+                             stop_gradient=False)
+        src = paddle.to_tensor(np.array([0, 1, 2, 0], np.int32))
+        dst = paddle.to_tensor(np.array([1, 2, 1, 0], np.int32))
+        out = geometric.send_u_recv(x, src, dst)
+        paddle.sum(out).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [[2.0], [1.0], [1.0]])
+
+    def test_segment_ops(self):
+        d = paddle.to_tensor(np.array([1.0, 2.0, 3.0, 4.0], np.float32))
+        seg = paddle.to_tensor(np.array([0, 0, 1, 1], np.int32))
+        np.testing.assert_allclose(geometric.segment_sum(d, seg).numpy(), [3.0, 7.0])
+        np.testing.assert_allclose(geometric.segment_mean(d, seg).numpy(), [1.5, 3.5])
+        np.testing.assert_allclose(geometric.segment_max(d, seg).numpy(), [2.0, 4.0])
+        np.testing.assert_allclose(geometric.segment_min(d, seg).numpy(), [1.0, 3.0])
+
+    def test_sample_and_reindex(self):
+        # CSC: node 0 <- {1,2}, node 1 <- {2}, node 2 <- {}
+        row = np.array([1, 2, 2], np.int64)
+        colptr = np.array([0, 2, 3, 3], np.int64)
+        nbrs, counts = geometric.sample_neighbors(
+            paddle.to_tensor(row), paddle.to_tensor(colptr),
+            paddle.to_tensor(np.array([0, 1], np.int64)), sample_size=-1)
+        np.testing.assert_array_equal(counts.numpy(), [2, 1])
+        np.testing.assert_array_equal(np.sort(nbrs.numpy()[:2]), [1, 2])
+        src, dst, nodes = geometric.reindex_graph(
+            paddle.to_tensor(np.array([0, 1], np.int64)), nbrs, counts)
+        assert len(src.numpy()) == 3
+        assert nodes.numpy()[0] == 0 and nodes.numpy()[1] == 1

@@ -1,0 +1,119 @@
+"""Tests for paddle_tpu.quantization (reference: test/quantization/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.quantization import (
+    AbsmaxObserver,
+    EMAObserver,
+    FakeQuanterWithAbsMax,
+    PTQ,
+    QAT,
+    QuantConfig,
+    QuantedLinear,
+)
+from paddle_tpu.quantization.quanters import fake_quant_dequant
+
+
+class Net(paddle.nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = paddle.nn.Linear(8, 16)
+        self.fc2 = paddle.nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(paddle.relu(self.fc1(x)))
+
+
+class TestObservers:
+    def test_absmax(self):
+        obs = AbsmaxObserver()
+        obs.observe(paddle.to_tensor(np.array([-3.0, 2.0], np.float32)))
+        obs.observe(paddle.to_tensor(np.array([1.0, -5.0], np.float32)))
+        assert obs.scales() == 5.0
+
+    def test_ema(self):
+        obs = EMAObserver(moving_rate=0.5)
+        obs.observe(paddle.to_tensor(np.array([4.0], np.float32)))
+        obs.observe(paddle.to_tensor(np.array([2.0], np.float32)))
+        assert abs(obs.scales() - 3.0) < 1e-6
+
+
+class TestFakeQuant:
+    def test_quant_dequant_error_bounded(self):
+        x = paddle.to_tensor(np.linspace(-1, 1, 64).astype(np.float32))
+        q = fake_quant_dequant(x, scale=1.0, bits=8)
+        err = np.abs(q.numpy() - x.numpy()).max()
+        assert err <= 1.0 / 127 + 1e-6
+
+    def test_ste_gradient_passthrough(self):
+        x = paddle.to_tensor(np.array([0.3, -0.7], np.float32), stop_gradient=False)
+        q = fake_quant_dequant(x, scale=1.0, bits=8)
+        paddle.sum(q * 2.0).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+class TestQAT:
+    def test_quantize_swaps_layers(self):
+        paddle.seed(0)
+        net = Net()
+        cfg = QuantConfig(activation=FakeQuanterWithAbsMax,
+                          weight=FakeQuanterWithAbsMax)
+        q = QAT(cfg).quantize(net)
+        assert isinstance(q.fc1, QuantedLinear)
+        assert isinstance(q.fc2, QuantedLinear)
+
+    def test_qat_output_close_and_trainable(self):
+        paddle.seed(0)
+        net = Net()
+        x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8).astype(np.float32))
+        ref = net(x).numpy()
+        cfg = QuantConfig(activation=FakeQuanterWithAbsMax,
+                          weight=FakeQuanterWithAbsMax)
+        q = QAT(cfg).quantize(net)
+        out = q(x)
+        assert np.abs(out.numpy() - ref).max() < 0.1  # int8 sim error
+        paddle.mean(out * out).backward()
+        assert np.abs(q.fc1.weight.grad.numpy()).sum() > 0
+        # inplace=False (default) must leave the original model untouched
+        assert not isinstance(net.fc1, QuantedLinear)
+        np.testing.assert_allclose(net(x).numpy(), ref)
+
+    def test_type_config_selective(self):
+        paddle.seed(0)
+        net = Net()
+        cfg = QuantConfig()
+        cfg.add_name_config("fc1", activation=FakeQuanterWithAbsMax,
+                            weight=FakeQuanterWithAbsMax)
+        q = QAT(cfg).quantize(net)
+        assert isinstance(q.fc1, QuantedLinear)
+        assert not isinstance(q.fc2, QuantedLinear)
+
+    def test_convert_records_scales(self):
+        paddle.seed(0)
+        net = Net()
+        cfg = QuantConfig(activation=FakeQuanterWithAbsMax,
+                          weight=FakeQuanterWithAbsMax)
+        qat = QAT(cfg)
+        q = qat.quantize(net)
+        x = paddle.to_tensor(np.random.RandomState(1).randn(4, 8).astype(np.float32))
+        q(x)
+        qat.convert(q)
+        assert q.fc1.weight_scale is not None and q.fc1.weight_scale > 0
+
+
+class TestPTQ:
+    def test_calibrate_and_convert(self):
+        paddle.seed(0)
+        net = Net()
+        cfg = QuantConfig(activation=AbsmaxObserver, weight=None)
+        ptq = PTQ(cfg)
+        calib = ptq.quantize(net)  # inplace=False returns a calibration copy
+        for seed in range(3):
+            x = paddle.to_tensor(np.random.RandomState(seed).randn(4, 8).astype(np.float32))
+            calib(x)
+        ptq.convert(calib)
+        assert calib.fc1.activation_scale > 0
+        assert calib.fc1.weight_scale > 0
+        assert calib.fc2.activation_scale > 0
+        assert not hasattr(net.fc1, "activation_scale")

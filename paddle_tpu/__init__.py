@@ -49,11 +49,14 @@ from . import ops  # noqa: F401  (loads the YAML registry)
 from . import tensor_methods  # noqa: F401  (installs Tensor methods)
 
 # Re-export every registered op as a top-level function (paddle.add, ...).
+# Names matching submodules (paddle.fft the namespace vs the fft op) stay
+# module-valued at top level, as in the reference.
 import sys as _sys
 
+_SUBMODULE_NAMES = {"fft", "signal", "audio", "text", "sparse", "linalg"}
 _this = _sys.modules[__name__]
 for _name in ops.all_ops():
-    if not hasattr(_this, _name):
+    if _name not in _SUBMODULE_NAMES and not hasattr(_this, _name):
         setattr(_this, _name, getattr(ops.api, _name))
 del _name, _this, _sys
 
@@ -87,6 +90,12 @@ from . import sparse  # noqa: F401, E402
 from . import profiler  # noqa: F401, E402
 from . import geometric  # noqa: F401, E402
 from . import quantization  # noqa: F401, E402
+from . import fft  # noqa: F401, E402
+from . import signal  # noqa: F401, E402
+from . import audio  # noqa: F401, E402
+from . import text  # noqa: F401, E402
+from . import inference  # noqa: F401, E402
+from . import onnx  # noqa: F401, E402
 from . import incubate  # noqa: F401, E402
 from .framework.io import load, save  # noqa: F401, E402
 from .hapi.model import Model, summary  # noqa: F401, E402

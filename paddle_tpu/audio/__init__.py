@@ -1,0 +1,7 @@
+"""paddle.audio analog (reference: python/paddle/audio/ — functional window/
+mel/mfcc features + Spectrogram/MelSpectrogram/MFCC layers + datasets)."""
+from . import functional  # noqa: F401
+from . import features  # noqa: F401
+from . import datasets  # noqa: F401
+
+__all__ = ["functional", "features", "datasets"]

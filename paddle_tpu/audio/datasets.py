@@ -1,0 +1,63 @@
+"""Audio datasets (reference: python/paddle/audio/datasets/{tess,esc50}.py).
+
+Zero-egress environment: datasets are synthetic but shaped/labeled like the
+originals (same pattern as vision.datasets.MNIST), so pipelines and tests run
+unchanged.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+
+class TESS(Dataset):
+    """Toronto emotional speech set stand-in: 7 emotion classes, 1-2s@24kHz."""
+
+    EMOTIONS = ["angry", "disgust", "fear", "happy", "neutral", "ps", "sad"]
+
+    def __init__(self, mode="train", n_samples=200, sample_rate=24000,
+                 duration=1.0, feat_type="raw", seed=0, **kwargs):
+        self.sample_rate = sample_rate
+        n = int(sample_rate * duration)
+        rng = np.random.RandomState(seed if mode == "train" else seed + 1)
+        self.labels = rng.randint(0, len(self.EMOTIONS), n_samples)
+        # class-dependent tone + noise so classifiers can actually learn
+        t = np.arange(n) / sample_rate
+        self.data = np.stack([
+            (np.sin(2 * np.pi * (200 + 100 * y) * t)
+             + 0.1 * rng.randn(n)).astype(np.float32)
+            for y in self.labels
+        ])
+
+    def __getitem__(self, idx):
+        return self.data[idx], int(self.labels[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class ESC50(Dataset):
+    """ESC-50 environmental sound stand-in: 50 classes, 1s@16kHz."""
+
+    def __init__(self, mode="train", n_samples=200, sample_rate=16000,
+                 seed=0, **kwargs):
+        self.sample_rate = sample_rate
+        n = sample_rate
+        rng = np.random.RandomState(seed if mode == "train" else seed + 1)
+        self.labels = rng.randint(0, 50, n_samples)
+        t = np.arange(n) / sample_rate
+        self.data = np.stack([
+            (np.sin(2 * np.pi * (100 + 30 * y) * t)
+             + 0.1 * rng.randn(n)).astype(np.float32)
+            for y in self.labels
+        ])
+
+    def __getitem__(self, idx):
+        return self.data[idx], int(self.labels[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+__all__ = ["TESS", "ESC50"]

@@ -1,0 +1,118 @@
+"""Audio functional ops (reference: python/paddle/audio/functional/
+{window,functional}.py)."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """Reference: audio/functional/window.py get_window."""
+    if isinstance(window, tuple):
+        name, *params = window
+    else:
+        name, params = window, []
+    M = win_length + (0 if fftbins else -1)
+    n = np.arange(win_length)
+    if name in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * n / max(M, 1))
+    elif name == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * n / max(M, 1))
+    elif name == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * n / max(M, 1))
+             + 0.08 * np.cos(4 * np.pi * n / max(M, 1)))
+    elif name == "rectangular" or name == "boxcar":
+        w = np.ones(win_length)
+    elif name == "triang":
+        w = 1.0 - np.abs((n - (win_length - 1) / 2) / ((win_length) / 2))
+    elif name == "gaussian":
+        std = params[0] if params else 7.0
+        w = np.exp(-0.5 * ((n - (win_length - 1) / 2) / std) ** 2)
+    else:
+        raise ValueError(f"unsupported window {name}")
+    return Tensor(w.astype(dtype))
+
+
+def hz_to_mel(freq, htk=False):
+    if htk:
+        return 2595.0 * np.log10(1.0 + np.asarray(freq) / 700.0)
+    f = np.asarray(freq, np.float64)
+    f_sp = 200.0 / 3
+    mel = f / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = min_log_hz / f_sp
+    logstep = math.log(6.4) / 27.0
+    return np.where(f >= min_log_hz,
+                    min_log_mel + np.log(np.maximum(f, 1e-10) / min_log_hz) / logstep,
+                    mel)
+
+
+def mel_to_hz(mel, htk=False):
+    if htk:
+        return 700.0 * (10.0 ** (np.asarray(mel) / 2595.0) - 1.0)
+    m = np.asarray(mel, np.float64)
+    f_sp = 200.0 / 3
+    freqs = f_sp * m
+    min_log_hz = 1000.0
+    min_log_mel = min_log_hz / f_sp
+    logstep = math.log(6.4) / 27.0
+    return np.where(m >= min_log_mel,
+                    min_log_hz * np.exp(logstep * (m - min_log_mel)),
+                    freqs)
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False, dtype="float32"):
+    mels = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk), n_mels)
+    return Tensor(mel_to_hz(mels, htk).astype(dtype))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    return Tensor(np.linspace(0, sr / 2, 1 + n_fft // 2).astype(dtype))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """Mel filterbank [n_mels, 1+n_fft//2] (reference: functional.py)."""
+    f_max = f_max or sr / 2.0
+    fftfreqs = np.linspace(0, sr / 2, 1 + n_fft // 2)
+    mel_f = np.asarray(mel_to_hz(np.linspace(hz_to_mel(f_min, htk),
+                                             hz_to_mel(f_max, htk), n_mels + 2), htk))
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights *= enorm[:, None]
+    return Tensor(weights.astype(dtype))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    s = spect._value if isinstance(spect, Tensor) else jnp.asarray(spect)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(s, amin))
+    log_spec = log_spec - 10.0 * jnp.log10(jnp.maximum(jnp.float32(ref_value), amin))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+    return Tensor(log_spec)
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """DCT-II matrix [n_mels, n_mfcc] (reference: functional.py create_dct)."""
+    n = np.arange(n_mels, dtype=np.float64)
+    k = np.arange(n_mfcc, dtype=np.float64)
+    dct = np.cos(np.pi / n_mels * (n[:, None] + 0.5) * k[None, :]) * 2.0
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2.0)
+        dct *= math.sqrt(1.0 / (2.0 * n_mels))
+    return Tensor(dct.astype(dtype))
+
+
+__all__ = [
+    "get_window", "hz_to_mel", "mel_to_hz", "mel_frequencies",
+    "fft_frequencies", "compute_fbank_matrix", "power_to_db", "create_dct",
+]

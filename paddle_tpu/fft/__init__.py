@@ -1,0 +1,44 @@
+"""paddle.fft namespace (reference: python/paddle/fft.py — 1.6k LoC of
+fft/ifft/rfft/... wrappers over the phi fft kernels).
+
+Thin re-export of the registered fft ops plus the frequency helpers.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops.api import (  # noqa: F401
+    fft,
+    fft2,
+    fftn,
+    fftshift,
+    hfft,
+    ifft,
+    ifft2,
+    ifftn,
+    ifftshift,
+    ihfft,
+    irfft,
+    irfft2,
+    irfftn,
+    rfft,
+    rfft2,
+    rfftn,
+)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.fftfreq(n, d=d).astype(dtype or jnp.float32))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d=d).astype(dtype or jnp.float32))
+
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2",
+    "fftn", "ifftn", "rfftn", "irfftn",
+    "fftshift", "ifftshift", "fftfreq", "rfftfreq",
+]

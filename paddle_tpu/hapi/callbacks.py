@@ -1,0 +1,230 @@
+"""hapi callbacks (reference: python/paddle/hapi/callbacks.py — Callback,
+ProgBarLogger, ModelCheckpoint, LRScheduler, EarlyStopping, VisualDL)."""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import List, Optional
+
+import numpy as np
+
+
+class Callback:
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_model(self, model):
+        self.model = model
+
+    def set_params(self, params):
+        self.params = params or {}
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+
+class CallbackList:
+    def __init__(self, callbacks: Optional[List[Callback]] = None):
+        self.callbacks = list(callbacks or [])
+
+    def append(self, cb):
+        self.callbacks.append(cb)
+
+    def set_model(self, model):
+        for cb in self.callbacks:
+            cb.set_model(model)
+
+    def set_params(self, params):
+        for cb in self.callbacks:
+            cb.set_params(params)
+
+    def __getattr__(self, name):
+        if name.startswith("on_"):
+            def broadcast(*args, **kwargs):
+                for cb in self.callbacks:
+                    getattr(cb, name)(*args, **kwargs)
+
+            return broadcast
+        raise AttributeError(name)
+
+
+class ProgBarLogger(Callback):
+    """Prints running loss/metrics (reference: callbacks.py ProgBarLogger)."""
+
+    def __init__(self, log_freq=1, verbose=2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        self._t0 = time.time()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose and self.log_freq and (step + 1) % self.log_freq == 0:
+            items = " ".join(f"{k}={v:.4f}" for k, v in (logs or {}).items()
+                             if isinstance(v, (int, float)))
+            print(f"Epoch {self._epoch + 1} step {step + 1}: {items}")
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            items = " ".join(f"{k}={v:.4f}" for k, v in (logs or {}).items()
+                             if isinstance(v, (int, float)))
+            print(f"Epoch {epoch + 1} done ({time.time() - self._t0:.1f}s): {items}")
+
+
+class ModelCheckpoint(Callback):
+    """Saves model+optimizer every save_freq epochs (reference: ModelCheckpoint)."""
+
+    def __init__(self, save_freq=1, save_dir=None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and (epoch + 1) % self.save_freq == 0:
+            self.model.save(os.path.join(self.save_dir, f"epoch_{epoch}"))
+
+    def on_train_end(self, logs=None):
+        if self.save_dir:
+            self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class LRScheduler(Callback):
+    """Steps the optimizer's LR scheduler (reference: callbacks.py LRScheduler).
+
+    NOTE: TrainStep already steps the scheduler once per batch
+    (jit/trainer.py), so the default here is per-EPOCH stepping for schedules
+    that want coarser cadence; enabling by_step would double-step.
+    """
+
+    def __init__(self, by_step=False, by_epoch=True):
+        super().__init__()
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        opt = getattr(self.model, "_optimizer", None)
+        return getattr(opt, "_lr_scheduler", None) if opt else None
+
+    def on_train_batch_end(self, step, logs=None):
+        s = self._sched()
+        if self.by_step and s is not None:
+            s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        s = self._sched()
+        if self.by_epoch and s is not None:
+            s.step()
+
+
+class EarlyStopping(Callback):
+    """Stops when a monitored metric stops improving (reference: EarlyStopping)."""
+
+    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
+                 min_delta=0, baseline=None, save_best_model=False):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.verbose = verbose
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self.best = baseline  # reference seeds best from baseline when given
+        self.wait = 0
+        self.stopped_epoch = -1
+
+    def _improved(self, value):
+        if self.best is None:
+            return True
+        if self.mode == "min":
+            return value < self.best - self.min_delta
+        return value > self.best + self.min_delta
+
+    def on_epoch_end(self, epoch, logs=None):
+        value = (logs or {}).get(self.monitor)
+        if value is None:
+            return
+        if isinstance(value, (list, np.ndarray)):
+            value = float(np.asarray(value).reshape(-1)[0])
+        if self._improved(value):
+            self.best = value
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait > self.patience:
+                self.stopped_epoch = epoch
+                self.model.stop_training = True
+                if self.verbose:
+                    print(f"Epoch {epoch + 1}: early stopping "
+                          f"(best {self.monitor}={self.best:.4f})")
+
+
+class VisualDL(Callback):
+    """Scalar logging (reference: callbacks.py VisualDL). Without the visualdl
+    wheel, scalars append to <log_dir>/scalars.jsonl — same data, greppable."""
+
+    def __init__(self, log_dir="vdl_log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self._step = 0
+
+    def _write(self, tag, value, step):
+        os.makedirs(self.log_dir, exist_ok=True)
+        with open(os.path.join(self.log_dir, "scalars.jsonl"), "a") as f:
+            f.write(json.dumps({"tag": tag, "value": float(value),
+                                "step": int(step), "ts": time.time()}) + "\n")
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        for k, v in (logs or {}).items():
+            if isinstance(v, (int, float)):
+                self._write(f"train/{k}", v, self._step)
+
+    def on_epoch_end(self, epoch, logs=None):
+        for k, v in (logs or {}).items():
+            if isinstance(v, (int, float)):
+                self._write(f"epoch/{k}", v, epoch)
+
+
+def config_callbacks(callbacks=None, model=None, log_freq=10, verbose=2,
+                     save_dir=None, save_freq=1) -> CallbackList:
+    """Assemble the default callback list (reference: config_callbacks)."""
+    cbs = list(callbacks or [])
+    if not any(isinstance(c, ProgBarLogger) for c in cbs) and verbose:
+        cbs.append(ProgBarLogger(log_freq, verbose=verbose))
+    # no default LRScheduler callback: TrainStep steps the scheduler per batch
+    if save_dir and not any(isinstance(c, ModelCheckpoint) for c in cbs):
+        cbs.append(ModelCheckpoint(save_freq, save_dir))
+    cl = CallbackList(cbs)
+    cl.set_model(model)
+    return cl
+
+
+__all__ = ["Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
+           "LRScheduler", "EarlyStopping", "VisualDL", "config_callbacks"]

@@ -44,30 +44,46 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+        from .callbacks import config_callbacks
+
         loader = train_data if isinstance(train_data, DataLoader) else DataLoader(
             train_data, batch_size=batch_size, shuffle=shuffle,
             drop_last=drop_last, num_workers=num_workers,
         )
         step_fn = self._get_train_step()
         history = {"loss": []}
+        self.stop_training = False
+        cbs = config_callbacks(callbacks, model=self, log_freq=log_freq,
+                               verbose=verbose, save_dir=save_dir,
+                               save_freq=save_freq)
+        cbs.set_params({"epochs": epochs, "verbose": verbose})
+        cbs.on_train_begin()
         for epoch in range(epochs):
             self.network.train()
-            t0 = time.time()
+            cbs.on_epoch_begin(epoch)
             losses = []
             for i, batch in enumerate(loader):
+                cbs.on_train_batch_begin(i)
                 x, y = batch[0], batch[1]
                 loss = step_fn(x, y)
                 losses.append(float(loss.item()))
-                if verbose and log_freq and (i + 1) % log_freq == 0:
-                    print(f"Epoch {epoch + 1}/{epochs} step {i + 1}: loss={np.mean(losses[-log_freq:]):.4f}")
+                cbs.on_train_batch_end(i, {"loss": losses[-1]})
             history["loss"].append(float(np.mean(losses)) if losses else float("nan"))
-            if verbose:
-                print(f"Epoch {epoch + 1}: mean loss {history['loss'][-1]:.4f} ({time.time() - t0:.1f}s)")
+            epoch_logs = {"loss": history["loss"][-1]}
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
-                self.evaluate(eval_data, batch_size=batch_size, verbose=verbose)
-            if save_dir and (epoch + 1) % save_freq == 0:
-                self.save(f"{save_dir}/epoch_{epoch}")
+                cbs.on_eval_begin()
+                eval_result = self.evaluate(eval_data, batch_size=batch_size,
+                                            verbose=verbose)
+                for k, v in eval_result.items():
+                    val = v[0] if isinstance(v, list) and v else v
+                    if isinstance(val, (int, float)):
+                        epoch_logs[f"eval_{k}"] = val
+                cbs.on_eval_end(eval_result)
+            cbs.on_epoch_end(epoch, epoch_logs)
+            if self.stop_training:
+                break
         step_fn.sync_to_optimizer()
+        cbs.on_train_end({"loss": history["loss"]})
         return history
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2, num_workers=0, callbacks=None):

@@ -7,3 +7,6 @@ from __future__ import annotations
 
 from . import nn  # noqa: F401
 from . import distributed  # noqa: F401, E402
+from . import asp  # noqa: F401, E402
+from . import optimizer  # noqa: F401, E402
+from .optimizer import LookAhead, ModelAverage  # noqa: F401, E402

@@ -1,0 +1,105 @@
+"""ASP: 2:4 structured sparsity (reference: python/paddle/incubate/asp/asp.py
+— mask generation, optimizer wrapping, supported-layer registry).
+
+TPU note: the reference's CUDA sparse-tensor-core payoff doesn't exist on
+TPU, but the *workflow* (prune masks + mask-preserving optimizer) is part of
+the capability surface; masks are plain multiplicative constants XLA folds
+into the weight reads.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from ..nn.layers import Conv2D, Linear
+
+_supported_layers = [Linear, Conv2D]
+_excluded_names: set = set()
+_masks: Dict[int, np.ndarray] = {}
+
+
+def set_excluded_layers(param_names, main_program=None):
+    _excluded_names.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded_names.clear()
+
+
+def create_mask(weight: np.ndarray, func_name: str = "mask_1d", n: int = 2,
+                m: int = 4) -> np.ndarray:
+    """n:m mask along the last axis (keep the n largest of every m)."""
+    w = np.abs(np.asarray(weight, np.float32))
+    orig_shape = w.shape
+    flat = w.reshape(-1, orig_shape[-1])
+    cols = orig_shape[-1]
+    pad = (-cols) % m
+    if pad:
+        flat = np.pad(flat, [(0, 0), (0, pad)])
+    groups = flat.reshape(flat.shape[0], -1, m)
+    order = np.argsort(-groups, axis=-1)
+    mask = np.zeros_like(groups)
+    np.put_along_axis(mask, order[..., :n], 1.0, axis=-1)
+    mask = mask.reshape(flat.shape[0], -1)[:, :cols]
+    return mask.reshape(orig_shape)
+
+
+def check_sparsity(weight: np.ndarray, n: int = 2, m: int = 4) -> bool:
+    w = np.asarray(weight)
+    flat = np.abs(w).reshape(-1, w.shape[-1])
+    cols = w.shape[-1]
+    pad = (-cols) % m
+    if pad:
+        flat = np.pad(flat, [(0, 0), (0, pad)])
+    groups = flat.reshape(flat.shape[0], -1, m)
+    return bool(np.all((groups != 0).sum(-1) <= n))
+
+
+def prune_model(model: Layer, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
+                with_mask: bool = True) -> Dict[str, np.ndarray]:
+    """Apply n:m masks to all supported layers (reference: asp.py prune_model)."""
+    masks = {}
+    for name, sub in model.named_sublayers(include_self=True):
+        if not any(isinstance(sub, t) for t in _supported_layers):
+            continue
+        w = getattr(sub, "weight", None)
+        # exclusions may name the layer ('fc1') or its param ('fc1.weight')
+        if w is None or name in _excluded_names or f"{name}.weight" in _excluded_names:
+            continue
+        mask = create_mask(w.numpy(), mask_algo, n, m)
+        w._value = w._value * jnp.asarray(mask)
+        _masks[id(w)] = mask
+        masks[name or "self"] = mask
+    return masks
+
+
+def decorate(optimizer):
+    """Wrap an optimizer so steps re-apply prune masks
+    (reference: asp.py decorate -> OptimizerWithSparsityGuarantee)."""
+
+    class ASPOptimizer:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __getattr__(self, item):
+            return getattr(self._inner, item)
+
+        def step(self):
+            self._inner.step()
+            for p in self._inner._parameter_list:
+                mask = _masks.get(id(p))
+                if mask is not None:
+                    p._value = p._value * jnp.asarray(mask)
+
+        def clear_grad(self, *a, **k):
+            return self._inner.clear_grad(*a, **k)
+
+    return ASPOptimizer(optimizer)
+
+
+__all__ = ["prune_model", "decorate", "create_mask", "check_sparsity",
+           "set_excluded_layers", "reset_excluded_layers"]

@@ -1,0 +1,105 @@
+"""Incubate optimizers (reference: python/paddle/incubate/optimizer/
+lookahead.py, modelaverage.py)."""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class LookAhead:
+    """Reference: incubate/optimizer/lookahead.py — slow/fast weights:
+    every k steps, slow += alpha * (fast - slow); fast <- slow."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_num = 0
+        self._slow: Dict[int, object] = {
+            id(p): p._value for p in inner_optimizer._parameter_list
+        }
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_num += 1
+        if self._step_num % self.k == 0:
+            for p in self.inner_optimizer._parameter_list:
+                slow = self._slow[id(p)]
+                slow = slow + self.alpha * (p._value - slow)
+                self._slow[id(p)] = slow
+                p._value = slow
+
+    def clear_grad(self, *a, **k):
+        return self.inner_optimizer.clear_grad(*a, **k)
+
+    def __getattr__(self, item):
+        return getattr(self.inner_optimizer, item)
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["lookahead_step"] = self._step_num
+        return sd
+
+    def minimize(self, loss):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+class ModelAverage:
+    """Reference: incubate/optimizer/modelaverage.py — maintains a running
+    average of parameters; apply()/restore() swap it in and out for eval."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000, name=None):
+        if parameters is None:
+            raise ValueError("parameters required")
+        self._params = list(parameters)
+        self.average_window_rate = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self._sum: Dict[int, object] = {id(p): jnp.zeros_like(p._value) for p in self._params}
+        self._count = 0
+        self._backup: Dict[int, object] = {}
+
+    def step(self):
+        """Accumulate current weights into the average."""
+        for p in self._params:
+            self._sum[id(p)] = self._sum[id(p)] + p._value
+        self._count += 1
+        if self._count > self.max_average_window:
+            # restart window (reference keeps nested sums; single window here)
+            for p in self._params:
+                self._sum[id(p)] = p._value * 1.0
+            self._count = 1
+
+    def apply(self, executor=None, need_restore=True):
+        """Swap averaged weights in (context-manager style also supported)."""
+        for p in self._params:
+            self._backup[id(p)] = p._value
+            if self._count > 0:
+                p._value = self._sum[id(p)] / float(self._count)
+        self._need_restore = need_restore
+        return self
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._value = self._backup.pop(id(p))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if getattr(self, "_need_restore", True):
+            self.restore()
+        return False
+
+    def minimize(self, loss):
+        self.step()
+
+
+__all__ = ["LookAhead", "ModelAverage"]

@@ -1,0 +1,141 @@
+"""paddle.inference analog (reference: paddle/fluid/inference/ —
+AnalysisPredictor at api/analysis_predictor.h:94, Config, zero-copy tensors).
+
+TPU-native: the "analysis passes + engine" pipeline collapses into XLA — a
+saved model is a serialized StableHLO artifact (jit.save) whose optimization
+happened at export time and whose runtime is the compiled executable. The
+Config/Predictor/handle API shape is preserved so deployment code ports over:
+
+    config = Config(model_path)           # .pdmodel/.pdiparams prefix
+    predictor = create_predictor(config)
+    inp = predictor.get_input_handle(predictor.get_input_names()[0])
+    inp.copy_from_cpu(batch_np)
+    predictor.run()
+    out = predictor.get_output_handle(predictor.get_output_names()[0])
+    result = out.copy_to_cpu()
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+class Config:
+    """Reference: paddle_infer.Config — holds model paths + exec options."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        # accept either a single prefix (jit.save style) or separate files
+        if prog_file is not None and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self.model_prefix = prog_file
+        self.params_file = params_file
+        self._memory_pool_mb = 0
+        self._device_id = 0
+        self._use_device = True
+
+    # API-parity knobs: on TPU these are XLA's concerns, kept as no-op state
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._memory_pool_mb = memory_pool_init_size_mb
+        self._device_id = device_id
+        self._use_device = True
+
+    def disable_gpu(self):
+        self._use_device = False
+
+    def enable_memory_optim(self):
+        pass  # XLA buffer assignment already does liveness-based reuse
+
+    def switch_ir_optim(self, on=True):
+        pass  # optimization happened at export (StableHLO) time
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._cpu_threads = n
+
+    def model_dir(self):
+        return self.model_prefix
+
+
+class PredictorTensor:
+    """Zero-copy handle (reference: ZeroCopyTensor)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = None
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._value = jnp.asarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def share_external_data(self, tensor):
+        self._value = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+
+    def shape(self):
+        return list(self._value.shape) if self._value is not None else None
+
+    def reshape(self, shape):
+        pass  # shapes are fixed by the exported program
+
+
+class Predictor:
+    """Reference: AnalysisPredictor — load -> run -> fetch."""
+
+    def __init__(self, config: Config):
+        from ..jit import load as jit_load
+
+        self.config = config
+        self._run_fn = jit_load(config.model_prefix)
+        self._inputs: Dict[str, PredictorTensor] = {}
+        self._outputs: Dict[str, PredictorTensor] = {}
+        self._input_names = ["input_0"]
+        self._output_names = ["output_0"]
+        self._last_result = None
+
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._output_names)
+
+    def get_input_handle(self, name: str) -> PredictorTensor:
+        if name not in self._inputs:
+            self._inputs[name] = PredictorTensor(name)
+            if name not in self._input_names:
+                self._input_names.append(name)
+        return self._inputs[name]
+
+    def get_output_handle(self, name: str) -> PredictorTensor:
+        return self._outputs.setdefault(name, PredictorTensor(name))
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """ZeroCopyRun (reference: analysis_predictor.h:221)."""
+        if inputs is not None:
+            args = [jnp.asarray(a) for a in inputs]
+        else:
+            args = [self._inputs[n]._value for n in self._input_names
+                    if n in self._inputs]
+        out = self._run_fn(*args)
+        leaves = jax.tree_util.tree_leaves(out)
+        self._output_names = [f"output_{i}" for i in range(len(leaves))]
+        for i, leaf in enumerate(leaves):
+            h = self.get_output_handle(f"output_{i}")
+            h._value = leaf._value if isinstance(leaf, Tensor) else leaf
+        self._last_result = leaves
+        if inputs is not None:
+            return [np.asarray(l._value if isinstance(l, Tensor) else l)
+                    for l in leaves]
+        return True
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+__all__ = ["Config", "Predictor", "PredictorTensor", "create_predictor"]

@@ -202,7 +202,7 @@ def save(layer, path, input_spec=None, **config):
             runner = _Functionalized(fwd._fn, fwd._params)
             return runner(param_vals, jnp.asarray(0, jnp.int32), args, {})
 
-        exported = jexport.export(jax.jit(infer))((tuple(specs),))
+        exported = jexport.export(jax.jit(infer))(tuple(specs))
         with open(path + ".pdmodel", "wb") as f:
             f.write(exported.serialize())
 
@@ -216,7 +216,7 @@ def load(path, **config):
 
     def run(*args):
         vals = tuple(a._value if isinstance(a, Tensor) else jnp.asarray(a) for a in args)
-        out = exported.call((vals,))
+        out = exported.call(vals)
         return jax.tree_util.tree_map(lambda x: Tensor(x), out)
 
     return run

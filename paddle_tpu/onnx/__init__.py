@@ -1,0 +1,38 @@
+"""paddle.onnx analog (reference: python/paddle/onnx/export.py -> paddle2onnx).
+
+TPU-native: the portable interchange artifact is StableHLO (jax.export), the
+format XLA consumes directly; ONNX conversion requires the onnx wheel, which
+is not part of this image. export() therefore always produces the StableHLO
+program + weights next to the requested path, and raises a clear error for
+the .onnx protobuf itself unless onnx is importable.
+"""
+from __future__ import annotations
+
+import os
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Reference signature: paddle.onnx.export(layer, path, input_spec, ...).
+
+    Writes <path>.pdmodel (StableHLO) + <path>.pdiparams.npz and returns the
+    .pdmodel path. The .onnx protobuf itself needs paddle2onnx-equivalent
+    tooling that is not in this image; a warning records that the portable
+    artifact is StableHLO instead.
+    """
+    import warnings
+
+    from ..jit import save as jit_save
+
+    if path.endswith(".onnx"):
+        path = path[:-5]
+    jit_save(layer, path, input_spec=input_spec)
+    warnings.warn(
+        "ONNX protobuf emission is unavailable (no paddle2onnx analog in this "
+        f"image); wrote the portable StableHLO artifact to {path}.pdmodel — "
+        "load it with paddle_tpu.jit.load or paddle_tpu.inference.Predictor.",
+        stacklevel=2,
+    )
+    return path + ".pdmodel"
+
+
+__all__ = ["export"]

@@ -1,0 +1,118 @@
+"""paddle.signal analog (reference: python/paddle/signal.py — frame/
+overlap_add/stft/istft over phi kernels).
+
+Framing is a strided gather; stft = frame -> window -> rfft, all of which XLA
+fuses into batched FFT calls on TPU.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.tensor import Tensor
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Split into overlapping frames (reference: signal.py frame)."""
+    xv = _val(x)
+    if axis not in (-1, xv.ndim - 1):
+        raise NotImplementedError("frame supports axis=-1")
+    n = xv.shape[-1]
+    if n < frame_length:
+        raise ValueError(
+            f"input length {n} is shorter than frame_length {frame_length}")
+    num_frames = 1 + (n - frame_length) // hop_length
+    idx = (jnp.arange(frame_length)[None, :]
+           + hop_length * jnp.arange(num_frames)[:, None])  # [F, L]
+    out = jnp.take(xv, idx, axis=-1)  # [..., F, L]
+    # reference layout: [..., frame_length, num_frames]
+    return Tensor(jnp.swapaxes(out, -1, -2))
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame (reference: signal.py overlap_add).
+
+    x: [..., frame_length, num_frames] -> [..., output_length]
+    """
+    xv = _val(x)
+    if axis not in (-1, xv.ndim - 1):
+        raise NotImplementedError("overlap_add supports axis=-1")
+    frame_length, num_frames = xv.shape[-2], xv.shape[-1]
+    out_len = frame_length + hop_length * (num_frames - 1)
+    batch_shape = xv.shape[:-2]
+    flat = xv.reshape((-1, frame_length, num_frames))
+    out = jnp.zeros((flat.shape[0], out_len), xv.dtype)
+    idx = (jnp.arange(frame_length)[:, None]
+           + hop_length * jnp.arange(num_frames)[None, :])  # [L, F]
+    out = out.at[:, idx.reshape(-1)].add(flat.reshape(flat.shape[0], -1))
+    return Tensor(out.reshape(batch_shape + (out_len,)))
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+         pad_mode="reflect", normalized=False, onesided=True, name=None):
+    """Short-time Fourier transform (reference: signal.py stft).
+
+    Returns [..., n_fft//2+1 (or n_fft), num_frames] complex.
+    """
+    xv = _val(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        win = jnp.ones((win_length,), jnp.float32)
+    else:
+        win = _val(window).astype(jnp.float32)
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+    if center:
+        pad = [(0, 0)] * (xv.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        xv = jnp.pad(xv, pad, mode=pad_mode)
+    frames = frame(Tensor(xv), n_fft, hop_length)._value  # [..., n_fft, F]
+    frames = frames * win[:, None]
+    if onesided:
+        spec = jnp.fft.rfft(frames, axis=-2)
+    else:
+        spec = jnp.fft.fft(frames, axis=-2)
+    if normalized:
+        spec = spec / jnp.sqrt(jnp.float32(n_fft))
+    return Tensor(spec)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+          normalized=False, onesided=True, length=None, return_complex=False,
+          name=None):
+    """Inverse STFT with window-envelope normalization (reference: signal.py
+    istft)."""
+    sv = _val(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        win = jnp.ones((win_length,), jnp.float32)
+    else:
+        win = _val(window).astype(jnp.float32)
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+    if normalized:
+        sv = sv * jnp.sqrt(jnp.float32(n_fft))
+    if onesided:
+        frames = jnp.fft.irfft(sv, n=n_fft, axis=-2)  # [..., n_fft, F]
+    else:
+        frames = jnp.fft.ifft(sv, axis=-2).real
+    frames = frames * win[:, None]
+    y = overlap_add(Tensor(frames), hop_length)._value
+    # normalize by the summed squared-window envelope
+    wsq = jnp.tile(win[:, None] ** 2, (1, sv.shape[-1]))
+    envelope = overlap_add(Tensor(wsq), hop_length)._value
+    y = y / jnp.maximum(envelope, 1e-10)
+    if center:
+        y = y[..., n_fft // 2: y.shape[-1] - n_fft // 2]
+    if length is not None:
+        y = y[..., :length]
+    return Tensor(y)
+
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]

@@ -1,0 +1,122 @@
+"""paddle.text analog (reference: python/paddle/text/ — datasets + viterbi).
+
+viterbi_decode mirrors paddle.text.viterbi_decode (phi viterbi_decode
+kernel): CRF max-sum decoding, implemented as a lax.scan so it compiles to
+one XLA while-free program.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..io.dataset import Dataset
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    """CRF Viterbi decoding.
+
+    Args:
+        potentials: emissions [batch, seq_len, num_tags].
+        transition_params: [num_tags, num_tags] (with BOS/EOS rows/cols last
+            two when include_bos_eos_tag, matching the reference convention).
+        lengths: [batch] int actual lengths (default full).
+    Returns:
+        (scores [batch], paths [batch, seq_len]) best tag sequences.
+    """
+    em = potentials._value if isinstance(potentials, Tensor) else jnp.asarray(potentials)
+    tr = (transition_params._value if isinstance(transition_params, Tensor)
+          else jnp.asarray(transition_params))
+    b, s, n = em.shape
+    if lengths is None:
+        lens = jnp.full((b,), s, jnp.int32)
+    else:
+        lens = (lengths._value if isinstance(lengths, Tensor)
+                else jnp.asarray(lengths)).astype(jnp.int32)
+
+    if include_bos_eos_tag:
+        # last two tags are BOS, EOS (reference convention)
+        bos, eos = n - 2, n - 1
+        init = em[:, 0] + tr[bos][None, :]
+    else:
+        init = em[:, 0]
+
+    def step(carry, t):
+        alpha, history_unused = carry
+        # alpha: [b, n] best score ending in tag j at prev step
+        scores = alpha[:, :, None] + tr[None, :, :]  # [b, from, to]
+        best_prev = jnp.argmax(scores, axis=1)  # [b, n]
+        best_score = jnp.max(scores, axis=1) + em[:, t]
+        # freeze past the sequence end
+        active = (t < lens)[:, None]
+        best_score = jnp.where(active, best_score, alpha)
+        return (best_score, None), best_prev
+
+    (alpha, _), history = jax.lax.scan(
+        step, (init, None), jnp.arange(1, s))
+    # history: [s-1, b, n] argmax backpointers
+
+    if include_bos_eos_tag:
+        alpha = alpha + tr[:, eos][None, :]
+
+    last_tag = jnp.argmax(alpha, axis=-1)  # [b]
+    scores = jnp.max(alpha, axis=-1)
+
+    def backtrace(carry, ptrs_t):
+        tag, t = carry
+        prev = jnp.take_along_axis(ptrs_t, tag[:, None], axis=1)[:, 0]
+        # only move back while within the sequence
+        within = (t < lens)
+        tag = jnp.where(within, prev, tag)
+        return (tag, t - 1), tag
+
+    (_, _), path_rev = jax.lax.scan(
+        backtrace, (last_tag, jnp.full((), s - 1, jnp.int32)), history[::-1])
+    paths = jnp.concatenate([path_rev[::-1].T, last_tag[:, None]], axis=1)  # [b, s]
+    return Tensor(scores), Tensor(paths.astype(jnp.int64))
+
+
+class Imdb(Dataset):
+    """IMDB sentiment stand-in (reference: text/datasets/imdb.py) — synthetic
+    but learnable: token distribution depends on the label."""
+
+    def __init__(self, mode="train", vocab_size=2000, seq_len=64,
+                 n_samples=500, seed=0, **kwargs):
+        rng = np.random.RandomState(seed if mode == "train" else seed + 1)
+        self.labels = rng.randint(0, 2, n_samples)
+        docs = []
+        for y in self.labels:
+            base = rng.randint(0, vocab_size // 2, seq_len)
+            if y == 1:
+                base = base + vocab_size // 2
+            docs.append(base)
+        self.docs = np.stack(docs).astype(np.int64)
+        self.vocab_size = vocab_size
+
+    def __getitem__(self, idx):
+        return self.docs[idx], int(self.labels[idx])
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Conll05st(Dataset):
+    """SRL tagging stand-in (reference: text/datasets/conll05.py)."""
+
+    def __init__(self, mode="train", vocab_size=500, num_tags=10, seq_len=32,
+                 n_samples=200, seed=0, **kwargs):
+        rng = np.random.RandomState(seed if mode == "train" else seed + 1)
+        self.words = rng.randint(0, vocab_size, (n_samples, seq_len)).astype(np.int64)
+        self.tags = (self.words % num_tags).astype(np.int64)  # learnable mapping
+        self.num_tags = num_tags
+
+    def __getitem__(self, idx):
+        return self.words[idx], self.tags[idx]
+
+    def __len__(self):
+        return len(self.words)
+
+
+__all__ = ["viterbi_decode", "Imdb", "Conll05st"]

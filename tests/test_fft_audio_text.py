@@ -1,0 +1,259 @@
+"""Tests: fft/signal namespaces, audio features, text (viterbi), incubate
+(ASP, LookAhead, ModelAverage), inference Predictor, hapi callbacks."""
+import itertools
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import audio, signal
+from paddle_tpu.incubate import LookAhead, ModelAverage, asp
+from paddle_tpu.text import viterbi_decode
+
+
+class TestFFT:
+    def test_fft_matches_numpy(self):
+        x = np.random.RandomState(0).randn(2, 64).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.fft.fft(paddle.to_tensor(x)).numpy(), np.fft.fft(x),
+            rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(
+            paddle.fft.rfft(paddle.to_tensor(x)).numpy(), np.fft.rfft(x),
+            rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(
+            paddle.fft.irfft(paddle.fft.rfft(paddle.to_tensor(x))).numpy(), x,
+            rtol=1e-4, atol=1e-5)
+
+    def test_fft2_and_shift(self):
+        x = np.random.RandomState(1).randn(4, 8).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.fft.fft2(paddle.to_tensor(x)).numpy(), np.fft.fft2(x),
+            rtol=1e-4, atol=1e-3)
+        np.testing.assert_allclose(
+            paddle.fft.fftshift(paddle.to_tensor(x)).numpy(), np.fft.fftshift(x))
+
+    def test_fftfreq(self):
+        np.testing.assert_allclose(paddle.fft.fftfreq(8, d=0.5).numpy(),
+                                   np.fft.fftfreq(8, d=0.5).astype(np.float32))
+
+    def test_fft_namespace_is_module(self):
+        import types
+
+        assert isinstance(paddle.fft, types.ModuleType)
+        assert callable(paddle.ops.api.fft)  # op form still reachable
+
+
+class TestSignal:
+    def test_frame_overlap_add_roundtrip(self):
+        x = np.arange(32, dtype=np.float32)[None]
+        framed = signal.frame(paddle.to_tensor(x), frame_length=8, hop_length=8)
+        assert framed.shape == [1, 8, 4]
+        back = signal.overlap_add(framed, hop_length=8)
+        np.testing.assert_allclose(back.numpy(), x)
+
+    def test_stft_istft_roundtrip(self):
+        x = np.random.RandomState(0).randn(2, 512).astype(np.float32)
+        win = audio.functional.get_window("hann", 256)
+        spec = signal.stft(paddle.to_tensor(x), n_fft=256, hop_length=64, window=win)
+        assert spec.shape == [2, 129, 9]
+        y = signal.istft(spec, n_fft=256, hop_length=64, window=win, length=512)
+        np.testing.assert_allclose(y.numpy(), x, atol=1e-4)
+
+
+class TestAudio:
+    def test_windows(self):
+        import scipy.signal as ss
+
+        for name in ["hann", "hamming", "blackman"]:
+            w = audio.functional.get_window(name, 64).numpy()
+            ref = ss.get_window(name, 64)
+            np.testing.assert_allclose(w, ref, atol=1e-6)
+
+    def test_mel_matches_librosa_formulas(self):
+        # slaney scale fixed points
+        np.testing.assert_allclose(audio.functional.hz_to_mel(1000.0), 15.0)
+        np.testing.assert_allclose(audio.functional.mel_to_hz(15.0), 1000.0)
+
+    def test_fbank_rows_nonneg_and_peaky(self):
+        fb = audio.functional.compute_fbank_matrix(16000, 512, n_mels=40).numpy()
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all()
+        assert (fb.sum(axis=1) > 0).all()
+
+    def test_feature_layers(self):
+        x = paddle.to_tensor(np.random.RandomState(0).randn(2, 4000).astype(np.float32))
+        spec = audio.features.Spectrogram(n_fft=256, hop_length=128)(x)
+        assert spec.shape[1] == 129
+        mel = audio.features.MelSpectrogram(sr=16000, n_fft=256, n_mels=32)(x)
+        assert mel.shape[1] == 32
+        mfcc = audio.features.MFCC(sr=16000, n_mfcc=13, n_fft=256, n_mels=32)(x)
+        assert mfcc.shape[1] == 13
+
+    def test_datasets(self):
+        ds = audio.datasets.TESS(n_samples=10)
+        wav, label = ds[0]
+        assert wav.shape[0] == 24000 and 0 <= label < 7
+
+
+class TestViterbi:
+    def test_matches_brute_force(self):
+        rng = np.random.RandomState(0)
+        em = rng.randn(2, 5, 4).astype(np.float32)
+        tr = rng.randn(4, 4).astype(np.float32)
+        scores, paths = viterbi_decode(paddle.to_tensor(em), paddle.to_tensor(tr),
+                                       include_bos_eos_tag=False)
+        for b in range(2):
+            best, bp = -1e9, None
+            for seq in itertools.product(range(4), repeat=5):
+                s = em[b, 0, seq[0]] + sum(
+                    tr[seq[t - 1], seq[t]] + em[b, t, seq[t]] for t in range(1, 5))
+                if s > best:
+                    best, bp = s, seq
+            np.testing.assert_allclose(float(scores.numpy()[b]), best, rtol=1e-5)
+            assert tuple(paths.numpy()[b]) == bp
+
+    def test_with_bos_eos(self):
+        rng = np.random.RandomState(1)
+        em = rng.randn(1, 4, 5).astype(np.float32)  # tags 3,4 are BOS,EOS
+        tr = rng.randn(5, 5).astype(np.float32)
+        scores, paths = viterbi_decode(paddle.to_tensor(em), paddle.to_tensor(tr),
+                                       include_bos_eos_tag=True)
+        assert paths.shape == [1, 4]
+
+
+class TestASP:
+    def test_mask_2_4(self):
+        w = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+        mask = asp.create_mask(w)
+        assert mask.shape == w.shape
+        groups = mask.reshape(8, 4, 4)
+        assert (groups.sum(-1) == 2).all()
+        # kept entries are the 2 largest |w| per group
+        wg = np.abs(w).reshape(8, 4, 4)
+        kept = np.take_along_axis(wg, np.argsort(-wg, -1)[..., :2], -1).sum()
+        np.testing.assert_allclose((wg * groups).sum(), kept, rtol=1e-6)
+
+    def test_prune_and_decorated_step_preserves_sparsity(self):
+        paddle.seed(0)
+        net = paddle.nn.Linear(16, 8)
+        asp.prune_model(net)
+        assert asp.check_sparsity(net.weight.numpy())
+        opt = asp.decorate(paddle.optimizer.SGD(0.1, parameters=net.parameters()))
+        for _ in range(2):
+            x = paddle.to_tensor(np.random.randn(4, 16).astype(np.float32))
+            loss = paddle.mean(net(x) ** 2.0)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert asp.check_sparsity(net.weight.numpy())
+
+
+class TestIncubateOptimizers:
+    def test_lookahead_interpolates(self):
+        paddle.seed(0)
+        net = paddle.nn.Linear(4, 4)
+        w0 = net.weight.numpy().copy()
+        inner = paddle.optimizer.SGD(0.5, parameters=net.parameters())
+        la = LookAhead(inner, alpha=0.5, k=2)
+        fasts = []
+        for _ in range(2):
+            loss = paddle.mean(net(paddle.to_tensor(np.ones((2, 4), np.float32))) ** 2.0)
+            loss.backward()
+            fasts.append(net.weight.numpy().copy())
+            la.step()
+            la.clear_grad()
+        # after k=2 steps: w = w0 + 0.5*(fast - w0)
+        fast = net.weight.numpy()  # slow was synced in
+        assert not np.allclose(fast, w0)
+
+    def test_model_average_apply_restore(self):
+        paddle.seed(0)
+        net = paddle.nn.Linear(4, 2)
+        ma = ModelAverage(parameters=net.parameters())
+        w_orig = net.weight.numpy().copy()
+        ma.step()
+        net.weight._value = net.weight._value + 1.0
+        w_new = net.weight.numpy().copy()
+        ma.step()
+        with ma.apply():
+            np.testing.assert_allclose(net.weight.numpy(),
+                                       (w_orig + w_new) / 2.0, rtol=1e-6)
+        np.testing.assert_allclose(net.weight.numpy(), w_new)
+
+
+class TestInference:
+    def test_predictor_end_to_end(self, tmp_path):
+        paddle.seed(0)
+        net = paddle.nn.Linear(4, 2)
+        net.eval()
+        x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        ref = net(paddle.to_tensor(x)).numpy()
+
+        prefix = str(tmp_path / "model")
+        paddle.jit.save(net, prefix, input_spec=[paddle.jit.InputSpec([3, 4], "float32")])
+
+        from paddle_tpu.inference import Config, create_predictor
+
+        config = Config(prefix + ".pdmodel")
+        predictor = create_predictor(config)
+        inp = predictor.get_input_handle("input_0")
+        inp.copy_from_cpu(x)
+        predictor.run()
+        out = predictor.get_output_handle(predictor.get_output_names()[0])
+        np.testing.assert_allclose(out.copy_to_cpu(), ref, rtol=1e-5)
+
+
+class TestCallbacks:
+    def _model(self):
+        paddle.seed(0)
+        net = paddle.nn.Linear(4, 2)
+        m = paddle.Model(net)
+        m.prepare(optimizer=paddle.optimizer.SGD(0.1, parameters=net.parameters()),
+                  loss=paddle.nn.MSELoss())
+        return m
+
+    def _data(self):
+        from paddle_tpu.io.dataset import Dataset
+
+        class DS(Dataset):
+            def __getitem__(self, i):
+                rng = np.random.RandomState(i)
+                x = rng.randn(4).astype(np.float32)
+                return x, (x[:2] * 2).astype(np.float32)
+
+            def __len__(self):
+                return 16
+
+        return DS()
+
+    def test_early_stopping(self):
+        from paddle_tpu.hapi.callbacks import EarlyStopping
+
+        m = self._model()
+        es = EarlyStopping(monitor="loss", patience=0, verbose=0, min_delta=100.0)
+        h = m.fit(self._data(), batch_size=8, epochs=10, verbose=0, callbacks=[es])
+        # min_delta=100 means "never improves" -> stops after epoch 2
+        assert len(h["loss"]) <= 3
+
+    def test_visualdl_and_checkpoint(self, tmp_path):
+        from paddle_tpu.hapi.callbacks import ModelCheckpoint, VisualDL
+
+        m = self._model()
+        vdl = VisualDL(log_dir=str(tmp_path / "vdl"))
+        ck = ModelCheckpoint(save_freq=1, save_dir=str(tmp_path / "ck"))
+        m.fit(self._data(), batch_size=8, epochs=2, verbose=0, callbacks=[vdl, ck])
+        assert (tmp_path / "vdl" / "scalars.jsonl").exists()
+        assert (tmp_path / "ck" / "final.pdparams").exists()
+
+    def test_lr_scheduler_steps(self):
+        from paddle_tpu.hapi.callbacks import LRScheduler
+
+        paddle.seed(0)
+        net = paddle.nn.Linear(4, 2)
+        sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=2, gamma=0.5)
+        m = paddle.Model(net)
+        m.prepare(optimizer=paddle.optimizer.SGD(sched, parameters=net.parameters()),
+                  loss=paddle.nn.MSELoss())
+        m.fit(self._data(), batch_size=8, epochs=1, verbose=0)
+        assert sched.last_epoch >= 2  # stepped once per batch (2 batches)

@@ -1,56 +1,98 @@
 """Benchmark: GPT pretrain step throughput on the local accelerator.
 
-Prints ONE JSON line:
+Prints exactly ONE JSON line on stdout:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
+Robustness contract (VERDICT r01 item 1): the driver must ALWAYS get a JSON
+line, even when TPU backend init hangs or crashes. So the default entry runs
+the measurement in a child subprocess with a hard timeout and retries with
+backoff across shrinking presets (large TPU config -> small -> CPU smoke); if
+every attempt fails it emits an error JSON and exits 0. Process model mirrors
+the reference's perf-gated CI (tools/ci_op_benchmark.sh +
+tools/check_op_benchmark_result.py) where a lost number fails the gate.
+
 Baseline: BASELINE.md north star is >=0.40 MFU for GPT hybrid pretrain;
-vs_baseline = achieved_MFU / 0.40 (the reference repo publishes no numbers,
-see BASELINE.md). Runs the full compiled train step (forward+backward+AdamW,
-donated buffers) with bf16 matmuls via amp auto_cast.
+vs_baseline = achieved_MFU / 0.40. The measured step is the full compiled
+train step (forward+backward+AdamW, donated buffers) with bf16 compute via
+amp auto_cast, Pallas flash-attention on (toggle with FLAGS_use_flash_attention).
 """
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
-
-import numpy as np
 
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def main():
+# (name, is_tpu, timeout_s, model kwargs, batch, seq, timed_steps)
+PRESETS = {
+    # ~355M params: big enough to evidence the 1.3B north star class.
+    "large": dict(hidden_size=1024, num_layers=24, num_heads=16,
+                  batch=8, seq=1024, timed_steps=10, timeout=1500),
+    # ~180M fallback if large OOMs.
+    "medium": dict(hidden_size=1024, num_layers=12, num_heads=16,
+                   batch=8, seq=1024, timed_steps=10, timeout=900),
+    # r01 config as a last-resort TPU preset.
+    "small": dict(hidden_size=768, num_layers=12, num_heads=12,
+                  batch=8, seq=512, timed_steps=10, timeout=900),
+    # CPU smoke so the driver always gets a real number.
+    "cpu": dict(hidden_size=128, num_layers=2, num_heads=4,
+                batch=2, seq=64, timed_steps=3, timeout=900,
+                vocab_size=1024, max_position_embeddings=256),
+}
+
+
+def _force_cpu_backend():
+    """The driver environment's sitecustomize pins the TPU tunnel platform at
+    jax import; env vars alone are read too early, so reset via jax.config
+    (same trick as tests/conftest.py)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from jax._src import xla_bridge as _xb
+
+    if _xb.backends_are_initialized():
+        import jax.extend.backend as _jeb
+
+        _jeb.clear_backends()
+        jax.config.update("jax_platforms", "cpu")
+
+
+def run_child(preset: str) -> int:
+    """Measure one preset. Runs inside the child subprocess."""
+    p = PRESETS[preset]
+    import numpy as np
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu" or preset == "cpu":
+        _force_cpu_backend()
     import jax
 
     backend = jax.default_backend()
-    on_accel = backend not in ("cpu",)
-    log(f"backend={backend} devices={jax.devices()}")
+    log(f"[{preset}] backend={backend} devices={jax.devices()}")
 
     import paddle_tpu as paddle
-    from paddle_tpu import amp, nn, optimizer
+    from paddle_tpu import amp, optimizer
     from paddle_tpu.jit.trainer import TrainStep
     from paddle_tpu.models import GPTConfig, GPTForCausalLM
 
-    if on_accel:
-        cfg = GPTConfig(
-            vocab_size=50304, hidden_size=768, num_layers=12, num_heads=12,
-            max_position_embeddings=1024,
-            hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
-        )
-        batch, seq = 8, 512
-        timed_steps = 10
-    else:  # CPU smoke fallback so the driver always gets a line
-        cfg = GPTConfig.tiny()
-        batch, seq = 2, 64
-        timed_steps = 3
+    cfg = GPTConfig(
+        vocab_size=p.get("vocab_size", 50304),
+        hidden_size=p["hidden_size"], num_layers=p["num_layers"],
+        num_heads=p["num_heads"],
+        max_position_embeddings=p.get("max_position_embeddings", 1024),
+        hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+    )
+    batch, seq, timed_steps = p["batch"], p["seq"], p["timed_steps"]
 
     paddle.seed(0)
     model = GPTForCausalLM(cfg)
-    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
-    log(f"params: {n_params / 1e6:.1f}M  batch={batch} seq={seq}")
+    n_params = sum(int(np.prod(q.shape)) for q in model.parameters())
+    log(f"[{preset}] params: {n_params / 1e6:.1f}M  batch={batch} seq={seq}")
 
     opt = optimizer.AdamW(1e-4, parameters=model.parameters(), weight_decay=0.01)
 
@@ -59,14 +101,14 @@ def main():
             return model(ids, labels=ids)
 
     step = TrainStep(model, loss_fn, opt)
-
     ids = paddle.to_tensor(
         np.random.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
 
     t0 = time.time()
     loss = step(ids)
     loss.block_until_ready()
-    log(f"compile+first step: {time.time() - t0:.1f}s loss={float(loss.item()):.3f}")
+    log(f"[{preset}] compile+first step: {time.time() - t0:.1f}s "
+        f"loss={float(loss.item()):.3f}")
     step(ids).block_until_ready()  # warm
 
     t0 = time.time()
@@ -77,13 +119,16 @@ def main():
     sps = timed_steps / dt
     tokens_per_sec = sps * batch * seq
 
-    # FLOPs/token for a decoder: 6*N (fwd+bwd matmuls) + 12*L*h*s attention term
+    # FLOPs/token: 6*N (fwd+bwd matmuls) + 12*L*h*s attention term
     flops_per_token = 6.0 * n_params + 12.0 * cfg.num_layers * cfg.hidden_size * seq
     achieved_flops = tokens_per_sec * flops_per_token
 
+    on_accel = backend not in ("cpu",)
     peak = float(os.environ.get("BENCH_PEAK_FLOPS", 0)) or (
         197e12 if on_accel else 1e12)  # v5e bf16 peak; override for v5p (459e12)
     mfu = achieved_flops / peak
+
+    from paddle_tpu.core import flags as _flags
 
     result = {
         "metric": "gpt_pretrain_tokens_per_sec_per_chip",
@@ -96,10 +141,104 @@ def main():
         "seq": seq,
         "steps_per_sec": round(sps, 3),
         "backend": backend,
+        "preset": preset,
+        "flash_attention": bool(_flags.get_flag("use_flash_attention")),
         "final_loss": round(float(loss.item()), 4),
     }
-    print(json.dumps(result))
+    print(json.dumps(result), flush=True)
+    return 0
+
+
+def _extract_json(text: str):
+    for line in reversed(text.splitlines()):
+        line = line.strip()
+        if line.startswith("{") and line.endswith("}"):
+            try:
+                obj = json.loads(line)
+                if "metric" in obj:
+                    return obj
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def _probe_tpu(timeout_s=300) -> bool:
+    """Cheap reachability check: init the accelerator backend + one tiny
+    compiled matmul in a subprocess. A hung tunnel costs `timeout_s` once here
+    instead of a full preset timeout per attempt."""
+    code = ("import jax, jax.numpy as jnp; "
+            "print(jax.default_backend()); "
+            "print(float(jax.jit(jnp.dot)(jnp.ones((8,8)), jnp.ones((8,8)))[0,0]))")
+    try:
+        res = subprocess.run([sys.executable, "-c", code], env=dict(os.environ),
+                             capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        log(f"TPU probe: timeout after {timeout_s}s — falling back to CPU")
+        return False
+    lines = res.stdout.strip().splitlines()
+    ok = res.returncode == 0 and lines and lines[0] not in ("cpu",)
+    log(f"TPU probe: rc={res.returncode} backend={lines[0] if lines else '?'} ok={ok}")
+    return ok
+
+
+def main() -> int:
+    """Parent: probe the accelerator, then try presets in order inside
+    timeout-bounded subprocesses; ALWAYS print one JSON line."""
+    attempts = []
+    force_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
+    if not force_cpu and _probe_tpu():
+        attempts += [("large", None), ("medium", None), ("small", None)]
+    attempts += [("cpu", "cpu")]
+
+    last_err = ""
+    for i, (preset, platform) in enumerate(attempts):
+        if i > 0:
+            time.sleep(min(10 * i, 30))  # backoff before each retry
+        env = dict(os.environ)
+        if platform:
+            env["JAX_PLATFORMS"] = platform
+        timeout = PRESETS[preset]["timeout"]
+        log(f"--- bench attempt {i + 1}/{len(attempts)}: preset={preset} "
+            f"platform={platform or 'auto'} timeout={timeout}s")
+        try:
+            res = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--run", preset],
+                env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+                capture_output=True, text=True, timeout=timeout,
+            )
+        except subprocess.TimeoutExpired:
+            last_err = f"preset {preset}: timeout after {timeout}s"
+            log(last_err)
+            continue
+        sys.stderr.write(res.stderr[-4000:])
+        obj = _extract_json(res.stdout)
+        if res.returncode == 0 and obj is not None:
+            print(json.dumps(obj), flush=True)
+            return 0
+        tail = (res.stderr or res.stdout).strip().splitlines()[-8:]
+        last_err = f"preset {preset}: rc={res.returncode}: " + " | ".join(tail)
+        log(last_err)
+
+    print(json.dumps({
+        "metric": "gpt_pretrain_tokens_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,
+        "error": last_err[-1500:],
+        "backend": "unknown",
+    }), flush=True)
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--run":
+        try:
+            sys.exit(run_child(sys.argv[2]))
+        except Exception as e:  # child failure -> nonzero rc, parent retries
+            import traceback
+
+            traceback.print_exc()
+            log(f"child failed: {e}")
+            sys.exit(1)
+    else:
+        sys.exit(main())

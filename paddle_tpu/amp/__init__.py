@@ -8,6 +8,7 @@ while keeping the full API for fp16 parity and code portability).
 from __future__ import annotations
 
 import contextlib
+import functools
 
 import jax.numpy as jnp
 import numpy as np
@@ -53,10 +54,58 @@ def decorate(models, optimizers=None, level="O2", dtype="bfloat16", master_weigh
     return models, optimizers
 
 
+@functools.lru_cache(maxsize=None)
+def _unscale_check_fn(n_grads: int):
+    """One fused XLA program: unscale all grads + single found_inf reduction
+    (the reference's fused check_finite_and_unscale kernel,
+    python/paddle/amp/grad_scaler.py:343)."""
+    import jax
+
+    def f(grads, inv_scale):
+        found = jnp.zeros((), jnp.float32)
+        out = []
+        for g in grads:
+            g = g * inv_scale.astype(g.dtype)
+            found = jnp.maximum(found, jnp.max((~jnp.isfinite(g)).astype(jnp.float32)))
+            out.append(g)
+        return out, found
+
+    return jax.jit(f)
+
+
+@functools.lru_cache(maxsize=None)
+def _scale_update_fn():
+    """Device-side dynamic loss-scale update (no host sync)."""
+    import jax
+
+    def f(scale, good, bad, found, incr_ratio, decr_ratio, incr_every, decr_every):
+        bad2 = jnp.where(found > 0, bad + 1, jnp.zeros_like(bad))
+        good2 = jnp.where(found > 0, jnp.zeros_like(good), good + 1)
+        do_decr = (found > 0) & (bad2 >= decr_every)
+        do_incr = (found == 0) & (good2 >= incr_every)
+        new_scale = jnp.where(
+            do_decr, jnp.maximum(scale * decr_ratio, 1.0),
+            jnp.where(do_incr, scale * incr_ratio, scale))
+        good3 = jnp.where(do_incr, jnp.zeros_like(good2), good2)
+        bad3 = jnp.where(do_decr, jnp.zeros_like(bad2), bad2)
+        return new_scale, good3, bad3
+
+    return jax.jit(f)
+
+
 class GradScaler:
     """paddle.amp.GradScaler (grad_scaler.py:41). On bf16 this is a pass-through;
     on fp16 it implements dynamic loss scaling with the reference's
-    incr/decr_every_n scheme."""
+    incr/decr_every_n scheme.
+
+    TPU execution model (VERDICT r01 item 8): unscale + finite-check is ONE
+    fused device program over all grads producing a single found_inf scalar
+    (no per-param host sync); found_inf is all-reduced (MAX) over the world
+    group so every rank takes the same skip decision (the reference allreduces
+    it the same way, SURVEY §3.4); the dynamic scale state lives as device
+    scalars updated device-side. The only host sync is the one bool read that
+    decides whether optimizer.step() runs — same as the reference.
+    """
 
     def __init__(
         self,
@@ -69,15 +118,16 @@ class GradScaler:
         use_dynamic_loss_scaling=True,
     ):
         self._enable = enable
-        self._scale = float(init_loss_scaling)
+        self._scale = jnp.asarray(float(init_loss_scaling), jnp.float32)
         self._incr_ratio = incr_ratio
         self._decr_ratio = decr_ratio
         self._incr_every_n_steps = incr_every_n_steps
         self._decr_every_n = decr_every_n_nan_or_inf
         self._dynamic = use_dynamic_loss_scaling
-        self._good_steps = 0
-        self._bad_steps = 0
-        self._found_inf = False
+        self._good_steps = jnp.zeros((), jnp.int32)
+        self._bad_steps = jnp.zeros((), jnp.int32)
+        self._found_inf_t = jnp.zeros((), jnp.float32)
+        self._unscaled = False  # reference OptimizerState.UNSCALED guard
 
     def is_enable(self):
         return self._enable
@@ -86,34 +136,51 @@ class GradScaler:
         return self._enable and self._dynamic
 
     def get_loss_scaling(self):
-        return self._scale
+        return float(self._scale)
+
+    @property
+    def _found_inf(self):
+        return bool(self._found_inf_t > 0)
 
     def scale(self, var):
         if not self._enable:
             return var
-        return var * self._scale
+        from ..core.tensor import Tensor
+
+        scale = Tensor(self._scale.astype(var.dtype))
+        scale.stop_gradient = True
+        return var * scale
 
     def unscale_(self, optimizer):
-        if not self._enable:
+        if not self._enable or self._unscaled:
+            return  # already unscaled this step (reference tracks UNSCALED
+            # state so the unscale_ -> clip -> step pattern is single-unscale)
+        self._unscaled = True
+        params = [p for p in optimizer._parameter_list if p.grad is not None]
+        if not params:
+            self._found_inf_t = jnp.zeros((), jnp.float32)
             return
-        inv = 1.0 / self._scale
-        found_inf = False
-        for p in optimizer._parameter_list:
-            if p.grad is not None:
-                g = p.grad._value * inv
-                if bool(jnp.any(~jnp.isfinite(g))):
-                    found_inf = True
-                p.grad._value = g
-        self._found_inf = found_inf
+        grads = [p.grad._value for p in params]
+        new_grads, found = _unscale_check_fn(len(grads))(grads, 1.0 / self._scale)
+        # all ranks must agree (reference allreduces found_inf over the world
+        # group); identity outside a mesh trace, pmax inside one.
+        from ..core.tensor import Tensor as _T
+        from ..distributed import collective as _coll
+
+        found = _coll.all_reduce(_T(found), op=_coll.ReduceOp.MAX)._value
+        for p, g in zip(params, new_grads):
+            p.grad._value = g
+        self._found_inf_t = found
 
     def step(self, optimizer):
         if not self._enable:
             optimizer.step()
             return
         self.unscale_(optimizer)
-        if not self._found_inf:
+        if not self._found_inf:  # the single host sync per step
             optimizer.step()
         self._update_scale()
+        self._unscaled = False
 
     def minimize(self, optimizer, scaled_loss):
         self.step(optimizer)
@@ -124,31 +191,23 @@ class GradScaler:
     def _update_scale(self):
         if not self._dynamic:
             return
-        if self._found_inf:
-            self._bad_steps += 1
-            self._good_steps = 0
-            if self._bad_steps >= self._decr_every_n:
-                self._scale = max(self._scale * self._decr_ratio, 1.0)
-                self._bad_steps = 0
-        else:
-            self._good_steps += 1
-            self._bad_steps = 0
-            if self._good_steps >= self._incr_every_n_steps:
-                self._scale *= self._incr_ratio
-                self._good_steps = 0
+        self._scale, self._good_steps, self._bad_steps = _scale_update_fn()(
+            self._scale, self._good_steps, self._bad_steps, self._found_inf_t,
+            jnp.float32(self._incr_ratio), jnp.float32(self._decr_ratio),
+            jnp.int32(self._incr_every_n_steps), jnp.int32(self._decr_every_n))
 
     def state_dict(self):
         return {
-            "scale": self._scale,
+            "scale": float(self._scale),
             "incr_ratio": self._incr_ratio,
             "decr_ratio": self._decr_ratio,
             "incr_every_n_steps": self._incr_every_n_steps,
             "decr_every_n_nan_or_inf": self._decr_every_n,
-            "good_steps": self._good_steps,
-            "bad_steps": self._bad_steps,
+            "good_steps": int(self._good_steps),
+            "bad_steps": int(self._bad_steps),
         }
 
     def load_state_dict(self, state):
-        self._scale = state["scale"]
-        self._good_steps = state.get("good_steps", 0)
-        self._bad_steps = state.get("bad_steps", 0)
+        self._scale = jnp.asarray(float(state["scale"]), jnp.float32)
+        self._good_steps = jnp.asarray(state.get("good_steps", 0), jnp.int32)
+        self._bad_steps = jnp.asarray(state.get("bad_steps", 0), jnp.int32)

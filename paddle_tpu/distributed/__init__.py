@@ -16,9 +16,24 @@ from .auto_parallel import (  # noqa: F401
     shard_layer,
     shard_tensor,
 )
+from . import checkpoint  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    load_model_sharded,
+    load_sharded,
+    save_model_sharded,
+    save_sharded,
+)
+from .sharding import (  # noqa: F401
+    group_sharded_parallel,
+    save_group_sharded_model,
+)
 from .collective import (  # noqa: F401
     Group,
     ReduceOp,
+    P2POp,
+    batch_isend_irecv,
+    irecv,
+    isend,
     all_gather,
     all_gather_concat,
     all_reduce,

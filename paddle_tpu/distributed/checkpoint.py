@@ -1,0 +1,130 @@
+"""Sharded (and async) checkpointing with mesh-reshard on load.
+
+Reference: rank-sharded state dicts in sharding stage 2/3
+(fleet/meta_parallel/sharding/group_sharded_optimizer_stage2.py state_dict),
+auto-parallel checkpoint conversion across meshes
+(distributed/auto_parallel/static/converter.py), dist_saver.py.
+
+TPU-native (SURVEY §5.4): arrays are saved shard-wise by Orbax/TensorStore —
+each host writes only its addressable shards (exactly the reference's
+"each rank saves its shard"), optionally async (save returns while the write
+completes in background). On load the caller supplies target shardings (e.g.
+the params of a model living on a DIFFERENT mesh) and restoration places each
+array directly into that sharding — the mesh-reshard-on-load the reference
+implements with its converter tool.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["save_sharded", "load_sharded", "save_model_sharded",
+           "load_model_sharded"]
+
+
+def _to_arrays(obj):
+    if isinstance(obj, Tensor):
+        return obj._value
+    if isinstance(obj, dict):
+        return {k: _to_arrays(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_to_arrays(v) for v in obj]  # orbax prefers lists
+    return obj
+
+
+def _checkpointer(async_save=False):
+    import orbax.checkpoint as ocp
+
+    if async_save:
+        return ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+    return ocp.StandardCheckpointer()
+
+
+_pending = []
+
+
+def save_sharded(state: Any, path: str, async_save: bool = False,
+                 overwrite: bool = True):
+    """Write a (nested) state of Tensors/arrays shard-wise. With
+    async_save=True returns immediately; call wait_all() (or save again) to
+    join the background write."""
+    path = os.path.abspath(path)
+    if os.path.exists(path):
+        if not overwrite:
+            raise FileExistsError(path)
+        shutil.rmtree(path)
+    ckptr = _checkpointer(async_save)
+    ckptr.save(path, _to_arrays(state))
+    if async_save:
+        _pending.append(ckptr)
+    else:
+        ckptr.close()
+
+
+def wait_all():
+    """Join all pending async saves."""
+    while _pending:
+        c = _pending.pop()
+        c.wait_until_finished()
+        c.close()
+
+
+def _abstract_like(obj):
+    """Template leaf -> abstract array carrying the TARGET sharding."""
+    if isinstance(obj, Tensor):
+        v = obj._value
+        return jax.ShapeDtypeStruct(v.shape, v.dtype, sharding=v.sharding)
+    if isinstance(obj, jax.ShapeDtypeStruct):
+        return obj
+    if isinstance(obj, jax.Array):
+        return jax.ShapeDtypeStruct(obj.shape, obj.dtype, sharding=obj.sharding)
+    if isinstance(obj, dict):
+        return {k: _abstract_like(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_abstract_like(v) for v in obj]
+    return obj
+
+
+def load_sharded(path: str, template: Optional[Any] = None):
+    """Restore a sharded checkpoint. `template` (nested Tensors /
+    ShapeDtypeStructs with shardings) directs placement — passing a model's
+    current state_dict loads each array straight into that model's (possibly
+    different-mesh) shardings. Without a template arrays restore replicated
+    on the default devices."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    try:
+        if template is None:
+            return ckptr.restore(path)
+        return ckptr.restore(path, _abstract_like(template))
+    finally:
+        ckptr.close()
+
+
+def save_model_sharded(model, path: str, optimizer=None, async_save=False):
+    """Save model (and optimizer) state shard-wise (reference:
+    save_group_sharded_model)."""
+    state = {"model": _to_arrays(dict(model.state_dict()))}
+    if optimizer is not None:
+        state["optimizer"] = _to_arrays(dict(optimizer.state_dict()))
+    save_sharded(state, path, async_save=async_save)
+
+
+def load_model_sharded(model, path: str, optimizer=None):
+    """Restore into the model's CURRENT shardings (mesh-reshard on load)."""
+    template = {"model": dict(model.state_dict())}
+    if optimizer is not None:
+        template["optimizer"] = dict(optimizer.state_dict())
+    restored = load_sharded(path, template)
+    model.set_state_dict({k: Tensor(v) for k, v in restored["model"].items()})
+    if optimizer is not None:
+        optimizer.set_state_dict(restored["optimizer"])
+    return model

@@ -309,16 +309,111 @@ def get_world_size(group=None):
     return _gw()
 
 
-# p2p API surface (compiled to ppermute pairs on TPU)
+# --- p2p: send/recv lower to collective-permute edges ------------------------
+#
+# Reference: ProcessGroup::Send/Recv (process_group.h:53) and the PP p2p layer
+# (fleet/meta_parallel/pp_utils/p2p_communication.py batched isend/irecv).
+#
+# Single-controller SPMD semantics: the program is uniform across ranks, so a
+# matched send(dst=d) + recv(src=s) pair *declares one edge s->d* of a
+# collective-permute; batch_isend_irecv collects many edges into ONE ppermute
+# (the analog of the reference's ncclGroupStart/End batching). Ranks that are
+# not the destination of any edge receive zeros (in the reference they simply
+# would not call recv).
+class _P2PState(threading.local):
+    def __init__(self):
+        self.pending = []  # list of (tensor_value, dst)
+
+
+_p2p_state = _P2PState()
+
+
+class P2POp:
+    """One half of a p2p edge (reference: distributed.P2POp)."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op  # the send or recv function below (isend/irecv aliases ok)
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
 def send(tensor, dst=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "Point-to-point send/recv compile to collective_permute on TPU; "
-        "use distributed.collective_permute or the pipeline executor."
-    )
+    """Queue this tensor for the next matching recv (the pair forms one
+    ppermute edge). Outside a mesh trace this is an identity no-op."""
+    if _bound_axis(group) is None:
+        return tensor
+    _p2p_state.pending.append((_val(tensor), dst))
+    return tensor
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "Point-to-point send/recv compile to collective_permute on TPU; "
-        "use distributed.collective_permute or the pipeline executor."
-    )
+    """Complete a send/recv pair: performs ppermute over the bound axis with
+    the single edge (src -> dst-of-matching-send). The received value is
+    written into `tensor` (zeros on ranks outside the edge)."""
+    bound = _bound_axis(group)
+    if bound is None:
+        return tensor
+    if not _p2p_state.pending:
+        raise RuntimeError(
+            "recv() without a matching send(): in the single-controller SPMD "
+            "model p2p pairs must both appear in the (uniform) program; use "
+            "batch_isend_irecv for many edges at once."
+        )
+    value, dst = _p2p_state.pending.pop(0)
+    src_local = _resolve_axis_rank(group, bound, src)
+    dst_local = _resolve_axis_rank(group, bound, dst)
+    out = lax.ppermute(value, bound, [(src_local, dst_local)])
+    tensor._value = out
+    return tensor
+
+
+isend = send
+irecv = recv
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Execute a batch of P2POps as ONE collective-permute (reference:
+    batch_isend_irecv over grouped NCCL calls). Send/recv ops are paired in
+    order; each pair (send dst=d, recv src=s) contributes the edge (s, d).
+    Returns the list of recv tensors (filled in place)."""
+    sends = [op for op in p2p_op_list if op.op in (send, isend)]
+    recvs = [op for op in p2p_op_list if op.op in (recv, irecv)]
+    if len(sends) != len(recvs):
+        raise ValueError(
+            f"batch_isend_irecv needs matched send/recv pairs, got "
+            f"{len(sends)} sends / {len(recvs)} recvs")
+    group = sends[0].group if sends else None
+    bound = _bound_axis(group)
+    if bound is None:
+        for s_op, r_op in zip(sends, recvs):
+            r_op.tensor._value = _val(s_op.tensor)
+        return [r.tensor for r in recvs]
+    edges = []
+    for s_op, r_op in zip(sends, recvs):
+        edges.append((
+            _resolve_axis_rank(r_op.group, bound, r_op.peer),
+            _resolve_axis_rank(s_op.group, bound, s_op.peer),
+        ))
+    # ppermute needs distinct sources and destinations; batch conflict-free
+    # rounds (a pipeline shift pattern is always a single round).
+    remaining = list(range(len(edges)))
+    while remaining:
+        round_ids, srcs, dsts = [], set(), set()
+        for i in remaining:
+            s, d = edges[i]
+            if s not in srcs and d not in dsts:
+                round_ids.append(i)
+                srcs.add(s)
+                dsts.add(d)
+        remaining = [i for i in remaining if i not in round_ids]
+        by_shape = {}
+        for i in round_ids:
+            v = _val(sends[i].tensor)
+            by_shape.setdefault((v.shape, str(v.dtype)), []).append(i)
+        for ids in by_shape.values():
+            stacked = jnp.stack([_val(sends[i].tensor) for i in ids], axis=0)
+            out = lax.ppermute(stacked, bound, [edges[i] for i in ids])
+            for k, i in enumerate(ids):
+                recvs[i].tensor._value = out[k]
+    return [r.tensor for r in recvs]

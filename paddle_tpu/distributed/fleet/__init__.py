@@ -19,6 +19,8 @@ from .mp_layers import (  # noqa: F401
     RowParallelLinear,
     VocabParallelEmbedding,
 )
+from .recompute import recompute, recompute_sequential  # noqa: F401
+from . import utils  # noqa: F401
 
 
 class DistributedStrategy:

@@ -22,22 +22,15 @@ from ...nn import functional as F
 from ...nn import initializer as I
 from ...nn.layer import Layer
 from ..collective import _bound_axis, all_gather_concat, all_reduce, reduce_scatter
-from ..mesh import get_mesh
 
 
 def _annotate(p: Tensor, spec: PartitionSpec):
     """Attach a sharding annotation to a parameter (applied lazily: eagerly via
-    device_put when a mesh exists; inside jit via with_sharding_constraint)."""
-    p._pspec = spec
-    mesh = get_mesh()
-    if mesh is not None and all(
-        (a is None) or (a in mesh.axis_names and mesh.shape[a] >= 1) for a in spec
-    ):
-        try:
-            p._value = jax.device_put(p._value, NamedSharding(mesh, spec))
-        except Exception:
-            pass  # mesh axis size may not divide dim; GSPMD handles at jit time
-    return p
+    device_put when a mesh exists; inside jit via with_sharding_constraint).
+    Unknown axis names raise; placement failures warn (mesh.annotate_param)."""
+    from ..mesh import annotate_param
+
+    return annotate_param(p, spec)
 
 
 class VocabParallelEmbedding(Layer):

@@ -1,16 +1,35 @@
-"""Pipeline parallelism (reference: fleet/meta_parallel/pipeline_parallel.py:131
-1F1B forward_backward_pipeline:382, pp_layers.py PipeLayer partitioning).
+"""Pipeline parallelism — Layer-level API over the SPMD schedule engines.
 
-TPU-native round-1 implementation: GPipe-style microbatching where stages are
-jit-compiled programs and stage handoff is a sharding annotation over the 'pp'
-mesh axis (XLA inserts the device-to-device copies over ICI). The 1F1B
-host-side schedule with donated activation buffers lands with the PP milestone
-(SURVEY.md §7 M5); this class provides the reference's train_batch API shape.
+Reference: PipelineParallel / 1F1B forward_backward_pipeline
+(python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py:131,382),
+PipeLayer stage partitioning (parallel_layers/pp_layers.py), p2p layer
+(pp_utils/p2p_communication.py:436-610).
+
+TPU-native design (see distributed/pipeline.py for the schedule engines):
+stage parameters are STACKED along a leading S dim sharded over the 'pp' mesh
+axis; the whole 1F1B schedule compiles into one XLA program whose stage
+handoffs are `lax.ppermute` over ICI. This requires structurally identical
+stages (same layer classes and param shapes per stage) — the same constraint
+TPU production pipelining (praxis LayerwiseShardablePipelined) accepts,
+because it is what makes the schedule expressible as uniform SPMD code. The
+reference's uniform layer-count segmentation produces exactly such stages for
+transformer stacks.
+
+With no 'pp' mesh axis (single chip / pp=1) train_batch degrades to plain
+microbatched gradient accumulation, which is then the correct semantics, not
+a facade.
 """
 from __future__ import annotations
 
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
 from ...core.tensor import Tensor
-from ...nn.layer import Layer
+from ...nn.layer import Layer, Parameter
 from ...ops import api
 
 
@@ -33,8 +52,8 @@ class SharedLayerDesc(LayerDesc):
 
 
 class PipelineLayer(Layer):
-    """Reference: parallel_layers/pp_layers.py PipeLayer — holds the full layer
-    list plus a segmentation into stages."""
+    """Reference: parallel_layers/pp_layers.py PipeLayer — the full layer list
+    plus a segmentation into `num_stages` stages."""
 
     def __init__(self, layers, num_stages=1, topology=None, loss_fn=None,
                  seg_method="uniform", recompute_interval=0, **kwargs):
@@ -43,14 +62,26 @@ class PipelineLayer(Layer):
 
         self._loss_fn = loss_fn
         self._num_stages = num_stages
+        self._recompute_interval = recompute_interval
         built = []
         for desc in layers:
             built.append(desc.build_layer() if isinstance(desc, LayerDesc) else desc)
         self.run_function = LayerList(built)
-        # uniform segmentation (reference: segment by layer count)
         n = len(built)
-        per = (n + num_stages - 1) // num_stages
-        self._stage_bounds = [(i * per, min((i + 1) * per, n)) for i in range(num_stages)]
+        if seg_method.startswith("layer:"):
+            # segment at layers of the named class (reference seg_method)
+            cls_name = seg_method.split(":", 1)[1]
+            marks = [i for i, l in enumerate(built) if type(l).__name__ == cls_name]
+            per = (len(marks) + num_stages - 1) // num_stages
+            bounds = []
+            for s in range(num_stages):
+                lo = marks[s * per] if s * per < len(marks) else n
+                hi = marks[(s + 1) * per] if (s + 1) * per < len(marks) else n
+                bounds.append((lo if s else 0, hi))
+            self._stage_bounds = bounds
+        else:
+            per = (n + num_stages - 1) // num_stages
+            self._stage_bounds = [(i * per, min((i + 1) * per, n)) for i in range(num_stages)]
 
     def forward(self, x):
         for layer in self.run_function:
@@ -61,34 +92,217 @@ class PipelineLayer(Layer):
         lo, hi = self._stage_bounds[stage_id]
         return list(self.run_function)[lo:hi]
 
+    def stages_are_homogeneous(self) -> bool:
+        """True when every stage has the same layer-class sequence and param
+        shapes — the precondition for the SPMD pipeline engines."""
+        sigs = []
+        for s in range(self._num_stages):
+            sig = []
+            for layer in self.get_stage_layers(s):
+                sig.append((
+                    type(layer).__name__,
+                    tuple((tuple(p.shape), str(p.dtype)) for p in layer.parameters()),
+                ))
+            sigs.append(tuple(sig))
+        return all(sig == sigs[0] for sig in sigs)
+
+
+def _run_layers(layers: List[Layer], x):
+    for layer in layers:
+        x = layer(x)
+    return x
+
 
 class PipelineParallel(Layer):
-    def __init__(self, layers, hcg, strategy):
+    """Wraps a PipelineLayer for training over the 'pp' mesh axis.
+
+    After wrapping, create the optimizer over `pp_model.parameters()` (the
+    stage-stacked master params), then call
+    `pp_model.train_batch((inputs, labels), optimizer)` — the reference
+    train_batch API (pipeline_parallel.py:582).
+    """
+
+    def __init__(self, layers: PipelineLayer, hcg=None, strategy=None):
         super().__init__()
+        from ..mesh import get_mesh
+
         self._layers = layers
         self.add_sublayer("_layers", layers)
         self._hcg = hcg
-        pcfg = strategy.pipeline_configs if strategy else {}
+        pcfg = strategy.pipeline_configs if strategy is not None else {}
         self.accumulate_steps = pcfg.get("accumulate_steps", 1)
         self.micro_batch_size = pcfg.get("micro_batch_size", 1)
+        self.schedule = pcfg.get("schedule", "1F1B")
+
+        mesh = get_mesh()
+        self._mesh = mesh
+        pp = mesh.shape["pp"] if (mesh is not None and "pp" in mesh.axis_names) else 1
+        self._pp_degree = pp
+        self._engine_step = None
+        self._stacked = []           # list[Parameter], one per stage-param slot
+        self._loss_params = []       # params of the loss head, if it's a Layer
+
+        if pp > 1:
+            if layers._num_stages != pp:
+                raise ValueError(
+                    f"PipelineLayer has {layers._num_stages} stages but the "
+                    f"mesh 'pp' axis has {pp} devices")
+            if not layers.stages_are_homogeneous():
+                raise ValueError(
+                    "SPMD pipeline parallelism needs structurally identical "
+                    "stages (same layer classes/param shapes per stage); "
+                    "got heterogeneous stages. Put embedding/head layers "
+                    "outside the PipelineLayer (they run replicated under "
+                    "dp/mp sharding) and pipeline only the repeated blocks.")
+            self._build_stacked()
+
+    # ---- stage-param stacking ----------------------------------------------
+    def _build_stacked(self):
+        mesh = self._mesh
+        pp = self._pp_degree
+        stage0 = self._layers.get_stage_layers(0)
+        self._stage0_params = [p for l in stage0 for p in l.parameters()]
+        per_stage = [
+            [p for l in self._layers.get_stage_layers(s) for p in l.parameters()]
+            for s in range(pp)
+        ]
+        self._stacked = []
+        for k in range(len(self._stage0_params)):
+            vals = [per_stage[s][k]._value for s in range(pp)]
+            spec = getattr(per_stage[0][k], "_pspec", None) or P()
+            stacked_spec = P("pp", *tuple(spec))
+            arr = jnp.stack(vals, axis=0)
+            arr = jax.device_put(arr, NamedSharding(mesh, stacked_spec))
+            sp = Parameter(Tensor(arr)._value)
+            sp.name = f"pp_stacked_{k}"
+            sp.stop_gradient = False
+            self._stacked.append(sp)
+        loss_fn = self._layers._loss_fn
+        if isinstance(loss_fn, Layer):
+            self._loss_params = list(loss_fn.parameters())
+
+    def parameters(self, include_sublayers=True):
+        if self._pp_degree > 1:
+            return list(self._stacked) + list(self._loss_params)
+        return super().parameters(include_sublayers)
+
+    def sync_layers_from_stacks(self):
+        """Write stacked master values back into the per-stage layer params
+        (for eval/state_dict after training)."""
+        if self._pp_degree <= 1:
+            return
+        pp = self._pp_degree
+        for s in range(pp):
+            ps = [p for l in self._layers.get_stage_layers(s) for p in l.parameters()]
+            for k, p in enumerate(ps):
+                p._value = self._stacked[k]._value[s]
+
+    def state_dict(self, *a, **kw):
+        self.sync_layers_from_stacks()
+        return self._layers.state_dict(*a, **kw)
 
     def forward(self, *args, **kwargs):
+        self.sync_layers_from_stacks()
         return self._layers(*args, **kwargs)
 
+    # ---- the train_batch API ------------------------------------------------
+    def _stage_fn(self, params_list, x):
+        saved = [(p._value, p._grad_node, p.stop_gradient) for p in self._stage0_params]
+        try:
+            for p, v in zip(self._stage0_params, params_list):
+                p._value = v
+                p._grad_node = None
+                p.stop_gradient = True  # engine handles grads via jax.vjp
+            out = _run_layers(self._layers.get_stage_layers(0), Tensor(x))
+            return out._value
+        finally:
+            for p, (v, gn, sg) in zip(self._stage0_params, saved):
+                p._value, p._grad_node, p.stop_gradient = v, gn, sg
+
+    def _loss_fn_jnp(self, loss_params, y, label):
+        loss_fn = self._layers._loss_fn
+        if isinstance(loss_fn, Layer):
+            saved = [(p._value, p._grad_node, p.stop_gradient) for p in self._loss_params]
+            try:
+                for p, v in zip(self._loss_params, loss_params):
+                    p._value = v
+                    p._grad_node = None
+                    p.stop_gradient = True
+                out = loss_fn(Tensor(y), Tensor(label))
+                return out._value
+            finally:
+                for p, (v, gn, sg) in zip(self._loss_params, saved):
+                    p._value, p._grad_node, p.stop_gradient = v, gn, sg
+        elif loss_fn is not None:
+            return loss_fn(Tensor(y), Tensor(label))._value
+        return jnp.mean(y)
+
+    def _make_engine(self):
+        from ..pipeline import ENGINES
+
+        engine = ENGINES[self.schedule]
+        mesh, pp = self._mesh, self._pp_degree
+
+        def run(stacked_vals, loss_vals, xs, labels):
+            return engine(
+                lambda params, x: self._stage_fn(params, x),
+                lambda lp, y, lab: self._loss_fn_jnp(lp, y, lab),
+                mesh, pp, stacked_vals, loss_vals, xs, labels,
+            )
+
+        return jax.jit(run)
+
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
-        """Microbatched forward/backward with grad accumulation; stage-to-stage
-        transfer is XLA's problem via the 'pp' sharding of layer params."""
         inputs, labels = data
-        mb = self.accumulate_steps
+        M = self.accumulate_steps
+        if self._pp_degree <= 1:
+            return self._train_batch_accumulate(inputs, labels, optimizer,
+                                                lr_scheduler, scaler)
         total = inputs.shape[0]
-        step = max(total // mb, 1)
+        if total % M != 0:
+            raise ValueError(f"batch {total} not divisible by accumulate_steps {M}")
+        mb = total // M
+        xs = api.reshape(inputs, [M, mb, *inputs.shape[1:]])._value
+        lab = api.reshape(labels, [M, mb, *labels.shape[1:]])._value
+
+        if self._engine_step is None:
+            self._engine_step = self._make_engine()
+        stacked_vals = [p._value for p in self._stacked]
+        loss_vals = [p._value for p in self._loss_params]
+        loss, d_stacked, d_loss, _ = self._engine_step(stacked_vals, loss_vals, xs, lab)
+
+        scale = None
+        if scaler is not None and scaler.is_enable():
+            # the engine computes grads of the UNSCALED loss (schedule runs in
+            # fp32/bf16); pre-scale them so scaler.step's unscale_ cancels and
+            # its found_inf/skip logic still applies
+            scale = scaler._scale
+        for p, g in zip(self._stacked, d_stacked):
+            p._grad = Tensor(g if scale is None else g * scale.astype(g.dtype))
+        for p, g in zip(self._loss_params, d_loss):
+            p._grad = Tensor(g if scale is None else g * scale.astype(g.dtype))
+        if scaler is not None:
+            scaler.step(optimizer)
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return Tensor(loss)
+
+    def _train_batch_accumulate(self, inputs, labels, optimizer, lr_scheduler, scaler):
+        """pp=1 path: plain microbatched gradient accumulation."""
+        M = self.accumulate_steps
+        total = inputs.shape[0]
+        step = max(total // M, 1)
         losses = []
         for i in range(0, total, step):
-            x = inputs[i : i + step]
-            y = labels[i : i + step]
+            x = inputs[i:i + step]
+            y = labels[i:i + step]
             out = self._layers(x)
-            loss = self._layers._loss_fn(out, y) if hasattr(self._layers, "_loss_fn") and self._layers._loss_fn else out
-            loss = loss / mb
+            lf = self._layers._loss_fn
+            loss = lf(out, y) if lf is not None else out
+            loss = loss / M
             if scaler is not None:
                 scaler.scale(loss).backward()
             else:

@@ -1,0 +1,187 @@
+"""Activation recomputation (gradient/activation checkpointing).
+
+Reference: python/paddle/distributed/fleet/recompute/recompute.py:334
+(`recompute(function, *args)`) and recompute_sequential/recompute_hybrid.
+
+TPU-native: the reference re-runs the forward inside a custom PyLayer backward
+with saved RNG state. Here the whole segment becomes ONE vjp of a
+`jax.checkpoint`-wrapped pure function, recorded as a single GradNode in the
+eager grad graph. Under the jit executor (TrainStep) that lowers to true XLA
+rematerialization — the backward pass recomputes the segment's activations
+from its inputs instead of keeping them in HBM, trading MXU FLOPs for HBM
+capacity. RNG consistency is structural: the trace-seed arithmetic is part of
+the replayed computation, so dropout masks match between forward and
+recompute (the reference saves/restores cuda RNG state by hand for the same
+guarantee).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...core import autograd as _ag
+from ...core.autograd import GradNode
+from ...core.tensor import Tensor
+from ...nn.layer import Layer
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+_POLICIES = {
+    None: None,
+    "full": None,  # recompute everything (reference default)
+    "dots_saveable": jax.checkpoint_policies.dots_saveable,
+    "dots_with_no_batch_dims_saveable":
+        jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
+    "everything_saveable": jax.checkpoint_policies.everything_saveable,
+}
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def recompute(function: Callable, *args, policy: Optional[str] = None,
+              preserve_rng_state: bool = True, use_reentrant: bool = True,
+              **kwargs):
+    """Run ``function(*args, **kwargs)`` without keeping its intermediate
+    activations; they are recomputed during backward.
+
+    When ``function`` is a Layer, its parameters participate in the grad graph
+    (like the reference, where autograd tracks them through the replayed ops).
+    ``policy`` selects what XLA may keep: None/'full' recomputes everything;
+    'dots_saveable' keeps matmul outputs (jax.checkpoint_policies).
+    """
+    if policy not in _POLICIES:
+        raise ValueError(f"unknown recompute policy {policy!r}; "
+                         f"one of {sorted(k for k in _POLICIES if k)}")
+    ckpt_policy = _POLICIES[policy]
+
+    params = [p for p in function.parameters() if p.trainable] \
+        if isinstance(function, Layer) else []
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor)
+    tensor_idx = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
+    arg_tensors = [leaves[i] for i in tensor_idx]
+
+    grad_on = _ag.is_grad_enabled()
+    primal_args = [
+        k for k, t in enumerate(arg_tensors)
+        if grad_on and not t.stop_gradient and jnp.issubdtype(t.dtype, jnp.inexact)
+    ]
+    primal_params = params if grad_on else []
+
+    def run_with(arg_vals, param_vals):
+        saved_p = [(p._value, p._grad_node, p.stop_gradient) for p in params]
+        vals = list(leaves)
+        for i, v in zip(tensor_idx, arg_vals):
+            vals[i] = Tensor(v)
+        a, k = jax.tree_util.tree_unflatten(treedef, vals)
+        try:
+            for p, v in zip(params, param_vals):
+                p._value = v
+                p._grad_node = None
+                p.stop_gradient = True
+            with _ag.no_grad():
+                out = function(*a, **k)
+        finally:
+            for p, (v, gn, sg) in zip(params, saved_p):
+                p._value, p._grad_node, p.stop_gradient = v, gn, sg
+        out_leaves, out_treedef = jax.tree_util.tree_flatten(out, is_leaf=_is_tensor)
+        out_vals = tuple(o._value if isinstance(o, Tensor) else o for o in out_leaves)
+        return out_vals, out_treedef
+
+    out_treedef_box = []
+
+    if not (primal_args or primal_params):
+        arg_vals = [t._value for t in arg_tensors]
+        param_vals = [p._value for p in params]
+        out_vals, out_treedef = run_with(arg_vals, param_vals)
+        return jax.tree_util.tree_unflatten(
+            out_treedef, [Tensor(v) for v in out_vals])
+
+    primal_arg_set = set(primal_args)
+    const_arg_vals = [t._value for k, t in enumerate(arg_tensors)
+                      if k not in primal_arg_set]
+
+    def pure(primal_arg_vals, param_vals):
+        it_p = iter(primal_arg_vals)
+        it_c = iter(const_arg_vals)
+        arg_vals = [next(it_p) if k in primal_arg_set else next(it_c)
+                    for k in range(len(arg_tensors))]
+        out_vals, out_treedef = run_with(arg_vals, param_vals)
+        if not out_treedef_box:
+            out_treedef_box.append(out_treedef)
+        return out_vals
+
+    ckpt = jax.checkpoint(pure, policy=ckpt_policy)
+    out_vals, vjp_fn = jax.vjp(
+        ckpt,
+        [arg_tensors[k]._value for k in primal_args],
+        [p._value for p in primal_params],
+    )
+    out_treedef = out_treedef_box[0]
+
+    # one GradNode covering the whole recomputed segment
+    edges = []
+    primal_tensors = [arg_tensors[k] for k in primal_args] + list(primal_params)
+    for t in primal_tensors:
+        if t._grad_node is not None:
+            node, idx = t._grad_node
+            edges.append(("node", node, idx))
+        else:
+            edges.append(("leaf", t))
+    out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in out_vals]
+
+    def segment_vjp(cots):
+        cots = cots if isinstance(cots, tuple) else (cots,)
+        d_args, d_params = vjp_fn(tuple(cots))
+        return tuple(d_args) + tuple(d_params)
+
+    node = GradNode("recompute", segment_vjp, edges, out_avals)
+
+    wrapped = []
+    for i, v in enumerate(out_vals):
+        t = Tensor(v)
+        if jnp.issubdtype(v.dtype, jnp.inexact):
+            t.stop_gradient = False
+            t._grad_node = (node, i)
+        wrapped.append(t)
+    return jax.tree_util.tree_unflatten(out_treedef, wrapped)
+
+
+def recompute_sequential(ctx: Optional[dict], functions, *args, **kwargs):
+    """Reference: recompute_sequential — run a Sequential/LayerList in
+    `segments` chunks, each chunk recomputed."""
+    ctx = ctx or {}
+    segments = ctx.get("segments", 1)
+    policy = ctx.get("policy", None)
+    layers = list(functions)
+    n = len(layers)
+    per = (n + segments - 1) // segments
+    out = args
+    for s in range(0, n, per):
+        chunk = layers[s:s + per]
+
+        class _Chunk(Layer):
+            def __init__(self, mods):
+                super().__init__()
+                from ...nn.container import LayerList
+
+                self.mods = LayerList(mods)
+
+            def forward(self, *xs):
+                for m in self.mods:
+                    xs = m(*xs) if isinstance(xs, tuple) else m(xs)
+                    if not isinstance(xs, tuple):
+                        xs = (xs,)
+                return xs if len(xs) > 1 else xs[0]
+
+        out = recompute(_Chunk(chunk), *(out if isinstance(out, tuple) else (out,)),
+                        policy=policy, **kwargs)
+        if not isinstance(out, tuple):
+            out = (out,)
+    return out if len(out) > 1 else out[0]

@@ -1,0 +1,6 @@
+"""fleet.utils — reference: python/paddle/distributed/fleet/utils/
+(recompute at fleet/recompute/recompute.py:334 is re-exported here, matching
+`paddle.distributed.fleet.utils.recompute`)."""
+from ..recompute import recompute, recompute_sequential  # noqa: F401
+
+__all__ = ["recompute", "recompute_sequential"]

@@ -1,0 +1,263 @@
+"""Pipeline-parallel schedule engines (pure jnp level).
+
+Reference: 1F1B host schedule `forward_backward_pipeline`
+(python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py:382) and
+the cached-shape p2p layer (fleet/meta_parallel/pp_utils/p2p_communication.py).
+
+TPU-native redesign (NOT a port): the reference drives 1F1B from the host with
+NCCL p2p between per-stage processes. Here the whole schedule is ONE compiled
+SPMD program over the 'pp' mesh axis:
+
+  * each pp rank holds its stage's parameters (stage-stacked arrays, leading
+    dim S sharded over 'pp');
+  * stage handoff is `lax.ppermute` over ICI neighbors (the send/recv);
+  * the 1F1B tick loop is a `lax.scan` whose body does one forward substep and
+    one 1F1B backward substep per tick, with ring buffers for in-flight
+    activations (max S in flight per rank — the 1F1B memory property);
+  * the backward recomputes the stage forward from its saved input (the
+    reference couples PP with recompute the same way), so in-flight state is
+    activations at stage boundaries only;
+  * bubbles are masked compute, exactly like the reference's idle ticks.
+
+Schedule arithmetic (stage s in [0,S), microbatch m in [0,M)):
+  forward tick  t_f(s,m) = m + s                     (warmup, m < S - s)
+                t_f(s,m) = 2m + s - 1                (steady state)
+  backward tick t_b(s,m) = 2m + 2(S-1) - s
+Derived properties used below: t_f(s+1,m) >= t_f(s,m)+1 (activations buffer at
+most S ticks), t_b(s-1,m) = t_b(s,m)+1 (grad handoff is a pure rotation), and
+steady-state ticks alternate fwd/bwd per rank (the "1F1B" in the name).
+
+Two engines with one signature:
+  pipeline_1f1b(...)    manual-vjp 1F1B (above)
+  pipeline_fthenb(...)  forward scan + jax AD backward (GPipe / "F-then-B",
+                        reference analog pipeline_scheduler_pass.py FThenB),
+                        with jax.checkpoint on the stage so memory also stays
+                        at stage boundaries.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map as _sm
+
+    shard_map = _sm.shard_map if hasattr(_sm, "shard_map") else _sm
+except Exception:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+
+def _zeros_like_tree(tree):
+    return jax.tree_util.tree_map(jnp.zeros_like, tree)
+
+
+def _tree_add(a, b):
+    return jax.tree_util.tree_map(jnp.add, a, b)
+
+
+def _tree_where(pred, a, b):
+    return jax.tree_util.tree_map(lambda x, y: jnp.where(pred, x, y), a, b)
+
+
+def _squeeze0(tree):
+    return jax.tree_util.tree_map(lambda x: jnp.squeeze(x, 0), tree)
+
+
+def _expand0(tree):
+    return jax.tree_util.tree_map(lambda x: jnp.expand_dims(x, 0), tree)
+
+
+def pipeline_1f1b(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    mesh: Mesh,
+    n_stages: int,
+    stage_params: Any,
+    loss_params: Any,
+    xs: jax.Array,
+    labels: jax.Array,
+    axis: str = "pp",
+):
+    """Run the 1F1B schedule; returns (loss, d_stage_params, d_loss_params, d_xs).
+
+    stage_fn(params, x) -> y        with y.shape == x.shape (homogeneous stages)
+    loss_fn(loss_params, y, label) -> scalar mean loss for one microbatch
+    stage_params: pytree with leading dim S (sharded over `axis`)
+    xs, labels:   leading dim M = number of microbatches (replicated over `axis`)
+    """
+    S, M = n_stages, xs.shape[0]
+    T = 2 * M + 2 * S - 3  # last tick: t_b(0, M-1) = 2(M-1) + 2(S-1)
+    fwd_perm = [(i, i + 1) for i in range(S - 1)]
+    bwd_perm = [(i + 1, i) for i in range(S - 1)]
+
+    def body(stage_params_l, loss_params_l, xs_l, labels_l):
+        params = _squeeze0(stage_params_l)  # local stage's params
+        sid = lax.axis_index(axis)
+        is_first = sid == 0
+        is_last = sid == S - 1
+
+        mb_shape = xs_l.shape[1:]
+        ring = jnp.zeros((S,) + mb_shape, xs_l.dtype)  # in-flight stage inputs
+        gbuf = jnp.zeros(mb_shape, xs_l.dtype)         # rotating upstream grad
+        gparams0 = _zeros_like_tree(params)
+        gloss0 = _zeros_like_tree(loss_params_l)
+        gxs0 = jnp.zeros_like(xs_l)
+        loss0 = jnp.zeros((), jnp.float32)
+
+        def warmup_of(s):
+            return S - s  # W_s: microbatches forwarded before first backward
+
+        def fwd_index(t, s):
+            """Microbatch index of the forward substep of stage s at tick t
+            (and its validity)."""
+            m_warm = t - s
+            in_warm = (m_warm >= 0) & (m_warm < jnp.minimum(warmup_of(s), M))
+            num = t + 1 - s
+            m_steady = num // 2
+            in_steady = (num % 2 == 0) & (m_steady >= warmup_of(s)) & (m_steady < M)
+            m = jnp.where(in_warm, m_warm, m_steady)
+            return m, in_warm | in_steady
+
+        def bwd_index(t, s):
+            num = t - 2 * (S - 1) + s
+            m = num // 2
+            valid = (num >= 0) & (num % 2 == 0) & (m < M)
+            return m, valid
+
+        def tick(carry, t):
+            ring, gbuf, gparams, gloss, gxs, loss_acc = carry
+
+            # ---- forward substep -------------------------------------------
+            m_f, f_valid = fwd_index(t, sid)
+            m_f = jnp.clip(m_f, 0, M - 1)
+            x_f = jnp.where(is_first, xs_l[m_f], ring[m_f % S])
+            y = stage_fn(params, x_f)
+            y_send = jnp.where(f_valid, y, jnp.zeros_like(y))
+
+            # ---- backward substep (recompute-from-input, 1F1B order) -------
+            m_b, b_valid = bwd_index(t, sid)
+            m_b = jnp.clip(m_b, 0, M - 1)
+            x_b = jnp.where(is_first, xs_l[m_b], ring[m_b % S])
+            y_b, stage_vjp = jax.vjp(stage_fn, params, x_b)
+            lval, loss_vjp = jax.vjp(loss_fn, loss_params_l, y_b, labels_l[m_b])
+            glp, gy_loss, _ = loss_vjp(jnp.ones_like(lval) / M)
+            gy = jnp.where(is_last, gy_loss.astype(gbuf.dtype), gbuf)
+            gp, gx = stage_vjp(gy.astype(y_b.dtype))
+
+            bmask = b_valid
+            gparams = _tree_add(gparams, _tree_where(bmask, gp, _zeros_like_tree(gp)))
+            gloss = _tree_add(
+                gloss, _tree_where(bmask & is_last, glp, _zeros_like_tree(glp)))
+            gxs = gxs.at[m_b].add(
+                jnp.where(bmask & is_first, gx.astype(gxs.dtype), jnp.zeros_like(gx, gxs.dtype)))
+            loss_acc = loss_acc + jnp.where(
+                bmask & is_last, lval.astype(jnp.float32) / M, 0.0)
+            gx_send = jnp.where(bmask, gx, jnp.zeros_like(gx))
+
+            # ---- communications (the reference's p2p send/recv layer) ------
+            y_rot = lax.ppermute(y_send, axis, fwd_perm)
+            gbuf = lax.ppermute(gx_send, axis, bwd_perm)
+
+            # arrival: what my upstream neighbor forwarded this tick
+            m_in, in_valid = fwd_index(t, sid - 1)
+            m_in = jnp.clip(m_in, 0, M - 1)
+            in_valid = in_valid & (sid >= 1)
+            slot = m_in % S
+            ring = ring.at[slot].set(jnp.where(in_valid, y_rot, ring[slot]))
+
+            return (ring, gbuf, gparams, gloss, gxs, loss_acc), None
+
+        carry0 = (ring, gbuf, gparams0, gloss0, gxs0, loss0)
+        (ring, gbuf, gparams, gloss, gxs, loss_acc), _ = lax.scan(
+            tick, carry0, jnp.arange(T))
+
+        # only one rank holds each piece; make outputs axis-invariant
+        loss_out = lax.psum(loss_acc, axis)
+        gloss_out = jax.tree_util.tree_map(lambda g: lax.psum(g, axis), gloss)
+        gxs_out = lax.psum(gxs, axis)
+        return _expand0(gparams), gloss_out, gxs_out, loss_out
+
+    in_specs = (
+        jax.tree_util.tree_map(lambda _: P(axis), stage_params),
+        jax.tree_util.tree_map(lambda _: P(), loss_params),
+        P(),
+        P(),
+    )
+    out_specs = (
+        jax.tree_util.tree_map(lambda _: P(axis), stage_params),
+        jax.tree_util.tree_map(lambda _: P(), loss_params),
+        P(),
+        P(),
+    )
+    fn = shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   axis_names=frozenset({axis}), check_vma=False)
+    d_stage, d_loss_p, d_xs, loss = fn(stage_params, loss_params, xs, labels)
+    return loss, d_stage, d_loss_p, d_xs
+
+
+def pipeline_fthenb(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    mesh: Mesh,
+    n_stages: int,
+    stage_params: Any,
+    loss_params: Any,
+    xs: jax.Array,
+    labels: jax.Array,
+    axis: str = "pp",
+):
+    """F-then-B engine: forward rotation scan, backward generated by jax AD
+    (the transpose of ppermute/scan IS the reverse schedule). Stage is
+    jax.checkpoint'ed so only stage-boundary activations are stored."""
+    S, M = n_stages, xs.shape[0]
+    T = M + S - 1
+    fwd_perm = [(i, i + 1) for i in range(S - 1)]
+    stage_ckpt = jax.checkpoint(stage_fn)
+
+    def forward(stage_params_l, loss_params_l, xs_l, labels_l):
+        params = _squeeze0(stage_params_l)
+        sid = lax.axis_index(axis)
+        is_first = sid == 0
+        is_last = sid == S - 1
+        mb_shape = xs_l.shape[1:]
+
+        def tick(state, t):
+            m_in = jnp.clip(t, 0, M - 1)
+            x = jnp.where(is_first & (t < M), xs_l[m_in], state)
+            y = stage_ckpt(params, x)
+            m_out = t - (S - 1)
+            collect = is_last & (m_out >= 0)
+            lval = loss_fn(loss_params_l, y, labels_l[jnp.clip(m_out, 0, M - 1)])
+            contrib = jnp.where(collect, lval.astype(jnp.float32) / M, 0.0)
+            state = lax.ppermute(y, axis, fwd_perm)
+            return state, contrib
+
+        state0 = jnp.zeros(mb_shape, xs_l.dtype)
+        _, contribs = lax.scan(tick, state0, jnp.arange(T))
+        return lax.psum(jnp.sum(contribs), axis)
+
+    in_specs = (
+        jax.tree_util.tree_map(lambda _: P(axis), stage_params),
+        jax.tree_util.tree_map(lambda _: P(), loss_params),
+        P(),
+        P(),
+    )
+    fn = shard_map(forward, mesh=mesh, in_specs=in_specs, out_specs=P(),
+                   axis_names=frozenset({axis}), check_vma=False)
+
+    def total(sp, lp, x):
+        return fn(sp, lp, x, labels)
+
+    loss, grads = jax.value_and_grad(total, argnums=(0, 1, 2))(
+        stage_params, loss_params, xs)
+    d_stage, d_loss_p, d_xs = grads
+    return loss, d_stage, d_loss_p, d_xs
+
+
+ENGINES = {"1F1B": pipeline_1f1b, "FThenB": pipeline_fthenb}

@@ -16,12 +16,10 @@ from __future__ import annotations
 import math
 from typing import Optional
 
-import jax
 import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec
+from jax.sharding import PartitionSpec
 
 from paddle_tpu.core.tensor import Tensor
-from paddle_tpu.distributed.mesh import get_mesh
 from paddle_tpu.nn import initializer as I
 from paddle_tpu.nn.layer import Layer
 from paddle_tpu.ops import api as F
@@ -30,14 +28,9 @@ from .gates import GShardGate, NaiveGate, SwitchGate
 
 
 def _annotate(p: Tensor, spec: PartitionSpec):
-    p._pspec = spec
-    mesh = get_mesh()
-    if mesh is not None and all(a is None or a in mesh.axis_names for a in spec):
-        try:
-            p._value = jax.device_put(p._value, NamedSharding(mesh, spec))
-        except Exception:
-            pass
-    return p
+    from paddle_tpu.distributed.mesh import annotate_param
+
+    return annotate_param(p, spec)
 
 
 class ExpertMLP(Layer):

@@ -59,6 +59,29 @@ class TrainStep:
         self._mesh = mesh
         self._step_i = 0
 
+        # ZeRO stage placements (distributed/sharding.py): optimizer state is
+        # sharded in all stages; grads carry a reduce-scatter constraint in
+        # stages 2/3 (params were placed by group_sharded_parallel itself).
+        from ..distributed.sharding import zero_grad_sharding, zero_state_sharding
+
+        state_sh = zero_state_sharding(optimizer, self.params)
+        if state_sh is not None:
+            placed = []
+            for st, sh, p in zip(self.opt_state, state_sh, self.params):
+                st = dict(st)
+                for k, v in st.items():
+                    if hasattr(v, "shape") and tuple(v.shape) == tuple(p._value.shape):
+                        st[k] = jax.device_put(v, sh)
+                placed.append(st)
+            self.opt_state = placed
+        self._grad_shardings = zero_grad_sharding(optimizer, self.params)
+        # pin updated params to their stage placement — otherwise GSPMD
+        # propagates the sharded optimizer-state layout onto them, silently
+        # turning stage 1/2 (replicated params) into stage 3
+        self._param_shardings = (
+            [p._value.sharding for p in self.params]
+            if getattr(optimizer, "_zero_level", None) else None)
+
         def step(param_vals, buffer_vals, opt_state, lr, seed, batch):
             saved = [(p._value, p._grad_node, p._grad, p.stop_gradient) for p in self.params]
             saved_buf = [(b._value,) for b in self.buffers]
@@ -78,6 +101,11 @@ class TrainStep:
                     (g._value if g is not None else jnp.zeros_like(p._value))
                     for g, p in zip(grads, self.params)
                 ]
+                if self._grad_shardings is not None:  # ZeRO-2/3 reduce-scatter
+                    g_vals = [
+                        jax.lax.with_sharding_constraint(g, sh)
+                        for g, sh in zip(g_vals, self._grad_shardings)
+                    ]
                 clip = optimizer._grad_clip
                 if isinstance(clip, ClipGradByGlobalNorm):
                     g_vals = clip.functional_clip(g_vals)
@@ -85,6 +113,11 @@ class TrainStep:
                     pairs = clip([(p, Tensor(g)) for p, g in zip(self.params, g_vals)])
                     g_vals = [g._value for _, g in pairs]
                 new_p, new_s = optimizer.functional_update(param_vals, g_vals, opt_state, lr)
+                if self._param_shardings is not None:
+                    new_p = [
+                        jax.lax.with_sharding_constraint(v, sh)
+                        for v, sh in zip(new_p, self._param_shardings)
+                    ]
                 new_buffer_vals = [b._value for b in self.buffers]  # BN stats updated in-place
                 return loss._value, new_p, new_buffer_vals, new_s
             finally:

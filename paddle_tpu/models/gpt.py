@@ -41,6 +41,8 @@ class GPTConfig:
     initializer_range: float = 0.02
     use_rotary: bool = False
     tie_word_embeddings: bool = True
+    recompute: bool = False           # activation checkpointing per block
+    recompute_policy: str = None      # jax.checkpoint policy name (None=full)
 
     def __post_init__(self):
         if not self.intermediate_size:
@@ -146,7 +148,13 @@ class GPTModel(nn.Layer):
             h = h + self.wpe(pos)
         h = self.drop(h)
         for block in self.blocks:
-            h = block(h, rope=rope)
+            if self.config.recompute and self.training:
+                from ..distributed.fleet.recompute import recompute
+
+                h = recompute(block, h, rope=rope,
+                              policy=self.config.recompute_policy)
+            else:
+                h = block(h, rope=rope)
         return self.ln_f(h)
 
 

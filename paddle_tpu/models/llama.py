@@ -41,6 +41,8 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     initializer_range: float = 0.02
     tie_word_embeddings: bool = False
+    recompute: bool = False           # activation checkpointing per decoder layer
+    recompute_policy: str = None      # jax.checkpoint policy name (None=full)
 
     def __post_init__(self):
         if not self.num_key_value_heads:
@@ -151,7 +153,13 @@ class LlamaModel(nn.Layer):
         sin = Tensor(self._rope[1]._value[:s])
         h = self.embed_tokens(input_ids)
         for layer in self.layers:
-            h = layer(h, (cos, sin))
+            if self.config.recompute and self.training:
+                from ..distributed.fleet.recompute import recompute
+
+                h = recompute(layer, h, (cos, sin),
+                              policy=self.config.recompute_policy)
+            else:
+                h = layer(h, (cos, sin))
         return self.norm(h)
 
 

@@ -1,0 +1,292 @@
+"""Recurrent layers: cells, RNN/BiRNN wrappers, SimpleRNN/LSTM/GRU.
+
+Reference: python/paddle/nn/layer/rnn.py (RNNCellBase:80, SimpleRNNCell:1613?
+— cell classes, RNN:1171, BiRNN:1285, RNNBase:1417, SimpleRNN:1613,
+LSTM:1735, GRU:1861).
+
+TPU-native: the multi-layer classes lower to the single fused `rnn` op
+(ops/kernels/rnn.py) whose time loop is lax.scan — one compiled program per
+shape, backward via the registry's vjp path. The generic RNN/BiRNN wrappers
+(arbitrary user cells) unroll in Python like the reference's dygraph path.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops import api
+from . import initializer as I
+from .layer import Layer, Parameter
+
+__all__ = [
+    "RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell",
+    "RNN", "BiRNN", "SimpleRNN", "LSTM", "GRU",
+]
+
+
+def _uniform_init(shape, dtype, bound):
+    return I.Uniform(-bound, bound)(shape, dtype)
+
+
+class RNNCellBase(Layer):
+    """Base for single-step cells (reference RNNCellBase)."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        batch = batch_ref.shape[batch_dim_idx]
+        shape = shape or self.state_shape
+        if isinstance(shape, (list, tuple)) and shape and isinstance(shape[0], (list, tuple)):
+            return tuple(
+                api.full([batch] + list(s), init_value, dtype=dtype or "float32")
+                for s in shape)
+        return api.full([batch] + list(shape), init_value, dtype=dtype or "float32")
+
+
+class _GateCell(RNNCellBase):
+    """Shared parameter layout for the builtin cells: weight_ih [kH, D],
+    weight_hh [kH, H], bias_ih/bias_hh [kH] with U(-1/sqrt(H), 1/sqrt(H))."""
+
+    def __init__(self, input_size, hidden_size, k, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        bound = 1.0 / math.sqrt(hidden_size)
+        mk = lambda shape: Parameter(_uniform_init(shape, "float32", bound))
+        self.weight_ih = mk([k * hidden_size, input_size])
+        self.weight_hh = mk([k * hidden_size, hidden_size])
+        self.bias_ih = mk([k * hidden_size]) if bias_ih_attr is not False else None
+        self.bias_hh = mk([k * hidden_size]) if bias_hh_attr is not False else None
+
+    def _proj(self, x, h):
+        g = api.matmul(x, self.weight_ih, transpose_y=True)
+        if self.bias_ih is not None:
+            g = g + self.bias_ih
+        g2 = api.matmul(h, self.weight_hh, transpose_y=True)
+        if self.bias_hh is not None:
+            g2 = g2 + self.bias_hh
+        return g + g2
+
+
+class SimpleRNNCell(_GateCell):
+    def __init__(self, input_size, hidden_size, activation="tanh", **kw):
+        super().__init__(input_size, hidden_size, 1, **kw)
+        if activation not in ("tanh", "relu"):
+            raise ValueError("activation must be tanh or relu")
+        self.activation = activation
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h = api.tanh(self._proj(inputs, states)) if self.activation == "tanh" \
+            else api.relu(self._proj(inputs, states))
+        return h, h
+
+
+class LSTMCell(_GateCell):
+    def __init__(self, input_size, hidden_size, **kw):
+        super().__init__(input_size, hidden_size, 4, **kw)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h_prev, c_prev = states
+        gates = self._proj(inputs, h_prev)
+        i, f, g, o = api.split(gates, 4, axis=-1)
+        i, f, o = api.sigmoid(i), api.sigmoid(f), api.sigmoid(o)
+        c = f * c_prev + i * api.tanh(g)
+        h = o * api.tanh(c)
+        return h, (h, c)
+
+
+class GRUCell(_GateCell):
+    def __init__(self, input_size, hidden_size, **kw):
+        super().__init__(input_size, hidden_size, 3, **kw)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h_prev = states
+        x_g = api.matmul(inputs, self.weight_ih, transpose_y=True)
+        if self.bias_ih is not None:
+            x_g = x_g + self.bias_ih
+        h_g = api.matmul(h_prev, self.weight_hh, transpose_y=True)
+        if self.bias_hh is not None:
+            h_g = h_g + self.bias_hh
+        xr, xz, xc = api.split(x_g, 3, axis=-1)
+        hr, hz, hc = api.split(h_g, 3, axis=-1)
+        r = api.sigmoid(xr + hr)
+        z = api.sigmoid(xz + hz)
+        c = api.tanh(xc + r * hc)
+        h = z * h_prev + (1.0 - z) * c
+        return h, h
+
+
+class RNN(Layer):
+    """Scan an arbitrary cell over time (reference RNN:1171; dygraph unroll)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        import jax
+
+        x = inputs if self.time_major else api.transpose(inputs, [1, 0, 2])
+        T = x.shape[0]
+        states = initial_states
+        if states is None and sequence_length is not None:
+            # materialize zeros so the masked update has a previous state
+            if hasattr(self.cell, "get_initial_states"):
+                states = self.cell.get_initial_states(x[0])
+            else:
+                _, states = self.cell(x[0] * 0.0, None)
+                states = jax.tree_util.tree_map(
+                    lambda s: s * 0.0, states,
+                    is_leaf=lambda v: isinstance(v, Tensor))
+        outs = []
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        for t in steps:
+            out, new_states = self.cell(x[t], states)
+            if sequence_length is not None:
+                valid = api.unsqueeze(
+                    api.cast(api.less_than(
+                        api.full([1], t, dtype="int32"), sequence_length), "float32"),
+                    -1)
+                out = out * valid
+                states = jax.tree_util.tree_map(
+                    lambda n, o: n * valid + o * (1.0 - valid),
+                    new_states, states,
+                    is_leaf=lambda v: isinstance(v, Tensor))
+            else:
+                states = new_states
+            outs.append(out)
+        if self.is_reverse:
+            outs.reverse()
+        outputs = api.stack(outs, axis=0)
+        if not self.time_major:
+            outputs = api.transpose(outputs, [1, 0, 2])
+        return outputs, states
+
+
+class BiRNN(Layer):
+    """Forward + backward cells, outputs concatenated (reference BiRNN:1285)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        st_fw, st_bw = (initial_states if initial_states is not None
+                        else (None, None))
+        out_fw, fin_fw = self.rnn_fw(inputs, st_fw, sequence_length)
+        out_bw, fin_bw = self.rnn_bw(inputs, st_bw, sequence_length)
+        outputs = api.concat([out_fw, out_bw], axis=-1)
+        return outputs, (fin_fw, fin_bw)
+
+
+class _RNNBase(Layer):
+    """Multi-layer stack lowering to the fused rnn op (reference RNNBase:1417)."""
+
+    _K = {"RNN_TANH": 1, "RNN_RELU": 1, "LSTM": 4, "GRU": 3}
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        super().__init__()
+        if direction not in ("forward", "bidirect", "bidirectional"):
+            raise ValueError(f"bad direction {direction}")
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        self.num_directions = 2 if direction != "forward" else 1
+        k = self._K[mode]
+        bound = 1.0 / math.sqrt(hidden_size)
+        self._weight_names = []
+        for layer in range(num_layers):
+            in_size = input_size if layer == 0 else hidden_size * self.num_directions
+            for d in range(self.num_directions):
+                suffix = f"_l{layer}" + ("_reverse" if d == 1 else "")
+                for wname, shape in (
+                    (f"weight_ih{suffix}", [k * hidden_size, in_size]),
+                    (f"weight_hh{suffix}", [k * hidden_size, hidden_size]),
+                    (f"bias_ih{suffix}", [k * hidden_size]),
+                    (f"bias_hh{suffix}", [k * hidden_size]),
+                ):
+                    p = Parameter(_uniform_init(shape, "float32", bound))
+                    self.add_parameter(wname, p)
+                    self._weight_names.append(wname)
+
+    def _weights(self):
+        return [getattr(self, n) for n in self._weight_names]
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        batch_idx = 1 if self.time_major else 0
+        batch = inputs.shape[batch_idx]
+        n = self.num_layers * self.num_directions
+        if initial_states is None:
+            h0 = api.zeros([n, batch, self.hidden_size], dtype="float32")
+            initial_states = (h0, api.zeros_like(h0)) if self.mode == "LSTM" else h0
+        mode_kernel = "LSTM" if self.mode == "LSTM" else (
+            "GRU" if self.mode == "GRU" else "SimpleRNN")
+        act = "relu" if self.mode == "RNN_RELU" else "tanh"
+        states = initial_states if isinstance(initial_states, (tuple, list)) \
+            else (initial_states,)
+        result = api.rnn(
+            inputs, tuple(states), self._weights(), mode=mode_kernel,
+            num_layers=self.num_layers, direction=self.direction,
+            time_major=self.time_major,
+            dropout=self.dropout, training=self.training, activation=act,
+            sequence_length=sequence_length)
+        if self.mode == "LSTM":
+            outputs, h_n, c_n = result
+            return outputs, (h_n, c_n)
+        outputs, h_n = result
+        return outputs, h_n
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        mode = "RNN_RELU" if activation == "relu" else "RNN_TANH"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, activation, **kw)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)

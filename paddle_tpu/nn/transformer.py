@@ -87,18 +87,27 @@ class TransformerEncoderLayer(Layer):
 
 
 class TransformerEncoder(Layer):
-    def __init__(self, encoder_layer, num_layers, norm=None):
+    """enable_recompute activates per-layer activation checkpointing
+    (reference: fleet recompute wiring in TransformerEncoder)."""
+
+    def __init__(self, encoder_layer, num_layers, norm=None, enable_recompute=False):
         super().__init__()
         import copy
 
         self.layers = LayerList([encoder_layer] + [copy.deepcopy(encoder_layer) for _ in range(num_layers - 1)])
         self.num_layers = num_layers
         self.norm = norm
+        self.enable_recompute = enable_recompute
 
     def forward(self, src, src_mask=None):
         out = src
         for layer in self.layers:
-            out = layer(out, src_mask=src_mask)
+            if self.enable_recompute and self.training:
+                from ..distributed.fleet.recompute import recompute
+
+                out = recompute(layer, out, src_mask=src_mask)
+            else:
+                out = layer(out, src_mask=src_mask)
         if self.norm is not None:
             out = self.norm(out)
         return out

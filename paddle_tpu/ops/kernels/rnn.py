@@ -1,0 +1,134 @@
+"""RNN kernels: fused multi-layer (bi)directional recurrences over lax.scan.
+
+Reference: the rnn op + cudnn kernels behind python/paddle/nn/layer/rnn.py
+(SimpleRNN:1613, LSTM:1735, GRU:1861) and phi rnn_kernel.
+
+TPU-native: the whole stack (layers x directions x time) is ONE kernel whose
+time loop is `lax.scan` — a single compiled program, differentiable by jax AD
+(so the registry's vjp path covers backward; no hand-written grad kernel).
+Per-step math keeps the MXU busy with [B, D] x [D, kH] matmuls; the input
+projection for all timesteps is hoisted out of the scan as one big
+[T*B, D] x [D, kH] matmul (the standard TPU rnn trick — the scan body then
+only does the hidden-to-hidden matmul).
+
+Weight layout matches the reference cells: weight_ih [kH, D],
+weight_hh [kH, H], bias_ih/bias_hh [kH]; gate order LSTM (i, f, g, o),
+GRU (r, z, c).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core import random as _random
+
+
+def _act(name):
+    return {"tanh": jnp.tanh, "relu": jax.nn.relu}[name]
+
+
+def simple_rnn_step(x_proj, h, w_hh, b_hh, activation="tanh"):
+    return _act(activation)(x_proj + h @ w_hh.T + b_hh)
+
+
+def lstm_step(x_proj, h, c, w_hh, b_hh):
+    gates = x_proj + h @ w_hh.T + b_hh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    c_new = f * c + i * jnp.tanh(g)
+    return o * jnp.tanh(c_new), c_new
+
+def gru_step(x_proj, h, w_hh, b_hh):
+    # x_proj = x @ w_ih.T + b_ih, all 3 gates; reference GRUCell keeps the
+    # reset gate INSIDE the candidate's hidden matmul term
+    hh = h @ w_hh.T + b_hh
+    xr, xz, xc = jnp.split(x_proj, 3, axis=-1)
+    hr, hz, hc = jnp.split(hh, 3, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    z = jax.nn.sigmoid(xz + hz)
+    c = jnp.tanh(xc + r * hc)
+    return z * h + (1.0 - z) * c
+
+
+def _scan_single(mode, x, h0, c0, w_ih, w_hh, b_ih, b_hh, reverse,
+                 mask, activation):
+    """One (layer, direction) recurrence. x: [T, B, D] time-major.
+    mask: [T, B, 1] validity (or None). Returns (outputs [T,B,H], h_T, c_T)."""
+    x_proj = x @ w_ih.T + b_ih  # hoisted input projection: one big matmul
+
+    def body(carry, inp):
+        h, c = carry
+        xp, m = inp
+        if mode == "LSTM":
+            h_new, c_new = lstm_step(xp, h, c, w_hh, b_hh)
+        elif mode == "GRU":
+            h_new = gru_step(xp, h, w_hh, b_hh)
+            c_new = c
+        else:
+            h_new = simple_rnn_step(xp, h, w_hh, b_hh, activation)
+            c_new = c
+        if m is not None:
+            h_new = jnp.where(m, h_new, h)
+            c_new = jnp.where(m, c_new, c)
+            out = jnp.where(m, h_new, jnp.zeros_like(h_new))
+        else:
+            out = h_new
+        return (h_new, c_new), out
+
+    xs = (x_proj, mask)
+    (h_T, c_T), outs = lax.scan(body, (h0, c0), xs, reverse=reverse)
+    return outs, h_T, c_T
+
+
+def rnn(inputs, initial_states, weights, mode="LSTM", num_layers=1,
+        direction="forward", time_major=False, dropout=0.0, training=True,
+        activation="tanh", sequence_length=None):
+    """Fused multi-layer RNN. weights: flat list, 4 arrays per
+    (layer, direction) in order [w_ih, w_hh, b_ih, b_hh], directions
+    interleaved per layer (fw, bw). initial_states: (h0,) or (h0, c0) with
+    shape [num_layers*num_dirs, B, H]. Returns (outputs, h_n[, c_n])."""
+    bidirect = direction in ("bidirect", "bidirectional")
+    ndirs = 2 if bidirect else 1
+
+    x = inputs if time_major else jnp.swapaxes(inputs, 0, 1)  # [T, B, D]
+    T, B = x.shape[0], x.shape[1]
+
+    if mode == "LSTM":
+        h0_all, c0_all = initial_states
+    else:
+        h0_all = initial_states[0] if isinstance(initial_states, (tuple, list)) \
+            else initial_states
+        c0_all = jnp.zeros_like(h0_all)
+
+    mask = None
+    if sequence_length is not None:
+        steps = jnp.arange(T)[:, None, None]  # [T, 1, 1]
+        mask = steps < sequence_length.astype(jnp.int32)[None, :, None]  # [T,B,1]
+
+    h_finals, c_finals = [], []
+    layer_in = x
+    for layer in range(num_layers):
+        outs_dirs = []
+        for d in range(ndirs):
+            idx = (layer * ndirs + d) * 4
+            w_ih, w_hh, b_ih, b_hh = weights[idx:idx + 4]
+            h0 = h0_all[layer * ndirs + d]
+            c0 = c0_all[layer * ndirs + d]
+            outs, h_T, c_T = _scan_single(
+                mode, layer_in, h0, c0, w_ih, w_hh, b_ih, b_hh,
+                reverse=(d == 1), mask=mask, activation=activation)
+            outs_dirs.append(outs)
+            h_finals.append(h_T)
+            c_finals.append(c_T)
+        layer_in = outs_dirs[0] if ndirs == 1 else jnp.concatenate(outs_dirs, axis=-1)
+        if dropout > 0.0 and training and layer < num_layers - 1:
+            key = _random.next_key()
+            keep = jax.random.bernoulli(key, 1.0 - dropout, layer_in.shape)
+            layer_in = jnp.where(keep, layer_in / (1.0 - dropout), 0.0)
+
+    outputs = layer_in if time_major else jnp.swapaxes(layer_in, 0, 1)
+    h_n = jnp.stack(h_finals, axis=0)
+    if mode == "LSTM":
+        return outputs, h_n, jnp.stack(c_finals, axis=0)
+    return outputs, h_n

@@ -42,15 +42,34 @@ def _is_inexact(dtype) -> bool:
     return jnp.issubdtype(dtype, jnp.inexact)
 
 
-class OpDef:
-    __slots__ = ("name", "fn", "sig", "n_outputs", "amp", "doc", "inplace_of")
+# Ops whose kernels consume host RNG state (core/random.next_key). They stay
+# cacheable: the cached executable takes a traced per-call seed argument that
+# the generator folds into every key (push_trace_seed), so randomness varies
+# across calls instead of being baked into the compiled program.
+_RNG_OPS = frozenset({
+    "dropout", "dropout2d", "dropout3d", "alpha_dropout", "rrelu",
+    "gumbel_softmax", "rnn", "scaled_dot_product_attention",
+})
 
-    def __init__(self, name: str, fn: Callable, amp: Optional[str] = None, doc: str = ""):
+# Flags kernels read at trace time: their values are baked into compiled
+# executables, so they must be part of the cache key (a later set_flags must
+# not silently keep hitting stale executables).
+_KERNEL_FLAGS = ("use_flash_attention", "pallas_interpret")
+
+
+class OpDef:
+    __slots__ = ("name", "fn", "sig", "n_outputs", "amp", "doc", "inplace_of",
+                 "cacheable", "uses_rng")
+
+    def __init__(self, name: str, fn: Callable, amp: Optional[str] = None, doc: str = "",
+                 cacheable: Optional[bool] = None):
         self.name = name
         self.fn = fn
         self.sig = inspect.signature(fn)
         self.amp = amp  # None | 'white' (run in low precision) | 'black' (keep fp32)
         self.doc = doc or fn.__doc__ or ""
+        self.uses_rng = fn.__module__.endswith(".random") or name in _RNG_OPS
+        self.cacheable = True if cacheable is None else cacheable
 
     def infer_meta(self, *args, **kwargs):
         """Shape/dtype inference without execution (InferMeta equivalent)."""
@@ -75,11 +94,12 @@ _REGISTRY: Dict[str, OpDef] = {}
 from . import api  # noqa: E402
 
 
-def register_op(name: str, fn: Callable = None, *, amp: Optional[str] = None):
+def register_op(name: str, fn: Callable = None, *, amp: Optional[str] = None,
+                cacheable: Optional[bool] = None):
     """Register a kernel function under an op name (PD_REGISTER_KERNEL analog)."""
 
     def _register(fn):
-        opdef = OpDef(name, fn, amp=amp)
+        opdef = OpDef(name, fn, amp=amp, cacheable=cacheable)
         _REGISTRY[name] = opdef
 
         @functools.wraps(fn)
@@ -107,6 +127,125 @@ def _is_tensor(x):
     return isinstance(x, Tensor)
 
 
+# --- eager compiled-program cache --------------------------------------------
+#
+# SURVEY §7 M1: "per-op eager execution via compiled singleton programs +
+# cache". Every eager dispatch compiles ONE XLA executable per
+# (op, tree structure, const attrs, tensor shapes/dtypes, grad positions) key
+# and reuses it. The vjp path is cached too: the forward executable returns
+# (out, vjp) where vjp is a jax Partial pytree (residual arrays + static
+# closure), and a second executable applies it — so repeated eager
+# forward+backward steps run entirely from cache, the analog of the
+# reference's generated *_ad_func + cached phi kernels without the per-op
+# dispatch tax (SURVEY §3.1). Keys that cannot be compiled (data-dependent
+# output shapes, unhashable attrs) permanently fall back to op-by-op eager.
+_EXEC_CACHE: Dict[tuple, tuple] = {}
+_FALLBACK_KEYS = set()
+_CACHE_LOCK = threading.Lock()
+
+flags.define_flag("eager_op_cache", True,
+                  "cache jit-compiled executables for eager op dispatch")
+
+
+def _hashable(x):
+    if isinstance(x, (list, tuple)):
+        return tuple(_hashable(e) for e in x)
+    hash(x)  # raises TypeError for unhashable leaves -> fallback
+    # pair with the type: hash(True) == hash(1) == hash(1.0) would otherwise
+    # collide keys whose baked-in consts behave differently
+    return (type(x).__name__, x)
+
+
+def _build_cached(opdef, key, treedef, const_leaves, tensor_idx, primal_pos):
+    """Compile executables for one dispatch key."""
+    from ..core import random as _random
+
+    primal_set = set(primal_pos)
+    n_tensors = len(tensor_idx)
+
+    def rebuild(tensor_vals, rng_seed):
+        vals = list(const_leaves)
+        # const_leaves has placeholders (None) at tensor positions
+        for i, v in zip(tensor_idx, tensor_vals):
+            vals[i] = v
+        a, k = jax.tree_util.tree_unflatten(treedef, vals)
+        if rng_seed is None:
+            return opdef.fn(*a, **k)
+        # RNG op: fold the traced per-call seed into every generator key so
+        # the cached executable stays stochastic across calls
+        prev = _random.default_generator.push_trace_seed(rng_seed)
+        try:
+            return opdef.fn(*a, **k)
+        finally:
+            _random.default_generator.pop_trace_seed(prev)
+
+    if not primal_pos:
+        exec_f = jax.jit(lambda tensor_vals, rng_seed: rebuild(tensor_vals, rng_seed))
+        return (exec_f, None)
+
+    def fwd(primal_vals, const_tensor_vals, rng_seed):
+        it_p = iter(primal_vals)
+        it_c = iter(const_tensor_vals)
+        base = [next(it_p) if k in primal_set else next(it_c)
+                for k in range(n_tensors)]
+
+        def pure(*pv):
+            it2p = iter(pv)
+            vals = [next(it2p) if k in primal_set else base[k]
+                    for k in range(n_tensors)]
+            return rebuild(vals, rng_seed)
+
+        return jax.vjp(pure, *primal_vals)
+
+    fwd_exec = jax.jit(fwd)
+    bwd_exec = jax.jit(lambda vjp_fn, cots: vjp_fn(cots))
+    return (fwd_exec, bwd_exec)
+
+
+def _dispatch_cached(opdef, key, leaves, treedef, tensor_idx, tensors, primal_pos):
+    entry = _EXEC_CACHE.get(key)
+    if entry is None:
+        const_leaves = [None if i in set(tensor_idx) else l
+                        for i, l in enumerate(leaves)]
+        entry = _build_cached(opdef, key, treedef, const_leaves, tensor_idx,
+                              tuple(primal_pos))
+        with _CACHE_LOCK:
+            _EXEC_CACHE[key] = entry
+
+    rng_seed = None
+    if opdef.uses_rng:
+        from ..core import random as _random
+
+        gen = _random.default_generator
+        with gen._lock:
+            c = gen._counter
+            gen._counter += 1
+        rng_seed = jnp.asarray((hash((gen._seed, c)) & 0x7FFFFFFF), jnp.int32)
+
+    if entry[1] is None:  # no-grad executable
+        out = entry[0]([t._value for t in tensors], rng_seed)
+        return _wrap_outputs(opdef, out, node=None)
+
+    fwd_exec, bwd_exec = entry
+    primal_set = set(primal_pos)
+    primal_vals = [tensors[k]._value for k in primal_pos]
+    const_vals = [t._value for k, t in enumerate(tensors) if k not in primal_set]
+    out, vjp_fn = fwd_exec(primal_vals, const_vals, rng_seed)
+
+    edges = []
+    for k in primal_pos:
+        t = tensors[k]
+        if t._grad_node is not None:
+            node, idx = t._grad_node
+            edges.append(("node", node, idx))
+        else:
+            edges.append(("leaf", t))
+    out_list = out if isinstance(out, (tuple, list)) else [out]
+    out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in out_list]
+    node = GradNode(opdef.name, lambda cots: bwd_exec(vjp_fn, cots), edges, out_avals)
+    return _wrap_outputs(opdef, out, node=node)
+
+
 def dispatch(opdef: OpDef, args, kwargs):
     # --- AMP auto-cast (eager_gen.py AMP hook analog) ---
     from ..amp.state import amp_state  # local import: amp depends on ops
@@ -127,6 +266,40 @@ def dispatch(opdef: OpDef, args, kwargs):
         if grad_on and not t.stop_gradient and _is_inexact(t.dtype)
     ]
     requires_grad = bool(primal_pos)
+
+    # --- compiled-program cache fast path (skip inside traces: the outer jit
+    # already compiles, and tracer values must not leak into the cache) ---
+    if (
+        opdef.cacheable
+        and flags.get_flag("eager_op_cache")
+        and not any(isinstance(t._value, jax.core.Tracer) for t in tensors)
+    ):
+        key = None
+        try:
+            key = (
+                opdef.name,
+                treedef,
+                tuple(tensor_idx),  # which leaf slots are tensor args
+                tuple(_hashable(l) for i, l in enumerate(leaves)
+                      if not isinstance(l, Tensor)),
+                tuple((t._value.shape, str(t._value.dtype)) for t in tensors),
+                tuple(primal_pos),
+                tuple(flags.get_flag(f) for f in _KERNEL_FLAGS),
+            )
+        except TypeError:
+            pass  # unhashable attr -> uncached path
+        if key is not None and key not in _FALLBACK_KEYS:
+            try:
+                return _dispatch_cached(opdef, key, leaves, treedef,
+                                        tensor_idx, tensors, primal_pos)
+            except Exception:
+                # data-dependent output shapes, ops jit can't linearize
+                # (e.g. reduce_window vjp under jit), host-side control flow:
+                # permanently op-by-op for this key. A genuine user error
+                # re-raises from the uncached path below.
+                with _CACHE_LOCK:
+                    _FALLBACK_KEYS.add(key)
+                    _EXEC_CACHE.pop(key, None)
 
     def run_with(tensor_vals):
         vals = list(leaves)

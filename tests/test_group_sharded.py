@@ -1,0 +1,142 @@
+"""ZeRO group-sharded stages + sharded checkpoint tests (VERDICT r01 item 6).
+
+Reference analog: test/collective/fleet/dygraph_group_sharded_stage{2,3}.py
+payloads; here the stage semantics are placement policies checked via the
+actual array shardings and per-device byte footprints on the 8-device mesh,
+plus save -> different mesh -> load -> loss parity.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import nn, optimizer
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.jit.trainer import TrainStep
+
+
+class _Net(nn.Layer):
+    def __init__(self, d=16):
+        super().__init__()
+        self.fc1 = nn.Linear(d, 4 * d)
+        self.fc2 = nn.Linear(4 * d, d)
+
+    def forward(self, x):
+        from paddle_tpu.ops import api
+
+        return self.fc2(api.gelu(self.fc1(x)))
+
+
+def _loss_fn(model):
+    def f(x, y):
+        from paddle_tpu.ops import api
+
+        return api.mse_loss(model(x), y)
+
+    return f
+
+
+def _per_device_bytes(arr):
+    return arr.addressable_shards[0].data.nbytes
+
+
+def _setup(level, seed=0):
+    paddle.seed(seed)
+    model = _Net()
+    opt = optimizer.AdamW(1e-3, parameters=model.parameters())
+    model, opt, _ = dist.group_sharded_parallel(model, opt, level)
+    step = TrainStep(model, _loss_fn(model), opt)
+    return model, opt, step
+
+
+@pytest.fixture
+def mesh8():
+    mesh = dist.build_mesh(sharding=8)
+    dist.set_mesh(mesh)
+    yield mesh
+    dist.set_mesh(None)
+
+
+def test_stage_placements_and_footprint(mesh8):
+    x = paddle.to_tensor(np.random.RandomState(0).randn(8, 16).astype(np.float32))
+    y = paddle.to_tensor(np.random.RandomState(1).randn(8, 16).astype(np.float32))
+
+    stats = {}
+    for level in ("os", "os_g", "p_g_os"):
+        model, opt, step = _setup(level)
+        loss = step(x, y)
+        assert np.isfinite(float(loss.item()))
+        param_bytes = sum(_per_device_bytes(p._value) for p in step.params)
+        state_bytes = sum(
+            _per_device_bytes(v) for st in step.opt_state for v in st.values()
+            if hasattr(v, "addressable_shards"))
+        stats[level] = (param_bytes, state_bytes)
+
+    # optimizer state sharded in ALL stages: ~1/8 of replicated
+    full_param = stats["os"][0]
+    assert stats["os"][1] < full_param  # m+v would be 2x params if replicated
+    # stage 3 shards the params themselves
+    assert stats["p_g_os"][0] <= full_param // 4
+    # stage 1 and 2 keep params replicated
+    assert stats["os_g"][0] == full_param
+
+
+def test_stage3_loss_parity_with_unsharded(mesh8):
+    x = paddle.to_tensor(np.random.RandomState(0).randn(8, 16).astype(np.float32))
+    y = paddle.to_tensor(np.random.RandomState(1).randn(8, 16).astype(np.float32))
+
+    model, opt, step = _setup("p_g_os", seed=3)
+    losses_sharded = [float(step(x, y).item()) for _ in range(3)]
+
+    paddle.seed(3)
+    model2 = _Net()
+    opt2 = optimizer.AdamW(1e-3, parameters=model2.parameters())
+    step2 = TrainStep(model2, _loss_fn(model2), opt2)
+    losses_plain = [float(step2(x, y).item()) for _ in range(3)]
+
+    np.testing.assert_allclose(losses_sharded, losses_plain, rtol=1e-5, atol=1e-6)
+
+
+def test_sharded_checkpoint_mesh_reshard(tmp_path, mesh8):
+    x = paddle.to_tensor(np.random.RandomState(0).randn(8, 16).astype(np.float32))
+    y = paddle.to_tensor(np.random.RandomState(1).randn(8, 16).astype(np.float32))
+
+    model, opt, step = _setup("p_g_os", seed=5)
+    step(x, y)
+    ref_loss = float(step(x, y).item())
+    path = str(tmp_path / "ckpt")
+    dist.save_model_sharded(model, path)
+
+    # new model on a DIFFERENT mesh layout; load reshards into it
+    mesh_b = dist.build_mesh(dp=2, sharding=4)
+    dist.set_mesh(mesh_b)
+    try:
+        paddle.seed(99)  # different init — must be overwritten by the load
+        model_b = _Net()
+        opt_b = optimizer.AdamW(1e-3, parameters=model_b.parameters())
+        model_b, opt_b, _ = dist.group_sharded_parallel(model_b, opt_b, "p_g_os")
+        dist.load_model_sharded(model_b, path)
+        for (n, p), (n2, p2) in zip(
+            sorted(model.state_dict().items()),
+            sorted(model_b.state_dict().items()),
+        ):
+            np.testing.assert_allclose(np.asarray(p._value),
+                                       np.asarray(p2._value), rtol=1e-6)
+    finally:
+        dist.set_mesh(None)
+
+
+def test_async_sharded_save(tmp_path, mesh8):
+    model, opt, step = _setup("os")
+    path = str(tmp_path / "async_ckpt")
+    dist.save_model_sharded(model, path)
+    restored = dist.load_sharded(path)
+    assert "model" in restored
+
+    from paddle_tpu.distributed import checkpoint as ckpt
+
+    ckpt.save_sharded({"w": Tensor(np.ones((4, 4), np.float32))},
+                      str(tmp_path / "a2"), async_save=True)
+    ckpt.wait_all()
+    back = ckpt.load_sharded(str(tmp_path / "a2"))
+    np.testing.assert_allclose(np.asarray(back["w"]), 1.0)

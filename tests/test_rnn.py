@@ -1,0 +1,175 @@
+"""RNN family tests: cells, fused multi-layer op, bidirectional, masking,
+numeric grads, bf16 tolerance. Parity reference: torch (same cell math as
+paddle — LSTM gates (i,f,g,o), GRU reset-inside-candidate).
+
+Reference analog: test/legacy_test/test_rnn_op.py + rnn cell/layer tests.
+"""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _copy_weights_to_torch(pd_rnn, th_rnn):
+    for layer in range(pd_rnn.num_layers):
+        for d in range(pd_rnn.num_directions):
+            sfx = f"_l{layer}" + ("_reverse" if d == 1 else "")
+            th_sfx = f"_l{layer}" + ("_reverse" if d == 1 else "")
+            for pd_name, th_name in (
+                (f"weight_ih{sfx}", f"weight_ih{th_sfx}"),
+                (f"weight_hh{sfx}", f"weight_hh{th_sfx}"),
+                (f"bias_ih{sfx}", f"bias_ih{th_sfx}"),
+                (f"bias_hh{sfx}", f"bias_hh{th_sfx}"),
+            ):
+                v = np.asarray(getattr(pd_rnn, pd_name)._value)
+                getattr(th_rnn, th_name).data = torch.from_numpy(v.copy())
+
+
+@pytest.mark.parametrize("cls,th_cls,mode", [
+    (nn.LSTM, torch.nn.LSTM, "LSTM"),
+    (nn.GRU, torch.nn.GRU, "GRU"),
+    (nn.SimpleRNN, torch.nn.RNN, "RNN"),
+])
+@pytest.mark.parametrize("layers,direction", [(1, "forward"), (2, "bidirect")])
+def test_fused_rnn_matches_torch(cls, th_cls, mode, layers, direction):
+    paddle.seed(0)
+    B, T, D, H = 3, 5, 4, 6
+    pd = cls(D, H, num_layers=layers, direction=direction)
+    pd.eval()
+    th = th_cls(D, H, num_layers=layers, batch_first=True,
+                bidirectional=(direction == "bidirect"))
+    _copy_weights_to_torch(pd, th)
+
+    x = np.random.RandomState(0).randn(B, T, D).astype(np.float32)
+    out, states = pd(paddle.to_tensor(x))
+    with torch.no_grad():
+        th_out, th_states = th(torch.from_numpy(x))
+
+    np.testing.assert_allclose(np.asarray(out._value), th_out.numpy(),
+                               rtol=1e-4, atol=1e-5)
+    if mode == "LSTM":
+        h, c = states
+        np.testing.assert_allclose(np.asarray(h._value), th_states[0].numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(c._value), th_states[1].numpy(),
+                                   rtol=1e-4, atol=1e-5)
+    else:
+        np.testing.assert_allclose(np.asarray(states._value),
+                                   th_states.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_cells_match_fused_single_step():
+    paddle.seed(0)
+    B, D, H = 2, 4, 5
+    cell = nn.LSTMCell(D, H)
+    x = paddle.to_tensor(np.random.RandomState(1).randn(B, D).astype(np.float32))
+    h, (h2, c2) = cell(x)
+    assert h.shape == [B, H] and c2.shape == [B, H]
+    np.testing.assert_allclose(np.asarray(h._value), np.asarray(h2._value))
+
+    # RNN wrapper over the cell == fused LSTM with the same weights
+    lstm = nn.LSTM(D, H)
+    for name in ("weight_ih", "weight_hh", "bias_ih", "bias_hh"):
+        getattr(cell, name)._value = getattr(lstm, name + "_l0")._value
+    wrapper = nn.RNN(cell)
+    xs = paddle.to_tensor(np.random.RandomState(2).randn(B, 6, D).astype(np.float32))
+    out_w, (h_w, c_w) = wrapper(xs)
+    out_f, (h_f, c_f) = lstm(xs)
+    np.testing.assert_allclose(np.asarray(out_w._value),
+                               np.asarray(out_f._value), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(h_w._value),
+                               np.asarray(h_f._value[0]), rtol=1e-5, atol=1e-6)
+
+
+def test_birnn_wrapper():
+    paddle.seed(0)
+    B, T, D, H = 2, 4, 3, 5
+    bi = nn.BiRNN(nn.GRUCell(D, H), nn.GRUCell(D, H))
+    x = paddle.to_tensor(np.random.RandomState(0).randn(B, T, D).astype(np.float32))
+    out, (st_f, st_b) = bi(x)
+    assert out.shape == [B, T, 2 * H]
+
+
+def test_sequence_length_masking():
+    paddle.seed(0)
+    B, T, D, H = 3, 6, 4, 5
+    lstm = nn.LSTM(D, H)
+    lstm.eval()
+    x = np.random.RandomState(0).randn(B, T, D).astype(np.float32)
+    lens = np.array([6, 3, 1], np.int32)
+    out, (h, c) = lstm(paddle.to_tensor(x),
+                       sequence_length=paddle.to_tensor(lens))
+    o = np.asarray(out._value)
+    # outputs past each row's length are zero
+    assert np.abs(o[1, 3:]).max() == 0.0
+    assert np.abs(o[2, 1:]).max() == 0.0
+    # final state equals the state at the last valid step
+    out_full, (h_full, _) = lstm(paddle.to_tensor(x[1:2, :3]))
+    np.testing.assert_allclose(np.asarray(h._value)[0, 1],
+                               np.asarray(h_full._value)[0, 0],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_rnn_numeric_grad():
+    paddle.seed(0)
+    B, T, D, H = 2, 3, 3, 4
+    gru = nn.GRU(D, H)
+    gru.eval()
+    x_np = np.random.RandomState(0).randn(B, T, D).astype(np.float32)
+
+    x = paddle.to_tensor(x_np, stop_gradient=False)
+    out, _ = gru(x)
+    out.sum().backward()
+    analytic = np.asarray(x.grad._value)
+
+    eps = 1e-3
+    numeric = np.zeros_like(x_np)
+    for idx in np.ndindex(*x_np.shape):
+        xp = x_np.copy(); xp[idx] += eps
+        xm = x_np.copy(); xm[idx] -= eps
+        op, _ = gru(paddle.to_tensor(xp))
+        om, _ = gru(paddle.to_tensor(xm))
+        numeric[idx] = (float(op.sum().item()) - float(om.sum().item())) / (2 * eps)
+    np.testing.assert_allclose(analytic, numeric, rtol=2e-2, atol=2e-3)
+
+    # weight grads exist for every parameter
+    for p in gru.parameters():
+        p._grad = None
+    x2 = paddle.to_tensor(x_np)
+    out2, _ = gru(x2)
+    out2.sum().backward()
+    for p in gru.parameters():
+        assert p.grad is not None
+
+
+def test_rnn_bf16_tolerance():
+    """bf16 forward within loose tolerance of fp32 (the OpTest white-list
+    style bf16 row, SURVEY §4)."""
+    paddle.seed(0)
+    B, T, D, H = 2, 4, 4, 4
+    lstm = nn.LSTM(D, H)
+    lstm.eval()
+    x_np = np.random.RandomState(0).randn(B, T, D).astype(np.float32)
+    out32, _ = lstm(paddle.to_tensor(x_np))
+
+    import jax.numpy as jnp
+
+    for name in lstm._weight_names:
+        p = getattr(lstm, name)
+        p._value = p._value.astype(jnp.bfloat16)
+    out16, _ = lstm(paddle.to_tensor(x_np.astype(jnp.bfloat16)))
+    np.testing.assert_allclose(
+        np.asarray(out16._value.astype(jnp.float32)),
+        np.asarray(out32._value), rtol=5e-2, atol=5e-2)
+
+
+def test_dropout_between_layers_random():
+    paddle.seed(0)
+    lstm = nn.LSTM(4, 4, num_layers=2, dropout=0.5)
+    lstm.train()
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 4, 4).astype(np.float32))
+    a, _ = lstm(x)
+    b, _ = lstm(x)
+    assert not np.array_equal(np.asarray(a._value), np.asarray(b._value))

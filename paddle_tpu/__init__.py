@@ -105,7 +105,9 @@ __version__ = version
 
 
 def disable_static():
-    pass  # dynamic mode is the default and only eager mode
+    from .static import disable_static as _ds
+
+    _ds()
 
 
 def enable_static():

@@ -89,6 +89,10 @@ class OpDef:
 
 _REGISTRY: Dict[str, OpDef] = {}
 
+# Set by paddle_tpu.static when static mode is on: callable(opdef, args,
+# kwargs, out) recording each op application onto the default Program.
+_static_recorder = None
+
 # Generated-API namespace: the `paddle._C_ops` analog (a real module so that
 # `from paddle_tpu.ops.api import matmul` works).
 from . import api  # noqa: E402
@@ -104,7 +108,10 @@ def register_op(name: str, fn: Callable = None, *, amp: Optional[str] = None,
 
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
-            return dispatch(opdef, args, kwargs)
+            out = dispatch(opdef, args, kwargs)
+            if _static_recorder is not None:  # static-mode Program tape
+                _static_recorder(opdef, args, kwargs, out)
+            return out
 
         wrapper.opdef = opdef
         setattr(api, name, wrapper)

@@ -102,6 +102,12 @@ class Optimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..static import _capture_minimize, _in_static_mode
+
+        if _in_static_mode():
+            # static mode: record the train op on the program; the Executor
+            # builds grads+update into the compiled replay (executor.py:1284)
+            return _capture_minimize(self, loss)
         loss.backward()
         self.step()
         return None, [(p, p.grad) for p in self._parameter_list]

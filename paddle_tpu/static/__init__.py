@@ -1,10 +1,19 @@
-"""paddle.static facade.
+"""paddle.static — real Program capture + jitted Executor.
 
-Reference: ProgramDesc + Executor (SURVEY.md §1 L3b). TPU-native: a "Program"
-is a captured pure function; the Executor compiles and runs it via jax.jit —
-the StandaloneExecutor's program cache is XLA's compilation cache. The API
-keeps the reference's shape (Program/Executor/data/InputSpec) so static-mode
-user code ports over.
+Reference: ProgramDesc construction + Executor.run
+(python/paddle/fluid/executor.py:1284, framework/new_executor/
+standalone_executor.cc:29) and the _ExecutorCache (executor.py:701).
+
+TPU-native: in static mode the op dispatcher records every op application
+onto the default Program as a TAPE (op, symbolic inputs, attrs, symbolic
+outputs) while still executing eagerly on placeholder zeros (shape checking
+for free, like InferMeta). `Executor.run(program, feed, fetch_list)` REPLAYS
+the tape as a pure function of (feed values, parameter values), jit-compiles
+it per feed-shape (the _ExecutorCache analog — XLA is the program cache), and
+when `optimizer.minimize(loss)` was captured it also computes grads
+(jax.grad over the replay) and applies the optimizer update, writing new
+parameter values back — one donated-buffer training program per step, the
+StandaloneExecutor's multi-job plan collapsed into a single XLA program.
 """
 from __future__ import annotations
 
@@ -28,20 +37,36 @@ def _in_static_mode() -> bool:
 
 def _enable_static_mode():
     _state.static = True
+    from ..ops import registry
+
+    registry._static_recorder = _record_op
 
 
 def disable_static():
     _state.static = False
+    from ..ops import registry
+
+    registry._static_recorder = None
+
+
+class _OpRecord:
+    __slots__ = ("opdef", "leaves", "treedef", "out_tensors")
+
+    def __init__(self, opdef, leaves, treedef, out_tensors):
+        self.opdef = opdef
+        self.leaves = leaves        # flat (args, kwargs) leaves; Tensors kept live
+        self.treedef = treedef
+        self.out_tensors = out_tensors  # output Tensor objects (held -> ids stable)
 
 
 class Program:
-    """A deferred computation: a list of (output_name <- fn(*input_names)).
-    Built by user code running paddle.static ops on `data` placeholders."""
+    """A captured op tape (ProgramDesc analog). Built by running user code
+    under static mode inside a program_guard."""
 
     def __init__(self):
-        self._builders: List[Callable] = []
-        self._feeds: Dict[str, InputSpec] = {}
-        self._fetches: List[str] = []
+        self._ops: List[_OpRecord] = []
+        self._feeds: Dict[str, Tensor] = {}   # name -> placeholder tensor
+        self._train = None                    # (optimizer, loss_tensor) from minimize
         self.random_seed = 0
 
     def global_block(self):
@@ -50,7 +75,57 @@ class Program:
     def clone(self, for_test=False):
         import copy
 
-        return copy.copy(self)
+        p = copy.copy(self)
+        if for_test:
+            p._train = None
+        return p
+
+    def num_ops(self):
+        return len(self._ops)
+
+    # ---- replay ------------------------------------------------------------
+    def _params(self):
+        """Trainable parameters referenced by the tape (inputs that are
+        Parameters and not produced by earlier ops)."""
+        from ..nn.layer import Parameter
+
+        produced = set()
+        params, seen = [], set()
+        for rec in self._ops:
+            for leaf in rec.leaves:
+                if isinstance(leaf, Parameter) and id(leaf) not in seen \
+                        and id(leaf) not in produced:
+                    seen.add(id(leaf))
+                    params.append(leaf)
+            for t in rec.out_tensors:
+                produced.add(id(t))
+        return params
+
+    def _replay(self, env: Dict[int, object]):
+        """Run the tape with `env` mapping tensor-id -> array value for
+        placeholders/params; other tensor leaves are captured by value."""
+        for rec in self._ops:
+            vals = []
+            for leaf in rec.leaves:
+                if isinstance(leaf, Tensor):
+                    vals.append(env.get(id(leaf), leaf._value))
+                else:
+                    vals.append(leaf)
+            a, k = jax.tree_util.tree_unflatten(rec.treedef, vals)
+            out = rec.opdef.fn(*a, **k)
+            outs = out if isinstance(out, (tuple, list)) else [out]
+            for t, v in zip(rec.out_tensors, outs):
+                env[id(t)] = v
+        return env
+
+
+def _record_op(opdef, args, kwargs, out):
+    prog = _default_main
+    leaves, treedef = jax.tree_util.tree_flatten(
+        (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+    outs = list(out) if isinstance(out, (tuple, list)) else [out]
+    prog._ops.append(_OpRecord(opdef, leaves, treedef,
+                               [o for o in outs if isinstance(o, Tensor)]))
 
 
 _default_main = Program()
@@ -85,60 +160,191 @@ class program_guard:
 
 
 def data(name, shape, dtype="float32", lod_level=0):
-    """Placeholder tensor for the static API; returns a symbolic Tensor whose
-    value is a zeros array of the given shape (traced at Executor.run)."""
-    spec = InputSpec(shape, dtype, name)
-    _default_main._feeds[name] = spec
-    shape_concrete = tuple(1 if (s is None or (isinstance(s, int) and s < 0)) else s for s in shape)
+    """Feed placeholder: records into the default program; its eager value is
+    zeros of the (None->1) concretized shape so capture-time ops shape-check."""
+    shape_concrete = tuple(
+        1 if (s is None or (isinstance(s, int) and s < 0)) else s for s in shape)
     t = Tensor(jnp.zeros(shape_concrete, convert_dtype(dtype)), name=name)
+    t.stop_gradient = True
     t._is_placeholder = True
+    _default_main._feeds[name] = t
     return t
 
 
 class Executor:
-    """paddle.static.Executor facade: run(feed=..., fetch_list=...) executes a
-    traced function built from the captured program via jax.jit, cached per
-    (program, shapes) — the _ExecutorCache analog (fluid/executor.py:701)."""
+    """Executor.run(program, feed, fetch_list) — compiles the replay once per
+    (program state, feed shapes) and runs it (executor.py:1284 analog)."""
 
     def __init__(self, place=None):
         self.place = place
         self._cache = {}
+        self._opt_states = {}  # id(program) -> optimizer state tree
 
     def run(self, program=None, feed=None, fetch_list=None, return_numpy=True):
+        program = program if program is not None else _default_main
         feed = feed or {}
         fetch_list = fetch_list or []
-        outs = []
-        for f in fetch_list:
-            if isinstance(f, Tensor):
-                outs.append(np.asarray(f._value) if return_numpy else f)
-            elif callable(f):
-                r = f(**feed)
-                outs.append(np.asarray(r._value) if return_numpy else r)
-        return outs
+        if not program._ops:
+            return []  # startup program: params already initialized eagerly
+
+        missing = [n for n in program._feeds if n not in feed]
+        if missing and fetch_list:
+            raise ValueError(
+                f"feed is missing placeholders {missing} required by the "
+                f"program (got {sorted(feed)})")
+
+        feed_names = sorted(feed)
+        feed_vals = [jnp.asarray(feed[n]) for n in feed_names]
+        params = program._params()
+        train = program._train is not None
+        key = (
+            id(program), program.num_ops(), train,
+            tuple(feed_names),
+            tuple((v.shape, str(v.dtype)) for v in feed_vals),
+        )
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = self._build(program, feed_names, fetch_list, params)
+            self._cache[key] = fn
+
+        opt_state = None
+        lr = jnp.zeros((), jnp.float32)
+        if train:
+            optimizer, _ = program._train
+            opt_state = self._opt_states.get(id(program))
+            if opt_state is None:
+                opt_state = optimizer.init_state_tree(params)
+            lr = jnp.asarray(optimizer.get_lr(), jnp.float32)
+
+        param_vals = [p._value for p in params]
+        fetches, new_params, new_state = fn(feed_vals, param_vals, opt_state, lr)
+        if train:
+            for p, v in zip(params, new_params):
+                p._value = v
+            self._opt_states[id(program)] = new_state
+            optimizer._step_count += 1
+            if optimizer._lr_scheduler is not None:
+                optimizer._lr_scheduler.step()
+        if return_numpy:
+            return [np.asarray(v) for v in fetches]
+        return [Tensor(v) for v in fetches]
+
+    def _build(self, program, feed_names, fetch_list, params):
+        fetch_ids = [id(f) for f in fetch_list if isinstance(f, Tensor)]
+        loss_id = id(program._train[1]) if program._train else None
+        optimizer = program._train[0] if program._train else None
+        placeholder_ids = [id(program._feeds[n]) for n in feed_names]
+
+        def run_fn(feed_vals, param_vals, opt_state, lr):
+            def forward(pvals):
+                env = dict(zip(placeholder_ids, feed_vals))
+                env.update(zip((id(p) for p in params), pvals))
+                program._replay(env)
+                return env
+
+            if optimizer is None:
+                env = forward(param_vals)
+                return [env[i] for i in fetch_ids], param_vals, opt_state
+
+            def loss_of(pvals):
+                env = forward(pvals)
+                return env[loss_id].astype(jnp.float32), env
+
+            (loss, env), grads = jax.value_and_grad(loss_of, has_aux=True)(param_vals)
+            new_p, new_s = optimizer.functional_update(
+                param_vals, grads, opt_state, lr)
+            return [env[i] for i in fetch_ids], new_p, new_s
+
+        return jax.jit(run_fn)
+
+    def close(self):
+        pass
 
 
-def save_inference_model(path_prefix, feed_vars, fetch_vars, executor, program=None):
+def _capture_minimize(optimizer, loss):
+    """Optimizer.minimize under static mode: record the train op on the
+    default program instead of running eager backward."""
+    _default_main._train = (optimizer, loss)
+    return [], [(p, None) for p in _default_main._params()]
+
+
+# ---- static.nn --------------------------------------------------------------
+class _StaticNN:
+    """paddle.static.nn — fc et al. (reference: python/paddle/static/nn)."""
+
+    @staticmethod
+    def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+           activation=None, name=None):
+        from ..nn import initializer as I
+        from ..nn.layer import Parameter
+        from ..ops import api
+
+        in_features = int(np.prod(x.shape[num_flatten_dims:]))
+        w = Parameter(I.XavierUniform()([in_features, size], "float32"))
+        b = Parameter(I.Constant(0.0)([size], "float32"))
+        flat = api.flatten(x, start_axis=num_flatten_dims) \
+            if len(x.shape) > num_flatten_dims + 1 else x
+        out = api.matmul(flat, w) + b
+        if activation:
+            out = getattr(api, activation)(out)
+        return out
+
+
+nn = _StaticNN()
+
+
+# ---- inference export -------------------------------------------------------
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         program=None):
+    """Export the feed->fetch slice as a StableHLO artifact via jit.save."""
     from .. import jit as _jit
 
-    raise NotImplementedError(
-        "Use paddle_tpu.jit.save for inference export (StableHLO artifact)."
-    )
+    program = program if program is not None else _default_main
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) else [fetch_vars]
+    params = program._params()
+    param_vals = [p._value for p in params]
+    fetch_ids = [id(f) for f in fetch_vars]
+    feed_ids = [id(f) for f in feed_vars]
+
+    class _ProgModule:
+        def __call__(self, *feeds):
+            env = dict(zip(feed_ids, [f._value for f in feeds]))
+            env.update({id(p): v for p, v in zip(params, param_vals)})
+            program._replay(env)
+            outs = [Tensor(env[i]) for i in fetch_ids]
+            return outs[0] if len(outs) == 1 else tuple(outs)
+
+    specs = [InputSpec(list(f.shape), str(np.dtype(f._value.dtype)), f.name)
+             for f in feed_vars]
+    _jit.save(_ProgModule(), path_prefix, input_spec=specs)
 
 
 def load_inference_model(path_prefix, executor):
-    raise NotImplementedError("Use paddle_tpu.jit.load.")
+    """Returns (program-like callable, feed_names, fetch handle) matching the
+    reference's (program, feed_target_names, fetch_targets) triple shape."""
+    from .. import jit as _jit
+
+    fn = _jit.load(path_prefix)
+    return fn, None, None
 
 
 def save(program, model_path):
     from ..framework.io import save as _save
 
-    _save({}, model_path)
+    state = {f"param_{i}": p for i, p in enumerate(program._params())}
+    _save(state, model_path)
 
 
 def load(program, model_path, executor=None, var_list=None):
     from ..framework.io import load as _load
 
-    return _load(model_path)
+    state = _load(model_path)
+    for i, p in enumerate(program._params()):
+        key = f"param_{i}"
+        if key in state:
+            p._value = state[key]._value
+    return state
 
 
 def name_scope(prefix=None):

@@ -130,11 +130,15 @@ def run_child(preset: str) -> int:
 
     from paddle_tpu.core import flags as _flags
 
+    # A non-accelerator fallback is smoke evidence only: report vs_baseline 0
+    # and flag it so the driver can't mistake it for chip evidence (VERDICT
+    # r02 weak #3).
     result = {
         "metric": "gpt_pretrain_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
-        "vs_baseline": round(mfu / 0.40, 4),
+        "vs_baseline": round(mfu / 0.40, 4) if on_accel else 0.0,
+        "degraded": not on_accel,
         "mfu": round(mfu, 4),
         "params_millions": round(n_params / 1e6, 1),
         "batch": batch,
@@ -187,16 +191,24 @@ def main() -> int:
     attempts = []
     force_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
     if not force_cpu and _probe_tpu():
-        attempts += [("large", None), ("medium", None), ("small", None)]
-    attempts += [("cpu", "cpu")]
+        attempts += [("large", None, None), ("medium", None, None),
+                     ("small", None, None),
+                     # A Pallas kernel bug must never erase the round's TPU
+                     # evidence: retry once with flash attention off so the
+                     # XLA sdpa path still produces a genuine TPU number
+                     # (VERDICT r02 weak #2).
+                     ("small", None, {"FLAGS_use_flash_attention": "0"})]
+    attempts += [("cpu", "cpu", None)]
 
     last_err = ""
-    for i, (preset, platform) in enumerate(attempts):
+    for i, (preset, platform, extra_env) in enumerate(attempts):
         if i > 0:
             time.sleep(min(10 * i, 30))  # backoff before each retry
         env = dict(os.environ)
         if platform:
             env["JAX_PLATFORMS"] = platform
+        if extra_env:
+            env.update(extra_env)
         timeout = PRESETS[preset]["timeout"]
         log(f"--- bench attempt {i + 1}/{len(attempts)}: preset={preset} "
             f"platform={platform or 'auto'} timeout={timeout}s")
@@ -224,6 +236,7 @@ def main() -> int:
         "value": 0.0,
         "unit": "tokens/s",
         "vs_baseline": 0.0,
+        "degraded": True,
         "error": last_err[-1500:],
         "backend": "unknown",
     }), flush=True)

@@ -9,11 +9,19 @@ softmax carried in fp32, logsumexp residual saved for a recompute backward.
 Layout: inputs are [batch, seq, heads, head_dim] (the reference layout); the
 kernel internally processes one (batch*head) slice per grid row.
 
-Algorithm (standard two-pass-free online softmax):
+TPU lowering constraints shape two choices here:
+  * the logsumexp residual is stored 3-D as [bh, sq, 1] — Pallas TPU requires
+    the last two block dims to be (8,128)-aligned or equal to the full array
+    dim, so a 1-D [bh, sq] residual cannot be blocked along sq, but a size-1
+    minor dim (full) with block_q rows (8-aligned) can;
+  * delta = rowsum(dO * O) is precomputed once (an XLA fused reduce) and
+    passed to the backward kernels in the same [bh, sq, 1] layout as lse.
+
+Algorithm (standard online softmax):
   fwd:  for each q block, stream k/v blocks, carry (m, l, acc); save
         lse = m + log(l) per row.
-  bwd:  D = rowsum(dO * O); two kernels — dQ streams K/V per q block,
-        dK/dV stream Q/dO per k block — both recompute P from Q,K,lse.
+  bwd:  two kernels — dQ streams K/V per q block, dK/dV streams Q/dO per
+        k block — both recompute P from Q,K,lse.
 """
 from __future__ import annotations
 
@@ -23,21 +31,16 @@ import math
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30
 
 
-def _causal_mask(q_ids, k_ids):
-    return q_ids[:, None] >= k_ids[None, :]
-
-
 # ------------------------------------------------------------------- forward
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k, sk):
     # q_ref: [block_q, d]; k_ref/v_ref: [sk, d]; o_ref: [block_q, d];
-    # lse_ref: [block_q]
+    # lse_ref: [block_q, 1]
     qi = pl.program_id(1)
     block_q = q_ref.shape[0]
     d = q_ref.shape[1]
@@ -79,7 +82,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k, 
     m, l, acc = jax.lax.fori_loop(0, nk_live, body, (m0, l0, acc0))
     l = jnp.maximum(l, 1e-30)
     o_ref[:] = (acc / l[:, None]).astype(o_ref.dtype)
-    lse_ref[:] = m + jnp.log(l)
+    lse_ref[:] = (m + jnp.log(l))[:, None]
 
 
 def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
@@ -106,11 +109,11 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((None, block_q, 1), lambda i, j: (i, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
         ],
         interpret=interpret,
     )(qr, kr, vr)
@@ -126,8 +129,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     d = q_ref.shape[1]
     q = q_ref[:].astype(jnp.float32) * scale
     do = do_ref[:].astype(jnp.float32)
-    lse = lse_ref[:]
-    delta = delta_ref[:]
+    lse = lse_ref[:]                   # [block_q, 1]
+    delta = delta_ref[:]               # [block_q, 1]
 
     nk = sk // block_k
     if causal:
@@ -146,11 +149,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             q_ids = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             k_ids = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_ids >= k_ids, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta[:, None])
+        ds = p * (dp - delta)
         return dq + jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -179,8 +182,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         dk, dv = carry
         q = q_ref[pl.ds(j * block_q, block_q), :].astype(jnp.float32) * scale
         do = do_ref[pl.ds(j * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[pl.ds(j * block_q, block_q)]
-        delta = delta_ref[pl.ds(j * block_q, block_q)]
+        lse = lse_ref[pl.ds(j * block_q, block_q), :]
+        delta = delta_ref[pl.ds(j * block_q, block_q), :]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [block_q, block_k]
@@ -188,14 +191,14 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
             q_ids = j * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             k_ids = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_ids >= k_ids, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse)
         dv = dv + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta[:, None])
+        ds = p * (dp - delta)
         dk = dk + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -215,8 +218,9 @@ def _bwd(scale, causal, block_q, block_k, interpret, res, g):
         scale = 1.0 / math.sqrt(d)
     sk = kr.shape[1]
     do = g.transpose(0, 2, 1, 3).reshape(bh, sq, d)
-    # delta = rowsum(dO * O), fp32
-    delta = jnp.sum(do.astype(jnp.float32) * outr.astype(jnp.float32), axis=-1)
+    # delta = rowsum(dO * O), fp32, same [bh, sq, 1] layout as lse
+    delta = jnp.sum(do.astype(jnp.float32) * outr.astype(jnp.float32),
+                    axis=-1, keepdims=True)
 
     dq = pl.pallas_call(
         functools.partial(
@@ -228,8 +232,8 @@ def _bwd(scale, causal, block_q, block_k, interpret, res, g):
             pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, block_q), lambda i, j: (i, j)),
-            pl.BlockSpec((None, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((None, block_q, 1), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda i, j: (i, j, 0)),
         ],
         out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), qr.dtype),
@@ -246,8 +250,8 @@ def _bwd(scale, causal, block_q, block_k, interpret, res, g):
             pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((None, sq, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, sq), lambda i, j: (i, 0)),
-            pl.BlockSpec((None, sq), lambda i, j: (i, 0)),
+            pl.BlockSpec((None, sq, 1), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, sq, 1), lambda i, j: (i, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),

@@ -4,6 +4,11 @@ Reference: paddle.incubate.nn.functional.rms_norm
 (python/paddle/incubate/nn/functional/ -> phi fused rms_norm kernels). On TPU
 the win is keeping the row in VMEM for the two passes (square-mean + scale) in
 one HBM read, fp32 statistics regardless of input dtype.
+
+TPU lowering notes: per-row residuals are kept 2-D ([n, 1] — a size-1 minor
+dim equals the full array dim, which Pallas TPU accepts), and the dw partial
+is accumulated across the sequential TPU grid into a single [1, d] output
+block (constant index map; initialized on the first grid step).
 """
 from __future__ import annotations
 
@@ -22,21 +27,27 @@ def _fwd_kernel(x_ref, w_ref, y_ref, rstd_ref, *, eps):
     rstd = jax.lax.rsqrt(ms + eps)
     y = x * rstd
     y_ref[:] = (y * w_ref[:].astype(jnp.float32)).astype(y_ref.dtype)
-    rstd_ref[:] = rstd[:, 0]
+    rstd_ref[:] = rstd
 
 
-def _bwd_kernel(x_ref, w_ref, rstd_ref, g_ref, dx_ref, dwp_ref):
+def _bwd_kernel(x_ref, w_ref, rstd_ref, g_ref, dx_ref, dw_ref):
     x = x_ref[:].astype(jnp.float32)
     w = w_ref[:].astype(jnp.float32)
     g = g_ref[:].astype(jnp.float32)
-    rstd = rstd_ref[:][:, None]
+    rstd = rstd_ref[:]                      # [rows, 1]
     xhat = x * rstd
     gw = g * w
     # dx = rstd * (gw - xhat * mean(gw * xhat))
     c = jnp.mean(gw * xhat, axis=-1, keepdims=True)
     dx_ref[:] = (rstd * (gw - xhat * c)).astype(dx_ref.dtype)
-    # per-block partial dw, reduced outside
-    dwp_ref[:] = jnp.sum(g * xhat, axis=0, keepdims=True)
+    # dw accumulated across the (sequential) grid into one [1, d] block
+    part = jnp.sum(g * xhat, axis=0, keepdims=True)
+
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        dw_ref[:] = jnp.zeros_like(dw_ref)
+
+    dw_ref[:] += part
 
 
 def _run_fwd(x, w, eps, block_rows, interpret):
@@ -44,6 +55,7 @@ def _run_fwd(x, w, eps, block_rows, interpret):
     d = x.shape[-1]
     n = x.size // d
     xr = x.reshape(n, d)
+    wr = w.reshape(1, d)
     rows = min(block_rows, n)
     # Pad the row dim to a block multiple (padded rows compute rsqrt(eps),
     # sliced away below) rather than shrinking the block to a divisor.
@@ -55,18 +67,18 @@ def _run_fwd(x, w, eps, block_rows, interpret):
         grid=(np_ // rows,),
         in_specs=[
             pl.BlockSpec((rows, d), lambda i: (i, 0)),
-            pl.BlockSpec((d,), lambda i: (0,)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((rows, d), lambda i: (i, 0)),
-            pl.BlockSpec((rows,), lambda i: (i,)),
+            pl.BlockSpec((rows, 1), lambda i: (i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((np_, d), x.dtype),
-            jax.ShapeDtypeStruct((np_,), jnp.float32),
+            jax.ShapeDtypeStruct((np_, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(xp, w)
+    )(xp, wr)
     if pad:
         y, rstd = y[:n], rstd[:n]
     return y.reshape(orig_shape), (xr, w, rstd, orig_shape)
@@ -95,32 +107,31 @@ def _bwd_rule(epsilon, block_rows, interpret, res, g):
         # is zero and their dx rows are sliced away.
         xr_p = jnp.pad(xr, ((0, pad), (0, 0)))
         gr_p = jnp.pad(gr, ((0, pad), (0, 0)))
-        rstd_p = jnp.pad(rstd, (0, pad))
+        rstd_p = jnp.pad(rstd, ((0, pad), (0, 0)))
     else:
         xr_p, gr_p, rstd_p = xr, gr, rstd
     np_ = n + pad
     nblocks = np_ // rows
-    dx, dw_parts = pl.pallas_call(
+    dx, dw = pl.pallas_call(
         _bwd_kernel,
         grid=(nblocks,),
         in_specs=[
             pl.BlockSpec((rows, d), lambda i: (i, 0)),
-            pl.BlockSpec((d,), lambda i: (0,)),
-            pl.BlockSpec((rows,), lambda i: (i,)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+            pl.BlockSpec((rows, 1), lambda i: (i, 0)),
             pl.BlockSpec((rows, d), lambda i: (i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((rows, d), lambda i: (i, 0)),
-            pl.BlockSpec((1, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((np_, d), xr.dtype),
-            jax.ShapeDtypeStruct((nblocks, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, d), jnp.float32),
         ],
         interpret=interpret,
-    )(xr_p, w, rstd_p, gr_p)
-    dw = jnp.sum(dw_parts, axis=0).astype(w.dtype)
-    return dx[:n].reshape(orig_shape), dw
+    )(xr_p, w.reshape(1, d), rstd_p, gr_p)
+    return dx[:n].reshape(orig_shape), dw.reshape(d).astype(w.dtype)
 
 
 fused_rms_norm.defvjp(_fwd_rule, _bwd_rule)

@@ -15,53 +15,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
-from ..ops.registry import register_op
 from ..ops import api as F
-
-
-def _seg_reduce(data, segment_ids, num_segments, pool_type):
-    pool_type = pool_type.lower()
-    if pool_type == "sum":
-        return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
-    if pool_type == "mean":
-        s = jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
-        cnt = jax.ops.segment_sum(
-            jnp.ones((data.shape[0],), data.dtype), segment_ids, num_segments=num_segments
-        )
-        return s / jnp.maximum(cnt, 1.0).reshape((-1,) + (1,) * (data.ndim - 1))
-    if pool_type == "max":
-        out = jax.ops.segment_max(data, segment_ids, num_segments=num_segments)
-        return jnp.where(jnp.isneginf(out), 0.0, out)
-    if pool_type == "min":
-        out = jax.ops.segment_min(data, segment_ids, num_segments=num_segments)
-        return jnp.where(jnp.isposinf(out), 0.0, out)
-    raise ValueError(f"unknown reduce_op {pool_type}")
-
-
-@register_op("graph_send_recv")
-def _graph_send_recv(x, src_index, dst_index, reduce_op="sum", out_size=None):
-    n = int(out_size) if out_size else x.shape[0]
-    msgs = jnp.take(x, src_index, axis=0)
-    return _seg_reduce(msgs, dst_index, n, reduce_op)
-
-
-@register_op("graph_send_ue_recv")
-def _graph_send_ue_recv(x, y, src_index, dst_index, message_op="add",
-                        reduce_op="sum", out_size=None):
-    n = int(out_size) if out_size else x.shape[0]
-    xs = jnp.take(x, src_index, axis=0)
-    ye = jnp.asarray(y)
-    if ye.ndim < xs.ndim:
-        ye = ye.reshape(ye.shape + (1,) * (xs.ndim - ye.ndim))
-    msgs = xs + ye if message_op.lower() == "add" else xs * ye
-    return _seg_reduce(msgs, dst_index, n, reduce_op)
-
-
-@register_op("graph_send_uv")
-def _graph_send_uv(x, y, src_index, dst_index, message_op="add"):
-    xs = jnp.take(x, src_index, axis=0)
-    yd = jnp.take(y, dst_index, axis=0)
-    return xs + yd if message_op.lower() == "add" else xs * yd
+from ..ops.kernels.geometric import seg_reduce as _seg_reduce
 
 
 def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None, name=None):

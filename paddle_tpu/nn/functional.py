@@ -68,6 +68,46 @@ from ..ops.api import (  # noqa: F401
     thresholded_relu,
     unfold,
     scaled_dot_product_attention,
+    conv3d,
+    conv1d_transpose,
+    conv3d_transpose,
+    max_pool1d,
+    avg_pool1d,
+    max_pool3d,
+    avg_pool3d,
+    max_unpool1d,
+    max_unpool2d,
+    adaptive_avg_pool1d,
+    adaptive_max_pool1d,
+    adaptive_avg_pool3d,
+    adaptive_max_pool3d,
+    lp_pool2d,
+    grid_sample,
+    affine_grid,
+    pixel_unshuffle,
+    channel_shuffle,
+    fold,
+    local_response_norm,
+    softsign,
+    alpha_dropout,
+    dropout3d,
+    zeropad2d,
+    ctc_loss,
+    margin_ranking_loss,
+    pairwise_distance,
+    triplet_margin_loss,
+    triplet_margin_with_distance_loss,
+    cosine_embedding_loss,
+    soft_margin_loss,
+    multi_label_soft_margin_loss,
+    multi_margin_loss,
+    poisson_nll_loss,
+    gaussian_nll_loss,
+    square_error_cost,
+    log_loss,
+    dice_loss,
+    npair_loss,
+    hsigmoid_loss,
 )
 from ..ops.api import softmax as softmax_  # noqa: F401
 from ..ops import api as _api

@@ -1,0 +1,108 @@
+"""Control-flow ops: cond / while_loop / case / switch_case.
+
+Reference: paddle/fluid/operators/controlflow/ (conditional_block_op.cc,
+while_op.cc) + python/paddle/static/nn/control_flow.py. TPU design: both
+lower to XLA's native structured control flow (lax.cond / lax.while_loop) —
+one staged program, no host round-trips — instead of the reference's
+sub-block interpreter re-entry.
+
+The callables here are VALUE-level (jax arrays in / out). The public
+paddle.static.nn wrappers adapt user Tensor-level callables and suspend the
+static-Program recorder while the branches trace, so the tape records ONE
+composite control-flow op (the analog of the reference's sub-block ops).
+
+cond is reverse-mode differentiable (lax.cond vjp); while_loop is
+forward-only, like the reference's while_op without backward.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _suspend_recorder():
+    from .. import registry
+
+    prev = registry._static_recorder
+    registry._static_recorder = None
+    return prev
+
+
+def _restore_recorder(prev):
+    from .. import registry
+
+    registry._static_recorder = prev
+
+
+def cond(pred, true_fn=None, false_fn=None, operands=()):
+    """pred: scalar bool; true_fn/false_fn: value-level callables over
+    `operands` (tuple of arrays) returning matching pytrees."""
+    prev = _suspend_recorder()
+    try:
+        p = jnp.asarray(pred).reshape(()).astype(bool)
+        return lax.cond(p, true_fn, false_fn, *operands)
+    finally:
+        _restore_recorder(prev)
+
+
+def while_loop(cond_fn, body_fn, loop_vars):
+    """loop_vars: list of arrays; cond_fn(*vars)->scalar bool;
+    body_fn(*vars)->list of arrays with identical shapes/dtypes."""
+    prev = _suspend_recorder()
+    try:
+        def c(vs):
+            return jnp.asarray(cond_fn(*vs)).reshape(()).astype(bool)
+
+        def b(vs):
+            out = body_fn(*vs)
+            return list(out) if isinstance(out, (tuple, list)) else [out]
+
+        return lax.while_loop(c, b, list(loop_vars))
+    finally:
+        _restore_recorder(prev)
+
+
+def case(pred_fn_pairs, default=None):
+    """Sequential predicate dispatch (reference static/nn/control_flow.py
+    case): first true predicate wins."""
+    prev = _suspend_recorder()
+    try:
+        preds = [jnp.asarray(p).reshape(()).astype(bool)
+                 for p, _ in pred_fn_pairs]
+        fns = [f for _, f in pred_fn_pairs]
+        if default is not None:
+            fns = fns + [default]
+        # index of first true pred (len(preds) if none -> default)
+        stacked = jnp.stack(preds)
+        first = jnp.argmax(stacked)
+        has_true = jnp.any(stacked)
+        # miss: the default if given, else the LAST branch (reference
+        # static/nn/control_flow.py case semantics)
+        miss = len(preds) if default is not None else len(preds) - 1
+        idx = jnp.where(has_true, first, miss)
+        return lax.switch(idx, fns)
+    finally:
+        _restore_recorder(prev)
+
+
+def switch_case(branch_index, branch_fns, default=None):
+    """Indexed dispatch (reference switch_case). branch_fns: dict index->fn
+    or list of (index, fn)."""
+    prev = _suspend_recorder()
+    try:
+        items = sorted(branch_fns.items()) if isinstance(branch_fns, dict) \
+            else sorted(branch_fns)
+        keys = jnp.asarray([k for k, _ in items])
+        fns = [f for _, f in items]
+        if default is not None:
+            fns = fns + [default]
+            miss = len(items)
+        else:
+            miss = len(items) - 1  # reference: last branch on miss
+        bi = jnp.asarray(branch_index).reshape(())
+        pos = jnp.argmax(keys == bi)
+        idx = jnp.where(jnp.any(keys == bi), pos, miss)
+        return lax.switch(idx, fns)
+    finally:
+        _restore_recorder(prev)

@@ -80,3 +80,7 @@ def complex(real, imag):
     import jax.lax as lax
 
     return lax.complex(real, imag)
+
+
+def vander(x, n=None, increasing=False):
+    return jnp.vander(x, N=n, increasing=increasing)

@@ -184,3 +184,131 @@ def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
 
 def corrcoef(x, rowvar=True):
     return jnp.corrcoef(x, rowvar=rowvar)
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True):
+    """Unpack lu_factor output into (P, L, U).
+
+    Reference: phi lu_unpack_kernel (paddle.linalg.lu_unpack). y holds
+    0-indexed pivot rows from jax's lu_factor (paddle's are 1-indexed; the
+    public API layer converts). Batched via vmap.
+    """
+    m, n = x.shape[-2], x.shape[-1]
+    k = min(m, n)
+
+    def one(lu_mat, piv):
+        l = jnp.tril(lu_mat[:, :k], -1) + jnp.eye(m, k, dtype=lu_mat.dtype)
+        u = jnp.triu(lu_mat[:k, :])
+        perm = jnp.arange(m)
+
+        def body(i, p):
+            j = piv[i]
+            pi, pj = p[i], p[j]
+            return p.at[i].set(pj).at[j].set(pi)
+
+        perm = jax.lax.fori_loop(0, piv.shape[0], body, perm)
+        p_mat = jnp.eye(m, dtype=lu_mat.dtype)[:, perm]
+        return p_mat, l, u
+
+    if x.ndim == 2:
+        return one(x, y.astype(jnp.int32))
+    batch = x.shape[:-2]
+    xf = x.reshape((-1,) + x.shape[-2:])
+    yf = y.reshape((-1,) + y.shape[-1:]).astype(jnp.int32)
+    p, l, u = jax.vmap(one)(xf, yf)
+    return (p.reshape(batch + p.shape[-2:]), l.reshape(batch + l.shape[-2:]),
+            u.reshape(batch + u.shape[-2:]))
+
+
+def matrix_exp(x):
+    """Reference: phi matrix_exp kernel (scaling-and-squaring Pade); jax's
+    expm is the same algorithm."""
+    import jax.scipy.linalg as jsl
+
+    if x.ndim == 2:
+        return jsl.expm(x)
+    batch = x.shape[:-2]
+    out = jax.vmap(jsl.expm)(x.reshape((-1,) + x.shape[-2:]))
+    return out.reshape(batch + x.shape[-2:])
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary"):
+    """Pairwise p-norm distances [..., M, N] (paddle.cdist). The p==2 path
+    uses the |a|^2 - 2ab + |b|^2 expansion so the inner product rides the MXU."""
+    if p == 2.0 and compute_mode.startswith("use_mm"):
+        x2 = jnp.sum(x * x, axis=-1, keepdims=True)
+        y2 = jnp.sum(y * y, axis=-1, keepdims=True)
+        sq = x2 - 2.0 * (x @ jnp.swapaxes(y, -1, -2)) + jnp.swapaxes(y2, -1, -2)
+        return jnp.sqrt(jnp.maximum(sq, 0.0))
+    diff = jnp.abs(x[..., :, None, :] - y[..., None, :, :])
+    if p == 0:
+        return jnp.sum((diff != 0).astype(x.dtype), axis=-1)
+    if jnp.isinf(p):
+        return jnp.max(diff, axis=-1)
+    return jnp.sum(diff ** p, axis=-1) ** (1.0 / p)
+
+
+def pdist(x, p=2.0):
+    m = x.shape[0]
+    full = cdist(x, x, p)
+    iu = jnp.triu_indices(m, k=1)
+    return full[iu]
+
+
+def householder_product(x, tau):
+    """Q from Householder reflectors (paddle.linalg.householder_product)."""
+    m, n = x.shape[-2], x.shape[-1]
+
+    def one(a, t):
+        q = jnp.eye(m, dtype=a.dtype)
+
+        def body(i, q):
+            v = jnp.where(jnp.arange(m) > i, a[:, i], 0.0).at[i].set(1.0)
+            h = jnp.eye(m, dtype=a.dtype) - t[i] * jnp.outer(v, v)
+            return q @ h
+
+        return jax.lax.fori_loop(0, t.shape[0], body, q)[:, :n]
+
+    if x.ndim == 2:
+        return one(x, tau)
+    batch = x.shape[:-2]
+    out = jax.vmap(one)(x.reshape((-1, m, n)),
+                        tau.reshape((-1,) + tau.shape[-1:]))
+    return out.reshape(batch + (m, n))
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False):
+    if p == "fro":
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdim))
+    if p == "nuc":
+        s = jnp.linalg.svd(x, compute_uv=False)
+        out = jnp.sum(s, axis=-1)
+        return out[..., None, None] if keepdim else out
+    return jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keepdim)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False):
+    if axis is None:
+        # flatten: vector norm over all entries (paddle semantics), never the
+        # induced matrix norm jnp.linalg.norm would compute on 2-D input
+        out = jnp.linalg.norm(x.reshape(-1), ord=p)
+        return out.reshape((1,) * x.ndim) if keepdim else out
+    return jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keepdim)
+
+
+def ormqr(x, tau, y, left=True, transpose=False):
+    m = x.shape[-2]
+    # full m x m Q (householder_product truncates to n columns)
+    eye_pad = jnp.zeros(x.shape[:-1] + (m - x.shape[-1],), x.dtype)
+    q = householder_product(jnp.concatenate([x, eye_pad], axis=-1),
+                            jnp.concatenate(
+                                [tau, jnp.zeros(tau.shape[:-1] + (m - tau.shape[-1],),
+                                                tau.dtype)], axis=-1))
+    qt = jnp.swapaxes(q, -1, -2) if transpose else q
+    return qt @ y if left else y @ qt
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None):
+    h, edges = jnp.histogramdd(x, bins=bins, range=ranges, density=density,
+                               weights=weights)
+    return h, list(edges)

@@ -82,3 +82,15 @@ def equal_all(x, y):
 
 def is_empty(x):
     return jnp.asarray(x.size == 0)
+
+
+def isneginf(x):
+    return jnp.isneginf(x)
+
+
+def isposinf(x):
+    return jnp.isposinf(x)
+
+
+def isreal(x):
+    return jnp.isreal(x)

@@ -1,0 +1,257 @@
+"""Loss op kernels beyond the core set in nn_ops.
+
+Reference surface: python/paddle/nn/functional/loss.py (ctc_loss via warpctc,
+margin_ranking_loss, triplet margin family, cosine_embedding_loss,
+soft_margin family, poisson/gaussian NLL, square_error_cost, log_loss,
+dice_loss, npair_loss). CTC here is a fresh log-domain alpha recursion staged
+with lax.scan (static [T] loop, SPMD-friendly) rather than the reference's
+dynloaded warpctc CUDA library.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC forward. log_probs: [T, N, C] (log-softmax applied here if the
+    rows do not already sum to 1 in prob space is NOT checked — pass logits
+    and we normalize, matching paddle which takes logits). labels: [N, L]
+    padded; input_lengths/label_lengths: [N] int.
+    """
+    log_probs = jax.nn.log_softmax(log_probs, axis=-1)
+    t_max, n, c = log_probs.shape
+    l_max = labels.shape[1]
+    labels = labels.astype(jnp.int32)
+    input_lengths = jnp.asarray(input_lengths, jnp.int32)
+    label_lengths = jnp.asarray(label_lengths, jnp.int32)
+
+    s = 2 * l_max + 1
+    ext = jnp.full((n, s), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    # alpha[s] may come from alpha[s-2] only if ext[s] != ext[s-2] and ext[s]
+    # is not blank (the standard CTC skip rule)
+    can_skip = jnp.concatenate(
+        [jnp.zeros((n, 2), bool),
+         (ext[:, 2:] != ext[:, :-2]) & (ext[:, 2:] != blank)], axis=1)
+    # positions beyond 2*label_len are dead
+    alive = jnp.arange(s)[None, :] < (2 * label_lengths + 1)[:, None]
+
+    batch = jnp.arange(n)
+    lp0 = log_probs[0]
+    alpha0 = jnp.full((n, s), _NEG_INF)
+    alpha0 = alpha0.at[:, 0].set(lp0[batch, ext[:, 0]])
+    has_label = label_lengths > 0
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(has_label, lp0[batch, ext[:, 1]], _NEG_INF))
+
+    def lse3(a, b, c3):
+        m = jnp.maximum(jnp.maximum(a, b), c3)
+        m_safe = jnp.maximum(m, _NEG_INF)
+        out = m_safe + jnp.log(
+            jnp.exp(a - m_safe) + jnp.exp(b - m_safe) + jnp.exp(c3 - m_safe))
+        return jnp.where(m <= _NEG_INF, _NEG_INF, out)
+
+    def step(alpha, tlp):
+        t, lp = tlp
+        a1 = jnp.concatenate([jnp.full((n, 1), _NEG_INF), alpha[:, :-1]], 1)
+        a2 = jnp.concatenate([jnp.full((n, 2), _NEG_INF), alpha[:, :-2]], 1)
+        a2 = jnp.where(can_skip, a2, _NEG_INF)
+        emit = jnp.take_along_axis(lp, ext, axis=1)
+        new = lse3(alpha, a1, a2) + emit
+        new = jnp.where(alive, new, _NEG_INF)
+        keep = (t < input_lengths)[:, None]
+        return jnp.where(keep, new, alpha), None
+
+    alpha_t, _ = lax.scan(step, alpha0,
+                          (jnp.arange(1, t_max), log_probs[1:]))
+    # total log-prob: lse of final blank (2L) and last label (2L-1)
+    idx_label = jnp.maximum(2 * label_lengths - 1, 0)
+    idx_blank = 2 * label_lengths
+    a_label = jnp.where(has_label,
+                        jnp.take_along_axis(alpha_t, idx_label[:, None], 1)[:, 0],
+                        _NEG_INF)
+    a_blank = jnp.take_along_axis(alpha_t, idx_blank[:, None], 1)[:, 0]
+    m = jnp.maximum(a_label, a_blank)
+    ll = m + jnp.log(jnp.exp(a_label - m) + jnp.exp(a_blank - m))
+    nll = -ll
+    if norm_by_times:
+        nll = nll / input_lengths.astype(nll.dtype)
+    if reduction == "mean":
+        # paddle: per-sample loss divided by label length, then batch mean
+        return jnp.mean(nll / jnp.maximum(label_lengths, 1).astype(nll.dtype))
+    return _reduce(nll, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):
+    loss = jnp.maximum(-label * (input - other) + margin, 0.0)
+    return _reduce(loss, reduction)
+
+
+def _p_norm(x, p, axis, eps=0.0):
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(x * x, axis=axis) + eps)
+    return jnp.sum((jnp.abs(x) + eps) ** p, axis=axis) ** (1.0 / p)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False):
+    d = x - y
+    out = _p_norm(d, p, axis=-1, eps=epsilon if p == 2.0 else epsilon)
+    return out[..., None] if keepdim else out
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean"):
+    d_pos = pairwise_distance(input, positive, p, epsilon)
+    d_neg = pairwise_distance(input, negative, p, epsilon)
+    if swap:
+        d_neg = jnp.minimum(d_neg, pairwise_distance(positive, negative, p, epsilon))
+    loss = jnp.maximum(d_pos - d_neg + margin, 0.0)
+    return _reduce(loss, reduction)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean"):
+    dist = distance_function or (lambda a, b: pairwise_distance(a, b, 2.0))
+    d_pos = dist(input, positive)
+    d_neg = dist(input, negative)
+    if swap:
+        d_neg = jnp.minimum(d_neg, dist(positive, negative))
+    loss = jnp.maximum(d_pos - d_neg + margin, 0.0)
+    return _reduce(loss, reduction)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean"):
+    dot = jnp.sum(input1 * input2, axis=-1)
+    n1 = jnp.sqrt(jnp.sum(input1 * input1, axis=-1))
+    n2 = jnp.sqrt(jnp.sum(input2 * input2, axis=-1))
+    cos = dot / jnp.maximum(n1 * n2, 1e-12)
+    loss = jnp.where(label == 1, 1.0 - cos,
+                     jnp.maximum(cos - margin, 0.0))
+    return _reduce(loss, reduction)
+
+
+def soft_margin_loss(input, label, reduction="mean"):
+    loss = jnp.log1p(jnp.exp(-label * input))
+    return _reduce(loss, reduction)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean"):
+    loss = -(label * jax.nn.log_sigmoid(input)
+             + (1 - label) * jax.nn.log_sigmoid(-input))
+    if weight is not None:
+        loss = loss * weight
+    loss = jnp.mean(loss, axis=-1)
+    return _reduce(loss, reduction)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean"):
+    n, c = input.shape
+    label = label.astype(jnp.int32)
+    x_y = jnp.take_along_axis(input, label[:, None], axis=1)  # [N, 1]
+    diff = jnp.maximum(margin - x_y + input, 0.0) ** p
+    if weight is not None:
+        diff = diff * weight[label][:, None]
+    mask = jax.nn.one_hot(label, c, dtype=input.dtype)
+    loss = jnp.sum(diff * (1 - mask), axis=1) / c
+    return _reduce(loss, reduction)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean"):
+    if log_input:
+        loss = jnp.exp(input) - label * input
+    else:
+        loss = input - label * jnp.log(input + epsilon)
+    if full:
+        stirling = label * jnp.log(label) - label + 0.5 * jnp.log(
+            2 * jnp.pi * label)
+        loss = loss + jnp.where(label > 1, stirling, 0.0)
+    return _reduce(loss, reduction)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean"):
+    var = jnp.maximum(variance, epsilon)
+    loss = 0.5 * (jnp.log(var) + (input - label) ** 2 / var)
+    if full:
+        loss = loss + 0.5 * jnp.log(2 * jnp.asarray(jnp.pi))
+    return _reduce(loss, reduction)
+
+
+def square_error_cost(input, label):
+    return jnp.square(input - label)
+
+
+def log_loss(input, label, epsilon=1e-4):
+    return (-label * jnp.log(input + epsilon)
+            - (1.0 - label) * jnp.log(1.0 - input + epsilon))
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    # input: [N, ..., C] probabilities; label: [N, ..., 1] int
+    label_one_hot = jax.nn.one_hot(label.squeeze(-1), input.shape[-1],
+                                   dtype=input.dtype)
+    reduce_axes = tuple(range(1, input.ndim))
+    inter = 2.0 * jnp.sum(input * label_one_hot, axis=reduce_axes)
+    union = jnp.sum(input, axis=reduce_axes) + jnp.sum(label_one_hot,
+                                                       axis=reduce_axes)
+    return jnp.mean(1.0 - (inter + epsilon) / (union + epsilon))
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """Reference: python/paddle/nn/functional/loss.py npair_loss — softmax CE
+    over anchor·positiveᵀ similarity + L2 on the embeddings."""
+    reg = l2_reg * (jnp.mean(jnp.sum(anchor * anchor, axis=1))
+                    + jnp.mean(jnp.sum(positive * positive, axis=1))) * 0.25
+    sim = anchor @ positive.T  # [N, N]
+    labels = labels.reshape(-1)
+    eq = (labels[:, None] == labels[None, :]).astype(sim.dtype)
+    target = eq / jnp.sum(eq, axis=1, keepdims=True)
+    logp = jax.nn.log_softmax(sim, axis=1)
+    ce = -jnp.mean(jnp.sum(target * logp, axis=1))
+    return ce + reg
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None):
+    """Hierarchical sigmoid with the default complete binary tree
+    (reference hsigmoid_loss_kernel). Only the default-tree path is
+    implemented; custom path tables are rejected."""
+    if path_table is not None or path_code is not None:
+        raise NotImplementedError("custom hsigmoid trees not supported")
+    # default tree: codes are the bits of (label + num_classes) walking down
+    n = input.shape[0]
+    depth = max(int(num_classes - 1).bit_length(), 1)
+    code = label.astype(jnp.int32) + num_classes
+    # walk up: parent chain node ids (root excluded), bit = left/right
+    losses = jnp.zeros((n,), input.dtype)
+    x_w = input @ weight.T  # [N, num_classes-1] pre-activations
+    if bias is not None:
+        x_w = x_w + bias.reshape(1, -1)
+    for _ in range(depth):
+        parent = code // 2
+        bit = (code % 2).astype(input.dtype)  # 1 => right child
+        valid = parent >= 1
+        idx = jnp.clip(parent - 1, 0, num_classes - 2)
+        logits = jnp.take_along_axis(x_w, idx[:, None], axis=1)[:, 0]
+        # sigmoid CE with target = bit
+        step_loss = jnp.maximum(logits, 0) - logits * bit + jnp.log1p(
+            jnp.exp(-jnp.abs(logits)))
+        losses = losses + jnp.where(valid, step_loss, 0.0)
+        code = parent
+    return losses[:, None]

@@ -359,3 +359,189 @@ def shard_index(x, index_num, nshards, shard_id, ignore_value=-1):
     hi = lo + shard_size
     in_range = (x >= lo) & (x < hi)
     return jnp.where(in_range, x - lo, ignore_value)
+
+
+_pyslice = __import__("builtins").slice
+
+
+def unstack(x, axis=0, num=None):
+    n = x.shape[axis] if num is None else num
+    return [jnp.squeeze(s, axis=axis) for s in jnp.split(x, n, axis=axis)]
+
+
+def tensor_split(x, num_or_indices, axis=0):
+    if isinstance(num_or_indices, int):
+        return jnp.array_split(x, num_or_indices, axis=axis)
+    return jnp.split(x, list(num_or_indices), axis=axis)
+
+
+def hsplit(x, num_or_indices):
+    return tensor_split(x, num_or_indices, axis=0 if x.ndim == 1 else 1)
+
+
+def vsplit(x, num_or_indices):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def hstack(xs):
+    return jnp.hstack(list(xs))
+
+
+def vstack(xs):
+    return jnp.vstack(list(xs))
+
+
+def dstack(xs):
+    return jnp.dstack(list(xs))
+
+
+def column_stack(xs):
+    return jnp.column_stack(list(xs))
+
+
+def row_stack(xs):
+    return jnp.vstack(list(xs))
+
+
+def take(x, index, mode="raise"):
+    """paddle.take: flat-index gather with raise/wrap/clip bounds modes
+    (raise clamps under jit, matching the reference's GPU behavior)."""
+    flat = x.reshape(-1)
+    idx = index.astype(jnp.int32)
+    n = flat.shape[0]
+    if mode == "wrap":
+        idx = ((idx % n) + n) % n
+    else:
+        idx = jnp.clip(jnp.where(idx < 0, idx + n, idx), 0, n - 1)
+    return jnp.take(flat, idx.reshape(-1)).reshape(index.shape)
+
+
+def index_add(x, index, axis, value):
+    idx = index.astype(jnp.int32)
+    moved = jnp.moveaxis(x, axis, 0)
+    vmoved = jnp.moveaxis(value, axis, 0)
+    out = moved.at[idx].add(vmoved)
+    return jnp.moveaxis(out, 0, axis)
+
+
+def index_fill(x, index, axis, value):
+    idx = index.astype(jnp.int32)
+    moved = jnp.moveaxis(x, axis, 0)
+    out = moved.at[idx].set(value)
+    return jnp.moveaxis(out, 0, axis)
+
+
+def index_put(x, indices, value, accumulate=False):
+    if accumulate:
+        return x.at[tuple(indices)].add(value)
+    return x.at[tuple(indices)].set(value)
+
+
+def masked_scatter(x, mask, value):
+    """Fill mask positions with consecutive values (phi masked_scatter).
+    Static-shape formulation: the k-th True position takes value.flat[k]."""
+    mask_b = jnp.broadcast_to(mask, x.shape)
+    order = jnp.cumsum(mask_b.reshape(-1).astype(jnp.int32)) - 1
+    vals = value.reshape(-1)
+    picked = jnp.take(vals, jnp.clip(order, 0, vals.shape[0] - 1))
+    return jnp.where(mask_b, picked.reshape(x.shape), x)
+
+
+def unflatten(x, axis, shape):
+    axis = axis % x.ndim
+    new_shape = x.shape[:axis] + tuple(shape) + x.shape[axis + 1:]
+    # one -1 allowed
+    return x.reshape(new_shape)
+
+
+def block_diag(inputs):
+    import jax.scipy.linalg as jsl
+
+    return jsl.block_diag(*[jnp.atleast_2d(i) for i in inputs])
+
+
+def broadcast_tensors(inputs):
+    shape = jnp.broadcast_shapes(*[i.shape for i in inputs])
+    return [jnp.broadcast_to(i, shape) for i in inputs]
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence, x, side=side)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+def select_scatter(x, value, axis, index):
+    idx = [_pyslice(None)] * x.ndim
+    idx[axis] = index
+    return x.at[tuple(idx)].set(value)
+
+
+def slice_scatter(x, value, axes, starts, ends, strides):
+    idx = [_pyslice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = _pyslice(st, en, sd)
+    return x.at[tuple(idx)].set(value)
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1):
+    # build index grid for the diagonal and scatter y onto it
+    n1, n2 = x.shape[axis1], x.shape[axis2]
+    dlen = min(n1, n2 - offset) if offset >= 0 else min(n1 + offset, n2)
+    i = jnp.arange(dlen) + (-offset if offset < 0 else 0)
+    j = jnp.arange(dlen) + (offset if offset > 0 else 0)
+    moved = jnp.moveaxis(x, (axis1, axis2), (0, 1))
+    ymoved = jnp.moveaxis(y, -1, 0) if y.ndim > 1 else y
+    out = moved.at[i, j].set(ymoved)
+    return jnp.moveaxis(out, (0, 1), (axis1, axis2))
+
+
+def crop(x, shape=None, offsets=None):
+    offsets = offsets or [0] * x.ndim
+    shape = shape or x.shape
+    idx = tuple(_pyslice(o, o + s) for o, s in zip(offsets, shape))
+    return x[idx]
+
+
+def view_as(x, other):
+    return x.reshape(other.shape)
+
+
+def combinations(x, r=2, with_replacement=False):
+    import itertools
+
+    n = x.shape[0]
+    gen = (itertools.combinations_with_replacement if with_replacement
+           else itertools.combinations)
+    idx = jnp.asarray(list(gen(range(n), r)), dtype=jnp.int32)
+    if idx.size == 0:
+        return jnp.zeros((0, r), x.dtype)
+    return x[idx]
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None):
+    """Eager-only (value-dependent output shape), like the reference op."""
+    import numpy as np
+
+    xv = np.asarray(x)
+    if axis is None:
+        xv = xv.reshape(-1)
+        change = np.concatenate([[True], xv[1:] != xv[:-1]])
+    else:
+        moved = np.moveaxis(xv, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        change = np.concatenate([[True], np.any(flat[1:] != flat[:-1], axis=1)])
+        xv = moved
+    starts = np.nonzero(change)[0]
+    out = jnp.asarray(xv[starts] if axis is None else
+                      np.moveaxis(xv[starts], 0, axis))
+    res = [out]
+    if return_inverse:
+        res.append(jnp.asarray(np.cumsum(change) - 1))
+    if return_counts:
+        res.append(jnp.asarray(np.diff(np.append(starts, len(change)))))
+    return res[0] if len(res) == 1 else tuple(res)

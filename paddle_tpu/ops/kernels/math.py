@@ -331,3 +331,110 @@ def diff(x, n=1, axis=-1, prepend=None, append=None):
 def multiply_add(x, y, z):
     """fma: x * y + z (reference: fused elementwise)."""
     return x * y + z
+
+
+def trapezoid(y, x=None, dx=None, axis=-1):
+    return jnp.trapezoid(y, x=x, dx=1.0 if dx is None else dx, axis=axis)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1):
+    y = jnp.asarray(y)
+    nd = y.ndim
+    axis = axis % nd
+    sl1 = [slice(None)] * nd
+    sl2 = [slice(None)] * nd
+    sl1[axis] = slice(1, None)
+    sl2[axis] = slice(None, -1)
+    if x is not None:
+        d = jnp.diff(jnp.asarray(x), axis=axis if jnp.asarray(x).ndim == nd else 0)
+        if d.ndim != nd:
+            shape = [1] * nd
+            shape[axis] = d.shape[0]
+            d = d.reshape(shape)
+    else:
+        d = 1.0 if dx is None else dx
+    return jnp.cumsum(d * (y[tuple(sl1)] + y[tuple(sl2)]) / 2.0, axis=axis)
+
+
+def copysign(x, y):
+    return jnp.copysign(x, y)
+
+
+def nextafter(x, y):
+    return jnp.nextafter(x, y)
+
+
+def hypot(x, y):
+    return jnp.hypot(x, y)
+
+
+def signbit(x):
+    return jnp.signbit(x)
+
+
+def ldexp(x, y):
+    return jnp.ldexp(x, y.astype(jnp.int32))
+
+
+def frexp(x):
+    return jnp.frexp(x)
+
+
+def i0(x):
+    return jax.scipy.special.i0(x)
+
+
+def i0e(x):
+    return jax.scipy.special.i0e(x)
+
+
+def i1(x):
+    return jax.scipy.special.i1(x)
+
+
+def i1e(x):
+    return jax.scipy.special.i1e(x)
+
+
+def polygamma(x, n):
+    return jax.scipy.special.polygamma(n, x)
+
+
+def igamma(x, a):
+    return jax.scipy.special.gammainc(a, x)
+
+
+def igammac(x, a):
+    return jax.scipy.special.gammaincc(a, x)
+
+
+def sinc(x):
+    return jnp.sinc(x)
+
+
+def renorm(x, p, axis, max_norm):
+    """Reference: phi renorm_kernel — scale each sub-tensor along `axis`
+    whose p-norm exceeds max_norm down to exactly max_norm."""
+    axes = tuple(i for i in range(x.ndim) if i != axis % x.ndim)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=axes, keepdims=True) ** (1.0 / p)
+    scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return x * scale
+
+
+def erfc(x):
+    return jax.scipy.special.erfc(x)
+
+
+def logaddexp2(x, y):
+    return jnp.logaddexp2(x, y)
+
+
+def sgn(x):
+    if jnp.iscomplexobj(x):
+        mag = jnp.abs(x)
+        return jnp.where(mag == 0, 0, x / jnp.where(mag == 0, 1, mag))
+    return jnp.sign(x)
+
+
+def log_normalize(x, axis=-1):
+    return x - jax.scipy.special.logsumexp(x, axis=axis, keepdims=True)

@@ -10,6 +10,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from jax import lax
+import numpy as _np
 
 from ...core import random as _random
 from ...core.dtype import convert_dtype
@@ -385,10 +386,10 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusiv
         window = (1, k[0], k[1], 1)
         strides = (1, s[0], s[1], 1)
         pads = ((0, 0), (p[0], p[0]), (p[1], p[1]), (0, 0))
-    summed = lax.reduce_window(x, jnp.zeros((), x.dtype), lax.add, window, strides, pads)
+    summed = lax.reduce_window(x, _np.zeros((), x.dtype), lax.add, window, strides, pads)
     if exclusive and (p[0] or p[1]):
         ones = jnp.ones_like(x)
-        counts = lax.reduce_window(ones, jnp.zeros((), x.dtype), lax.add, window, strides, pads)
+        counts = lax.reduce_window(ones, _np.zeros((), x.dtype), lax.add, window, strides, pads)
         return summed / counts
     return summed / (k[0] * k[1])
 
@@ -720,3 +721,40 @@ def rotary_position_embedding(q, k, cos, sin, rotate_half=True):
     q_out = q * cos + rot(q) * sin
     k_out = k * cos + rot(k) * sin
     return q_out, k_out
+
+
+def softsign(x):
+    return x / (1.0 + jnp.abs(x))
+
+
+def alpha_dropout(x, p=0.5, training=True):
+    """SELU-preserving dropout (reference nn/functional/common.py
+    alpha_dropout)."""
+    if not training or p == 0.0:
+        return x
+    alpha = -1.7580993408473766
+    keep = 1.0 - p
+    a = (keep + alpha * alpha * keep * (1 - keep)) ** -0.5
+    b = -a * alpha * (1 - keep)
+    mask = jax.random.bernoulli(_random.next_key(), keep, x.shape).astype(x.dtype)
+    return a * (x * mask + alpha * (1 - mask)) + b
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW"):
+    if not training or p == 0.0:
+        return x
+    n = x.shape[0]
+    if data_format == "NCDHW":
+        shape = (n, x.shape[1], 1, 1, 1)
+    else:  # NDHWC: drop whole channels, not depth slices
+        shape = (n, 1, 1, 1, x.shape[4])
+    mask = jax.random.bernoulli(_random.next_key(), 1.0 - p,
+                                shape).astype(x.dtype)
+    return x * mask / (1.0 - p)
+
+
+def zeropad2d(x, padding, data_format="NCHW"):
+    l, r, t, b = padding
+    if data_format == "NCHW":
+        return jnp.pad(x, ((0, 0), (0, 0), (t, b), (l, r)))
+    return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0)))

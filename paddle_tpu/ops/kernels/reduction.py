@@ -131,3 +131,40 @@ def kthvalue(x, k, axis=-1, keepdim=False):
         taken = jnp.expand_dims(taken, axis)
         taken_idx = jnp.expand_dims(taken_idx, axis)
     return taken, taken_idx
+
+
+def mode(x, axis=-1, keepdim=False):
+    """Most frequent value + its last index along axis (phi mode_kernel)."""
+    axis = axis % x.ndim
+    moved = jnp.moveaxis(x, axis, -1)
+    srt = jnp.sort(moved, axis=-1)
+    n = srt.shape[-1]
+    # run lengths in the sorted order: count of equal elements ending here
+    eq = jnp.concatenate(
+        [jnp.zeros(srt.shape[:-1] + (1,), jnp.int32),
+         (srt[..., 1:] == srt[..., :-1]).astype(jnp.int32)], axis=-1)
+    run = jnp.zeros_like(eq)
+
+    def body(i, run):
+        prev = jnp.where(eq[..., i] == 1, run[..., i - 1] + 1, 0)
+        return run.at[..., i].set(prev)
+
+    run = jax.lax.fori_loop(1, n, body, run)
+    best = jnp.argmax(run, axis=-1)
+    vals = jnp.take_along_axis(srt, best[..., None], axis=-1)[..., 0]
+    # index: last occurrence in the ORIGINAL order
+    match = moved == vals[..., None]
+    idx_grid = jnp.arange(n)
+    last_idx = jnp.max(jnp.where(match, idx_grid, -1), axis=-1)
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        last_idx = jnp.expand_dims(last_idx, axis)
+    return vals, last_idx.astype(jnp.int64)
+
+
+def nanmedian(x, axis=None, keepdim=False):
+    return jnp.nanmedian(x, axis=axis, keepdims=keepdim)
+
+
+def nanquantile(x, q, axis=None, keepdim=False):
+    return jnp.nanquantile(x, q, axis=axis, keepdims=keepdim)

@@ -1,0 +1,706 @@
+"""Spatial / vision op kernels: N-d conv & pooling, grid sampling, ROI ops.
+
+Reference surface: python/paddle/nn/functional/conv.py (conv3d at
+nn/layer/conv.py:899), pooling.py (1d/3d + adaptive variants),
+vision.py (grid_sample, affine_grid, pixel_unshuffle, channel_shuffle),
+paddle.vision.ops (roi_align, roi_pool, deform_conv2d, nms), and the phi
+kernels grid_sample_kernel.cu / roi_align_kernel.cu / deformable_conv_kernel.
+TPU design: everything lowers to lax.conv_general_dilated /
+lax.reduce_window / gather compositions that XLA tiles onto the MXU — no
+per-op CUDA. All ops are differentiable through jax's vjp.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+import numpy as _np
+
+from .nn_ops import avg_pool2d, max_pool2d  # re-used by adaptive wrappers
+
+
+def _ntuple(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(v)
+        if len(v) == 1:
+            return tuple(v) * n
+        raise ValueError(f"expected {n}-tuple, got {v}")
+    return (v,) * n
+
+
+# ----------------------------------------------------------------- conv N-d
+_CONV_FMT = {1: ("NCL", "OIL"), 2: ("NCHW", "OIHW"), 3: ("NCDHW", "OIDHW")}
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, nd,
+             channel_last=False):
+    stride = _ntuple(stride, nd)
+    dilation = _ntuple(dilation, nd)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        p = _ntuple(padding, nd) if not (
+            isinstance(padding, (list, tuple)) and len(padding) == 2 * nd
+        ) else padding
+        if len(p) == nd:
+            pad = [(pi, pi) for pi in p]
+        else:  # [before0, after0, before1, after1, ...]
+            pad = [(p[2 * i], p[2 * i + 1]) for i in range(nd)]
+    lhs_fmt, rhs_fmt = _CONV_FMT[nd]
+    if channel_last:
+        lhs_fmt = "N" + lhs_fmt[2:] + "C"
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape,
+                                    (lhs_fmt, rhs_fmt, lhs_fmt))
+    out = lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad, rhs_dilation=dilation,
+        dimension_numbers=dn, feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.float32 else None,
+    )
+    if bias is not None:
+        shape = [1, -1] + [1] * nd if not channel_last else [1] + [1] * nd + [-1]
+        out = out + bias.reshape(shape)
+    return out
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW"):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3,
+                    channel_last=(data_format == "NDHWC"))
+
+
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                       dilation, groups, nd):
+    stride = _ntuple(stride, nd)
+    dilation = _ntuple(dilation, nd)
+    p = _ntuple(padding, nd)
+    op = _ntuple(output_padding, nd)
+    ks = weight.shape[2:]
+    pad = [
+        (dilation[i] * (ks[i] - 1) - p[i],
+         dilation[i] * (ks[i] - 1) - p[i] + op[i])
+        for i in range(nd)
+    ]
+    # weight layout paddle: [in, out//groups, *ks] -> flip + swap to OI*ks
+    w = jnp.flip(weight, axis=tuple(range(2, 2 + nd)))
+    w = jnp.swapaxes(w, 0, 1)
+    if groups > 1:
+        w = jnp.concatenate(jnp.split(w, groups, axis=1), axis=0)
+    lhs_fmt, rhs_fmt = _CONV_FMT[nd]
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, (lhs_fmt, rhs_fmt, lhs_fmt))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=(1,) * nd, padding=pad, lhs_dilation=stride,
+        rhs_dilation=dilation, dimension_numbers=dn, feature_group_count=groups,
+    )
+    if bias is not None:
+        out = out + bias.reshape([1, -1] + [1] * nd)
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1, data_format="NCL"):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 1)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCDHW"):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 3)
+
+
+# ----------------------------------------------------------------- pool N-d
+def _pool_nd(x, kernel_size, stride, padding, nd, reducer, init, ceil_mode):
+    k = _ntuple(kernel_size, nd)
+    s = _ntuple(stride, nd) if stride is not None else k
+    p = _ntuple(padding, nd)
+    window = (1, 1) + k
+    strides = (1, 1) + s
+    pads = [(0, 0), (0, 0)] + [(pi, pi) for pi in p]
+    if ceil_mode:
+        # extend the right pad so a partial final window is kept
+        for i in range(nd):
+            size = x.shape[2 + i] + 2 * p[i]
+            rem = (size - k[i]) % s[i]
+            if rem:
+                pads[2 + i] = (p[i], p[i] + s[i] - rem)
+    return lax.reduce_window(x, init, reducer, window, strides, pads)
+
+
+def _neg_init(x):
+    return -jnp.inf if jnp.issubdtype(x.dtype, jnp.inexact) else jnp.iinfo(x.dtype).min
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False):
+    k = _ntuple(kernel_size, 1)
+    s = _ntuple(stride, 1) if stride is not None else k
+    p = _ntuple(padding, 1)
+    neg = _neg_init(x)
+    if return_mask:
+        out, idx = _max_pool_with_mask(x[..., None], (k[0], 1), (s[0], 1),
+                                       (p[0], 0))
+        return out[..., 0], idx[..., 0]
+    pads = [(0, 0), (0, 0), (p[0], p[0])]
+    if ceil_mode:
+        size = x.shape[2] + 2 * p[0]
+        rem = (size - k[0]) % s[0]
+        if rem:
+            pads[2] = (p[0], p[0] + s[0] - rem)
+    return lax.reduce_window(x, neg, lax.max, (1, 1, k[0]), (1, 1, s[0]), pads)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False):
+    k = _ntuple(kernel_size, 1)
+    s = _ntuple(stride, 1) if stride is not None else k
+    p = _ntuple(padding, 1)
+    summed = _pool_nd(x[:, :, :, None], (k[0], 1), (s[0], 1), (p[0], 0), 2,
+                      lax.add, _np.zeros((), x.dtype), ceil_mode)[..., 0]
+    if exclusive and p[0]:
+        counts = _pool_nd(jnp.ones_like(x)[:, :, :, None], (k[0], 1), (s[0], 1),
+                          (p[0], 0), 2, lax.add, _np.zeros((), x.dtype),
+                          ceil_mode)[..., 0]
+        return summed / counts
+    return summed / k[0]
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW"):
+    if return_mask:
+        return _max_pool_with_mask_nd(x, kernel_size, stride, padding, 3)
+    return _pool_nd(x, kernel_size, stride, padding, 3, lax.max, _neg_init(x),
+                    ceil_mode)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCDHW"):
+    k = _ntuple(kernel_size, 3)
+    p = _ntuple(padding, 3)
+    summed = _pool_nd(x, kernel_size, stride, padding, 3, lax.add,
+                      _np.zeros((), x.dtype), ceil_mode)
+    if exclusive and any(p):
+        counts = _pool_nd(jnp.ones_like(x), kernel_size, stride, padding, 3,
+                          lax.add, _np.zeros((), x.dtype), ceil_mode)
+        return summed / counts
+    return summed / (k[0] * k[1] * k[2])
+
+
+def _max_pool_with_mask(x, k, s, p):
+    """max_pool2d returning (out, flat-index mask) like the reference
+    (mask = argmax position in the flattened input H*W, phi max_pool2d_with_index).
+
+    Padding is applied explicitly with the dtype minimum
+    (conv_general_dilated_patches zero-pads, and a 0 pad slot would win the
+    max over negative inputs and yield an out-of-range index; -inf is not
+    usable because patch extraction is conv-based and -inf * 0 = NaN)."""
+    n, c, h, w = x.shape
+    neg = (_np.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.inexact)
+           else _np.iinfo(x.dtype).min)
+    xp = jnp.pad(x, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])),
+                 constant_values=neg)
+    patches = lax.conv_general_dilated_patches(
+        xp, filter_shape=k, window_strides=s, padding=[(0, 0), (0, 0)],
+        dimension_numbers=lax.conv_dimension_numbers(
+            xp.shape, (1, c, *k), ("NCHW", "OIHW", "NCHW")),
+    )  # [n, c*kh*kw, oh, ow]
+    oh, ow = patches.shape[2], patches.shape[3]
+    patches = patches.reshape(n, c, k[0] * k[1], oh, ow)
+    # index map: same extraction over the flat row/col index grid
+    ri = jnp.arange(-p[0], h + p[0])
+    ci = jnp.arange(-p[1], w + p[1])
+    flat = (ri[:, None] * w + ci[None, :]).astype(jnp.float32)
+    flat = jnp.broadcast_to(flat[None, None], (1, 1, *flat.shape))
+    ipatches = lax.conv_general_dilated_patches(
+        flat, filter_shape=k, window_strides=s, padding=[(0, 0), (0, 0)],
+        dimension_numbers=lax.conv_dimension_numbers(
+            flat.shape, (1, 1, *k), ("NCHW", "OIHW", "NCHW")),
+    ).reshape(1, 1, k[0] * k[1], oh, ow)
+    am = jnp.argmax(patches, axis=2)
+    out = jnp.max(patches, axis=2)
+    idx = jnp.take_along_axis(
+        jnp.broadcast_to(ipatches, (n, c, k[0] * k[1], oh, ow)),
+        am[:, :, None], axis=2)[:, :, 0]
+    return out, idx.astype(jnp.int64)
+
+
+def _max_pool_with_mask_nd(x, kernel_size, stride, padding, nd):
+    if nd == 3:
+        # fold depth into batch and pool 2-d per depth slice is wrong for
+        # kd > 1; use the generic patch route via reshape to 2-d when kd == 1
+        k = _ntuple(kernel_size, 3)
+        if k[0] == 1:
+            n, c, d, h, w = x.shape
+            s = _ntuple(stride, 3) if stride is not None else k
+            p = _ntuple(padding, 3)
+            out, idx = _max_pool_with_mask(
+                x.reshape(n, c * d, h, w), (k[1], k[2]), (s[1], s[2]),
+                (p[1], p[2]))
+            oh, ow = out.shape[-2:]
+            return (out.reshape(n, c, d, oh, ow), idx.reshape(n, c, d, oh, ow))
+        raise NotImplementedError("max_pool3d return_mask requires kd == 1")
+    raise NotImplementedError
+
+
+def max_pool2d_with_mask(x, kernel_size, stride=None, padding=0):
+    k = _ntuple(kernel_size, 2)
+    s = _ntuple(stride, 2) if stride is not None else k
+    p = _ntuple(padding, 2)
+    return _max_pool_with_mask(x, k, s, p)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW"):
+    """Scatter pooled values back to the argmax positions (phi max_unpool2d)."""
+    k = _ntuple(kernel_size, 2)
+    s = _ntuple(stride, 2) if stride is not None else k
+    p = _ntuple(padding, 2)
+    n, c, oh, ow = x.shape
+    if output_size is None:
+        h = (oh - 1) * s[0] - 2 * p[0] + k[0]
+        w = (ow - 1) * s[1] - 2 * p[1] + k[1]
+    else:
+        h, w = output_size[-2:]
+    flat = jnp.zeros((n, c, h * w), x.dtype)
+    idx = indices.reshape(n, c, oh * ow).astype(jnp.int32)
+    vals = x.reshape(n, c, oh * ow)
+    out = jax.vmap(jax.vmap(lambda f, i, v: f.at[i].set(v)))(flat, idx, vals)
+    return out.reshape(n, c, h, w)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None):
+    out = max_unpool2d(x[..., None], indices[..., None],
+                       (_ntuple(kernel_size, 1)[0], 1),
+                       (_ntuple(stride, 1)[0], 1) if stride is not None else None,
+                       (_ntuple(padding, 1)[0], 0),
+                       output_size=None if output_size is None
+                       else (*tuple(output_size), 1))
+    return out[..., 0]
+
+
+def adaptive_avg_pool1d(x, output_size):
+    from .nn_ops import _adaptive_pool_general
+
+    out = _ntuple(output_size, 1)[0]
+    l = x.shape[2]
+    if l % out == 0:
+        k = l // out
+        return avg_pool1d(x, k, stride=k)
+    x4 = x[:, :, :, None]
+    return _adaptive_pool_general(x4, out, 1, (2, 3))[..., 0]
+
+
+def adaptive_max_pool1d(x, output_size):
+    from .nn_ops import _adaptive_pool_general
+
+    out = _ntuple(output_size, 1)[0]
+    l = x.shape[2]
+    if l % out == 0:
+        k = l // out
+        return max_pool1d(x, k, stride=k)
+    x4 = x[:, :, :, None]
+    return _adaptive_pool_general(x4, out, 1, (2, 3), reducer=jnp.max)[..., 0]
+
+
+def _adaptive_pool3d(x, output_size, reducer):
+    import numpy as np
+
+    od, oh, ow = _ntuple(output_size, 3)
+    n, c, d, h, w = x.shape
+    if d % od == 0 and h % oh == 0 and w % ow == 0:
+        k = (d // od, h // oh, w // ow)
+        if reducer is jnp.mean:
+            return avg_pool3d(x, k, stride=k)
+        return max_pool3d(x, k, stride=k)
+    cells = []
+    for i in range(od):
+        sl_d = slice(int(np.floor(i * d / od)), int(np.ceil((i + 1) * d / od)))
+        rows = []
+        for j in range(oh):
+            sl_h = slice(int(np.floor(j * h / oh)), int(np.ceil((j + 1) * h / oh)))
+            cols = []
+            for m in range(ow):
+                sl_w = slice(int(np.floor(m * w / ow)), int(np.ceil((m + 1) * w / ow)))
+                cols.append(reducer(x[:, :, sl_d, sl_h, sl_w], axis=(2, 3, 4),
+                                    keepdims=True))
+            rows.append(jnp.concatenate(cols, axis=4))
+        cells.append(jnp.concatenate(rows, axis=3))
+    return jnp.concatenate(cells, axis=2)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
+    return _adaptive_pool3d(x, output_size, jnp.mean)
+
+
+def adaptive_max_pool3d(x, output_size, data_format="NCDHW"):
+    return _adaptive_pool3d(x, output_size, jnp.max)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW"):
+    k = _ntuple(kernel_size, 2)
+    p = float(norm_type)
+    powed = jnp.abs(x) ** p
+    summed = _pool_nd(powed, kernel_size, stride, padding, 2, lax.add,
+                      _np.zeros((), x.dtype), ceil_mode)
+    return summed ** (1.0 / p)
+
+
+# ------------------------------------------------------------ grid sampling
+def _unnormalize(coord, size, align_corners):
+    if align_corners:
+        return (coord + 1.0) * (size - 1) / 2.0
+    return ((coord + 1.0) * size - 1.0) / 2.0
+
+
+def _reflect(x, lo, hi):
+    # reflect into [lo, hi] (float bounds), standard double-mirror
+    rng = hi - lo
+    if rng <= 0:
+        return jnp.zeros_like(x)
+    x = jnp.abs(x - lo) % (2 * rng)
+    return lo + jnp.where(x > rng, 2 * rng - x, x)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    """x: [N, C, H, W]; grid: [N, Hg, Wg, 2] with (x, y) in [-1, 1].
+
+    Reference: phi/kernels/gpu/grid_sample_kernel.cu. Gather-based bilinear
+    with zeros/border/reflection handling; nearest supported.
+    """
+    n, c, h, w = x.shape
+    gx = _unnormalize(grid[..., 0].astype(jnp.float32), w, align_corners)
+    gy = _unnormalize(grid[..., 1].astype(jnp.float32), h, align_corners)
+
+    if padding_mode == "reflection":
+        if align_corners:
+            gx = _reflect(gx, 0.0, w - 1.0)
+            gy = _reflect(gy, 0.0, h - 1.0)
+        else:
+            gx = _reflect(gx, -0.5, w - 0.5)
+            gy = _reflect(gy, -0.5, h - 0.5)
+        gx = jnp.clip(gx, 0, w - 1)
+        gy = jnp.clip(gy, 0, h - 1)
+    elif padding_mode == "border":
+        gx = jnp.clip(gx, 0, w - 1)
+        gy = jnp.clip(gy, 0, h - 1)
+
+    def gather(ix, iy, valid):
+        # ix/iy int32 [N, Hg, Wg]; returns [N, C, Hg, Wg]
+        ixc = jnp.clip(ix, 0, w - 1)
+        iyc = jnp.clip(iy, 0, h - 1)
+        flat = x.reshape(n, c, h * w)
+        lin = (iyc * w + ixc).reshape(n, -1)  # [N, Hg*Wg]
+        out = jnp.take_along_axis(flat, lin[:, None, :], axis=2)
+        out = out.reshape(n, c, *ix.shape[1:])
+        return out * valid[:, None].astype(x.dtype)
+
+    def in_bounds(ix, iy):
+        if padding_mode == "zeros":
+            return ((ix >= 0) & (ix <= w - 1) & (iy >= 0) & (iy <= h - 1))
+        return jnp.ones_like(ix, dtype=bool)
+
+    if mode == "nearest":
+        ix = jnp.round(gx).astype(jnp.int32)
+        iy = jnp.round(gy).astype(jnp.int32)
+        return gather(ix, iy, in_bounds(ix, iy))
+
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    x1, y1 = x0 + 1, y0 + 1
+    wx1 = (gx - x0).astype(x.dtype)
+    wy1 = (gy - y0).astype(x.dtype)
+    wx0, wy0 = 1 - wx1, 1 - wy1
+    x0i, x1i = x0.astype(jnp.int32), x1.astype(jnp.int32)
+    y0i, y1i = y0.astype(jnp.int32), y1.astype(jnp.int32)
+    out = (
+        gather(x0i, y0i, in_bounds(x0i, y0i)) * (wx0 * wy0)[:, None]
+        + gather(x1i, y0i, in_bounds(x1i, y0i)) * (wx1 * wy0)[:, None]
+        + gather(x0i, y1i, in_bounds(x0i, y1i)) * (wx0 * wy1)[:, None]
+        + gather(x1i, y1i, in_bounds(x1i, y1i)) * (wx1 * wy1)[:, None]
+    )
+    return out
+
+
+def affine_grid(theta, out_shape, align_corners=True):
+    """theta: [N, 2, 3] -> grid [N, H, W, 2] (4-len out_shape), or
+    [N, 3, 4] -> [N, D, H, W, 3] (5-len). Reference: phi affine_grid."""
+    out_shape = [int(s) for s in out_shape]
+
+    def base(size):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, size)
+        step = 2.0 / size
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size)
+
+    if len(out_shape) == 4:
+        n, _, h, w = out_shape
+        ys, xs = jnp.meshgrid(base(h), base(w), indexing="ij")
+        ones = jnp.ones_like(xs)
+        coords = jnp.stack([xs, ys, ones], axis=-1)  # [H, W, 3]
+        grid = jnp.einsum("hwk,njk->nhwj", coords, theta.astype(jnp.float32))
+        return grid  # [N, H, W, 2]
+    n, _, d, h, w = out_shape
+    zs, ys, xs = jnp.meshgrid(base(d), base(h), base(w), indexing="ij")
+    ones = jnp.ones_like(xs)
+    coords = jnp.stack([xs, ys, zs, ones], axis=-1)
+    return jnp.einsum("dhwk,njk->ndhwj", coords, theta.astype(jnp.float32))
+
+
+# ---------------------------------------------------------------- ROI ops
+def roi_align(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """x: [N, C, H, W]; boxes: [R, 4] (x1, y1, x2, y2); boxes_num: [N].
+
+    Reference: phi/kernels/gpu/roi_align_kernel.cu. sampling_ratio=-1 (the
+    reference's adaptive bin sampling) is approximated with a fixed 2x2
+    sample grid per bin — adaptive counts are data-dependent, which cannot
+    be staged into one XLA program.
+    """
+    ph, pw = _ntuple(output_size, 2)
+    sr = 2 if sampling_ratio <= 0 else sampling_ratio
+    n, c, h, w = x.shape
+    r = boxes.shape[0]
+    if boxes_num is None:
+        batch_idx = jnp.zeros((r,), jnp.int32)
+    else:
+        batch_idx = jnp.searchsorted(
+            jnp.cumsum(jnp.asarray(boxes_num)), jnp.arange(r), side="right"
+        ).astype(jnp.int32)
+
+    offset = 0.5 if aligned else 0.0
+    boxes = boxes.astype(jnp.float32) * spatial_scale - offset
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    roi_w = x2 - x1 if aligned else jnp.maximum(x2 - x1, 1.0)
+    roi_h = y2 - y1 if aligned else jnp.maximum(y2 - y1, 1.0)
+    bin_w = roi_w / pw
+    bin_h = roi_h / ph
+
+    # sample coordinates: [R, ph*sr] x [R, pw*sr]
+    iy = jnp.arange(ph * sr)
+    ix = jnp.arange(pw * sr)
+    sy = y1[:, None] + (iy[None, :] // sr) * bin_h[:, None] + \
+        ((iy[None, :] % sr) + 0.5) / sr * bin_h[:, None]
+    sx = x1[:, None] + (ix[None, :] // sr) * bin_w[:, None] + \
+        ((ix[None, :] % sr) + 0.5) / sr * bin_w[:, None]
+
+    def sample_one(xi, syi, sxi):
+        # xi: [C, H, W]; syi: [ph*sr]; sxi: [pw*sr] -> [C, ph, pw]
+        gy = jnp.broadcast_to(syi[:, None], (ph * sr, pw * sr))
+        gx = jnp.broadcast_to(sxi[None, :], (ph * sr, pw * sr))
+        x0 = jnp.floor(gx)
+        y0 = jnp.floor(gy)
+        wx1 = gx - x0
+        wy1 = gy - y0
+
+        def g(ixg, iyg):
+            v = ((ixg >= 0) & (ixg <= w - 1) & (iyg >= 0) & (iyg <= h - 1))
+            ixc = jnp.clip(ixg, 0, w - 1)
+            iyc = jnp.clip(iyg, 0, h - 1)
+            flat = xi.reshape(c, h * w)
+            lin = (iyc * w + ixc).reshape(-1)
+            out = jnp.take(flat, lin, axis=1).reshape(c, ph * sr, pw * sr)
+            return out * v.astype(xi.dtype)
+
+        x0i, y0i = x0.astype(jnp.int32), y0.astype(jnp.int32)
+        val = (g(x0i, y0i) * ((1 - wx1) * (1 - wy1))
+               + g(x0i + 1, y0i) * (wx1 * (1 - wy1))
+               + g(x0i, y0i + 1) * ((1 - wx1) * wy1)
+               + g(x0i + 1, y0i + 1) * (wx1 * wy1))
+        return jnp.mean(val.reshape(c, ph, sr, pw, sr), axis=(2, 4))
+
+    feats = x[batch_idx]  # [R, C, H, W]
+    return jax.vmap(sample_one)(feats, sy, sx)
+
+
+def roi_pool(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0):
+    """Max-pool ROI features (phi roi_pool_kernel). Same sampled-grid
+    approximation as roi_align but with a max reduction."""
+    ph, pw = _ntuple(output_size, 2)
+    sr = 2
+    n, c, h, w = x.shape
+    r = boxes.shape[0]
+    if boxes_num is None:
+        batch_idx = jnp.zeros((r,), jnp.int32)
+    else:
+        batch_idx = jnp.searchsorted(
+            jnp.cumsum(jnp.asarray(boxes_num)), jnp.arange(r), side="right"
+        ).astype(jnp.int32)
+    boxes = jnp.round(boxes.astype(jnp.float32) * spatial_scale)
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    roi_w = jnp.maximum(x2 - x1 + 1, 1.0)
+    roi_h = jnp.maximum(y2 - y1 + 1, 1.0)
+    iy = jnp.arange(ph * sr)
+    ix = jnp.arange(pw * sr)
+    sy = y1[:, None] + (iy[None, :] + 0.5) / (ph * sr) * roi_h[:, None]
+    sx = x1[:, None] + (ix[None, :] + 0.5) / (pw * sr) * roi_w[:, None]
+
+    def sample_one(xi, syi, sxi):
+        iyg = jnp.clip(syi.astype(jnp.int32), 0, h - 1)
+        ixg = jnp.clip(sxi.astype(jnp.int32), 0, w - 1)
+        grid_y = jnp.broadcast_to(iyg[:, None], (ph * sr, pw * sr))
+        grid_x = jnp.broadcast_to(ixg[None, :], (ph * sr, pw * sr))
+        flat = xi.reshape(c, h * w)
+        lin = (grid_y * w + grid_x).reshape(-1)
+        vals = jnp.take(flat, lin, axis=1).reshape(c, ph * sr, pw * sr)
+        return jnp.max(vals.reshape(c, ph, sr, pw, sr), axis=(2, 4))
+
+    return jax.vmap(sample_one)(x[batch_idx], sy, sx)
+
+
+# ------------------------------------------------------- deformable conv
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None):
+    """Deformable conv v1/v2 (phi deformable_conv_kernel). Bilinear-samples
+    the input at offset-shifted taps, then a dense matmul with the weights —
+    the gather/matmul split keeps the FLOPs on the MXU."""
+    s = _ntuple(stride, 2)
+    p = _ntuple(padding, 2)
+    d = _ntuple(dilation, 2)
+    n, c, h, w = x.shape
+    oc, ic_g, kh, kw = weight.shape
+    oh = (h + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
+    ow = (w + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
+    # offset: [N, 2*dg*kh*kw, oh, ow] (y, x interleaved pairs, reference order)
+    off = offset.reshape(n, deformable_groups, kh * kw, 2, oh, ow)
+
+    base_y = (jnp.arange(oh) * s[0] - p[0])[:, None]  # [oh, 1]
+    base_x = (jnp.arange(ow) * s[1] - p[1])[None, :]  # [1, ow]
+    taps_y = jnp.repeat(jnp.arange(kh) * d[0], kw)     # [kh*kw]
+    taps_x = jnp.tile(jnp.arange(kw) * d[1], kh)       # [kh*kw]
+    ty = base_y[None] + taps_y[:, None, None]          # [kh*kw, oh, ow]
+    tx = base_x[None] + taps_x[:, None, None]
+
+    sy = ty[None, None] + off[:, :, :, 0]              # [N, dg, kh*kw, oh, ow]
+    sx = tx[None, None] + off[:, :, :, 1]
+
+    cg = c // deformable_groups
+
+    def bilinear(img, gy, gx):
+        # img: [cg, h, w]; gy/gx: [kh*kw, oh, ow] -> [cg, kh*kw, oh, ow]
+        x0 = jnp.floor(gx)
+        y0 = jnp.floor(gy)
+        wx1 = (gx - x0).astype(img.dtype)
+        wy1 = (gy - y0).astype(img.dtype)
+
+        def g(ixg, iyg):
+            v = ((ixg >= 0) & (ixg <= w - 1) & (iyg >= 0) & (iyg <= h - 1))
+            ixc = jnp.clip(ixg, 0, w - 1)
+            iyc = jnp.clip(iyg, 0, h - 1)
+            lin = (iyc * w + ixc).reshape(-1)
+            out = jnp.take(img.reshape(cg, h * w), lin, axis=1)
+            return out.reshape(cg, *gy.shape) * v.astype(img.dtype)
+
+        x0i, y0i = x0.astype(jnp.int32), y0.astype(jnp.int32)
+        return (g(x0i, y0i) * ((1 - wx1) * (1 - wy1))
+                + g(x0i + 1, y0i) * (wx1 * (1 - wy1))
+                + g(x0i, y0i + 1) * ((1 - wx1) * wy1)
+                + g(x0i + 1, y0i + 1) * (wx1 * wy1))
+
+    # [N, dg, cg, kh*kw, oh, ow]
+    cols = jax.vmap(  # over batch
+        jax.vmap(bilinear)  # over deformable groups
+    )(x.reshape(n, deformable_groups, cg, h, w), sy, sx)
+    if mask is not None:  # v2 modulation: [N, dg*kh*kw, oh, ow]
+        m = mask.reshape(n, deformable_groups, 1, kh * kw, oh, ow)
+        cols = cols * m.astype(cols.dtype)
+    cols = cols.reshape(n, c * kh * kw, oh * ow)
+    wmat = weight.reshape(groups, oc // groups, ic_g * kh * kw)
+    cols = cols.reshape(n, groups, ic_g * kh * kw, oh * ow)
+    out = jnp.einsum("goi,ngip->ngop", wmat, cols).reshape(n, oc, oh, ow)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+# ------------------------------------------------------------- misc vision
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
+    r = downscale_factor
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // r, r, w // r, r)
+    x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
+    return x.reshape(n, c * r * r, h // r, w // r)
+
+
+def channel_shuffle(x, groups, data_format="NCHW"):
+    n, c, h, w = x.shape
+    x = x.reshape(n, groups, c // groups, h, w)
+    return jnp.swapaxes(x, 1, 2).reshape(n, c, h, w)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """col2im — inverse of unfold (phi fold_kernel). x: [N, C*kh*kw, L]."""
+    oh, ow = _ntuple(output_sizes, 2)
+    k = _ntuple(kernel_sizes, 2)
+    s = _ntuple(strides, 2)
+    p = _ntuple(paddings, 2)
+    d = _ntuple(dilations, 2)
+    n, ckk, l = x.shape
+    c = ckk // (k[0] * k[1])
+    nh = (oh + 2 * p[0] - d[0] * (k[0] - 1) - 1) // s[0] + 1
+    nw = (ow + 2 * p[1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
+    cols = x.reshape(n, c, k[0], k[1], nh, nw)
+    padded = jnp.zeros((n, c, oh + 2 * p[0], ow + 2 * p[1]), x.dtype)
+
+    def add_tap(acc, tap):
+        i, j = tap
+        patch = cols[:, :, i, j]  # [n, c, nh, nw]
+        ys = i * d[0] + jnp.arange(nh) * s[0]
+        xs = j * d[1] + jnp.arange(nw) * s[1]
+        return acc.at[:, :, ys[:, None], xs[None, :]].add(patch)
+
+    for i in range(k[0]):
+        for j in range(k[1]):
+            padded = add_tap(padded, (i, j))
+    return padded[:, :, p[0]:p[0] + oh, p[1]:p[1] + ow]
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW"):
+    sq = jnp.square(x)
+    half = size // 2
+    pads = [(0, 0), (half, size - 1 - half), (0, 0), (0, 0)]
+    div = lax.reduce_window(sq, _np.zeros((), x.dtype), lax.add,
+                            (1, size, 1, 1), (1, 1, 1, 1), pads)
+    return x / (k + alpha * div) ** beta
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy NMS over [R, 4] boxes; returns kept indices sorted by score.
+    O(R^2) IoU matrix + sequential suppression via fori_loop (static shape;
+    the reference's phi nms_kernel is the same greedy algorithm)."""
+    r = boxes.shape[0]
+    if scores is None:
+        order = jnp.arange(r)
+    else:
+        order = jnp.argsort(-scores)
+    b = boxes[order]
+    x1, y1, x2, y2 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+    area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+    xx1 = jnp.maximum(x1[:, None], x1[None, :])
+    yy1 = jnp.maximum(y1[:, None], y1[None, :])
+    xx2 = jnp.minimum(x2[:, None], x2[None, :])
+    yy2 = jnp.minimum(y2[:, None], y2[None, :])
+    inter = jnp.maximum(xx2 - xx1, 0) * jnp.maximum(yy2 - yy1, 0)
+    iou = inter / jnp.maximum(area[:, None] + area[None, :] - inter, 1e-10)
+    if category_idxs is not None:
+        cat = category_idxs[order]
+        iou = jnp.where(cat[:, None] == cat[None, :], iou, 0.0)
+
+    def body(i, keep):
+        # suppress j > i overlapping a kept i
+        sup = keep[i] & (iou[i] > iou_threshold)
+        sup = sup & (jnp.arange(r) > i)
+        return keep & ~sup
+
+    keep = lax.fori_loop(0, r, body, jnp.ones((r,), bool))
+    # variable-length result: eager-only, like the reference op
+    kept = order[jnp.nonzero(keep, size=r, fill_value=-1)[0]]
+    kept = kept[: int(jnp.sum(keep))]
+    if top_k is not None:
+        kept = kept[:top_k]
+    return kept

@@ -29,17 +29,31 @@ def _rope_kernel(x_ref, cos_ref, sin_ref, o_ref, *, sign):
     o_ref[:] = (x * cos + sign * rot * sin).astype(o_ref.dtype)
 
 
+def _seq_block(s, h, d, itemsize):
+    """Largest seq chunk whose (block_s, h, d) block stays well under VMEM
+    (the whole (s, h, d) row of a long-context batch does not fit: 2048x16x128
+    bf16 is 8M per input before fp32 staging)."""
+    # fp32 staging + rot/concat temporaries + double buffering multiply the
+    # live block ~8x, so keep the raw operand block well under 1/8 of VMEM
+    budget = 512 * 1024  # per-operand block budget in bytes
+    for bs in (512, 256, 128, 64, 32, 16, 8):
+        if s % bs == 0 and bs * h * d * itemsize <= budget:
+            return bs
+    return s
+
+
 def _apply(x, cos, sin, sign, interpret):
     b, s, h, d = x.shape
+    bs = _seq_block(s, h, d, x.dtype.itemsize)
     return pl.pallas_call(
         functools.partial(_rope_kernel, sign=sign),
-        grid=(b,),
+        grid=(b, s // bs),
         in_specs=[
-            pl.BlockSpec((None, s, h, d), lambda i: (i, 0, 0, 0)),
-            pl.BlockSpec((s, d), lambda i: (0, 0)),
-            pl.BlockSpec((s, d), lambda i: (0, 0)),
+            pl.BlockSpec((None, bs, h, d), lambda i, j: (i, j, 0, 0)),
+            pl.BlockSpec((bs, d), lambda i, j: (j, 0)),
+            pl.BlockSpec((bs, d), lambda i, j: (j, 0)),
         ],
-        out_specs=pl.BlockSpec((None, s, h, d), lambda i: (i, 0, 0, 0)),
+        out_specs=pl.BlockSpec((None, bs, h, d), lambda i, j: (i, j, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((b, s, h, d), x.dtype),
         interpret=interpret,
     )(x, cos, sin)

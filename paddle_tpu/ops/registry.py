@@ -25,6 +25,7 @@ import functools
 import inspect
 import threading
 import types
+from collections import OrderedDict
 from typing import Callable, Dict, Optional, Sequence
 
 import jax
@@ -146,12 +147,17 @@ def _is_tensor(x):
 # reference's generated *_ad_func + cached phi kernels without the per-op
 # dispatch tax (SURVEY §3.1). Keys that cannot be compiled (data-dependent
 # output shapes, unhashable attrs) permanently fall back to op-by-op eager.
-_EXEC_CACHE: Dict[tuple, tuple] = {}
+# LRU-bounded (reference pattern: size-bounded autotune cache,
+# paddle/phi/kernels/autotune/cache.h): a shape-polymorphic eager workload
+# (variable seq lens) must not accumulate executables without bound.
+_EXEC_CACHE: "OrderedDict[tuple, tuple]" = OrderedDict()
 _FALLBACK_KEYS = set()
 _CACHE_LOCK = threading.Lock()
 
 flags.define_flag("eager_op_cache", True,
                   "cache jit-compiled executables for eager op dispatch")
+flags.define_flag("eager_op_cache_size", 4096,
+                  "max cached executables for eager dispatch (LRU eviction)")
 
 
 def _hashable(x):
@@ -210,7 +216,14 @@ def _build_cached(opdef, key, treedef, const_leaves, tensor_idx, primal_pos):
 
 
 def _dispatch_cached(opdef, key, leaves, treedef, tensor_idx, tensors, primal_pos):
+    # hit path stays lock-free: get/move_to_end are C-level (GIL-atomic);
+    # a lost recency bump under a racing evict is benign
     entry = _EXEC_CACHE.get(key)
+    if entry is not None:
+        try:
+            _EXEC_CACHE.move_to_end(key)
+        except KeyError:
+            pass
     if entry is None:
         const_leaves = [None if i in set(tensor_idx) else l
                         for i, l in enumerate(leaves)]
@@ -218,6 +231,10 @@ def _dispatch_cached(opdef, key, leaves, treedef, tensor_idx, tensors, primal_po
                               tuple(primal_pos))
         with _CACHE_LOCK:
             _EXEC_CACHE[key] = entry
+            _EXEC_CACHE.move_to_end(key)
+            limit = flags.get_flag("eager_op_cache_size")
+            while limit > 0 and len(_EXEC_CACHE) > limit:
+                _EXEC_CACHE.popitem(last=False)
 
     rng_seed = None
     if opdef.uses_rng:

@@ -1,0 +1,216 @@
+"""L-BFGS optimizer (reference: python/paddle/optimizer/lbfgs.py:1).
+
+Quasi-Newton with a bounded (s, y) history and two-loop recursion; optional
+strong-Wolfe line search. The algorithm is inherently sequential (closure
+re-evaluations with data-dependent step counts), so it runs eagerly on the
+host driving compiled loss/grad evaluations — the same split the reference
+uses (Python loop over C++ kernels).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .optimizer import Optimizer
+
+
+def _flatten(vals):
+    return jnp.concatenate([jnp.ravel(v).astype(jnp.float32) for v in vals])
+
+
+class LBFGS(Optimizer):
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate=learning_rate, parameters=parameters,
+                         weight_decay=weight_decay, grad_clip=grad_clip)
+        if max_eval is None:
+            max_eval = max_iter * 5 // 4
+        self._opts = dict(max_iter=max_iter, max_eval=max_eval,
+                          tolerance_grad=tolerance_grad,
+                          tolerance_change=tolerance_change,
+                          history_size=history_size,
+                          line_search_fn=line_search_fn)
+        self._hist_s: List = []
+        self._hist_y: List = []
+        self._rho: List = []
+        self._prev_flat_grad = None
+        self._d = None
+        self._t = None
+        self._n_iter = 0
+
+    # ---- packing ----------------------------------------------------------
+    def _gather_flat_grad(self):
+        gs = []
+        for p in self._parameter_list:
+            if p.grad is None:
+                gs.append(jnp.zeros(int(jnp.size(p._value)), jnp.float32))
+            else:
+                g = p.grad._value if isinstance(p.grad, Tensor) else p.grad
+                gs.append(jnp.ravel(g).astype(jnp.float32))
+        return jnp.concatenate(gs)
+
+    def _add_to_params(self, step_size, direction):
+        offset = 0
+        for p in self._parameter_list:
+            n = int(jnp.size(p._value))
+            upd = direction[offset:offset + n].reshape(p._value.shape)
+            p._value = (p._value.astype(jnp.float32)
+                        + step_size * upd).astype(p._value.dtype)
+            offset += n
+
+    def _clone_params(self):
+        return [p._value for p in self._parameter_list]
+
+    def _restore_params(self, saved):
+        for p, v in zip(self._parameter_list, saved):
+            p._value = v
+
+    # ---- two-loop recursion ------------------------------------------------
+    def _direction(self, flat_grad):
+        m = len(self._hist_s)
+        if m == 0:
+            return -flat_grad
+        q = -flat_grad
+        alphas = [None] * m
+        for i in range(m - 1, -1, -1):
+            alphas[i] = self._rho[i] * jnp.dot(self._hist_s[i], q)
+            q = q - alphas[i] * self._hist_y[i]
+        # initial Hessian scaling gamma = s·y / y·y
+        gamma = (jnp.dot(self._hist_s[-1], self._hist_y[-1])
+                 / jnp.maximum(jnp.dot(self._hist_y[-1], self._hist_y[-1]),
+                               1e-10))
+        r = q * gamma
+        for i in range(m):
+            beta = self._rho[i] * jnp.dot(self._hist_y[i], r)
+            r = r + (alphas[i] - beta) * self._hist_s[i]
+        return r
+
+    # ---- strong Wolfe line search ------------------------------------------
+    def _strong_wolfe(self, closure, d, loss0, g0, t0, c1=1e-4, c2=0.9,
+                      max_ls=25):
+        dg0 = float(jnp.dot(g0, d))
+        if dg0 >= 0:
+            return float(loss0), g0, 0.0
+        saved = self._clone_params()
+
+        def eval_at(t):
+            self._restore_params(saved)
+            self._add_to_params(t, d)
+            loss = closure()
+            g = self._gather_flat_grad()
+            return float(loss.item() if isinstance(loss, Tensor) else loss), g
+
+        t_prev, f_prev, g_prev = 0.0, float(loss0), g0
+        t = t0
+        f_new, g_new = eval_at(t)
+        for _ in range(max_ls):
+            dg_new = float(jnp.dot(g_new, d))
+            if f_new > float(loss0) + c1 * t * dg0 or f_new >= f_prev and t_prev > 0:
+                # zoom between t_prev and t
+                lo, hi = (t_prev, t) if f_prev <= f_new else (t, t_prev)
+                for _ in range(10):
+                    tm = 0.5 * (lo + hi)
+                    fm, gm = eval_at(tm)
+                    if fm > float(loss0) + c1 * tm * dg0:
+                        hi = tm
+                    else:
+                        dgm = float(jnp.dot(gm, d))
+                        if abs(dgm) <= -c2 * dg0:
+                            return fm, gm, tm
+                        if dgm * (hi - lo) >= 0:
+                            hi = lo
+                        lo = tm
+                fm, gm = eval_at(0.5 * (lo + hi))
+                return fm, gm, 0.5 * (lo + hi)
+            if abs(dg_new) <= -c2 * dg0:
+                return f_new, g_new, t
+            if dg_new >= 0:
+                lo, hi = t, t_prev
+                for _ in range(10):
+                    tm = 0.5 * (lo + hi)
+                    fm, gm = eval_at(tm)
+                    dgm = float(jnp.dot(gm, d))
+                    if fm > float(loss0) + c1 * tm * dg0:
+                        hi = tm
+                    elif abs(dgm) <= -c2 * dg0:
+                        return fm, gm, tm
+                    else:
+                        lo = tm
+                return fm, gm, 0.5 * (lo + hi)
+            t_prev, f_prev, g_prev = t, f_new, g_new
+            t = 2.0 * t
+            f_new, g_new = eval_at(t)
+        return f_new, g_new, t
+
+    # ---- step --------------------------------------------------------------
+    def step(self, closure=None):  # noqa: C901 — mirrors the reference loop
+        """closure: re-evaluates the model and returns the loss (with
+        backward() called inside, or grads already populated)."""
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure")
+        opts = self._opts
+        lr = self.get_lr()
+
+        def closure_with_grad():
+            self.clear_grad()
+            loss = closure()
+            return loss
+
+        loss = closure_with_grad()
+        loss_val = float(loss.item() if isinstance(loss, Tensor) else loss)
+        flat_grad = self._gather_flat_grad()
+        if float(jnp.max(jnp.abs(flat_grad))) <= opts["tolerance_grad"]:
+            return loss
+
+        n_evals = 1
+        for _ in range(opts["max_iter"]):
+            self._n_iter += 1
+            d = self._direction(flat_grad)
+            # first iteration: scale the step like the reference
+            t = (min(1.0, 1.0 / float(jnp.sum(jnp.abs(flat_grad)))) * lr
+                 if self._n_iter == 1 else lr)
+
+            gtd = float(jnp.dot(flat_grad, d))
+            if gtd > -opts["tolerance_change"]:
+                break
+
+            prev_flat_grad = flat_grad
+            prev_loss = loss_val
+            if opts["line_search_fn"] == "strong_wolfe":
+                loss_val, flat_grad, t = self._strong_wolfe(
+                    closure_with_grad, d, loss_val, flat_grad, t)
+                n_evals += 1
+            else:
+                self._add_to_params(t, d)
+                loss = closure_with_grad()
+                loss_val = float(loss.item() if isinstance(loss, Tensor) else loss)
+                flat_grad = self._gather_flat_grad()
+                n_evals += 1
+
+            # history update
+            s = t * d
+            y = flat_grad - prev_flat_grad
+            ys = float(jnp.dot(y, s))
+            if ys > 1e-10:
+                if len(self._hist_s) >= opts["history_size"]:
+                    self._hist_s.pop(0)
+                    self._hist_y.pop(0)
+                    self._rho.pop(0)
+                self._hist_s.append(s)
+                self._hist_y.append(y)
+                self._rho.append(1.0 / ys)
+
+            if n_evals >= opts["max_eval"]:
+                break
+            if float(jnp.max(jnp.abs(flat_grad))) <= opts["tolerance_grad"]:
+                break
+            if float(jnp.sum(jnp.abs(s))) <= opts["tolerance_change"]:
+                break
+            if abs(loss_val - prev_loss) < opts["tolerance_change"]:
+                break
+        self._step_count += 1
+        return Tensor(jnp.asarray(loss_val))

@@ -355,3 +355,104 @@ def name_scope(prefix=None):
         yield
 
     return _scope()
+
+
+# ---- static.nn control flow -------------------------------------------------
+def _unwrap_tree(out):
+    return jax.tree_util.tree_map(
+        lambda t: t._value if isinstance(t, Tensor) else t, out,
+        is_leaf=lambda t: isinstance(t, Tensor))
+
+
+def _value_fn(fn):
+    """Adapt a user Tensor-level callable to a value-level one."""
+    def vfn(*vals):
+        ts = [Tensor(v) for v in vals]
+        out = fn(*ts) if vals else fn()
+        return _unwrap_tree(out)
+
+    return vfn
+
+
+def _closure_tensors(*fns):
+    """Tensors captured by the callables' closures, deduped in order. These
+    become explicit operands of the staged control-flow op so gradients flow
+    to them (the reference's sub-block backward collects them the same way)."""
+    seen, out = set(), []
+    for fn in fns:
+        for cell in (getattr(fn, "__closure__", None) or ()):
+            try:
+                v = cell.cell_contents
+            except ValueError:
+                continue
+            if isinstance(v, Tensor) and id(v) not in seen:
+                seen.add(id(v))
+                out.append(v)
+    return out
+
+
+class _swapped:
+    """Temporarily rebind captured Tensors' values to traced operands."""
+
+    def __init__(self, tensors, vals):
+        self.tensors, self.vals = tensors, vals
+
+    def __enter__(self):
+        self.saved = [t._value for t in self.tensors]
+        for t, v in zip(self.tensors, self.vals):
+            t._value = v
+
+    def __exit__(self, *exc):
+        for t, v in zip(self.tensors, self.saved):
+            t._value = v
+
+
+def cond(pred, true_fn, false_fn, name=None):
+    """paddle.static.nn.cond — both branches staged into one lax.cond
+    (reference conditional_block_op; control_flow.py:cond). Differentiable,
+    including w.r.t. closure-captured tensors."""
+    from ..ops import api
+
+    caps = _closure_tensors(true_fn, false_fn)
+
+    def mk(fn):
+        def vfn(*vals):
+            with _swapped(caps, vals):
+                return _unwrap_tree(fn())
+
+        return vfn
+
+    return api.cond(pred, mk(true_fn), mk(false_fn), operands=tuple(caps))
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """paddle.static.nn.while_loop over lax.while_loop (reference while_op).
+    Forward-only (XLA while has no reverse-mode)."""
+    from ..ops import api
+
+    return api.while_loop(_value_fn(cond_fn), _value_fn(body_fn),
+                          [v for v in loop_vars])
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    from ..ops import api
+
+    pairs = [(p, _value_fn(f)) for p, f in pred_fn_pairs]
+    return api.case(pairs, _value_fn(default) if default else None)
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    from ..ops import api
+
+    if isinstance(branch_fns, dict):
+        fns = {k: _value_fn(f) for k, f in branch_fns.items()}
+    else:
+        fns = [(k, _value_fn(f)) for k, f in branch_fns]
+    return api.switch_case(branch_index, fns,
+                           _value_fn(default) if default else None)
+
+
+_StaticNN.cond = staticmethod(cond)
+_StaticNN.while_loop = staticmethod(while_loop)
+_StaticNN.case = staticmethod(case)
+_StaticNN.switch_case = staticmethod(switch_case)

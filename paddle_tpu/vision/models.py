@@ -162,3 +162,604 @@ def resnet101(pretrained=False, **kwargs):
 
 def resnet152(pretrained=False, **kwargs):
     return ResNet(BottleneckBlock, 152, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Round-3 zoo: VGG, AlexNet, MobileNetV1/V2/V3, SqueezeNet, DenseNet,
+# ShuffleNetV2, GoogLeNet (reference: python/paddle/vision/models/{vgg,
+# alexnet,mobilenetv1,mobilenetv2,mobilenetv3,squeezenet,densenet,
+# shufflenetv2,googlenet}.py). Same topologies, fresh layer-API builds.
+# ---------------------------------------------------------------------------
+class VGG(nn.Layer):
+    """Reference: vision/models/vgg.py:1."""
+
+    def __init__(self, features, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.features = features
+        self.with_pool = with_pool
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D((7, 7))
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(512 * 7 * 7, 4096), nn.ReLU(), nn.Dropout(),
+                nn.Linear(4096, 4096), nn.ReLU(), nn.Dropout(),
+                nn.Linear(4096, num_classes),
+            )
+        self.num_classes = num_classes
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+_VGG_CFGS = {
+    "A": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "B": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "D": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
+          512, 512, 512, "M"],
+    "E": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+          512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+def _vgg_features(cfg, batch_norm=False):
+    layers, in_c = [], 3
+    for v in cfg:
+        if v == "M":
+            layers.append(nn.MaxPool2D(2, 2))
+        else:
+            layers.append(nn.Conv2D(in_c, v, 3, padding=1))
+            if batch_norm:
+                layers.append(nn.BatchNorm2D(v))
+            layers.append(nn.ReLU())
+            in_c = v
+    return nn.Sequential(*layers)
+
+
+def _vgg(cfg, batch_norm, **kwargs):
+    return VGG(_vgg_features(_VGG_CFGS[cfg], batch_norm), **kwargs)
+
+
+def vgg11(pretrained=False, batch_norm=False, **kwargs):
+    return _vgg("A", batch_norm, **kwargs)
+
+
+def vgg13(pretrained=False, batch_norm=False, **kwargs):
+    return _vgg("B", batch_norm, **kwargs)
+
+
+def vgg16(pretrained=False, batch_norm=False, **kwargs):
+    return _vgg("D", batch_norm, **kwargs)
+
+
+def vgg19(pretrained=False, batch_norm=False, **kwargs):
+    return _vgg("E", batch_norm, **kwargs)
+
+
+class AlexNet(nn.Layer):
+    """Reference: vision/models/alexnet.py."""
+
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(3, 64, 11, stride=4, padding=2), nn.ReLU(),
+            nn.MaxPool2D(3, 2),
+            nn.Conv2D(64, 192, 5, padding=2), nn.ReLU(),
+            nn.MaxPool2D(3, 2),
+            nn.Conv2D(192, 384, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(384, 256, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(256, 256, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(3, 2),
+        )
+        self.avgpool = nn.AdaptiveAvgPool2D((6, 6))
+        self.classifier = nn.Sequential(
+            nn.Dropout(), nn.Linear(256 * 6 * 6, 4096), nn.ReLU(),
+            nn.Dropout(), nn.Linear(4096, 4096), nn.ReLU(),
+            nn.Linear(4096, num_classes),
+        )
+
+    def forward(self, x):
+        x = self.avgpool(self.features(x))
+        return self.classifier(x.flatten(1))
+
+
+def alexnet(pretrained=False, **kwargs):
+    return AlexNet(**kwargs)
+
+
+class _ConvBNReLU(nn.Sequential):
+    def __init__(self, in_c, out_c, k, stride=1, groups=1, act=nn.ReLU6):
+        pad = (k - 1) // 2
+        layers = [nn.Conv2D(in_c, out_c, k, stride=stride, padding=pad,
+                            groups=groups, bias_attr=False),
+                  nn.BatchNorm2D(out_c)]
+        if act is not None:
+            layers.append(act())
+        super().__init__(*layers)
+
+
+class MobileNetV1(nn.Layer):
+    """Reference: vision/models/mobilenetv1.py — depthwise-separable stacks."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return max(8, int(ch * scale))
+
+        cfg = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+               (256, 256, 1), (256, 512, 2)] + [(512, 512, 1)] * 5 + \
+              [(512, 1024, 2), (1024, 1024, 1)]
+        layers = [_ConvBNReLU(3, c(32), 3, stride=2, act=nn.ReLU)]
+        for in_c, out_c, s in cfg:
+            layers.append(_ConvBNReLU(c(in_c), c(in_c), 3, stride=s,
+                                      groups=c(in_c), act=nn.ReLU))
+            layers.append(_ConvBNReLU(c(in_c), c(out_c), 1, act=nn.ReLU))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, inp, oup, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(inp * expand_ratio))
+        self.use_res = stride == 1 and inp == oup
+        layers = []
+        if expand_ratio != 1:
+            layers.append(_ConvBNReLU(inp, hidden, 1))
+        layers += [
+            _ConvBNReLU(hidden, hidden, 3, stride=stride, groups=hidden),
+            nn.Conv2D(hidden, oup, 1, bias_attr=False),
+            nn.BatchNorm2D(oup),
+        ]
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(nn.Layer):
+    """Reference: vision/models/mobilenetv2.py:1."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+               (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        in_c = max(8, int(32 * scale))
+        last_c = max(8, int(1280 * max(1.0, scale)))
+        layers = [_ConvBNReLU(3, in_c, 3, stride=2)]
+        for t, c_, n, s in cfg:
+            out_c = max(8, int(c_ * scale))
+            for i in range(n):
+                layers.append(InvertedResidual(in_c, out_c,
+                                               s if i == 0 else 1, t))
+                in_c = out_c
+        layers.append(_ConvBNReLU(in_c, last_c, 1))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(nn.Dropout(0.2),
+                                            nn.Linear(last_c, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV2(scale=scale, **kwargs)
+
+
+class _SqueezeExcite(nn.Layer):
+    def __init__(self, ch, squeeze):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(ch, squeeze, 1)
+        self.fc2 = nn.Conv2D(squeeze, ch, 1)
+
+    def forward(self, x):
+        s = self.pool(x)
+        s = nn.functional.relu(self.fc1(s))
+        s = nn.functional.hardsigmoid(self.fc2(s))
+        return x * s
+
+
+class _MBV3Block(nn.Layer):
+    def __init__(self, inp, hidden, oup, k, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and inp == oup
+        layers = []
+        if hidden != inp:
+            layers.append(_ConvBNReLU(inp, hidden, 1, act=act))
+        layers.append(_ConvBNReLU(hidden, hidden, k, stride=stride,
+                                  groups=hidden, act=act))
+        if use_se:
+            layers.append(_SqueezeExcite(hidden, max(8, hidden // 4)))
+        layers += [nn.Conv2D(hidden, oup, 1, bias_attr=False),
+                   nn.BatchNorm2D(oup)]
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV3Small(nn.Layer):
+    """Reference: vision/models/mobilenetv3.py (small config)."""
+
+    CFG = [
+        # k, hidden, out, se, act, stride
+        (3, 16, 16, True, nn.ReLU, 2),
+        (3, 72, 24, False, nn.ReLU, 2),
+        (3, 88, 24, False, nn.ReLU, 1),
+        (5, 96, 40, True, nn.Hardswish, 2),
+        (5, 240, 40, True, nn.Hardswish, 1),
+        (5, 240, 40, True, nn.Hardswish, 1),
+        (5, 120, 48, True, nn.Hardswish, 1),
+        (5, 144, 48, True, nn.Hardswish, 1),
+        (5, 288, 96, True, nn.Hardswish, 2),
+        (5, 576, 96, True, nn.Hardswish, 1),
+        (5, 576, 96, True, nn.Hardswish, 1),
+    ]
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return max(8, int(ch * scale))
+
+        layers = [_ConvBNReLU(3, c(16), 3, stride=2, act=nn.Hardswish)]
+        in_c = c(16)
+        for k, hid, out, se, act, s in self.CFG:
+            layers.append(_MBV3Block(in_c, c(hid), c(out), k, s, se, act))
+            in_c = c(out)
+        layers.append(_ConvBNReLU(in_c, c(576), 1, act=nn.Hardswish))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(c(576), 1024), nn.Hardswish(), nn.Dropout(0.2),
+                nn.Linear(1024, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+class MobileNetV3Large(MobileNetV3Small):
+    """Reference: vision/models/mobilenetv3.py (large config)."""
+
+    CFG = [
+        (3, 16, 16, False, nn.ReLU, 1),
+        (3, 64, 24, False, nn.ReLU, 2),
+        (3, 72, 24, False, nn.ReLU, 1),
+        (5, 72, 40, True, nn.ReLU, 2),
+        (5, 120, 40, True, nn.ReLU, 1),
+        (5, 120, 40, True, nn.ReLU, 1),
+        (3, 240, 80, False, nn.Hardswish, 2),
+        (3, 200, 80, False, nn.Hardswish, 1),
+        (3, 184, 80, False, nn.Hardswish, 1),
+        (3, 184, 80, False, nn.Hardswish, 1),
+        (3, 480, 112, True, nn.Hardswish, 1),
+        (3, 672, 112, True, nn.Hardswish, 1),
+        (5, 672, 160, True, nn.Hardswish, 2),
+        (5, 960, 160, True, nn.Hardswish, 1),
+        (5, 960, 160, True, nn.Hardswish, 1),
+    ]
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        nn.Layer.__init__(self)
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return max(8, int(ch * scale))
+
+        layers = [_ConvBNReLU(3, c(16), 3, stride=2, act=nn.Hardswish)]
+        in_c = c(16)
+        for k, hid, out, se, act, s in self.CFG:
+            layers.append(_MBV3Block(in_c, c(hid), c(out), k, s, se, act))
+            in_c = c(out)
+        layers.append(_ConvBNReLU(in_c, c(960), 1, act=nn.Hardswish))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(c(960), 1280), nn.Hardswish(), nn.Dropout(0.2),
+                nn.Linear(1280, num_classes))
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Large(scale=scale, **kwargs)
+
+
+class _Fire(nn.Layer):
+    def __init__(self, in_c, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = nn.Conv2D(in_c, squeeze, 1)
+        self.e1 = nn.Conv2D(squeeze, e1, 1)
+        self.e3 = nn.Conv2D(squeeze, e3, 3, padding=1)
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+
+        x = nn.functional.relu(self.squeeze(x))
+        return paddle.concat([nn.functional.relu(self.e1(x)),
+                              nn.functional.relu(self.e3(x))], axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    """Reference: vision/models/squeezenet.py (1.1 topology)."""
+
+    def __init__(self, version="1.1", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.features = nn.Sequential(
+            nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(), nn.MaxPool2D(3, 2),
+            _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64), nn.MaxPool2D(3, 2),
+            _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+            nn.MaxPool2D(3, 2),
+            _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+            _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256),
+        )
+        self.classifier = nn.Sequential(
+            nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1), nn.ReLU(),
+            nn.AdaptiveAvgPool2D(1))
+
+    def forward(self, x):
+        x = self.classifier(self.features(x))
+        return x.flatten(1)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    return SqueezeNet(version="1.1", **kwargs)
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, in_c, growth, bn_size):
+        super().__init__()
+        self.bn1 = nn.BatchNorm2D(in_c)
+        self.conv1 = nn.Conv2D(in_c, bn_size * growth, 1, bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(bn_size * growth)
+        self.conv2 = nn.Conv2D(bn_size * growth, growth, 3, padding=1,
+                               bias_attr=False)
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+
+        out = self.conv1(nn.functional.relu(self.bn1(x)))
+        out = self.conv2(nn.functional.relu(self.bn2(out)))
+        return paddle.concat([x, out], axis=1)
+
+
+class DenseNet(nn.Layer):
+    """Reference: vision/models/densenet.py:1."""
+
+    def __init__(self, layers=121, growth_rate=32, bn_size=4,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        block_cfg = {121: (6, 12, 24, 16), 161: (6, 12, 36, 24),
+                     169: (6, 12, 32, 32), 201: (6, 12, 48, 32)}[layers]
+        num_init = 2 * growth_rate
+        feats = [nn.Conv2D(3, num_init, 7, stride=2, padding=3, bias_attr=False),
+                 nn.BatchNorm2D(num_init), nn.ReLU(), nn.MaxPool2D(3, 2, 1)]
+        ch = num_init
+        for i, n in enumerate(block_cfg):
+            for _ in range(n):
+                feats.append(_DenseLayer(ch, growth_rate, bn_size))
+                ch += growth_rate
+            if i != len(block_cfg) - 1:
+                feats += [nn.BatchNorm2D(ch), nn.ReLU(),
+                          nn.Conv2D(ch, ch // 2, 1, bias_attr=False),
+                          nn.AvgPool2D(2, 2)]
+                ch //= 2
+        feats += [nn.BatchNorm2D(ch), nn.ReLU()]
+        self.features = nn.Sequential(*feats)
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+def densenet121(pretrained=False, **kwargs):
+    return DenseNet(layers=121, **kwargs)
+
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, in_c, out_c, stride):
+        super().__init__()
+        self.stride = stride
+        branch = out_c // 2
+        if stride == 2:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(in_c, in_c, 3, stride=2, padding=1, groups=in_c,
+                          bias_attr=False),
+                nn.BatchNorm2D(in_c),
+                nn.Conv2D(in_c, branch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch), nn.ReLU())
+            b2_in = in_c
+        else:
+            self.branch1 = None
+            b2_in = in_c // 2
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(b2_in, branch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch), nn.ReLU(),
+            nn.Conv2D(branch, branch, 3, stride=stride, padding=1,
+                      groups=branch, bias_attr=False),
+            nn.BatchNorm2D(branch),
+            nn.Conv2D(branch, branch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch), nn.ReLU())
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+
+        if self.stride == 1:
+            half = x.shape[1] // 2
+            x1, x2 = x[:, :half], x[:, half:]
+            out = paddle.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = paddle.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return nn.functional.channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    """Reference: vision/models/shufflenetv2.py:1."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        stage_out = {0.5: [24, 48, 96, 192, 1024],
+                     1.0: [24, 116, 232, 464, 1024],
+                     1.5: [24, 176, 352, 704, 1024],
+                     2.0: [24, 244, 488, 976, 2048]}[scale]
+        repeats = [4, 8, 4]
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, stage_out[0], 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(stage_out[0]), nn.ReLU())
+        self.maxpool = nn.MaxPool2D(3, 2, 1)
+        stages = []
+        in_c = stage_out[0]
+        for out_c, n in zip(stage_out[1:4], repeats):
+            units = [_ShuffleUnit(in_c, out_c, 2)]
+            units += [_ShuffleUnit(out_c, out_c, 1) for _ in range(n - 1)]
+            stages.append(nn.Sequential(*units))
+            in_c = out_c
+        self.stages = nn.LayerList(stages)
+        self.conv5 = nn.Sequential(
+            nn.Conv2D(in_c, stage_out[4], 1, bias_attr=False),
+            nn.BatchNorm2D(stage_out[4]), nn.ReLU())
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(stage_out[4], num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.conv1(x))
+        for s in self.stages:
+            x = s(x)
+        x = self.conv5(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, **kwargs)
+
+
+class _Inception(nn.Layer):
+    def __init__(self, in_c, c1, c3r, c3, c5r, c5, pool_proj):
+        super().__init__()
+        self.b1 = nn.Sequential(nn.Conv2D(in_c, c1, 1), nn.ReLU())
+        self.b2 = nn.Sequential(nn.Conv2D(in_c, c3r, 1), nn.ReLU(),
+                                nn.Conv2D(c3r, c3, 3, padding=1), nn.ReLU())
+        self.b3 = nn.Sequential(nn.Conv2D(in_c, c5r, 1), nn.ReLU(),
+                                nn.Conv2D(c5r, c5, 5, padding=2), nn.ReLU())
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, 1, 1),
+                                nn.Conv2D(in_c, pool_proj, 1), nn.ReLU())
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+
+        return paddle.concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)],
+                             axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    """Reference: vision/models/googlenet.py:1 (inference branches only)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, 64, 7, stride=2, padding=3), nn.ReLU(),
+            nn.MaxPool2D(3, 2, 1),
+            nn.Conv2D(64, 64, 1), nn.ReLU(),
+            nn.Conv2D(64, 192, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(3, 2, 1))
+        self.i3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, 2, 1)
+        self.i4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, 2, 1)
+        self.i5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool5 = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.2)
+            self.fc = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.i3b(self.i3a(x)))
+        x = self.pool4(self.i4e(self.i4d(self.i4c(self.i4b(self.i4a(x))))))
+        x = self.i5b(self.i5a(x))
+        if self.with_pool:
+            x = self.pool5(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(x.flatten(1)))
+        return x
+
+
+def googlenet(pretrained=False, **kwargs):
+    return GoogLeNet(**kwargs)

@@ -1,0 +1,66 @@
+"""paddle.vision.ops analog (reference: python/paddle/vision/ops.py —
+roi_align, roi_pool, deform_conv2d/DeformConv2D, nms, box utilities)."""
+from __future__ import annotations
+
+from ..nn.layer import Layer
+from ..ops.api import (  # noqa: F401
+    deform_conv2d,
+    nms,
+    roi_align,
+    roi_pool,
+)
+
+
+class DeformConv2D(Layer):
+    """Deformable convolution layer (reference vision/ops.py DeformConv2D);
+    v2 (modulated) when a mask is passed to forward."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        ks = kernel_size if isinstance(kernel_size, (list, tuple)) else (kernel_size,) * 2
+        self._attrs = (stride, padding, dilation, deformable_groups, groups)
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, *ks], attr=weight_attr)
+        self.bias = (None if bias_attr is False
+                     else self.create_parameter([out_channels], is_bias=True))
+
+    def forward(self, x, offset, mask=None):
+        s, p, d, dg, g = self._attrs
+        return deform_conv2d(x, offset, self.weight, self.bias, s, p, d, dg,
+                             g, mask)
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True):
+    """Encode/decode boxes against priors (reference phi box_coder kernel)."""
+    import jax.numpy as jnp
+
+    pb = prior_box
+    pw = pb[:, 2] - pb[:, 0] + (0.0 if box_normalized else 1.0)
+    ph = pb[:, 3] - pb[:, 1] + (0.0 if box_normalized else 1.0)
+    px = pb[:, 0] + pw * 0.5
+    py = pb[:, 1] + ph * 0.5
+    if code_type == "encode_center_size":
+        tb = target_box
+        tw = tb[:, 2] - tb[:, 0] + (0.0 if box_normalized else 1.0)
+        th = tb[:, 3] - tb[:, 1] + (0.0 if box_normalized else 1.0)
+        tx = tb[:, 0] + tw * 0.5
+        ty = tb[:, 1] + th * 0.5
+        out = jnp.stack([(tx - px) / pw, (ty - py) / ph,
+                         jnp.log(tw / pw), jnp.log(th / ph)], axis=1)
+        if prior_box_var is not None:
+            out = out / prior_box_var
+        return out
+    # decode_center_size
+    tb = target_box
+    if prior_box_var is not None:
+        tb = tb * prior_box_var
+    ox = tb[..., 0] * pw + px
+    oy = tb[..., 1] * ph + py
+    ow = jnp.exp(tb[..., 2]) * pw
+    oh = jnp.exp(tb[..., 3]) * ph
+    return jnp.stack([ox - ow * 0.5, oy - oh * 0.5,
+                      ox + ow * 0.5 - (0.0 if box_normalized else 1.0),
+                      oy + oh * 0.5 - (0.0 if box_normalized else 1.0)], axis=-1)

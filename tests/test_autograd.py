@@ -9,6 +9,12 @@ from paddle_tpu.ops import api
 from op_test import check_grad
 
 
+# seed before the parametrize tables are built at import: collection-order
+# changes must not reroll the test inputs (fp32 finite differences are only
+# within tolerance for moderate draws)
+np.random.seed(1234)
+
+
 def _f32(*shape):
     return np.random.randn(*shape).astype(np.float32)
 

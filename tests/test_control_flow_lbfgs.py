@@ -1,0 +1,155 @@
+"""Control-flow op + LBFGS tests.
+
+Reference models: test/legacy_test/test_cond.py, test_while_loop_op.py,
+test_lbfgs.py (SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestCond:
+    def test_values_both_branches(self):
+        def run(flag):
+            x = paddle.to_tensor(np.float32(3.0))
+            return paddle.static.nn.cond(
+                paddle.to_tensor(flag), lambda: x * 2, lambda: x - 1)
+
+        assert float(run(True).item()) == 6.0
+        assert float(run(False).item()) == 2.0
+
+    def test_grad_through_closure(self):
+        x = paddle.to_tensor(np.float32(3.0), stop_gradient=False)
+        out = paddle.static.nn.cond(paddle.to_tensor(True),
+                                    lambda: x * x, lambda: x * 3)
+        out.backward()
+        assert float(x.grad.item()) == pytest.approx(6.0)
+
+    def test_grad_false_branch(self):
+        x = paddle.to_tensor(np.float32(3.0), stop_gradient=False)
+        out = paddle.static.nn.cond(paddle.to_tensor(False),
+                                    lambda: x * x, lambda: x * 3)
+        out.backward()
+        assert float(x.grad.item()) == pytest.approx(3.0)
+
+    def test_pytree_outputs(self):
+        x = paddle.to_tensor(np.float32(2.0))
+        a, b = paddle.static.nn.cond(paddle.to_tensor(True),
+                                     lambda: (x + 1, x + 2),
+                                     lambda: (x - 1, x - 2))
+        assert float(a.item()) == 3.0 and float(b.item()) == 4.0
+
+    def test_inside_jit(self):
+        # staged: the whole cond traces into one program
+        import paddle_tpu.jit as jit
+
+        @jit.to_static
+        def f(x):
+            return paddle.static.nn.cond(
+                (x.sum() > 0), lambda: x * 2, lambda: x * -1)
+
+        xs = paddle.to_tensor(np.ones(4, np.float32))
+        np.testing.assert_allclose(np.asarray(f(xs)._value), 2 * np.ones(4))
+        xneg = paddle.to_tensor(-np.ones(4, np.float32))
+        np.testing.assert_allclose(np.asarray(f(xneg)._value), np.ones(4))
+
+
+class TestWhileLoop:
+    def test_counts(self):
+        i = paddle.to_tensor(np.int32(0))
+        s = paddle.to_tensor(np.float32(0))
+        iv, sv = paddle.static.nn.while_loop(
+            lambda i, s: i < 7, lambda i, s: [i + 1, s + 3.0], [i, s])
+        assert int(iv.item()) == 7
+        assert float(sv.item()) == pytest.approx(21.0)
+
+    def test_matrix_state(self):
+        # power iteration step count via while_loop
+        a = paddle.to_tensor(np.eye(3, dtype=np.float32) * 2)
+        k = paddle.to_tensor(np.int32(0))
+        m = paddle.to_tensor(np.eye(3, dtype=np.float32))
+        kv, mv = paddle.static.nn.while_loop(
+            lambda k, m: k < 4, lambda k, m: [k + 1, m @ a], [k, m])
+        np.testing.assert_allclose(np.asarray(mv._value),
+                                   np.eye(3) * 16, atol=1e-5)
+
+
+class TestCaseSwitch:
+    def test_switch_case(self):
+        x = paddle.to_tensor(np.float32(3.0))
+
+        def run(i):
+            return paddle.static.nn.switch_case(
+                paddle.to_tensor(np.int32(i)),
+                {0: lambda: x * 0, 1: lambda: x * 10, 3: lambda: x + 1})
+
+        assert float(run(1).item()) == 30.0
+        assert float(run(3).item()) == 4.0
+        # miss with no default -> last branch (reference semantics)
+        assert float(run(7).item()) == 4.0
+
+    def test_case_first_true_wins(self):
+        x = paddle.to_tensor(np.float32(5.0))
+        out = paddle.static.nn.case(
+            [(paddle.to_tensor(False), lambda: x * 0),
+             (paddle.to_tensor(True), lambda: x * 2),
+             (paddle.to_tensor(True), lambda: x * 9)],
+            default=lambda: x)
+        assert float(out.item()) == 10.0
+
+    def test_case_default(self):
+        x = paddle.to_tensor(np.float32(5.0))
+        out = paddle.static.nn.case(
+            [(paddle.to_tensor(False), lambda: x * 0)], default=lambda: x + 1)
+        assert float(out.item()) == 6.0
+
+
+class TestLBFGS:
+    def test_quadratic(self):
+        rng = np.random.default_rng(0)
+        A = paddle.to_tensor(rng.standard_normal((10, 4)).astype(np.float32))
+        b = paddle.to_tensor(rng.standard_normal((10,)).astype(np.float32))
+        x = paddle.to_tensor(np.zeros(4, np.float32), stop_gradient=False)
+        opt = paddle.optimizer.LBFGS(learning_rate=1.0, max_iter=30,
+                                     line_search_fn="strong_wolfe",
+                                     parameters=[x])
+
+        def closure():
+            loss = ((A @ x - b) ** 2).sum()
+            loss.backward()
+            return loss
+
+        for _ in range(3):
+            opt.step(closure)
+        ref = np.linalg.lstsq(np.asarray(A._value), np.asarray(b._value),
+                              rcond=None)[0]
+        np.testing.assert_allclose(np.asarray(x._value), ref, atol=1e-3)
+
+    def test_rosenbrock(self):
+        p = paddle.to_tensor(np.array([-1.0, 1.0], np.float32),
+                             stop_gradient=False)
+        opt = paddle.optimizer.LBFGS(max_iter=100,
+                                     line_search_fn="strong_wolfe",
+                                     parameters=[p])
+
+        def closure():
+            loss = (1 - p[0]) ** 2 + 100 * (p[1] - p[0] ** 2) ** 2
+            loss.backward()
+            return loss
+
+        for _ in range(5):
+            loss = opt.step(closure)
+        assert float(loss.item()) < 1e-4
+
+    def test_no_line_search(self):
+        x = paddle.to_tensor(np.array([4.0], np.float32), stop_gradient=False)
+        opt = paddle.optimizer.LBFGS(learning_rate=0.5, max_iter=20,
+                                     parameters=[x])
+
+        def closure():
+            loss = (x ** 2).sum()
+            loss.backward()
+            return loss
+
+        loss = opt.step(closure)
+        assert float(loss.item()) < 1.0

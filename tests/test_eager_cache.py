@@ -93,3 +93,31 @@ def test_dispatch_overhead_vs_raw_jit():
     assert ratio < 2.5, (
         f"eager dispatch {per_dispatch*1e6:.1f}us vs raw jit "
         f"{per_raw*1e6:.1f}us (ratio {ratio:.2f}) — cache regression")
+
+
+def test_exec_cache_lru_bound():
+    """FLAGS_eager_op_cache_size bounds the executable cache with LRU
+    eviction (reference: size-bounded autotune cache, phi autotune/cache.h)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.ops import registry
+
+    old = paddle.get_flags("eager_op_cache_size")["eager_op_cache_size"]
+    with registry._CACHE_LOCK:
+        registry._EXEC_CACHE.clear()
+    paddle.set_flags({"eager_op_cache_size": 4})
+    try:
+        for n in range(1, 8):  # 7 distinct shape keys
+            x = paddle.to_tensor(np.ones((n,), np.float32))
+            (x + x).numpy()
+        assert len(registry._EXEC_CACHE) <= 4
+        # most-recent key stays cached across a new insert; oldest evicted
+        keys_before = list(registry._EXEC_CACHE)
+        x = paddle.to_tensor(np.ones((9,), np.float32))
+        (x + x).numpy()
+        keys_after = list(registry._EXEC_CACHE)
+        assert keys_before[-1] in keys_after
+        assert keys_before[0] not in keys_after
+    finally:
+        paddle.set_flags({"eager_op_cache_size": old})

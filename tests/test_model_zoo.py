@@ -1,0 +1,82 @@
+"""Vision model zoo tests: forward shapes + one train step.
+
+Reference model: test/legacy_test/test_vision_models.py (build each family,
+check logits shape; SURVEY.md §4). Small scales/inputs keep the CPU suite
+fast — topology, not capacity, is what's under test."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models as M
+
+BUILDERS = [
+    ("mobilenet_v1", lambda: M.mobilenet_v1(scale=0.25, num_classes=7)),
+    ("mobilenet_v2", lambda: M.mobilenet_v2(scale=0.25, num_classes=7)),
+    ("mobilenet_v3_small", lambda: M.mobilenet_v3_small(scale=0.5, num_classes=7)),
+    ("squeezenet1_1", lambda: M.squeezenet1_1(num_classes=7)),
+    ("shufflenet_v2", lambda: M.shufflenet_v2_x1_0(num_classes=7)),
+]
+
+
+@pytest.mark.parametrize("name,builder", BUILDERS)
+def test_forward_shape(name, builder):
+    m = builder()
+    m.eval()
+    x = paddle.to_tensor(np.random.randn(2, 3, 64, 64).astype(np.float32))
+    out = m(x)
+    assert tuple(out.shape) == (2, 7)
+
+
+def test_vgg_alexnet_shapes():
+    x = paddle.to_tensor(np.random.randn(1, 3, 64, 64).astype(np.float32))
+    m = M.vgg11(num_classes=5)
+    m.eval()
+    assert tuple(m(x).shape) == (1, 5)
+    m2 = M.alexnet(num_classes=5)
+    m2.eval()
+    assert tuple(m2(x).shape) == (1, 5)
+
+
+def test_densenet_googlenet_shapes():
+    x = paddle.to_tensor(np.random.randn(1, 3, 64, 64).astype(np.float32))
+    m = M.DenseNet(layers=121, growth_rate=8, num_classes=5)
+    m.eval()
+    assert tuple(m(x).shape) == (1, 5)
+    g = M.googlenet(num_classes=5)
+    g.eval()
+    assert tuple(g(x).shape) == (1, 5)
+
+
+def test_train_step_mobilenet():
+    """One SGD step reduces loss on a fixed batch (hapi-style trainability)."""
+    paddle.seed(0)
+    m = M.mobilenet_v2(scale=0.25, num_classes=4)
+    m.train()
+    opt = paddle.optimizer.SGD(0.05, parameters=m.parameters())
+    x = paddle.to_tensor(np.random.randn(4, 3, 32, 32).astype(np.float32))
+    y = paddle.to_tensor(np.array([0, 1, 2, 3], np.int64))
+    ce = paddle.nn.CrossEntropyLoss()
+
+    losses = []
+    for _ in range(3):
+        loss = ce(m(x), y)
+        losses.append(float(loss.item()))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert losses[-1] < losses[0]
+
+
+def test_hapi_fit_squeezenet():
+    """Model.fit drives a zoo model end to end (hapi integration)."""
+    from paddle_tpu.io import DataLoader, TensorDataset
+
+    paddle.seed(0)
+    xs = np.random.randn(16, 3, 32, 32).astype(np.float32)
+    ys = np.random.randint(0, 3, (16, 1)).astype(np.int64)
+    ds = TensorDataset([paddle.to_tensor(xs), paddle.to_tensor(ys)])
+    model = paddle.Model(M.squeezenet1_1(num_classes=3))
+    model.prepare(optimizer=paddle.optimizer.Adam(1e-3, parameters=model.parameters()),
+                  loss=paddle.nn.CrossEntropyLoss())
+    hist = model.fit(ds, batch_size=8, epochs=1, verbose=0)
+    assert np.isfinite(hist["loss"][0])

@@ -53,20 +53,58 @@ class SharedLayerDesc(LayerDesc):
 
 class PipelineLayer(Layer):
     """Reference: parallel_layers/pp_layers.py PipeLayer — the full layer list
-    plus a segmentation into `num_stages` stages."""
+    plus a segmentation into `num_stages` stages.
+
+    `num_virtual_pipeline_stages` V > 1 segments into num_stages*V chunks for
+    the interleaved schedule (reference PipelineParallelWithInterleave).
+
+    A SharedLayerDesc at the FIRST position paired with one of the same key at
+    the LAST position expresses tied embedding+head across stages (reference
+    pp_layers.py shared-weight groups): ONE layer instance is built, runs as a
+    pre-step on the first stage and (via `forward_func`) as the head on the
+    last, with its weights replicated over 'pp' and grads all-reduced by the
+    schedule engine."""
 
     def __init__(self, layers, num_stages=1, topology=None, loss_fn=None,
-                 seg_method="uniform", recompute_interval=0, **kwargs):
+                 seg_method="uniform", recompute_interval=0,
+                 num_virtual_pipeline_stages=1, **kwargs):
         super().__init__()
         from ...nn.container import LayerList
 
         self._loss_fn = loss_fn
         self._num_stages = num_stages
+        self._num_virtual = num_virtual_pipeline_stages
         self._recompute_interval = recompute_interval
+
+        descs = list(layers)
+        self.shared_pre = None           # Layer run before stage 0
+        self.shared_post = None          # (Layer, forward_func) head on last stage
+        shared_built = {}
+        if descs and isinstance(descs[0], SharedLayerDesc):
+            pre_desc = descs.pop(0)
+            self.shared_pre = pre_desc.build_layer()
+            shared_built[pre_desc.layer_name] = self.shared_pre
+            self.add_sublayer("shared_pre", self.shared_pre)
+        if descs and isinstance(descs[-1], SharedLayerDesc):
+            post_desc = descs.pop(-1)
+            layer = shared_built.get(post_desc.layer_name)
+            if layer is None:
+                layer = post_desc.build_layer()
+                self.add_sublayer("shared_post_layer", layer)
+            fwd = post_desc.forward_func
+            if fwd is None:
+                attr = post_desc.shared_weight_attr
+                def fwd(l, x, _attr=attr):
+                    from ...ops import api
+                    return api.matmul(x, getattr(l, _attr), transpose_y=True)
+            self.shared_post = (layer, fwd)
+
         built = []
-        for desc in layers:
+        for desc in descs:
             built.append(desc.build_layer() if isinstance(desc, LayerDesc) else desc)
         self.run_function = LayerList(built)
+        num_stages = num_stages * num_virtual_pipeline_stages  # total segments
+        self._num_segments = num_stages
         n = len(built)
         if seg_method.startswith("layer:"):
             # segment at layers of the named class (reference seg_method)
@@ -84,19 +122,36 @@ class PipelineLayer(Layer):
             self._stage_bounds = [(i * per, min((i + 1) * per, n)) for i in range(num_stages)]
 
     def forward(self, x):
+        if self.shared_pre is not None:
+            x = self.shared_pre(x)
         for layer in self.run_function:
             x = layer(x)
+        if self.shared_post is not None:
+            layer, fwd = self.shared_post
+            x = fwd(layer, x)
         return x
 
     def get_stage_layers(self, stage_id):
         lo, hi = self._stage_bounds[stage_id]
         return list(self.run_function)[lo:hi]
 
+    def shared_parameters(self):
+        seen, out = set(), []
+        if self.shared_pre is not None:
+            for p in self.shared_pre.parameters():
+                if id(p) not in seen:
+                    seen.add(id(p)); out.append(p)
+        if self.shared_post is not None:
+            for p in self.shared_post[0].parameters():
+                if id(p) not in seen:
+                    seen.add(id(p)); out.append(p)
+        return out
+
     def stages_are_homogeneous(self) -> bool:
         """True when every stage has the same layer-class sequence and param
         shapes — the precondition for the SPMD pipeline engines."""
         sigs = []
-        for s in range(self._num_stages):
+        for s in range(self._num_segments):
             sig = []
             for layer in self.get_stage_layers(s):
                 sig.append((
@@ -133,6 +188,19 @@ class PipelineParallel(Layer):
         self.accumulate_steps = pcfg.get("accumulate_steps", 1)
         self.micro_batch_size = pcfg.get("micro_batch_size", 1)
         self.schedule = pcfg.get("schedule", "1F1B")
+        self._vpp = max(pcfg.get("virtual_pp_degree", layers._num_virtual), 1)
+        if self._vpp != layers._num_virtual:
+            raise ValueError(
+                f"strategy virtual_pp_degree={self._vpp} does not match "
+                f"PipelineLayer num_virtual_pipeline_stages="
+                f"{layers._num_virtual}; a mismatch would silently drop "
+                "stages from training")
+        self._has_shared = (layers.shared_pre is not None
+                            or layers.shared_post is not None)
+        if self._vpp > 1 or self._has_shared:
+            # virtual stages / tied ends are only expressible on the
+            # interleave engine (1F1B/FThenB are its V=1 special cases)
+            self.schedule = "Interleave"
 
         mesh = get_mesh()
         self._mesh = mesh
@@ -140,6 +208,7 @@ class PipelineParallel(Layer):
         self._pp_degree = pp
         self._engine_step = None
         self._stacked = []           # list[Parameter], one per stage-param slot
+        self._shared_params = []     # tied embedding/head params (replicated)
         self._loss_params = []       # params of the loss head, if it's a Layer
 
         if pp > 1:
@@ -151,25 +220,35 @@ class PipelineParallel(Layer):
                 raise ValueError(
                     "SPMD pipeline parallelism needs structurally identical "
                     "stages (same layer classes/param shapes per stage); "
-                    "got heterogeneous stages. Put embedding/head layers "
-                    "outside the PipelineLayer (they run replicated under "
-                    "dp/mp sharding) and pipeline only the repeated blocks.")
+                    "got heterogeneous stages. Express embedding/head via "
+                    "SharedLayerDesc at the ends of the layer list (they run "
+                    "fused into the first/last stages with pp-replicated "
+                    "weights) and pipeline only the repeated blocks.")
             self._build_stacked()
 
     # ---- stage-param stacking ----------------------------------------------
+    def _stack_order(self):
+        """Stacked index i -> segment id g. Plain engines: identity over pp.
+        Interleave: i = r*V + v <-> g = v*S + r, so sharding dim 0 over 'pp'
+        hands rank r its V chunks contiguously."""
+        S, V = self._pp_degree, self._vpp
+        if self.schedule == "Interleave":
+            return [(i % V) * S + (i // V) for i in range(S * V)]
+        return list(range(S))
+
     def _build_stacked(self):
         mesh = self._mesh
-        pp = self._pp_degree
+        order = self._stack_order()
         stage0 = self._layers.get_stage_layers(0)
         self._stage0_params = [p for l in stage0 for p in l.parameters()]
-        per_stage = [
-            [p for l in self._layers.get_stage_layers(s) for p in l.parameters()]
-            for s in range(pp)
+        per_seg = [
+            [p for l in self._layers.get_stage_layers(g) for p in l.parameters()]
+            for g in range(self._layers._num_segments)
         ]
         self._stacked = []
         for k in range(len(self._stage0_params)):
-            vals = [per_stage[s][k]._value for s in range(pp)]
-            spec = getattr(per_stage[0][k], "_pspec", None) or P()
+            vals = [per_seg[g][k]._value for g in order]
+            spec = getattr(per_seg[0][k], "_pspec", None) or P()
             stacked_spec = P("pp", *tuple(spec))
             arr = jnp.stack(vals, axis=0)
             arr = jax.device_put(arr, NamedSharding(mesh, stacked_spec))
@@ -177,13 +256,15 @@ class PipelineParallel(Layer):
             sp.name = f"pp_stacked_{k}"
             sp.stop_gradient = False
             self._stacked.append(sp)
+        self._shared_params = self._layers.shared_parameters()
         loss_fn = self._layers._loss_fn
         if isinstance(loss_fn, Layer):
             self._loss_params = list(loss_fn.parameters())
 
     def parameters(self, include_sublayers=True):
         if self._pp_degree > 1:
-            return list(self._stacked) + list(self._loss_params)
+            return (list(self._stacked) + list(self._shared_params)
+                    + list(self._loss_params))
         return super().parameters(include_sublayers)
 
     def sync_layers_from_stacks(self):
@@ -191,11 +272,10 @@ class PipelineParallel(Layer):
         (for eval/state_dict after training)."""
         if self._pp_degree <= 1:
             return
-        pp = self._pp_degree
-        for s in range(pp):
-            ps = [p for l in self._layers.get_stage_layers(s) for p in l.parameters()]
+        for i, g in enumerate(self._stack_order()):
+            ps = [p for l in self._layers.get_stage_layers(g) for p in l.parameters()]
             for k, p in enumerate(ps):
-                p._value = self._stacked[k]._value[s]
+                p._value = self._stacked[k]._value[i]
 
     def state_dict(self, *a, **kw):
         self.sync_layers_from_stacks()
@@ -237,18 +317,58 @@ class PipelineParallel(Layer):
             return loss_fn(Tensor(y), Tensor(label))._value
         return jnp.mean(y)
 
-    def _make_engine(self):
-        from ..pipeline import ENGINES
+    def _swap_run(self, layer_params, vals, fn):
+        saved = [(p._value, p._grad_node, p.stop_gradient) for p in layer_params]
+        try:
+            for p, v in zip(layer_params, vals):
+                p._value = v
+                p._grad_node = None
+                p.stop_gradient = True
+            return fn()
+        finally:
+            for p, (v, gn, sg) in zip(layer_params, saved):
+                p._value, p._grad_node, p.stop_gradient = v, gn, sg
 
-        engine = ENGINES[self.schedule]
+    def _pre_fn_jnp(self, shared_vals, x):
+        pre = self._layers.shared_pre
+        return self._swap_run(self._shared_params, shared_vals,
+                              lambda: pre(Tensor(x))._value)
+
+    def _post_fn_jnp(self, shared_vals, y):
+        layer, fwd = self._layers.shared_post
+        return self._swap_run(self._shared_params, shared_vals,
+                              lambda: fwd(layer, Tensor(y))._value)
+
+    def _make_engine(self):
+        from ..pipeline import ENGINES, pipeline_interleave
+
         mesh, pp = self._mesh, self._pp_degree
 
-        def run(stacked_vals, loss_vals, xs, labels):
-            return engine(
+        if self.schedule == "Interleave":
+            lay = self._layers
+            pre = self._pre_fn_jnp if lay.shared_pre is not None else None
+            post = self._post_fn_jnp if lay.shared_post is not None else None
+
+            def run(stacked_vals, shared_vals, loss_vals, xs, labels):
+                return pipeline_interleave(
+                    lambda params, x: self._stage_fn(params, x),
+                    lambda lp, y, lab: self._loss_fn_jnp(lp, y, lab),
+                    mesh, pp, stacked_vals, loss_vals, xs, labels,
+                    n_virtual=self._vpp, pre_fn=pre, post_fn=post,
+                    shared_params=shared_vals,
+                )
+
+            return jax.jit(run)
+
+        engine = ENGINES[self.schedule]
+
+        def run(stacked_vals, shared_vals, loss_vals, xs, labels):
+            loss, d_stage, d_loss, d_xs = engine(
                 lambda params, x: self._stage_fn(params, x),
                 lambda lp, y, lab: self._loss_fn_jnp(lp, y, lab),
                 mesh, pp, stacked_vals, loss_vals, xs, labels,
             )
+            return loss, d_stage, [], d_loss, d_xs
 
         return jax.jit(run)
 
@@ -268,8 +388,10 @@ class PipelineParallel(Layer):
         if self._engine_step is None:
             self._engine_step = self._make_engine()
         stacked_vals = [p._value for p in self._stacked]
+        shared_vals = [p._value for p in self._shared_params]
         loss_vals = [p._value for p in self._loss_params]
-        loss, d_stacked, d_loss, _ = self._engine_step(stacked_vals, loss_vals, xs, lab)
+        loss, d_stacked, d_shared, d_loss, _ = self._engine_step(
+            stacked_vals, shared_vals, loss_vals, xs, lab)
 
         scale = None
         if scaler is not None and scaler.is_enable():
@@ -278,6 +400,8 @@ class PipelineParallel(Layer):
             # its found_inf/skip logic still applies
             scale = scaler._scale
         for p, g in zip(self._stacked, d_stacked):
+            p._grad = Tensor(g if scale is None else g * scale.astype(g.dtype))
+        for p, g in zip(self._shared_params, d_shared):
             p._grad = Tensor(g if scale is None else g * scale.astype(g.dtype))
         for p, g in zip(self._loss_params, d_loss):
             p._grad = Tensor(g if scale is None else g * scale.astype(g.dtype))

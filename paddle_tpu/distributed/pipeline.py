@@ -33,6 +33,25 @@ Two engines with one signature:
                         reference analog pipeline_scheduler_pass.py FThenB),
                         with jax.checkpoint on the stage so memory also stays
                         at stage boundaries.
+
+Plus the interleaved virtual-stage engine (reference
+PipelineParallelWithInterleave, pipeline_parallel.py:814, schedule :959):
+  pipeline_interleave(...)  each pp rank hosts V "virtual" chunks; global
+                        stage g = v*S + r lives on rank r = g mod S. Every
+                        handoff — within-chunk r->r+1 AND chunk-boundary
+                        wraparound (S-1)->0 — is the SAME ring ppermute, so
+                        the whole schedule stays one uniform SPMD program.
+                        The per-substep schedule (derivation in the
+                        pipeline_interleave docstring) fills the pipeline in
+                        O(D) substeps of 1/V-size stages, cutting the bubble
+                        by V vs plain 1F1B — the reason interleave exists.
+                        It also supports heterogeneous first/last ends
+                        (pre_fn/post_fn with a SHARED param tree), which is
+                        how tied embedding+head across pipeline stages
+                        (reference pp_layers.py shared_comm) is expressed:
+                        the shared weights are replicated over 'pp' and their
+                        grad is psum'ed over the axis — the reference's
+                        first/last-stage grad all-reduce.
 """
 from __future__ import annotations
 
@@ -260,4 +279,217 @@ def pipeline_fthenb(
     return loss, d_stage, d_loss_p, d_xs
 
 
-ENGINES = {"1F1B": pipeline_1f1b, "FThenB": pipeline_fthenb}
+def pipeline_interleave(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    mesh: Mesh,
+    n_stages: int,
+    stage_params: Any,
+    loss_params: Any,
+    xs: jax.Array,
+    labels: jax.Array,
+    axis: str = "pp",
+    n_virtual: int = 1,
+    pre_fn: Callable | None = None,
+    post_fn: Callable | None = None,
+    shared_params: Any = None,
+):
+    """Interleaved virtual-stage schedule as one compiled SPMD program.
+
+    Layout: D = S*V global stages; global stage g = v*S + r runs on rank
+    r = g % S as its chunk v = g // S. `stage_params` leaves have leading dim
+    D ordered as index i = r*V + v, so sharding P('pp') on dim 0 hands rank r
+    exactly its V chunks.
+
+    Schedule (all in substep "ticks"; each tick every rank runs ONE masked
+    forward substep and ONE masked backward substep of a 1/V-size stage):
+
+      t_f(g, m) = (m % S) + S*V*(m // S) + g
+      t_b(g, m) = t_f(g, m) + 2*(D - 1 - g) + 1
+
+    Properties (each is a proof obligation the code relies on):
+      * t_f(g,m) = t_f(g-1,m) + 1 and t_b(g,m) = t_b(g+1,m) + 1 — every
+        activation/grad is consumed exactly one tick after it is produced,
+        so handoffs need NO buffering: the ppermute arrival IS the operand.
+      * per rank per tick at most one forward and one backward slot fire
+        (proof: mod-S then div-V decomposition of t is injective in (v, m)),
+        and in steady state both fire -> full utilization.
+      * fill = O(D) ticks of u/V-cost substeps -> bubble ~ 2*D*(u/V) = 2*S*u
+        independent of V in ticks but 1/V in cost per tick relative to plain
+        1F1B's full-size stages; total span T = M*V + D + S - 1 ticks when
+        S | M (see code for the exact any-M count).
+      * a stage input is needed again at its backward, 2(D-1-g)+1 < 2D ticks
+        later; consecutive microbatches hitting the same (rank, chunk) slot
+        modulo 2S are exactly 2D ticks apart -> a [V, 2S] ring of stage
+        inputs is collision-free.
+
+    pre_fn(shared, raw_x) -> h runs fused into stage 0's substeps;
+    post_fn(shared, y) -> logits runs fused into the loss at stage D-1. Both
+    read the SAME `shared_params` tree (replicated over 'pp'); its gradient
+    collects contributions from both ends and is psum'ed over the axis.
+
+    Returns (loss, d_stage_params, d_shared, d_loss_params, d_xs).
+    """
+    S, V = n_stages, n_virtual
+    D = S * V
+    M = xs.shape[0]
+    # last tick: t_b(0, M-1) = t_f(0, M-1) + 2(D-1) + 1, exact for any M
+    T = ((M - 1) % S) + S * V * ((M - 1) // S) + 2 * D
+    ring_fwd = [(i, (i + 1) % S) for i in range(S)]
+    ring_bwd = [(i, (i - 1) % S) for i in range(S)]
+    has_pre = pre_fn is not None
+    has_post = post_fn is not None
+    if shared_params is None:
+        shared_params = ()
+
+    # hidden (pipeline-carried) microbatch shape/dtype
+    if has_pre:
+        h_aval = jax.eval_shape(pre_fn, shared_params, jax.ShapeDtypeStruct(xs.shape[1:], xs.dtype))
+    else:
+        h_aval = jax.ShapeDtypeStruct(xs.shape[1:], xs.dtype)
+
+    def body(sp_l, sh_l, lp_l, xs_l, labels_l):
+        r = lax.axis_index(axis)
+
+        def fwd_slot(t):
+            q = t - r
+            b = q % S
+            p = q // S
+            v = p % V
+            m = (p // V) * S + b
+            return v, m, (q >= 0) & (m >= 0) & (m < M)
+
+        def bwd_slot(t):
+            q = t - D - (S - 1 - r)
+            b = q % S
+            p = q // S
+            v = (V - 1) - (p % V)
+            m = (p // V) * S + b
+            return v, m, (q >= 0) & (m >= 0) & (m < M)
+
+        def pick(tree, v):
+            return jax.tree_util.tree_map(lambda a: a[v], tree)
+
+        h0 = jnp.zeros(h_aval.shape, h_aval.dtype)
+        xbuf0 = jnp.zeros((V, 2 * S) + h_aval.shape, h_aval.dtype)
+        gparams0 = _zeros_like_tree(sp_l)
+        gshared0 = _zeros_like_tree(sh_l)
+        gloss0 = _zeros_like_tree(lp_l)
+        gxs0 = jnp.zeros_like(xs_l)
+
+        def tick(carry, t):
+            h_recv, g_recv, xbuf, gparams, gshared, gloss, gxs, loss_acc = carry
+
+            # ---- forward substep -------------------------------------------
+            v_f, m_f, fvalid = fwd_slot(t)
+            g_f = v_f * S + r
+            m_fc = jnp.clip(m_f, 0, M - 1)
+            params_f = pick(sp_l, v_f)
+            if has_pre:
+                h_in = lax.cond(
+                    g_f == 0,
+                    lambda: pre_fn(sh_l, xs_l[m_fc]).astype(h_aval.dtype),
+                    lambda: h_recv,
+                )
+            else:
+                h_in = jnp.where(g_f == 0, xs_l[m_fc], h_recv)
+            y = stage_fn(params_f, h_in)
+            y_send = jnp.where(fvalid & (g_f < D - 1), y, jnp.zeros_like(y))
+            slot_f = m_fc % (2 * S)
+            xbuf = xbuf.at[v_f, slot_f].set(
+                jnp.where(fvalid, h_in, xbuf[v_f, slot_f]))
+
+            # ---- backward substep (recompute-from-input) -------------------
+            v_b, m_b, bvalid = bwd_slot(t)
+            g_b = v_b * S + r
+            m_bc = jnp.clip(m_b, 0, M - 1)
+            params_b = pick(sp_l, v_b)
+            xh = xbuf[v_b, m_bc % (2 * S)]
+            is_first_g = g_b == 0
+            is_last_g = g_b == D - 1
+            lab = labels_l[m_bc]
+            raw = xs_l[m_bc]
+
+            def full(pv, sp, lp, x_hidden):
+                if has_pre:
+                    h = lax.cond(
+                        is_first_g,
+                        lambda: pre_fn(sp, raw).astype(h_aval.dtype),
+                        lambda: x_hidden,
+                    )
+                else:
+                    h = x_hidden
+                yy = stage_fn(pv, h)
+                if has_post:
+                    lval = lax.cond(
+                        is_last_g,
+                        lambda: loss_fn(lp, post_fn(sp, yy), lab).astype(jnp.float32),
+                        lambda: jnp.zeros((), jnp.float32),
+                    )
+                else:
+                    lval = lax.cond(
+                        is_last_g,
+                        lambda: loss_fn(lp, yy, lab).astype(jnp.float32),
+                        lambda: jnp.zeros((), jnp.float32),
+                    )
+                return yy, lval
+
+            (y_b, lval), vjp = jax.vjp(full, params_b, sh_l, lp_l, xh)
+            gy = jnp.where(is_last_g | ~bvalid, jnp.zeros_like(g_recv), g_recv)
+            ct_loss = jnp.where(bvalid, 1.0 / M, 0.0).astype(jnp.float32)
+            gpv, gsh, glp, gxh = vjp((gy.astype(y_b.dtype), ct_loss))
+
+            gparams = jax.tree_util.tree_map(
+                lambda acc, g: acc.at[v_b].add(jnp.where(bvalid, g, jnp.zeros_like(g))),
+                gparams, gpv)
+            gshared = _tree_add(
+                gshared, _tree_where(bvalid, gsh, _zeros_like_tree(gsh)))
+            gloss = _tree_add(
+                gloss, _tree_where(bvalid, glp, _zeros_like_tree(glp)))
+            if not has_pre:
+                gxs = gxs.at[m_bc].add(jnp.where(
+                    bvalid & is_first_g, gxh.astype(gxs.dtype),
+                    jnp.zeros_like(gxh, gxs.dtype)))
+            loss_acc = loss_acc + jnp.where(bvalid, lval, 0.0) / M
+            gx_send = jnp.where(bvalid & (g_b > 0), gxh, jnp.zeros_like(gxh))
+
+            # ---- ring handoffs ---------------------------------------------
+            h_recv = lax.ppermute(y_send, axis, ring_fwd)
+            g_recv = lax.ppermute(gx_send.astype(h_aval.dtype), axis, ring_bwd)
+            return (h_recv, g_recv, xbuf, gparams, gshared, gloss, gxs,
+                    loss_acc), None
+
+        carry0 = (h0, h0, xbuf0, gparams0, gshared0, gloss0, gxs0,
+                  jnp.zeros((), jnp.float32))
+        carry, _ = lax.scan(tick, carry0, jnp.arange(T))
+        _, _, _, gparams, gshared, gloss, gxs, loss_acc = carry
+
+        loss_out = lax.psum(loss_acc, axis)
+        gshared_out = jax.tree_util.tree_map(lambda g: lax.psum(g, axis), gshared)
+        gloss_out = jax.tree_util.tree_map(lambda g: lax.psum(g, axis), gloss)
+        gxs_out = lax.psum(gxs, axis)
+        return gparams, gshared_out, gloss_out, gxs_out, loss_out
+
+    in_specs = (
+        jax.tree_util.tree_map(lambda _: P(axis), stage_params),
+        jax.tree_util.tree_map(lambda _: P(), shared_params),
+        jax.tree_util.tree_map(lambda _: P(), loss_params),
+        P(),
+        P(),
+    )
+    out_specs = (
+        jax.tree_util.tree_map(lambda _: P(axis), stage_params),
+        jax.tree_util.tree_map(lambda _: P(), shared_params),
+        jax.tree_util.tree_map(lambda _: P(), loss_params),
+        P(),
+        P(),
+    )
+    fn = shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   axis_names=frozenset({axis}), check_vma=False)
+    d_stage, d_shared, d_loss_p, d_xs, loss = fn(
+        stage_params, shared_params, loss_params, xs, labels)
+    return loss, d_stage, d_shared, d_loss_p, d_xs
+
+
+ENGINES = {"1F1B": pipeline_1f1b, "FThenB": pipeline_fthenb,
+           "Interleave": pipeline_interleave}

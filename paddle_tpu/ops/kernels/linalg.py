@@ -281,9 +281,17 @@ def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False):
     if p == "fro":
         return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdim))
     if p == "nuc":
-        s = jnp.linalg.svd(x, compute_uv=False)
+        # SVD runs over the trailing two dims; honor `axis` by moving the
+        # requested matrix dims there first (and back for keepdim)
+        a0 = axis[0] % x.ndim
+        a1 = axis[1] % x.ndim
+        xm = jnp.moveaxis(x, (a0, a1), (-2, -1))
+        s = jnp.linalg.svd(xm, compute_uv=False)
         out = jnp.sum(s, axis=-1)
-        return out[..., None, None] if keepdim else out
+        if keepdim:
+            out = jnp.expand_dims(out, (-2, -1))
+            return jnp.moveaxis(out, (-2, -1), (a0, a1))
+        return out
     return jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keepdim)
 
 

@@ -357,18 +357,42 @@ def conv2d_transpose(
     return out
 
 
+def _ceil_hi_pad(dim, k, s, p):
+    """Extra high padding so ceil_mode keeps a partial final window — but 0
+    if that extra window would lie entirely in padding (the reference drops
+    it: pooling output-size rule `(out-1)*stride >= dim + pad` => out -= 1).
+    Without the drop, exclusive avg pools divide by a 0 count (NaN) and max
+    pools emit a -inf rim."""
+    size = dim + 2 * p
+    rem = (size - k) % s
+    if rem == 0:
+        return 0
+    start = ((size - k) // s + 1) * s
+    if start >= dim + p:
+        return 0
+    return s - rem
+
+
+def _pool2d_geometry(x, k, s, p, ceil_mode, data_format):
+    """Window/stride/pad tuples for a 2-d pool; ceil_mode extends the high
+    pad so a partial final window is kept (reference pooling.cc ceil path)."""
+    hw = (x.shape[2], x.shape[3]) if data_format == "NCHW" else (x.shape[1], x.shape[2])
+    hi = list(p)
+    if ceil_mode:
+        for i in range(2):
+            hi[i] += _ceil_hi_pad(hw[i], k[i], s[i], p[i])
+    if data_format == "NCHW":
+        return ((1, 1, k[0], k[1]), (1, 1, s[0], s[1]),
+                ((0, 0), (0, 0), (p[0], hi[0]), (p[1], hi[1])))
+    return ((1, k[0], k[1], 1), (1, s[0], s[1], 1),
+            ((0, 0), (p[0], hi[0]), (p[1], hi[1]), (0, 0)))
+
+
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, data_format="NCHW"):
     k = _pair(kernel_size)
     s = _pair(stride) if stride is not None else k
     p = _pair(padding)
-    if data_format == "NCHW":
-        window = (1, 1, k[0], k[1])
-        strides = (1, 1, s[0], s[1])
-        pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
-    else:
-        window = (1, k[0], k[1], 1)
-        strides = (1, s[0], s[1], 1)
-        pads = ((0, 0), (p[0], p[0]), (p[1], p[1]), (0, 0))
+    window, strides, pads = _pool2d_geometry(x, k, s, p, ceil_mode, data_format)
     # -inf init keeps this on the reduce_window_max primitive (differentiable)
     neg = -jnp.inf if jnp.issubdtype(x.dtype, jnp.inexact) else jnp.iinfo(x.dtype).min
     return lax.reduce_window(x, neg, lax.max, window, strides, pads)
@@ -378,16 +402,11 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusiv
     k = _pair(kernel_size)
     s = _pair(stride) if stride is not None else k
     p = _pair(padding)
-    if data_format == "NCHW":
-        window = (1, 1, k[0], k[1])
-        strides = (1, 1, s[0], s[1])
-        pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
-    else:
-        window = (1, k[0], k[1], 1)
-        strides = (1, s[0], s[1], 1)
-        pads = ((0, 0), (p[0], p[0]), (p[1], p[1]), (0, 0))
+    window, strides, pads = _pool2d_geometry(x, k, s, p, ceil_mode, data_format)
     summed = lax.reduce_window(x, _np.zeros((), x.dtype), lax.add, window, strides, pads)
-    if exclusive and (p[0] or p[1]):
+    if exclusive and (p[0] or p[1] or ceil_mode):
+        # exclusive divides by the count of REAL elements; padding and the
+        # ceil-mode extension both count as excluded padding
         ones = jnp.ones_like(x)
         counts = lax.reduce_window(ones, _np.zeros((), x.dtype), lax.add, window, strides, pads)
         return summed / counts

@@ -119,12 +119,11 @@ def _pool_nd(x, kernel_size, stride, padding, nd, reducer, init, ceil_mode):
     strides = (1, 1) + s
     pads = [(0, 0), (0, 0)] + [(pi, pi) for pi in p]
     if ceil_mode:
-        # extend the right pad so a partial final window is kept
+        from .nn_ops import _ceil_hi_pad
+
         for i in range(nd):
-            size = x.shape[2 + i] + 2 * p[i]
-            rem = (size - k[i]) % s[i]
-            if rem:
-                pads[2 + i] = (p[i], p[i] + s[i] - rem)
+            pads[2 + i] = (p[i], p[i] + _ceil_hi_pad(x.shape[2 + i], k[i],
+                                                     s[i], p[i]))
     return lax.reduce_window(x, init, reducer, window, strides, pads)
 
 
@@ -140,14 +139,13 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
     neg = _neg_init(x)
     if return_mask:
         out, idx = _max_pool_with_mask(x[..., None], (k[0], 1), (s[0], 1),
-                                       (p[0], 0))
+                                       (p[0], 0), ceil_mode=ceil_mode)
         return out[..., 0], idx[..., 0]
     pads = [(0, 0), (0, 0), (p[0], p[0])]
     if ceil_mode:
-        size = x.shape[2] + 2 * p[0]
-        rem = (size - k[0]) % s[0]
-        if rem:
-            pads[2] = (p[0], p[0] + s[0] - rem)
+        from .nn_ops import _ceil_hi_pad
+
+        pads[2] = (p[0], p[0] + _ceil_hi_pad(x.shape[2], k[0], s[0], p[0]))
     return lax.reduce_window(x, neg, lax.max, (1, 1, k[0]), (1, 1, s[0]), pads)
 
 
@@ -158,7 +156,7 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
     p = _ntuple(padding, 1)
     summed = _pool_nd(x[:, :, :, None], (k[0], 1), (s[0], 1), (p[0], 0), 2,
                       lax.add, _np.zeros((), x.dtype), ceil_mode)[..., 0]
-    if exclusive and p[0]:
+    if exclusive and (p[0] or ceil_mode):
         counts = _pool_nd(jnp.ones_like(x)[:, :, :, None], (k[0], 1), (s[0], 1),
                           (p[0], 0), 2, lax.add, _np.zeros((), x.dtype),
                           ceil_mode)[..., 0]
@@ -169,7 +167,8 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW"):
     if return_mask:
-        return _max_pool_with_mask_nd(x, kernel_size, stride, padding, 3)
+        return _max_pool_with_mask_nd(x, kernel_size, stride, padding, 3,
+                                      ceil_mode=ceil_mode)
     return _pool_nd(x, kernel_size, stride, padding, 3, lax.max, _neg_init(x),
                     ceil_mode)
 
@@ -180,25 +179,33 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, exclusive=True,
     p = _ntuple(padding, 3)
     summed = _pool_nd(x, kernel_size, stride, padding, 3, lax.add,
                       _np.zeros((), x.dtype), ceil_mode)
-    if exclusive and any(p):
+    if exclusive and (any(p) or ceil_mode):
         counts = _pool_nd(jnp.ones_like(x), kernel_size, stride, padding, 3,
                           lax.add, _np.zeros((), x.dtype), ceil_mode)
         return summed / counts
     return summed / (k[0] * k[1] * k[2])
 
 
-def _max_pool_with_mask(x, k, s, p):
+def _max_pool_with_mask(x, k, s, p, ceil_mode=False):
     """max_pool2d returning (out, flat-index mask) like the reference
     (mask = argmax position in the flattened input H*W, phi max_pool2d_with_index).
 
     Padding is applied explicitly with the dtype minimum
     (conv_general_dilated_patches zero-pads, and a 0 pad slot would win the
-    max over negative inputs and yield an out-of-range index; -inf is not
-    usable because patch extraction is conv-based and -inf * 0 = NaN)."""
+    max over negative inputs and yield an out-of-range index). The flat index
+    is reconstructed from the within-window argmax in INTEGER arithmetic
+    (row = oy*s - p + am//kw ...) — no float index map, so it is exact for
+    any H*W (a float32 map breaks above 2^24)."""
     n, c, h, w = x.shape
     neg = (_np.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.inexact)
            else _np.iinfo(x.dtype).min)
-    xp = jnp.pad(x, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])),
+    hi = [p[0], p[1]]
+    if ceil_mode:
+        from .nn_ops import _ceil_hi_pad
+
+        for i, dim in enumerate((h, w)):
+            hi[i] += _ceil_hi_pad(dim, k[i], s[i], p[i])
+    xp = jnp.pad(x, ((0, 0), (0, 0), (p[0], hi[0]), (p[1], hi[1])),
                  constant_values=neg)
     patches = lax.conv_general_dilated_patches(
         xp, filter_shape=k, window_strides=s, padding=[(0, 0), (0, 0)],
@@ -207,25 +214,17 @@ def _max_pool_with_mask(x, k, s, p):
     )  # [n, c*kh*kw, oh, ow]
     oh, ow = patches.shape[2], patches.shape[3]
     patches = patches.reshape(n, c, k[0] * k[1], oh, ow)
-    # index map: same extraction over the flat row/col index grid
-    ri = jnp.arange(-p[0], h + p[0])
-    ci = jnp.arange(-p[1], w + p[1])
-    flat = (ri[:, None] * w + ci[None, :]).astype(jnp.float32)
-    flat = jnp.broadcast_to(flat[None, None], (1, 1, *flat.shape))
-    ipatches = lax.conv_general_dilated_patches(
-        flat, filter_shape=k, window_strides=s, padding=[(0, 0), (0, 0)],
-        dimension_numbers=lax.conv_dimension_numbers(
-            flat.shape, (1, 1, *k), ("NCHW", "OIHW", "NCHW")),
-    ).reshape(1, 1, k[0] * k[1], oh, ow)
     am = jnp.argmax(patches, axis=2)
     out = jnp.max(patches, axis=2)
-    idx = jnp.take_along_axis(
-        jnp.broadcast_to(ipatches, (n, c, k[0] * k[1], oh, ow)),
-        am[:, :, None], axis=2)[:, :, 0]
-    return out, idx.astype(jnp.int64)
+    row = jnp.arange(oh, dtype=jnp.int32)[None, None, :, None] * s[0] - p[0] + (am // k[1]).astype(jnp.int32)
+    col = jnp.arange(ow, dtype=jnp.int32)[None, None, None, :] * s[1] - p[1] + (am % k[1]).astype(jnp.int32)
+    row = jnp.clip(row, 0, h - 1)  # all-padding windows argmax to a pad slot
+    col = jnp.clip(col, 0, w - 1)
+    idx = row.astype(jnp.int64) * w + col.astype(jnp.int64)
+    return out, idx
 
 
-def _max_pool_with_mask_nd(x, kernel_size, stride, padding, nd):
+def _max_pool_with_mask_nd(x, kernel_size, stride, padding, nd, ceil_mode=False):
     if nd == 3:
         # fold depth into batch and pool 2-d per depth slice is wrong for
         # kd > 1; use the generic patch route via reshape to 2-d when kd == 1
@@ -236,18 +235,18 @@ def _max_pool_with_mask_nd(x, kernel_size, stride, padding, nd):
             p = _ntuple(padding, 3)
             out, idx = _max_pool_with_mask(
                 x.reshape(n, c * d, h, w), (k[1], k[2]), (s[1], s[2]),
-                (p[1], p[2]))
+                (p[1], p[2]), ceil_mode=ceil_mode)
             oh, ow = out.shape[-2:]
             return (out.reshape(n, c, d, oh, ow), idx.reshape(n, c, d, oh, ow))
         raise NotImplementedError("max_pool3d return_mask requires kd == 1")
     raise NotImplementedError
 
 
-def max_pool2d_with_mask(x, kernel_size, stride=None, padding=0):
+def max_pool2d_with_mask(x, kernel_size, stride=None, padding=0, ceil_mode=False):
     k = _ntuple(kernel_size, 2)
     s = _ntuple(stride, 2) if stride is not None else k
     p = _ntuple(padding, 2)
-    return _max_pool_with_mask(x, k, s, p)
+    return _max_pool_with_mask(x, k, s, p, ceil_mode=ceil_mode)
 
 
 def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,

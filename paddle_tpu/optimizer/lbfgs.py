@@ -94,10 +94,12 @@ class LBFGS(Optimizer):
                       max_ls=25):
         dg0 = float(jnp.dot(g0, d))
         if dg0 >= 0:
-            return float(loss0), g0, 0.0
+            return float(loss0), g0, 0.0, 0
         saved = self._clone_params()
+        n_ev = [0]  # closure-evaluation count, returned for the max_eval budget
 
         def eval_at(t):
+            n_ev[0] += 1
             self._restore_params(saved)
             self._add_to_params(t, d)
             loss = closure()
@@ -120,14 +122,14 @@ class LBFGS(Optimizer):
                     else:
                         dgm = float(jnp.dot(gm, d))
                         if abs(dgm) <= -c2 * dg0:
-                            return fm, gm, tm
+                            return fm, gm, tm, n_ev[0]
                         if dgm * (hi - lo) >= 0:
                             hi = lo
                         lo = tm
                 fm, gm = eval_at(0.5 * (lo + hi))
-                return fm, gm, 0.5 * (lo + hi)
+                return fm, gm, 0.5 * (lo + hi), n_ev[0]
             if abs(dg_new) <= -c2 * dg0:
-                return f_new, g_new, t
+                return f_new, g_new, t, n_ev[0]
             if dg_new >= 0:
                 lo, hi = t, t_prev
                 for _ in range(10):
@@ -137,14 +139,16 @@ class LBFGS(Optimizer):
                     if fm > float(loss0) + c1 * tm * dg0:
                         hi = tm
                     elif abs(dgm) <= -c2 * dg0:
-                        return fm, gm, tm
+                        return fm, gm, tm, n_ev[0]
                     else:
                         lo = tm
-                return fm, gm, 0.5 * (lo + hi)
+                tm = 0.5 * (lo + hi)
+                fm, gm = eval_at(tm)  # params must end at the returned step
+                return fm, gm, tm, n_ev[0]
             t_prev, f_prev, g_prev = t, f_new, g_new
             t = 2.0 * t
             f_new, g_new = eval_at(t)
-        return f_new, g_new, t
+        return f_new, g_new, t, n_ev[0]
 
     # ---- step --------------------------------------------------------------
     def step(self, closure=None):  # noqa: C901 — mirrors the reference loop
@@ -181,9 +185,9 @@ class LBFGS(Optimizer):
             prev_flat_grad = flat_grad
             prev_loss = loss_val
             if opts["line_search_fn"] == "strong_wolfe":
-                loss_val, flat_grad, t = self._strong_wolfe(
+                loss_val, flat_grad, t, ls_evals = self._strong_wolfe(
                     closure_with_grad, d, loss_val, flat_grad, t)
-                n_evals += 1
+                n_evals += max(ls_evals, 1)
             else:
                 self._add_to_params(t, d)
                 loss = closure_with_grad()

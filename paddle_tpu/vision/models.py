@@ -431,6 +431,9 @@ class MobileNetV3Small(nn.Layer):
         (5, 576, 96, True, nn.Hardswish, 1),
     ]
 
+    LAST_C = 576   # channels of the final 1x1 conv
+    HEAD_C = 1024  # classifier hidden width
+
     def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
         super().__init__()
         self.num_classes = num_classes
@@ -444,14 +447,14 @@ class MobileNetV3Small(nn.Layer):
         for k, hid, out, se, act, s in self.CFG:
             layers.append(_MBV3Block(in_c, c(hid), c(out), k, s, se, act))
             in_c = c(out)
-        layers.append(_ConvBNReLU(in_c, c(576), 1, act=nn.Hardswish))
+        layers.append(_ConvBNReLU(in_c, c(self.LAST_C), 1, act=nn.Hardswish))
         self.features = nn.Sequential(*layers)
         if with_pool:
             self.pool = nn.AdaptiveAvgPool2D(1)
         if num_classes > 0:
             self.classifier = nn.Sequential(
-                nn.Linear(c(576), 1024), nn.Hardswish(), nn.Dropout(0.2),
-                nn.Linear(1024, num_classes))
+                nn.Linear(c(self.LAST_C), self.HEAD_C), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(self.HEAD_C, num_classes))
 
     def forward(self, x):
         x = self.features(x)
@@ -482,28 +485,8 @@ class MobileNetV3Large(MobileNetV3Small):
         (5, 960, 160, True, nn.Hardswish, 1),
         (5, 960, 160, True, nn.Hardswish, 1),
     ]
-
-    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
-        nn.Layer.__init__(self)
-        self.num_classes = num_classes
-        self.with_pool = with_pool
-
-        def c(ch):
-            return max(8, int(ch * scale))
-
-        layers = [_ConvBNReLU(3, c(16), 3, stride=2, act=nn.Hardswish)]
-        in_c = c(16)
-        for k, hid, out, se, act, s in self.CFG:
-            layers.append(_MBV3Block(in_c, c(hid), c(out), k, s, se, act))
-            in_c = c(out)
-        layers.append(_ConvBNReLU(in_c, c(960), 1, act=nn.Hardswish))
-        self.features = nn.Sequential(*layers)
-        if with_pool:
-            self.pool = nn.AdaptiveAvgPool2D(1)
-        if num_classes > 0:
-            self.classifier = nn.Sequential(
-                nn.Linear(c(960), 1280), nn.Hardswish(), nn.Dropout(0.2),
-                nn.Linear(1280, num_classes))
+    LAST_C = 960
+    HEAD_C = 1280
 
 
 def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
@@ -530,19 +513,34 @@ class _Fire(nn.Layer):
 
 
 class SqueezeNet(nn.Layer):
-    """Reference: vision/models/squeezenet.py (1.1 topology)."""
+    """Reference: vision/models/squeezenet.py (1.0 and 1.1 topologies)."""
 
     def __init__(self, version="1.1", num_classes=1000, with_pool=True):
         super().__init__()
         self.num_classes = num_classes
-        self.features = nn.Sequential(
-            nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(), nn.MaxPool2D(3, 2),
-            _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64), nn.MaxPool2D(3, 2),
-            _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
-            nn.MaxPool2D(3, 2),
-            _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
-            _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256),
-        )
+        if version == "1.1":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(), nn.MaxPool2D(3, 2),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                nn.MaxPool2D(3, 2),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                nn.MaxPool2D(3, 2),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256),
+            )
+        elif version == "1.0":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 96, 7, stride=2), nn.ReLU(), nn.MaxPool2D(3, 2),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128), nn.MaxPool2D(3, 2),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                nn.MaxPool2D(3, 2),
+                _Fire(512, 64, 256, 256),
+            )
+        else:
+            raise ValueError(f"unsupported SqueezeNet version {version!r}; "
+                             "expected '1.0' or '1.1'")
         self.classifier = nn.Sequential(
             nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1), nn.ReLU(),
             nn.AdaptiveAvgPool2D(1))
@@ -550,6 +548,10 @@ class SqueezeNet(nn.Layer):
     def forward(self, x):
         x = self.classifier(self.features(x))
         return x.flatten(1)
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    return SqueezeNet(version="1.0", **kwargs)
 
 
 def squeezenet1_1(pretrained=False, **kwargs):

@@ -630,3 +630,124 @@ class TestReviewRegressions:
         got = np.asarray(F.cdist(paddle.to_tensor(x), paddle.to_tensor(x),
                                  compute_mode="donot_use_mm_for_euclid_dist")._value)
         assert np.abs(np.diag(got)).max() == 0.0
+
+
+class TestPoolCeilModeFixes:
+    """Regressions: ceil_mode interaction with exclusive counts and masks."""
+
+    def test_avg_pool1d_exclusive_ceil(self):
+        # windows [1,2,3],[3,4,5],[5,6] -> exclusive divides last by 2
+        x = paddle.to_tensor(np.arange(1.0, 7.0, dtype=np.float32).reshape(1, 1, 6))
+        out = F.avg_pool1d(x, 3, stride=2, padding=0, exclusive=True,
+                             ceil_mode=True)
+        np.testing.assert_allclose(np.asarray(out._value).ravel(),
+                                   [2.0, 4.0, 5.5])
+
+    def test_avg_pool2d_exclusive_ceil(self):
+        x = paddle.to_tensor(np.arange(25, dtype=np.float32).reshape(1, 1, 5, 5))
+        out = F.avg_pool2d(x, 2, stride=2, padding=0, exclusive=True,
+                             ceil_mode=True)
+        v = np.asarray(out._value)[0, 0]
+        assert v.shape == (3, 3)
+        np.testing.assert_allclose(v[2, 2], 24.0)  # single-element window
+        np.testing.assert_allclose(v[0, 0], (0 + 1 + 5 + 6) / 4.0)
+
+    def test_max_pool1d_mask_ceil(self):
+        x = paddle.to_tensor(np.arange(5, dtype=np.float32).reshape(1, 1, 5))
+        out, mask = F.max_pool1d(x, 2, stride=2, return_mask=True,
+                                   ceil_mode=True)
+        np.testing.assert_allclose(np.asarray(out._value).ravel(), [1, 3, 4])
+        np.testing.assert_allclose(np.asarray(mask._value).ravel(), [1, 3, 4])
+
+    def test_max_pool2d_ceil_matches_torch_shape(self):
+        import torch
+        import torch.nn.functional as TF
+
+        x = np.random.RandomState(0).randn(2, 3, 5, 7).astype(np.float32)
+        ref = TF.max_pool2d(torch.tensor(x), 2, stride=2, ceil_mode=True)
+        out = F.max_pool2d(paddle.to_tensor(x), 2, stride=2, ceil_mode=True)
+        np.testing.assert_allclose(np.asarray(out._value), ref.numpy(),
+                                   rtol=1e-5)
+
+    def test_max_pool2d_mask_large_indices_exact(self):
+        # integer index math must be exact where a float32 map would round
+        h, w = 6, 5
+        x = np.zeros((1, 1, h, w), np.float32)
+        x[0, 0, 5, 2] = 9.0
+        out, mask = F.max_pool2d_with_mask(paddle.to_tensor(x), (3, 3),
+                                             stride=3, padding=0, ceil_mode=False)
+        assert int(np.asarray(mask._value)[0, 0, 1, 0]) == 5 * w + 2
+
+    def test_ceil_mode_drops_all_padding_window(self):
+        # k=2, s=3, p=1 on H=W=4: the candidate extra window starts at 6 >=
+        # dim+pad=5 and must be dropped (torch/paddle output-size rule),
+        # else exclusive avg divides by zero
+        import torch
+        import torch.nn.functional as TF
+
+        x = np.random.RandomState(0).randn(1, 1, 4, 4).astype(np.float32)
+        ref = TF.avg_pool2d(torch.tensor(x), 2, stride=3, padding=1,
+                            ceil_mode=True, count_include_pad=False)
+        out = F.avg_pool2d(paddle.to_tensor(x), 2, stride=3, padding=1,
+                           ceil_mode=True, exclusive=True)
+        v = np.asarray(out._value)
+        assert np.isfinite(v).all()
+        np.testing.assert_allclose(v, ref.numpy(), rtol=1e-5)
+        ref_m = TF.max_pool2d(torch.tensor(x), 2, stride=3, padding=1,
+                              ceil_mode=True)
+        out_m = F.max_pool2d(paddle.to_tensor(x), 2, stride=3, padding=1,
+                             ceil_mode=True)
+        np.testing.assert_allclose(np.asarray(out_m._value), ref_m.numpy(),
+                                   rtol=1e-5)
+
+
+class TestRound3ReviewFixes:
+    def test_matrix_norm_nuc_axis(self):
+        x = np.random.RandomState(0).randn(3, 4, 5).astype(np.float32)
+        out = F.matrix_norm(paddle.to_tensor(x), p="nuc", axis=(0, 1))
+        ref = np.array([np.linalg.svd(x[:, :, i], compute_uv=False).sum()
+                        for i in range(5)])
+        np.testing.assert_allclose(np.asarray(out._value), ref, rtol=1e-4)
+        outk = F.matrix_norm(paddle.to_tensor(x), p="nuc", axis=(0, 1),
+                             keepdim=True)
+        assert tuple(outk.shape) == (1, 1, 5)
+
+    def test_squeezenet_versions(self):
+        from paddle_tpu.vision.models import SqueezeNet, squeezenet1_0
+
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(1, 3, 96, 96).astype(np.float32))
+        m0 = squeezenet1_0(num_classes=10)
+        assert tuple(m0(x).shape) == (1, 10)
+        # 1.0 stem is 7x7/96 (vs 1.1's 3x3/64)
+        assert m0.features[0].weight.shape[-2:] == [7, 7]
+        with pytest.raises(ValueError, match="unsupported"):
+            SqueezeNet(version="2.0")
+
+    def test_pipeline_vpp_mismatch_raises(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.fleet.pipeline_parallel import (
+            LayerDesc, PipelineLayer, PipelineParallel)
+        from paddle_tpu import nn as pnn
+
+        class Blk(pnn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = pnn.Linear(4, 4)
+
+            def forward(self, x):
+                return self.fc(x)
+
+        mesh = dist.build_mesh(pp=4)
+        dist.set_mesh(mesh)
+        try:
+            layer = PipelineLayer([LayerDesc(Blk) for _ in range(8)],
+                                  num_stages=4, num_virtual_pipeline_stages=2)
+
+            class Strat:
+                pipeline_configs = {"virtual_pp_degree": 1}
+
+            with pytest.raises(ValueError, match="virtual_pp_degree"):
+                PipelineParallel(layer, strategy=Strat())
+        finally:
+            dist.set_mesh(None)

@@ -193,7 +193,12 @@ def main() -> int:
 
     worst = max(r["ratio"] for r in results["ops"].values())
     results["worst_ratio"] = round(worst, 4)
-    results["pass_5pct_gate"] = bool(worst <= 1.05)
+    # the BASELINE.md gate covers these ops only; the rest are informational
+    gated = [r["ratio"] for name, r in results["ops"].items()
+             if name.startswith(("matmul", "layer_norm", "flash_attn",
+                                 "embedding"))]
+    results["gated_worst_ratio"] = round(max(gated), 4)
+    results["pass_5pct_gate"] = bool(max(gated) <= 1.05)
     out = json.dumps(results)
     print(out)
     if args.out:

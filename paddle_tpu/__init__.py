@@ -97,6 +97,7 @@ from . import text  # noqa: F401, E402
 from . import inference  # noqa: F401, E402
 from . import onnx  # noqa: F401, E402
 from . import incubate  # noqa: F401, E402
+from . import utils  # noqa: F401, E402
 from .framework.io import load, save  # noqa: F401, E402
 from .hapi.model import Model, summary  # noqa: F401, E402
 

@@ -21,6 +21,7 @@ through. All softmax statistics are fp32 regardless of input dtype.
 """
 from __future__ import annotations
 
+import functools
 import math
 from functools import partial
 
@@ -59,6 +60,20 @@ def _combine(o, lse, o_i, lse_i):
     w = jnp.exp(lse - new_lse).transpose(0, 2, 1)[..., None]  # [b,sq,h,1]
     w_i = jnp.exp(lse_i - new_lse).transpose(0, 2, 1)[..., None]
     return o * w + o_i * w_i, new_lse
+
+
+def dense_causal_attention(q, k, v, causal=True, scale=None):
+    """Plain dense attention on full [b, s, h, d] arrays — the single-device
+    reference the sharded kernels (and their parity tests) reduce to."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    extra = None
+    if causal:
+        ids = jnp.arange(q.shape[1])
+        extra = jnp.where(ids[:, None] >= ids[None, :], 0.0,
+                          NEG_INF).astype(jnp.float32)
+    o, _ = _chunk_attention(q, k, v, scale, extra)
+    return o.astype(q.dtype)
 
 
 def ring_attention(q, k, v, axis_name, causal=False, scale=None):
@@ -130,15 +145,7 @@ def ulysses_attention(q, k, v, axis_name, causal=False, scale=None,
 
     qf, kf, vf = to_full_seq(q), to_full_seq(k), to_full_seq(v)
     if dense_fn is None:
-        if scale is None:
-            scale = 1.0 / math.sqrt(d)
-        s_full = qf.shape[1]
-        extra = None
-        if causal:
-            ids = jnp.arange(s_full)
-            extra = jnp.where(ids[:, None] >= ids[None, :], 0.0, NEG_INF).astype(jnp.float32)
-        of, _ = _chunk_attention(qf, kf, vf, scale, extra)
-        of = of.astype(q.dtype)
+        of = dense_causal_attention(qf, kf, vf, causal=causal, scale=scale)
     else:
         of = dense_fn(qf, kf, vf)
     return to_shard_seq(of)
@@ -183,3 +190,62 @@ class RingAttention:
 
     def __call__(self, q, k, v):
         return ring_attention(q, k, v, self.axis_name, causal=self.causal)
+
+
+# ---------------------------------------------------------------- model hook
+# Registered through the PUBLIC custom-op API (utils.register_custom_op) so
+# CP attention is an ordinary op: eager autograd via jax.vjp through
+# shard_map, usable inside TrainStep/jit, recorded on static Programs.
+# cacheable=False: the kernel captures the ambient mesh, which is not part
+# of the op's cache key.
+@functools.lru_cache(maxsize=64)
+def _sp_attention_fn(mesh, axis_name, mode, causal):
+    """Jitted partial-manual shard_map for one (mesh, attrs) combination.
+    Cached so repeated eager calls hit jit's compile cache instead of
+    rebuilding a fresh function identity (and recompiling) every forward."""
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    from .sharded import shard_map
+
+    inner = ring_attention if mode == "ring" else ulysses_attention
+    spec = P(None, axis_name, None, None)
+    fn = shard_map(
+        partial(inner, axis_name=axis_name, causal=causal),
+        mesh=mesh, in_specs=(spec,) * 3, out_specs=spec,
+        axis_names=frozenset({axis_name}), check_vma=False)
+    # partial-manual shard_map (manual 'sep', auto dp/mp) requires a jit
+    # scope in jax 0.9; nested jit inlines when already traced
+    return jax.jit(fn)
+
+
+def _register_sp_attention():
+    from ..utils import register_custom_op
+
+    @register_custom_op(name="sequence_parallel_attention", cacheable=False)
+    def sequence_parallel_attention(q, k, v, *, axis_name="sep", mode="ring",
+                                    causal=True):
+        """Attention with the sequence dim sharded over `axis_name`.
+
+        q, k, v: GLOBAL [b, s, h, d]. The op wraps ring/Ulysses attention in
+        a partial-manual shard_map: only `axis_name` goes manual, so dp/mp
+        dims stay under GSPMD and compose with TrainStep shardings. This is
+        the TPU-native subsumption of the reference's
+        Column/RowSequenceParallelLinear SP layers
+        (fleet/utils/sequence_parallel_utils.py:228,340)."""
+        from .mesh import get_mesh
+
+        if mode not in ("ring", "ulysses"):
+            raise ValueError(
+                f"sequence_parallel mode must be 'ring' or 'ulysses', "
+                f"got {mode!r}")
+        mesh = get_mesh()
+        if mesh is None or axis_name not in mesh.axis_names \
+                or mesh.shape[axis_name] == 1:
+            # no sep axis -> plain dense attention, same math
+            return dense_causal_attention(q, k, v, causal=causal)
+        return _sp_attention_fn(mesh, axis_name, mode, causal)(q, k, v)
+
+
+_register_sp_attention()

@@ -43,6 +43,9 @@ class GPTConfig:
     tie_word_embeddings: bool = True
     recompute: bool = False           # activation checkpointing per block
     recompute_policy: str = None      # jax.checkpoint policy name (None=full)
+    sequence_parallel: str = None     # None | 'ring' | 'ulysses': attention
+                                      # over the 'sep' mesh axis (long context)
+    sep_axis: str = "sep"
 
     def __post_init__(self):
         if not self.intermediate_size:
@@ -71,6 +74,17 @@ class CausalSelfAttention(nn.Layer):
         self.out_proj = RowParallelLinear(c.hidden_size, c.hidden_size, input_is_parallel=True)
         self.attn_dropout_p = c.attention_dropout_prob
         self.resid_dropout = nn.Dropout(c.hidden_dropout_prob)
+        self.sequence_parallel = c.sequence_parallel
+        self.sep_axis = c.sep_axis
+        if c.sequence_parallel and c.sequence_parallel not in ("ring", "ulysses"):
+            raise ValueError(
+                f"GPTConfig.sequence_parallel must be None, 'ring' or "
+                f"'ulysses', got {c.sequence_parallel!r}")
+        if c.sequence_parallel and c.attention_dropout_prob:
+            raise ValueError(
+                "attention dropout is not supported under context "
+                "parallelism (the ring/Ulysses kernels are deterministic); "
+                "set attention_dropout_prob=0")
 
     def forward(self, x, rope=None):
         b, s, h = x.shape
@@ -79,11 +93,18 @@ class CausalSelfAttention(nn.Layer):
         q, k, v = api.split(qkv, 3, axis=-1)
         if rope is not None:
             q, k = api.rotary_position_embedding(q, k, rope[0], rope[1])
-        out = F.scaled_dot_product_attention(
-            q, k, v, is_causal=True,
-            dropout_p=self.attn_dropout_p if self.training else 0.0,
-            training=self.training,
-        )
+        if self.sequence_parallel:
+            # long-context path: sequence sharded over the 'sep' mesh axis,
+            # ring/Ulysses attention as one registered op (context_parallel)
+            out = api.sequence_parallel_attention(
+                q, k, v, axis_name=self.sep_axis,
+                mode=self.sequence_parallel, causal=True)
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True,
+                dropout_p=self.attn_dropout_p if self.training else 0.0,
+                training=self.training,
+            )
         out = api.reshape(out, [b, s, h])
         return self.resid_dropout(self.out_proj(out))
 

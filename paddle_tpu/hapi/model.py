@@ -14,6 +14,7 @@ from ..core.tensor import Tensor
 from ..io import DataLoader
 from ..jit.trainer import TrainStep
 from ..nn.layer import Layer
+from ..profiler.timer import benchmark
 
 
 class Model:
@@ -67,6 +68,7 @@ class Model:
                 x, y = batch[0], batch[1]
                 loss = step_fn(x, y)
                 losses.append(float(loss.item()))
+                benchmark().step(num_samples=int(x.shape[0]))
                 cbs.on_train_batch_end(i, {"loss": losses[-1]})
             history["loss"].append(float(np.mean(losses)) if losses else float("nan"))
             epoch_logs = {"loss": history["loss"][-1]}

@@ -1,21 +1,33 @@
 """DataLoader (reference: python/paddle/io/dataloader/dataloader_iter.py +
 worker.py — multiprocess workers + shared-memory queues).
 
-TPU-native: thread workers + a bounded prefetch queue. Batches collate to numpy
-(GIL released in np ops) and convert to device arrays lazily. For TPU input
-pipelines the compiled-step path consumes numpy directly via device_put, which
-overlaps H2D with compute through PJRT's async dispatch.
+Worker modes:
+  * num_workers=0 — inline.
+  * mode='process' (default for num_workers>0, the reference's semantics) —
+    fork workers run __getitem__ + numpy collate and ship batches through
+    POSIX shared memory (io/worker.py). This is the path that keeps an
+    ImageNet-class pipeline ahead of the device: Python-level decode/augment
+    does not share the parent's GIL.
+  * mode='thread' — thread workers + a bounded prefetch queue, for datasets
+    that are not fork-safe (open file handles, sockets) or numpy-only
+    pipelines whose ops release the GIL anyway.
+
+Reader-cost accounting: every iterator reports time spent blocked waiting
+for data to profiler.timer.benchmark() (the reference's
+profiler/timer.py reader_cost machinery), so input starvation is measurable.
 """
 from __future__ import annotations
 
 import queue
 import threading
+import time
 from dataclasses import dataclass
 from typing import Optional
 
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..profiler.timer import benchmark
 from .dataset import IterableDataset
 from .sampler import BatchSampler
 
@@ -55,12 +67,19 @@ class DataLoader:
         batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
         collate_fn=None, num_workers=0, use_buffer_reader=True,
         prefetch_factor=2, use_shared_memory=True, timeout=0, worker_init_fn=None,
+        persistent_workers=False, mode="process",
     ):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = int(num_workers)
         self.prefetch_factor = prefetch_factor
         self.worker_init_fn = worker_init_fn
+        self.use_shared_memory = use_shared_memory
+        self.persistent_workers = persistent_workers
+        if mode not in ("process", "thread"):
+            raise ValueError(f"mode must be 'process' or 'thread', got {mode!r}")
+        self.mode = mode
+        self._pool = None
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
             self.batch_sampler = None
@@ -80,10 +99,27 @@ class DataLoader:
 
     def __iter__(self):
         if self._iterable:
-            return self._iter_iterable()
-        if self.num_workers == 0:
-            return self._iter_single()
-        return self._iter_threaded()
+            it = self._iter_iterable()
+        elif self.num_workers == 0:
+            it = self._iter_single()
+        elif self.mode == "process":
+            it = self._iter_multiprocess()
+        else:
+            it = self._iter_threaded()
+        return self._timed(it)
+
+    @staticmethod
+    def _timed(it):
+        """Report per-batch production time to the global Benchmark."""
+        bm = benchmark()
+        while True:
+            t0 = time.perf_counter()
+            try:
+                item = next(it)
+            except StopIteration:
+                return
+            bm.record_reader(time.perf_counter() - t0)
+            yield item
 
     def _iter_single(self):
         for batch_indices in self.batch_sampler:
@@ -98,6 +134,49 @@ class DataLoader:
                 batch = []
         if batch and not self.drop_last:
             yield self.collate_fn(batch)
+
+    def _iter_multiprocess(self):
+        """Process workers + shared-memory transport (io/worker.py); ordered
+        reassembly; persistent_workers keeps the pool across epochs."""
+        import multiprocessing as _mp
+
+        if "fork" not in _mp.get_all_start_methods():
+            # no fork (e.g. Windows): spawn would re-import jax in every
+            # worker (and grab the TPU), so fall back to thread workers
+            import warnings
+
+            warnings.warn("fork start method unavailable; DataLoader falls "
+                          "back to thread workers")
+            yield from self._iter_threaded()
+            return
+        from .worker import WorkerPool
+
+        # workers must not build Tensors (jax in a forked child): they use the
+        # numpy collate unless the user supplied their own (which must then
+        # also be numpy-level)
+        worker_collate = (None if self.collate_fn is default_collate_fn
+                          else self.collate_fn)
+        pool = self._pool
+        if pool is None or not pool.alive:
+            pool = WorkerPool(self.dataset, worker_collate, self.num_workers,
+                              self.worker_init_fn, self.use_shared_memory,
+                              self.prefetch_factor)
+            if self.persistent_workers:
+                self._pool = pool
+        indices = list(self.batch_sampler)
+        try:
+            yield from pool.run_epoch(indices, Tensor)
+        finally:
+            if not self.persistent_workers:
+                pool.shutdown()
+
+    def __del__(self):  # pragma: no cover
+        pool = getattr(self, "_pool", None)
+        if pool is not None:
+            try:
+                pool.shutdown()
+            except Exception:
+                pass
 
     def _iter_threaded(self):
         indices = list(self.batch_sampler)

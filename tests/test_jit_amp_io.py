@@ -226,3 +226,164 @@ def test_hapi_model_fit_evaluate():
     hist = model.fit(ds, batch_size=16, epochs=40, verbose=0)
     assert hist["loss"][-1] < hist["loss"][0]
     assert hist["loss"][-1] < 0.1
+
+
+# --- multiprocess DataLoader (reference worker.py/_DataLoaderIterMultiProcess)
+class _MPDataset:
+    """Module-level so it forks cleanly; big samples exercise the shm path."""
+
+    def __init__(self, n=64, hw=64):
+        self.n = n
+        self.hw = hw
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        import numpy as np
+
+        x = np.full((3, self.hw, self.hw), float(i), np.float32)
+        return x, np.int64(i)
+
+
+class _FailingDataset(_MPDataset):
+    def __getitem__(self, i):
+        if i == 13:
+            raise ValueError("boom at 13")
+        return super().__getitem__(i)
+
+
+class TestMultiprocessDataLoader:
+    def _check_epoch(self, dl, n, bs):
+        import numpy as np
+
+        seen = []
+        for xb, yb in dl:
+            assert tuple(xb.shape)[1:] == (3, 64, 64)
+            ys = np.asarray(yb._value)
+            # shm payload integrity: each image is filled with its index
+            np.testing.assert_allclose(
+                np.asarray(xb._value)[:, 0, 0, 0], ys.astype(np.float32))
+            seen.extend(ys.tolist())
+        assert seen == list(range(n))  # ordered reassembly
+
+    def test_process_loader_parity_and_order(self):
+        from paddle_tpu.io import DataLoader
+
+        ds = _MPDataset(48)
+        dl = DataLoader(ds, batch_size=8, num_workers=3, mode="process")
+        self._check_epoch(dl, 48, 8)
+
+    def test_persistent_workers_two_epochs(self):
+        from paddle_tpu.io import DataLoader
+
+        ds = _MPDataset(32)
+        dl = DataLoader(ds, batch_size=8, num_workers=2, mode="process",
+                        persistent_workers=True)
+        self._check_epoch(dl, 32, 8)
+        pool = dl._pool
+        assert pool is not None and pool.alive
+        self._check_epoch(dl, 32, 8)  # same pool serves epoch 2
+        assert dl._pool is pool
+        pool.shutdown()
+
+    def test_worker_error_propagates(self):
+        import pytest
+
+        from paddle_tpu.io import DataLoader
+
+        dl = DataLoader(_FailingDataset(32), batch_size=8, num_workers=2,
+                        mode="process")
+        with pytest.raises(RuntimeError, match="boom at 13"):
+            for _ in dl:
+                pass
+
+    def test_worker_init_fn_and_info(self):
+        import numpy as np
+
+        from paddle_tpu.io import DataLoader
+
+        # worker_init_fn runs in the child; get_worker_info is set there.
+        # Verify via a side effect observable in the data: scale by worker id
+        # through a module-global the init fn sets.
+        def init_fn(wid):
+            import paddle_tpu.io.dataloader as dlmod
+
+            info = dlmod.get_worker_info()
+            assert info is not None and info.id == wid
+            assert info.num_workers == 2
+
+        dl = DataLoader(_MPDataset(16), batch_size=4, num_workers=2,
+                        mode="process", worker_init_fn=init_fn)
+        assert sum(int(x.shape[0]) for x, _ in dl) == 16
+
+    def test_small_batches_skip_shm(self):
+        from paddle_tpu.io import DataLoader
+
+        class Tiny(_MPDataset):
+            def __getitem__(self, i):
+                import numpy as np
+
+                return np.full((4,), float(i), np.float32), np.int64(i)
+
+        dl = DataLoader(Tiny(24), batch_size=4, num_workers=2, mode="process")
+        import numpy as np
+
+        ys = []
+        for xb, yb in dl:
+            ys.extend(np.asarray(yb._value).tolist())
+        assert ys == list(range(24))
+
+    def test_reader_timer_records(self):
+        from paddle_tpu.io import DataLoader
+        from paddle_tpu.profiler.timer import benchmark
+
+        bm = benchmark()
+        bm.reset()
+        dl = DataLoader(_MPDataset(16), batch_size=4, num_workers=0)
+        for i, _ in enumerate(dl):
+            bm.step(num_samples=4)
+        assert bm.reader.count == 4
+        assert bm.reader_cost > 0
+        assert bm.ips > 0
+        s = bm.summary()
+        assert set(s) == {"reader_cost_avg_s", "batch_cost_avg_s", "ips",
+                          "reader_fraction"}
+
+    def test_abandoned_epoch_then_clean_epoch(self):
+        """Breaking out of an epoch must not corrupt the next one
+        (epoch-tagged tasks/results + slot ack on stale discard)."""
+        import numpy as np
+
+        from paddle_tpu.io import DataLoader
+
+        ds = _MPDataset(32)
+        dl = DataLoader(ds, batch_size=4, num_workers=2, mode="process",
+                        persistent_workers=True)
+        it = iter(dl)
+        next(it)
+        del it  # abandon mid-epoch with tasks in flight
+        ys = []
+        for xb, yb in dl:  # fresh epoch must deliver all 32, in order
+            ys.extend(np.asarray(yb._value).tolist())
+        assert ys == list(range(32))
+        dl._pool.shutdown()
+
+    def test_dead_worker_raises_not_hangs(self):
+        import os
+
+        import pytest
+
+        from paddle_tpu.io import DataLoader
+
+        class Suicide(_MPDataset):
+            def __getitem__(self, i):
+                if i == 9:
+                    os._exit(17)  # hard crash, no exception path
+                return super().__getitem__(i)
+
+        dl = DataLoader(Suicide(32), batch_size=4, num_workers=2,
+                        mode="process")
+        with pytest.raises(RuntimeError, match="exited unexpectedly"):
+            for _ in dl:
+                pass

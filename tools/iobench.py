@@ -1,0 +1,100 @@
+"""Input-pipeline ingest benchmark: process vs thread vs inline DataLoader.
+
+Reference process model: the reader-cost machinery in
+python/paddle/profiler/timer.py plus the DataLoader worker tests
+(test/legacy_test/test_multiprocess_dataloader_*). BASELINE config[1]
+(ResNet-50 ImageNet) needs the input pipeline to stay ahead of the device;
+this tool measures ingest throughput (images/sec) of an ImageNet-shaped
+synthetic pipeline whose per-sample decode/augment cost is Python-level
+(GIL-bound), the shape real JPEG decode + augmentation takes.
+
+Usage: python tools/iobench.py [--quick]
+Emits one JSON line: {"ips_process":..., "ips_thread":..., "ips_inline":...,
+"speedup_process_vs_thread":...}.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from paddle_tpu.io import DataLoader, Dataset  # noqa: E402
+
+
+class SyntheticImageNet(Dataset):
+    """224x224x3 samples with a GIL-holding python/numpy augment step that
+    models JPEG decode + crop + flip + normalize cost."""
+
+    def __init__(self, n=512, work=24):
+        self.n = n
+        self.work = work
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        rng = np.random.RandomState(i)
+        img = rng.randint(0, 256, (256, 256, 3), np.uint8)
+        # python-level work: per-row ops under the GIL (decode stand-in)
+        acc = 0
+        for k in range(self.work):
+            acc += int(img[(i + k) % 256, k % 256, 0])
+        y0 = (i + acc) % 32
+        x0 = (i * 7 + acc) % 32
+        crop = img[y0:y0 + 224, x0:x0 + 224]
+        if (i + acc) % 2:
+            crop = crop[:, ::-1]
+        out = crop.astype(np.float32)
+        out -= np.array([123.675, 116.28, 103.53], np.float32)
+        out /= np.array([58.395, 57.12, 57.375], np.float32)
+        return out.transpose(2, 0, 1), np.int64(i % 1000)
+
+
+def run(mode, n, batch_size, num_workers):
+    ds = SyntheticImageNet(n=n)
+    kwargs = dict(batch_size=batch_size, num_workers=num_workers)
+    if mode == "inline":
+        kwargs["num_workers"] = 0
+    else:
+        kwargs["mode"] = mode
+    dl = DataLoader(ds, **kwargs)
+    # warm one epoch start (fork + first batches)
+    t0 = time.perf_counter()
+    seen = 0
+    for xb, yb in dl:
+        seen += int(xb.shape[0])
+    dt = time.perf_counter() - t0
+    return seen / dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--n", type=int, default=0)
+    ap.add_argument("--workers", type=int, default=4)
+    args = ap.parse_args()
+    n = args.n or (192 if args.quick else 768)
+    out = {"cpus": os.cpu_count()}
+    for mode in ("inline", "thread", "process"):
+        out[f"ips_{mode}"] = round(run(mode, n, 32, args.workers), 1)
+    out["speedup_process_vs_thread"] = round(
+        out["ips_process"] / out["ips_thread"], 2)
+    out["speedup_process_vs_inline"] = round(
+        out["ips_process"] / out["ips_inline"], 2)
+    if out["cpus"] <= 2:
+        # worker parallelism cannot beat inline without cores to run on;
+        # the numbers then measure transport overhead, not pipeline scaling
+        out["note"] = (f"only {out['cpus']} cpu(s) visible: speedups are "
+                       "core-bound; run on the training host for the real "
+                       "ingest ceiling")
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

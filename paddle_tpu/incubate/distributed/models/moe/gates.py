@@ -71,9 +71,38 @@ class BaseGate(Layer):
         oh = _const(jax.nn.one_hot(idx, self.num_experts, dtype=jnp.float32))
         return F.sum(gates * oh, axis=-1)
 
-    def routing(self, x: Tensor):
-        """-> (combine [T,E,C] Tensor, dispatch [T,E,C] const Tensor, aux Tensor)."""
+    def _choices(self, x: Tensor):
+        """-> (list of (idx [T] jnp const, pos [T] jnp const, keep [T] jnp
+        const bool, w Tensor [T] differentiable, already keep-masked and
+        normalized), aux Tensor). One entry per routing fan-out choice —
+        the single source both dispatch formulations derive from."""
         raise NotImplementedError
+
+    def routing(self, x: Tensor):
+        """Dense (GShard einsum) formulation:
+        -> (combine [T,E,C] Tensor, dispatch [T,E,C] const Tensor, aux)."""
+        choices, aux = self._choices(x)
+        tokens = x.shape[0]
+        combine = None
+        dispatch = jnp.zeros((tokens, self.num_experts, self.capacity), bool)
+        for idx, pos, keep, w in choices:
+            d = _dispatch_tensor(idx, pos, keep, self.num_experts, self.capacity)
+            part = _const(d) * F.reshape(w, [tokens, 1, 1])
+            combine = part if combine is None else combine + part
+            dispatch = dispatch | (d > 0)
+        return combine, _const(dispatch), aux
+
+    def routing_sparse(self, x: Tensor):
+        """Ragged formulation for scatter/gather dispatch:
+        -> (expert_idx [T,K] const int32, slot [T,K] const int32 (-1 where the
+        token was dropped), weights [T,K] Tensor (keep-masked), aux)."""
+        choices, aux = self._choices(x)
+        eidx = jnp.stack([c[0].astype(jnp.int32) for c in choices], axis=1)
+        slot = jnp.stack(
+            [jnp.where(c[2], c[1], -1).astype(jnp.int32) for c in choices],
+            axis=1)
+        weights = F.stack([c[3] for c in choices], axis=1)
+        return _const(eidx), _const(slot), weights, aux
 
 
 class NaiveGate(BaseGate):
@@ -83,15 +112,12 @@ class NaiveGate(BaseGate):
         super().__init__(d_model, num_experts, capacity)
         self.top_k = top_k
 
-    def routing(self, x: Tensor):
+    def _choices(self, x: Tensor):
         gates = self._gates(x)
         gv = gates._value
-        tokens = gv.shape[0]
-
-        combine = None
-        dispatch = jnp.zeros((tokens, self.num_experts, self.capacity), jnp.float32)
         occupancy = jnp.zeros((self.num_experts,), jnp.int32)
         remaining = gv
+        choices = []
         for _ in range(self.top_k):
             idx = jnp.argmax(remaining, axis=-1)
             remaining = remaining * (
@@ -100,13 +126,10 @@ class NaiveGate(BaseGate):
             oh = jax.nn.one_hot(idx, self.num_experts, dtype=jnp.int32)
             pos = jnp.sum((jnp.cumsum(oh, axis=0) + occupancy[None, :]) * oh, -1) - 1
             keep = (pos >= 0) & (pos < self.capacity)
-            d = _dispatch_tensor(idx, pos, keep, self.num_experts, self.capacity)
-            w = self._selected_weight(gates, idx)  # differentiable [T]
-            part = _const(d) * F.reshape(w, [tokens, 1, 1])
-            combine = part if combine is None else combine + part
-            dispatch = dispatch + d
+            w = self._selected_weight(gates, idx) * _const(keep.astype(jnp.float32))
+            choices.append((idx, pos, keep, w))
             occupancy = occupancy + jnp.sum(oh * keep[:, None], axis=0)
-        return combine, _const(dispatch > 0), F.zeros([])
+        return choices, F.zeros([])
 
 
 class SwitchGate(BaseGate):
@@ -117,7 +140,7 @@ class SwitchGate(BaseGate):
         super().__init__(d_model, num_experts, capacity)
         self.jitter = jitter
 
-    def routing(self, x: Tensor):
+    def _choices(self, x: Tensor):
         if self.jitter > 0.0 and self.training:
             noise = _const(
                 jax.random.uniform(
@@ -130,15 +153,12 @@ class SwitchGate(BaseGate):
             x = x * noise
         gates = self._gates(x)
         gv = gates._value
-        tokens = gv.shape[0]
         idx = jnp.argmax(gv, axis=-1)
         oh = jax.nn.one_hot(idx, self.num_experts, dtype=jnp.int32)
         pos = _positions_in_expert(oh)
         keep = (pos >= 0) & (pos < self.capacity)
-        d = _dispatch_tensor(idx, pos, keep, self.num_experts, self.capacity)
-        w = self._selected_weight(gates, idx)
-        combine = _const(d) * F.reshape(w, [tokens, 1, 1])
-        return combine, _const(d > 0), self._aux_loss(gates, idx)
+        w = self._selected_weight(gates, idx) * _const(keep.astype(jnp.float32))
+        return [(idx, pos, keep, w)], self._aux_loss(gates, idx)
 
 
 class GShardGate(BaseGate):
@@ -149,10 +169,9 @@ class GShardGate(BaseGate):
         super().__init__(d_model, num_experts, capacity)
         self.second_policy = second_policy
 
-    def routing(self, x: Tensor):
+    def _choices(self, x: Tensor):
         gates = self._gates(x)
         gv = gates._value
-        tokens = gv.shape[0]
 
         idx1 = jnp.argmax(gv, axis=-1)
         masked = gv * (1.0 - jax.nn.one_hot(idx1, self.num_experts, dtype=gv.dtype))
@@ -174,15 +193,13 @@ class GShardGate(BaseGate):
         pos2 = jnp.sum((jnp.cumsum(oh2, axis=0) + count1[None, :]) * oh2, -1) - 1
         keep2 = (pos2 >= 0) & (pos2 < self.capacity) & keep2_gate
 
-        d1 = _dispatch_tensor(idx1, pos1, keep1, self.num_experts, self.capacity)
-        d2 = _dispatch_tensor(idx2, pos2, keep2, self.num_experts, self.capacity)
-
         w1 = self._selected_weight(gates, idx1)
         w2 = self._selected_weight(gates, idx2)
         k1 = _const(keep1.astype(jnp.float32))
         k2 = _const(keep2.astype(jnp.float32))
         denom = F.maximum(w1 * k1 + w2 * k2, F.full_like(w1, 1e-9))
-        combine = _const(d1) * F.reshape(w1 * k1 / denom, [tokens, 1, 1]) + _const(
-            d2
-        ) * F.reshape(w2 * k2 / denom, [tokens, 1, 1])
-        return combine, _const((d1 + d2) > 0), self._aux_loss(gates, idx1)
+        choices = [
+            (idx1, pos1, keep1, w1 * k1 / denom),
+            (idx2, pos2, keep2, w2 * k2 / denom),
+        ]
+        return choices, self._aux_loss(gates, idx1)

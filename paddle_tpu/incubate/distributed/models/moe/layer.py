@@ -94,12 +94,17 @@ class MoELayer(Layer):
         top_k: int = 2,
         capacity_factor: float = 1.25,
         moe_group=None,
+        dispatch_mode: str = "auto",
         name=None,
     ):
         super().__init__()
         self.d_model = d_model
         self.capacity_factor = capacity_factor
         self.group = moe_group
+        if dispatch_mode not in ("auto", "dense", "sparse"):
+            raise ValueError(
+                f"dispatch_mode must be auto|dense|sparse, got {dispatch_mode!r}")
+        self.dispatch_mode = dispatch_mode
 
         if isinstance(experts, (list, tuple)):
             self.experts = list(experts)
@@ -158,16 +163,80 @@ class MoELayer(Layer):
         else:
             self.gate.capacity = capacity
 
+        mode = self.dispatch_mode
+        if mode != "dense" and not self._gate_supports_sparse():
+            # custom gate written against the routing()-only contract
+            if mode == "sparse":
+                import warnings
+
+                warnings.warn(
+                    f"gate {type(self.gate).__name__} does not implement "
+                    "_choices()/routing_sparse(); using dense dispatch")
+            mode = "dense"
+        if mode == "auto":
+            # dense dispatch burns T*E*C*M ~ cf*k*T^2*M flops in the routing
+            # einsums (quadratic in tokens); the scatter/gather path is
+            # O(k*T*M) memory-bound. tools/moebench.py measures the
+            # crossover — dense only wins for small token counts / few
+            # experts where the einsum stays on the MXU's fast path.
+            mode = "sparse" if (tokens * self.num_experts >= 1 << 15
+                                or self.num_experts >= 16) else "dense"
+
+        if mode == "sparse":
+            out = self._forward_sparse(x2d, tokens, capacity)
+        else:
+            out = self._forward_dense(x2d)
+        return F.reshape(out, orig_shape)
+
+    def _gate_supports_sparse(self):
+        from .gates import BaseGate
+
+        cls = type(self.gate)
+        return (cls._choices is not BaseGate._choices
+                or cls.routing_sparse is not BaseGate.routing_sparse)
+
+    def _run_experts(self, expert_in):
+        if self._fused is not None:
+            return self._fused(expert_in)
+        parts = F.unbind(expert_in, axis=0)
+        return F.stack([e(p) for e, p in zip(self.experts, parts)], axis=0)
+
+    def _forward_dense(self, x2d):
         combine, dispatch, aux = self.gate.routing(x2d)
         self.aux_loss = aux
-
         # dispatch: [T,E,C] x [T,M] -> [E,C,M]  (GSPMD: all-to-all over 'ep')
         expert_in = F.einsum("tec,tm->ecm", F.cast(dispatch, x2d.dtype), x2d)
-        if self._fused is not None:
-            expert_out = self._fused(expert_in)
-        else:
-            parts = F.unbind(expert_in, axis=0)
-            expert_out = F.stack([e(p) for e, p in zip(self.experts, parts)], axis=0)
+        expert_out = self._run_experts(expert_in)
         # combine: [T,E,C] x [E,C,M] -> [T,M]
-        out = F.einsum("tec,ecm->tm", F.cast(combine, expert_out.dtype), expert_out)
-        return F.reshape(out, orig_shape)
+        return F.einsum("tec,ecm->tm", F.cast(combine, expert_out.dtype), expert_out)
+
+    def _forward_sparse(self, x2d, tokens, capacity):
+        """Ragged dispatch: scatter tokens into their (expert, slot) rows and
+        gather them back — O(k*T*M) instead of the dense einsum's
+        cf*k*T^2*M (reference analog: moe_utils.py global_scatter/
+        global_gather move only routed tokens)."""
+        E, C, d = self.num_experts, capacity, x2d.shape[-1]
+        eidx, slot, weights, aux = self.gate.routing_sparse(x2d)
+        self.aux_loss = aux
+        K = eidx.shape[1]
+
+        valid = F.cast(slot >= 0, x2d.dtype)                      # [T,K]
+        # dropped tokens route to a trash row E*C that never reaches experts
+        flat = eidx * C + F.cast(F.clip(F.cast(slot, "int32"), 0, C - 1), "int32")
+        flat = F.where(slot >= 0, flat, F.full_like(flat, E * C))  # [T,K]
+
+        zeros = F.zeros([E * C + 1, d], dtype=x2d.dtype)
+        contrib = F.reshape(
+            F.expand(F.unsqueeze(x2d, 1), [tokens, K, d]) * F.unsqueeze(valid, -1),
+            [tokens * K, d])
+        expert_in_flat = F.index_add(zeros, F.reshape(flat, [-1]), 0, contrib)
+        expert_in = F.reshape(expert_in_flat[:E * C], [E, C, d])
+
+        expert_out = self._run_experts(expert_in)
+
+        out_flat = F.reshape(expert_out, [E * C, d])
+        out_flat = F.concat([out_flat, F.zeros([1, d], dtype=out_flat.dtype)], axis=0)
+        gathered = F.reshape(
+            F.gather(out_flat, F.reshape(flat, [-1]), axis=0), [tokens, K, d])
+        w = F.cast(weights, gathered.dtype) * valid
+        return F.sum(gathered * F.unsqueeze(w, -1), axis=1)

@@ -121,6 +121,32 @@ class DataLoader:
             bm.record_reader(time.perf_counter() - t0)
             yield item
 
+    def _produces_tensors(self) -> bool:
+        """Probe one sample (and the custom collate, if any) in the parent:
+        Tensor leaves mean the pipeline touches jax and cannot fork."""
+        def has_tensor(tree):
+            if isinstance(tree, Tensor):
+                return True
+            if isinstance(tree, (tuple, list)):
+                return any(has_tensor(t) for t in tree)
+            if isinstance(tree, dict):
+                return any(has_tensor(v) for v in tree.values())
+            return False
+
+        try:
+            first = next(iter(self.batch_sampler))
+            sample = self.dataset[first[0]]
+        except Exception:
+            return False  # let the worker surface the real error
+        if has_tensor(sample):
+            return True
+        if self.collate_fn is not default_collate_fn:
+            try:
+                return has_tensor(self.collate_fn([sample]))
+            except Exception:
+                return False
+        return False
+
     def _iter_single(self):
         for batch_indices in self.batch_sampler:
             yield self.collate_fn([self.dataset[i] for i in batch_indices])
@@ -156,6 +182,17 @@ class DataLoader:
         # also be numpy-level)
         worker_collate = (None if self.collate_fn is default_collate_fn
                           else self.collate_fn)
+        if self._produces_tensors():
+            # Tensor-producing datasets/collates predate process mode and
+            # must not run jax inside a forked child — keep them on threads
+            import warnings
+
+            warnings.warn(
+                "dataset/collate_fn produces Tensors; process workers would "
+                "run jax in a forked child — falling back to thread workers. "
+                "Return numpy from __getitem__/collate_fn to use processes.")
+            yield from self._iter_threaded()
+            return
         pool = self._pool
         if pool is None or not pool.alive:
             pool = WorkerPool(self.dataset, worker_collate, self.num_workers,
@@ -164,8 +201,12 @@ class DataLoader:
             if self.persistent_workers:
                 self._pool = pool
         indices = list(self.batch_sampler)
+        # default collate yields Tensors; a custom collate's output passes
+        # through EXACTLY as produced (numpy stays numpy), matching the
+        # num_workers=0 path
+        to_tensor = Tensor if worker_collate is None else (lambda a: a)
         try:
-            yield from pool.run_epoch(indices, Tensor)
+            yield from pool.run_epoch(indices, to_tensor)
         finally:
             if not self.persistent_workers:
                 pool.shutdown()

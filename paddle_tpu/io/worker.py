@@ -261,17 +261,11 @@ class WorkerPool:
                 old.close()
         seg = self._attached.get(seg_name)
         if seg is None:
-            # attach-only mapping; the worker owns creation and unlink.
-            # (Python 3.12 tracks attachments too, so balance the tracker to
-            # avoid a spurious unlink when the parent exits; 3.13's
-            # track=False does this properly.)
+            # attach-only mapping. The worker owns creation and unlink; the
+            # tracker's name set coalesces the child's register with this
+            # attach-register, and the worker's unlink removes it — balanced,
+            # and a killed worker's segments still get tracker leak-cleanup.
             seg = shared_memory.SharedMemory(name=seg_name)
-            try:
-                from multiprocessing import resource_tracker
-
-                resource_tracker.unregister(seg._name, "shared_memory")
-            except Exception:  # pragma: no cover
-                pass
             self._attached[seg_name] = seg
             self._slot_names[key] = seg_name
         out = _unpack(payload, seg.buf, to_tensor)
@@ -341,7 +335,10 @@ class WorkerPool:
             except Exception:  # pragma: no cover
                 pass
         for p in self.procs:
-            p.join(timeout=2.0)
+            # generous join so a worker inside a slow __getitem__ can reach
+            # its finally-block and unlink its ring segments; a terminated
+            # worker's segments fall to the resource tracker's exit cleanup
+            p.join(timeout=5.0)
             if p.is_alive():  # pragma: no cover
                 p.terminate()
         for seg in self._attached.values():

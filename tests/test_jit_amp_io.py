@@ -387,3 +387,38 @@ class TestMultiprocessDataLoader:
         with pytest.raises(RuntimeError, match="exited unexpectedly"):
             for _ in dl:
                 pass
+
+    def test_tensor_dataset_falls_back_to_threads(self):
+        import numpy as np
+        import pytest as _pytest
+
+        import paddle_tpu as paddle
+        from paddle_tpu.io import DataLoader
+
+        class TensorDS(_MPDataset):
+            def __getitem__(self, i):
+                x, y = super().__getitem__(i)
+                return paddle.to_tensor(x), y
+
+        dl = DataLoader(TensorDS(8), batch_size=4, num_workers=2,
+                        mode="process")
+        with _pytest.warns(UserWarning, match="thread workers"):
+            batches = list(dl)
+        assert len(batches) == 2
+
+    def test_custom_collate_numpy_passthrough(self):
+        import numpy as np
+
+        from paddle_tpu.io import DataLoader
+
+        def np_collate(batch):
+            xs = np.stack([b[0] for b in batch])
+            ys = np.asarray([b[1] for b in batch])
+            return xs, ys
+
+        dl = DataLoader(_MPDataset(16), batch_size=4, num_workers=2,
+                        mode="process", collate_fn=np_collate)
+        for xb, yb in dl:
+            # custom collate output passes through as numpy, matching
+            # the num_workers=0 behavior
+            assert isinstance(xb, np.ndarray) and isinstance(yb, np.ndarray)

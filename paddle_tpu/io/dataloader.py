@@ -121,9 +121,15 @@ class DataLoader:
             bm.record_reader(time.perf_counter() - t0)
             yield item
 
-    def _produces_tensors(self) -> bool:
+    def _produces_tensors(self, probe_index) -> bool:
         """Probe one sample (and the custom collate, if any) in the parent:
-        Tensor leaves mean the pipeline touches jax and cannot fork."""
+        Tensor leaves mean the pipeline touches jax and cannot fork. Probed
+        once per loader (cached) with an index from the already-materialized
+        epoch list, so one-shot/stateful samplers are never consumed."""
+        cached = getattr(self, "_tensor_probe", None)
+        if cached is not None:
+            return cached
+
         def has_tensor(tree):
             if isinstance(tree, Tensor):
                 return True
@@ -133,19 +139,16 @@ class DataLoader:
                 return any(has_tensor(v) for v in tree.values())
             return False
 
+        result = False
         try:
-            first = next(iter(self.batch_sampler))
-            sample = self.dataset[first[0]]
+            sample = self.dataset[probe_index]
+            result = has_tensor(sample)
+            if not result and self.collate_fn is not default_collate_fn:
+                result = has_tensor(self.collate_fn([sample]))
         except Exception:
-            return False  # let the worker surface the real error
-        if has_tensor(sample):
-            return True
-        if self.collate_fn is not default_collate_fn:
-            try:
-                return has_tensor(self.collate_fn([sample]))
-            except Exception:
-                return False
-        return False
+            result = False  # let the worker surface the real error
+        self._tensor_probe = result
+        return result
 
     def _iter_single(self):
         for batch_indices in self.batch_sampler:
@@ -182,7 +185,10 @@ class DataLoader:
         # also be numpy-level)
         worker_collate = (None if self.collate_fn is default_collate_fn
                           else self.collate_fn)
-        if self._produces_tensors():
+        indices = list(self.batch_sampler)
+        if not indices:
+            return
+        if self._produces_tensors(indices[0][0]):
             # Tensor-producing datasets/collates predate process mode and
             # must not run jax inside a forked child — keep them on threads
             import warnings
@@ -200,7 +206,6 @@ class DataLoader:
                               self.prefetch_factor)
             if self.persistent_workers:
                 self._pool = pool
-        indices = list(self.batch_sampler)
         # default collate yields Tensors; a custom collate's output passes
         # through EXACTLY as produced (numpy stays numpy), matching the
         # num_workers=0 path

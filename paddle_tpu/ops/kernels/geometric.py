@@ -51,3 +51,28 @@ def graph_send_uv(x, y, src_index, dst_index, message_op="add"):
     xs = jnp.take(x, src_index, axis=0)
     yd = jnp.take(y, dst_index, axis=0)
     return xs + yd if message_op.lower() == "add" else xs * yd
+
+
+def segment_pool(x, segment_ids, pooltype="SUM"):
+    """phi segment_pool_kernel: pool rows of x by contiguous segment ids.
+    Output has num_segments = max(id)+1 rows (data-dependent => eager-only,
+    like the reference); MEAN/SUM/MAX/MIN supported."""
+    ids = segment_ids.astype(jnp.int32)
+    n = int(jax.device_get(jnp.max(ids))) + 1 if ids.size else 0
+    kind = pooltype.upper()
+    if kind in ("SUM", "MEAN"):
+        out = jnp.zeros((n,) + x.shape[1:], x.dtype).at[ids].add(x)
+        if kind == "MEAN":
+            cnt = jnp.zeros((n,), x.dtype).at[ids].add(1.0)
+            shape = (n,) + (1,) * (x.ndim - 1)
+            out = out / jnp.maximum(cnt, 1.0).reshape(shape)
+        return out
+    if kind == "MAX":
+        init = jnp.full((n,) + x.shape[1:], -jnp.inf, x.dtype)
+        out = init.at[ids].max(x)
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+    if kind == "MIN":
+        init = jnp.full((n,) + x.shape[1:], jnp.inf, x.dtype)
+        out = init.at[ids].min(x)
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+    raise ValueError(f"unknown pooltype {pooltype!r}")

@@ -255,3 +255,130 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
         losses = losses + jnp.where(valid, step_loss, 0.0)
         code = parent
     return losses[:, None]
+
+
+def huber_loss(input, label, delta=1.0):
+    """phi huber_loss_kernel (NOT smooth_l1: no /delta normalization)."""
+    r = input - label
+    a = jnp.abs(r)
+    return jnp.where(a <= delta, 0.5 * r * r, delta * (a - 0.5 * delta))
+
+
+def sigmoid_cross_entropy_with_logits(x, label, normalize=False,
+                                      ignore_index=-100, pos_weight=None):
+    """phi sigmoid_cross_entropy_with_logits_kernel."""
+    valid = (label != ignore_index)
+    lab = jnp.where(valid, label, 0).astype(x.dtype)
+    # stable BCE-with-logits
+    base = jnp.maximum(x, 0) - x * lab + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    if pos_weight is not None:
+        w = 1.0 + (pos_weight - 1.0) * lab
+        base = base * w
+    out = jnp.where(valid, base, 0.0)
+    if normalize:
+        out = out / jnp.maximum(jnp.sum(valid.astype(x.dtype)), 1.0)
+    return out
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, return_softmax=False):
+    """phi margin_cross_entropy (ArcFace-family margin softmax):
+    cos(m1*theta + m2) - m3 applied to the target logit, then scaled CE."""
+    # clip strictly inside [-1, 1]: arccos' is infinite at the boundary and
+    # a single cos==1.0 sample (embedding vs its own center) would NaN the
+    # whole gradient
+    t = jnp.clip(logits, -1.0 + 1e-7, 1.0 - 1e-7)
+    theta = jnp.arccos(t)
+    target_theta = jnp.take_along_axis(theta, label[:, None].astype(jnp.int32), 1)
+    target = jnp.cos(margin1 * target_theta + margin2) - margin3
+    oh = jax.nn.one_hot(label.astype(jnp.int32), logits.shape[-1], dtype=t.dtype)
+    adj = t * (1.0 - oh) + target * oh
+    z = adj * scale
+    logp = jax.nn.log_softmax(z, axis=-1)
+    loss = -jnp.take_along_axis(logp, label[:, None].astype(jnp.int32), 1)
+    if return_softmax:
+        return loss, jnp.exp(logp)
+    return loss
+
+
+def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.0):
+    """RNN-Transducer loss (phi warprnnt analog), TPU-native formulation.
+
+    logits: [B, T, U+1, V] joint-network log-probs (unnormalized ok);
+    labels: [B, U] int32. The alpha recursion
+        a[t,u] = logaddexp(a[t-1,u] + blank(t-1,u), a[t,u-1] + emit(t,u-1))
+    runs as a lax.scan over T whose body solves the u-recursion with an
+    associative scan in the (log,+) semiring — first-order linear recurrences
+    compose associatively as affine maps (c2, b2)o(c1, b1) =
+    (c1+c2, logaddexp(b2, c2+b1)) — so each step is O(log U) depth instead
+    of a sequential U loop.
+    """
+    if fastemit_lambda:
+        raise NotImplementedError("rnnt_loss: fastemit_lambda not supported")
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    B, T, U1, V = lp.shape
+    U = U1 - 1
+    blank_lp = lp[..., blank]                                  # [B, T, U+1]
+    lab = labels.astype(jnp.int32)
+    emit_lp = jnp.take_along_axis(
+        lp[:, :, :U, :], lab[:, None, :, None], axis=-1)[..., 0]  # [B,T,U]
+    NEG = -1e30
+
+    def solve_row(base, c):
+        """y[u] = logaddexp(base[u], y[u-1] + c[u-1]); y[-1] = -inf."""
+        cs = jnp.concatenate([jnp.full(c.shape[:-1] + (1,), NEG), c[..., :-1]],
+                             axis=-1)
+
+        def comb(l, r):
+            cl, bl = l
+            cr, br = r
+            return cl + cr, jnp.logaddexp(br, cr + bl)
+
+        _, y = jax.lax.associative_scan(comb, (cs, base), axis=-1)
+        return y
+
+    def step(alpha_prev, t):
+        # base: from the T-direction (blank transition t-1 -> t)
+        init0 = jnp.concatenate(
+            [jnp.zeros((B, 1)), jnp.full((B, U), NEG)], -1)
+        base = jnp.where(t == 0, init0,
+                         alpha_prev + blank_lp[:, jnp.maximum(t - 1, 0), :])
+        # u-recursion: emit transition (t, u-1) -> (t, u); pad so
+        # solve_row's right-shift yields cs[u] = emit[u-1]
+        c_in = jnp.concatenate(
+            [emit_lp[:, t, :], jnp.full((B, 1), NEG)], -1)
+        alpha = solve_row(base, c_in)
+        return alpha, alpha
+
+    alpha0 = jnp.full((B, U1), NEG)
+    _, alphas = jax.lax.scan(step, alpha0, jnp.arange(T))      # [T, B, U+1]
+    alphas = jnp.moveaxis(alphas, 0, 1)                        # [B, T, U+1]
+    tl = logit_lengths.astype(jnp.int32)
+    ul = label_lengths.astype(jnp.int32)
+    a_final = jnp.take_along_axis(
+        jnp.take_along_axis(alphas, (tl - 1)[:, None, None], axis=1)[:, 0, :],
+        ul[:, None], axis=1)[:, 0]
+    final_blank = jnp.take_along_axis(
+        jnp.take_along_axis(blank_lp, (tl - 1)[:, None, None], axis=1)[:, 0, :],
+        ul[:, None], axis=1)[:, 0]
+    return -(a_final + final_blank)
+
+
+def class_center_sample(label, num_classes, num_samples, seed=None):
+    """phi class_center_sample: keep all positive classes + uniformly sampled
+    negatives up to num_samples; remap labels into the sampled set."""
+    from ...core.random import next_key
+
+    lab = label.astype(jnp.int32)
+    pos = jnp.zeros((num_classes,), bool).at[lab].set(True)
+    # rank positives first (stable), then randomly-permuted negatives
+    key = next_key()
+    noise = jax.random.uniform(key, (num_classes,))
+    score = jnp.where(pos, 2.0, noise)  # positives sort first
+    order = jnp.argsort(-score)
+    sampled = jnp.sort(order[:num_samples])
+    # remap: position of each label inside the (sorted) sampled set
+    inv = jnp.full((num_classes,), -1, jnp.int32).at[sampled].set(
+        jnp.arange(num_samples, dtype=jnp.int32))
+    return inv[lab], sampled

@@ -545,3 +545,67 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None):
     if return_counts:
         res.append(jnp.asarray(np.diff(np.append(starts, len(change)))))
     return res[0] if len(res) == 1 else tuple(res)
+
+
+def fill_diagonal(x, value, offset=0, wrap=False):
+    """phi fill_diagonal_kernel: write `value` on the (offset) diagonal of the
+    last two dims; wrap=True restarts the diagonal every w+1 rows on tall
+    matrices (numpy fill_diagonal wrap semantics)."""
+    h, w = x.shape[-2], x.shape[-1]
+    rows = jnp.arange(h)[:, None]
+    cols = jnp.arange(w)[None, :]
+    eff_rows = rows % (w + 1) if (wrap and h > w) else rows
+    mask = (cols - eff_rows) == offset
+    return jnp.where(mask, jnp.asarray(value, x.dtype), x)
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1):
+    """phi fill_diagonal_tensor_kernel: write tensor y along the diagonal of
+    dims (dim1, dim2)."""
+    xm = jnp.moveaxis(x, (dim1 % x.ndim, dim2 % x.ndim), (-2, -1))
+    h, w = xm.shape[-2], xm.shape[-1]
+    rows = jnp.arange(h)[:, None]
+    cols = jnp.arange(w)[None, :]
+    mask = (cols - rows) == offset
+    n = min(h, w - max(offset, 0)) if offset >= 0 else min(h + offset, w)
+    ypad = jnp.zeros(xm.shape[:-2] + (h, w), x.dtype)
+    ridx = jnp.arange(n) + (-offset if offset < 0 else 0)
+    cidx = jnp.arange(n) + (offset if offset > 0 else 0)
+    ypad = ypad.at[..., ridx, cidx].set(y.astype(x.dtype))
+    out = jnp.where(mask, ypad, xm)
+    return jnp.moveaxis(out, (-2, -1), (dim1 % x.ndim, dim2 % x.ndim))
+
+
+def reverse(x, axis):
+    """legacy reverse op (alias of flip with list axis)."""
+    if isinstance(axis, int):
+        axis = [axis]
+    return jnp.flip(x, axis=tuple(axis))
+
+
+def multiplex(inputs, index):
+    """legacy multiplex: per-row select among candidate tensors.
+    inputs: list of [N, ...]; index: [N, 1] int. out[i] = inputs[index[i]][i]."""
+    stacked = jnp.stack(inputs, axis=0)  # [K, N, ...]
+    idx = index.reshape(-1).astype(jnp.int32)
+    rows = jnp.arange(stacked.shape[1])
+    return stacked[idx, rows]
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
+    """phi temporal_shift_kernel (TSM): shift a channel slice one step
+    forward/backward along the segment (time) axis."""
+    if data_format == "NHWC":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    xr = x.reshape(n, seg_num, c, h, w)
+    c1 = int(c * shift_ratio)
+    c2 = int(c * 2 * shift_ratio)
+    back = jnp.concatenate([xr[:, 1:, :c1], jnp.zeros_like(xr[:, :1, :c1])], axis=1)
+    fwd = jnp.concatenate([jnp.zeros_like(xr[:, :1, c1:c2]), xr[:, :-1, c1:c2]], axis=1)
+    keep = xr[:, :, c2:]
+    out = jnp.concatenate([back, fwd, keep], axis=2).reshape(nt, c, h, w)
+    if data_format == "NHWC":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out

@@ -438,3 +438,39 @@ def sgn(x):
 
 def log_normalize(x, axis=-1):
     return x - jax.scipy.special.logsumexp(x, axis=axis, keepdims=True)
+
+
+def elementwise_pow(x, y):
+    """Reference name for tensor-tensor pow (legacy_ops.yaml elementwise_pow)."""
+    return jnp.power(x, y)
+
+
+def squared_l2_norm(x):
+    """phi squared_l2_norm_kernel: sum of squares as a 0-d tensor."""
+    return jnp.sum(jnp.square(x))
+
+
+def frobenius_norm(x, axis=None, keepdim=False):
+    if axis is None:
+        axis = tuple(range(x.ndim))
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=tuple(jnp.atleast_1d(jnp.asarray(axis)).tolist()) if not isinstance(axis, (tuple, list)) else tuple(axis), keepdims=keepdim))
+
+
+def clip_by_norm(x, max_norm):
+    """phi clip_by_norm_kernel: scale x so ||x||_2 <= max_norm."""
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    return jnp.where(norm > max_norm, x * (max_norm / jnp.maximum(norm, 1e-12)), x)
+
+
+def increment(x, value=1.0):
+    """legacy increment op: x + value (0-d/1-element tensors)."""
+    return x + jnp.asarray(value, x.dtype)
+
+
+def mean_all(x):
+    """phi mean_all_kernel: mean over every element (0-d out)."""
+    return jnp.mean(x)
+
+
+def gammaln(x):
+    return jax.scipy.special.gammaln(x)

@@ -1,0 +1,36 @@
+"""Metric op kernels (reference: phi accuracy_kernel, auc_kernel)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def accuracy(input, label, k=1):
+    """phi accuracy_kernel: fraction of rows whose top-k predictions contain
+    the label. input: [N, C] scores (or [N, k] pre-computed top-k indices
+    when integer-typed), label: [N, 1] or [N]."""
+    lab = label.reshape(-1).astype(jnp.int32)
+    if jnp.issubdtype(input.dtype, jnp.integer):
+        topk = input[:, :k].astype(jnp.int32)
+    else:
+        topk = jnp.argsort(-input, axis=-1)[:, :k].astype(jnp.int32)
+    hit = jnp.any(topk == lab[:, None], axis=-1)
+    return jnp.mean(hit.astype(jnp.float32))
+
+
+def auc(predict, label, num_thresholds=4095):
+    """phi auc_kernel (ROC-AUC by threshold bucketing, single batch).
+    predict: [N, 2] binary-class probabilities (positive = column 1) or [N]."""
+    p = predict[:, 1] if predict.ndim == 2 else predict
+    lab = label.reshape(-1).astype(jnp.float32)
+    bucket = jnp.clip((p * num_thresholds).astype(jnp.int32), 0, num_thresholds)
+    pos = jnp.zeros((num_thresholds + 1,), jnp.float32).at[bucket].add(lab)
+    neg = jnp.zeros((num_thresholds + 1,), jnp.float32).at[bucket].add(1.0 - lab)
+    # sweep thresholds high->low: cumulative TP/FP
+    tp = jnp.cumsum(pos[::-1])[::-1]
+    fp = jnp.cumsum(neg[::-1])[::-1]
+    tot_pos = tp[0]
+    tot_neg = fp[0]
+    # trapezoid over the ROC curve (threshold steps low->high)
+    tpr = jnp.concatenate([tp, jnp.zeros((1,))]) / jnp.maximum(tot_pos, 1.0)
+    fpr = jnp.concatenate([fp, jnp.zeros((1,))]) / jnp.maximum(tot_neg, 1.0)
+    return jnp.sum((fpr[:-1] - fpr[1:]) * (tpr[:-1] + tpr[1:]) * 0.5)

@@ -777,3 +777,56 @@ def zeropad2d(x, padding, data_format="NCHW"):
     if data_format == "NCHW":
         return jnp.pad(x, ((0, 0), (0, 0), (t, b), (l, r)))
     return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0)))
+
+
+def spectral_norm(weight, u, v, dim=0, power_iters=1, eps=1e-12):
+    """phi spectral_norm_kernel: weight / sigma_max estimated by power
+    iteration; u, v are the persistent iteration vectors."""
+    w = jnp.moveaxis(weight, dim, 0)
+    h = w.shape[0]
+    wm = w.reshape(h, -1)
+    for _ in range(max(power_iters, 0)):
+        v = wm.T @ u
+        v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+        u = wm @ v
+        u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+    sigma = u @ (wm @ v)
+    return weight / jnp.maximum(sigma, eps)
+
+
+def bilinear(x1, x2, weight, bias=None):
+    """phi bilinear_kernel: out[b, o] = x1[b] @ W[o] @ x2[b] (+ bias)."""
+    out = jnp.einsum("bi,oij,bj->bo", x1, weight, x2)
+    if bias is not None:
+        out = out + bias.reshape(1, -1)
+    return out
+
+
+def pad3d(x, paddings, mode="constant", value=0.0, data_format="NCDHW"):
+    """phi pad3d_kernel: paddings = [left, right, top, bottom, front, back]
+    over (W, H, D)."""
+    l, r, t, b, f, bk = (int(p) for p in paddings)
+    if data_format == "NCDHW":
+        width = [(0, 0), (0, 0), (f, bk), (t, b), (l, r)]
+    else:  # NDHWC
+        width = [(0, 0), (f, bk), (t, b), (l, r), (0, 0)]
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, width, mode="constant", constant_values=value)
+    return jnp.pad(x, width, mode=jmode)
+
+
+def memory_efficient_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                               is_causal=False, scale=None, training=True):
+    """Reference memory_efficient_attention op: same contract as
+    scaled_dot_product_attention (the TPU path is already streaming/fused)."""
+    return scaled_dot_product_attention(
+        query, key, value, attn_mask=attn_mask, dropout_p=dropout_p,
+        is_causal=is_causal, training=training, scale=scale)
+
+
+logsigmoid = log_sigmoid
+tanh_shrink = tanhshrink
+bce_loss = binary_cross_entropy
+kldiv_loss = kl_div

@@ -93,3 +93,27 @@ def uniform_(x, min=-1.0, max=1.0):
 def exponential(x, lam=1.0):
     key = _random.next_key()
     return jax.random.exponential(key, x.shape, x.dtype) / lam
+
+
+def dirichlet(alpha):
+    """phi dirichlet_kernel: sample Dirichlet(alpha) along the last dim."""
+    from ...core.random import next_key
+
+    return jax.random.dirichlet(next_key(), alpha)
+
+
+def truncated_normal(shape, mean=0.0, std=1.0, a=-2.0, b=2.0, dtype="float32"):
+    """phi truncated_gaussian_random: normal truncated to [a, b] std units."""
+    from ...core.random import next_key
+    from ...core.dtype import convert_dtype
+
+    dt = convert_dtype(dtype)
+    z = jax.random.truncated_normal(next_key(), a, b, tuple(shape), dt)
+    return z * jnp.asarray(std, dt) + jnp.asarray(mean, dt)
+
+
+def standard_gamma(alpha):
+    """paddle.standard_gamma: Gamma(alpha, 1) sampling."""
+    from ...core.random import next_key
+
+    return jax.random.gamma(next_key(), alpha)

@@ -1,0 +1,146 @@
+"""Sequence/decoding op kernels: beam-search backtrace, Viterbi, edit
+distance, STFT framing.
+
+Reference: phi gather_tree_kernel, viterbi_decode_kernel,
+edit_distance_kernel (paddle/phi/kernels/cpu+gpu), and the paddle.signal
+frame/overlap_add ops. TPU design: every recursion is a lax.scan (static
+shapes); edit distance runs the Levenshtein DP as a scan over one sequence
+with the row vectorized.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace. ids/parents: [T, B, W] (time-major, like the
+    reference). Walks parent pointers from the last step back, emitting the
+    full beam paths."""
+    T = ids.shape[0]
+    W = ids.shape[2]
+
+    def step(beam_idx, t):
+        # beam_idx: [B, W] — which beam each final slot follows at time t+1
+        tok = jnp.take_along_axis(ids[t], beam_idx, axis=1)
+        par = jnp.take_along_axis(parents[t], beam_idx, axis=1)
+        return par.astype(jnp.int32), tok
+
+    init = jnp.broadcast_to(jnp.arange(W, dtype=jnp.int32), ids.shape[1:])
+    _, toks = lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+    return jnp.flip(toks, axis=0)
+
+
+def viterbi_decode(potentials, transition, lengths, include_bos_eos_tag=True):
+    """CRF Viterbi decoding (phi viterbi_decode_kernel).
+
+    potentials: [B, T, N] emission scores; transition: [N, N] (with BOS=N-2,
+    EOS=N-1 rows/cols when include_bos_eos_tag). Returns (scores [B],
+    paths [B, T])."""
+    B, T, N = potentials.shape
+    trans = transition
+
+    if include_bos_eos_tag:
+        bos, eos = N - 2, N - 1
+        alpha0 = potentials[:, 0, :] + trans[bos][None, :]
+    else:
+        alpha0 = potentials[:, 0, :]
+
+    def step(carry, t):
+        alpha = carry  # [B, N]
+        scores = alpha[:, :, None] + trans[None, :, :] + potentials[:, t][:, None, :]
+        best_prev = jnp.argmax(scores, axis=1).astype(jnp.int32)   # [B, N]
+        new_alpha = jnp.max(scores, axis=1)
+        # sequences already finished keep their alpha (masked by length)
+        active = (t < lengths)[:, None]
+        new_alpha = jnp.where(active, new_alpha, alpha)
+        best_prev = jnp.where(active, best_prev,
+                              jnp.broadcast_to(jnp.arange(N, dtype=jnp.int32),
+                                               (B, N)))
+        return new_alpha, best_prev
+
+    alpha, history = lax.scan(step, alpha0, jnp.arange(1, T))
+    if include_bos_eos_tag:
+        alpha = alpha + trans[:, eos][None, :]
+    last_tag = jnp.argmax(alpha, axis=-1).astype(jnp.int32)
+    score = jnp.max(alpha, axis=-1)
+
+    def back(tag, prev):
+        new_tag = jnp.take_along_axis(prev, tag[:, None], axis=1)[:, 0]
+        return new_tag, new_tag
+
+    _, tags = lax.scan(back, last_tag, history, reverse=True)
+    paths = jnp.concatenate([jnp.moveaxis(tags, 0, 1), last_tag[:, None]], 1)
+    return score, paths
+
+
+def edit_distance(hyps, refs, hyp_lengths, ref_lengths, normalized=False):
+    """Levenshtein distance (phi edit_distance_kernel). hyps/refs: [B, Tmax]
+    int token ids padded; lengths give the valid prefix. DP row recursion is
+    a lax.scan over the hypothesis with the reference row vectorized via an
+    associative min-plus prefix scan for the insertion chain."""
+    B, Th = hyps.shape
+    Tr = refs.shape[1]
+    BIG = jnp.asarray(1e9, jnp.float32)
+
+    def one(hyp, ref, hl, rl):
+        row0 = jnp.arange(Tr + 1, dtype=jnp.float32)
+        row0 = jnp.where(jnp.arange(Tr + 1) <= rl, row0, BIG)
+
+        def step(row, i):
+            valid_i = i < hl
+            sub = row[:-1] + (ref != hyp[i]).astype(jnp.float32)
+            dele = row[1:] + 1.0
+            base = jnp.minimum(sub, dele)
+            base = jnp.concatenate([jnp.array([i + 1.0]), base])
+            # insertion chain: new[j] = min(base[j], new[j-1] + 1) — a
+            # min-plus prefix scan: new[j] = min_k (base[k] + (j - k))
+            shifted = base - jnp.arange(Tr + 1, dtype=jnp.float32)
+            run_min = lax.associative_scan(jnp.minimum, shifted)
+            new = run_min + jnp.arange(Tr + 1, dtype=jnp.float32)
+            new = jnp.where(jnp.arange(Tr + 1) <= rl, new, BIG)
+            return jnp.where(valid_i, new, row), None
+
+        row, _ = lax.scan(step, row0, jnp.arange(Th))
+        d = row[rl]
+        if normalized:
+            d = d / jnp.maximum(rl.astype(jnp.float32), 1.0)
+        return d
+
+    return jax.vmap(one)(hyps.astype(jnp.int32), refs.astype(jnp.int32),
+                         hyp_lengths.astype(jnp.int32),
+                         ref_lengths.astype(jnp.int32))
+
+
+def frame(x, frame_length, hop_length, axis=-1):
+    """paddle.signal.frame: sliding windows along `axis`.
+    out last dims: [..., frame_length, num_frames] for axis=-1 (reference
+    layout)."""
+    if axis not in (-1, x.ndim - 1):
+        x = jnp.moveaxis(x, axis, -1)
+    n = x.shape[-1]
+    num = 1 + (n - frame_length) // hop_length
+    starts = jnp.arange(num) * hop_length
+    idx = starts[None, :] + jnp.arange(frame_length)[:, None]  # [L, F]
+    out = x[..., idx]  # [..., L, F]
+    if axis not in (-1, x.ndim - 1):
+        out = jnp.moveaxis(out, -1, axis)
+    return out
+
+
+def overlap_add(x, hop_length, axis=-1):
+    """paddle.signal.overlap_add: inverse of frame (sum overlapping windows).
+    x: [..., frame_length, num_frames] for axis=-1."""
+    if axis not in (-1, x.ndim - 1):
+        x = jnp.moveaxis(x, axis, -1)
+    L, F = x.shape[-2], x.shape[-1]
+    n = (F - 1) * hop_length + L
+    starts = jnp.arange(F) * hop_length
+    idx = (starts[None, :] + jnp.arange(L)[:, None]).reshape(-1)  # [L*F]
+    flat = jnp.moveaxis(x, -1, -1).reshape(x.shape[:-2] + (L * F,))
+    zeros = jnp.zeros(x.shape[:-2] + (n,), x.dtype)
+    out = zeros.at[..., idx].add(flat)
+    if axis not in (-1, x.ndim - 1):
+        out = jnp.moveaxis(out, -1, axis)
+    return out

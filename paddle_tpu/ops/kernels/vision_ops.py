@@ -703,3 +703,351 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
     if top_k is not None:
         kept = kept[:top_k]
     return kept
+
+
+# ------------------------------------------------------------- detection ops
+def depthwise_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                     data_format="NCHW"):
+    """phi depthwise_conv2d_kernel: conv2d with groups == in_channels.
+    XLA fuses the grouped conv onto the MXU; no separate kernel needed."""
+    from .nn_ops import conv2d
+
+    channels = x.shape[3] if data_format == "NHWC" else x.shape[1]
+    return conv2d(x, weight, bias=bias, stride=stride, padding=padding,
+                  dilation=dilation, groups=channels,
+                  data_format=data_format)
+
+
+def depthwise_conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                               output_padding=0, dilation=1,
+                               data_format="NCHW"):
+    from .nn_ops import conv2d_transpose
+
+    channels = x.shape[3] if data_format == "NHWC" else x.shape[1]
+    return conv2d_transpose(x, weight, bias=bias, stride=stride,
+                            padding=padding, output_padding=output_padding,
+                            dilation=dilation, groups=channels,
+                            data_format=data_format)
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0, variance=None):
+    """phi box_coder_kernel: encode/decode boxes against priors
+    (center-size parameterization, SSD/Faster-RCNN)."""
+    pb = prior_box
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + pw * 0.5
+    pcy = pb[:, 1] + ph * 0.5
+    if prior_box_var is not None:
+        var = prior_box_var
+    elif variance:
+        var = jnp.asarray(variance, pb.dtype)[None, :]
+    else:
+        var = jnp.ones((1, 4), pb.dtype)
+    if code_type == "encode_center_size":
+        tb = target_box
+        tw = tb[:, None, 2] - tb[:, None, 0] + norm
+        th = tb[:, None, 3] - tb[:, None, 1] + norm
+        tcx = tb[:, None, 0] + tw * 0.5
+        tcy = tb[:, None, 1] + th * 0.5
+        dx = (tcx - pcx[None, :]) / pw[None, :]
+        dy = (tcy - pcy[None, :]) / ph[None, :]
+        dw = jnp.log(jnp.abs(tw / pw[None, :]))
+        dh = jnp.log(jnp.abs(th / ph[None, :]))
+        out = jnp.stack([dx, dy, dw, dh], axis=-1)
+        return out / var[None] if var.ndim == 2 else out / var
+    # decode_center_size: target_box [N, M, 4] deltas
+    tb = target_box
+    if axis == 0:
+        pw_, ph_, pcx_, pcy_ = (v[None, :] for v in (pw, ph, pcx, pcy))
+        v4 = var[None] if var.shape[0] != 1 else var[None]
+    else:
+        pw_, ph_, pcx_, pcy_ = (v[:, None] for v in (pw, ph, pcx, pcy))
+        v4 = var[:, None] if var.shape[0] != 1 else var[None]
+    d = tb * v4
+    ocx = d[..., 0] * pw_ + pcx_
+    ocy = d[..., 1] * ph_ + pcy_
+    ow = jnp.exp(d[..., 2]) * pw_
+    oh = jnp.exp(d[..., 3]) * ph_
+    return jnp.stack([ocx - ow * 0.5, ocy - oh * 0.5,
+                      ocx + ow * 0.5 - norm, ocy + oh * 0.5 - norm], axis=-1)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variances=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False):
+    """phi prior_box_kernel (SSD): anchor boxes per feature-map cell."""
+    import numpy as np
+
+    fh, fw = input.shape[2], input.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    boxes = []
+    for k, ms in enumerate(min_sizes):
+        ms = float(ms)
+        if min_max_aspect_ratios_order:
+            boxes.append((ms, ms))
+            if max_sizes:
+                mx = float(max_sizes[k])
+                boxes.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                boxes.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        else:
+            for ar in ars:
+                boxes.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+            if max_sizes:
+                mx = float(max_sizes[k])
+                boxes.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+    wh = jnp.asarray(boxes, jnp.float32)  # [P, 2]
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)  # [fh, fw]
+    cxy = jnp.stack([cxg, cyg], -1)[:, :, None, :]      # [fh,fw,1,2]
+    half = wh[None, None, :, :] / 2.0
+    mins = (cxy - half) / jnp.asarray([iw, ih], jnp.float32)
+    maxs = (cxy + half) / jnp.asarray([iw, ih], jnp.float32)
+    out = jnp.concatenate([mins, maxs], axis=-1)  # [fh, fw, P, 4]
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), out.shape)
+    return out, var
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """phi yolo_box_kernel: decode YOLOv3 head to boxes+scores."""
+    n, c, h, w = x.shape
+    an = len(anchors) // 2
+    anc = jnp.asarray(anchors, jnp.float32).reshape(an, 2)
+    if iou_aware:
+        # phi layout: leading an channels are the iou block, then boxes block
+        iou_pred = jax.nn.sigmoid(x[:, :an])            # [n, an, h, w]
+        xr = x[:, an:].reshape(n, an, -1, h, w)
+    else:
+        iou_pred = None
+        xr = x.reshape(n, an, -1, h, w)  # [n, an, 5+cls, h, w]
+    gx = (jax.nn.sigmoid(xr[:, :, 0]) - 0.5) * scale_x_y + 0.5
+    gy = (jax.nn.sigmoid(xr[:, :, 1]) - 0.5) * scale_x_y + 0.5
+    cxg = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    cyg = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+    bx = (gx + cxg) / w
+    by = (gy + cyg) / h
+    input_size = downsample_ratio * jnp.asarray([w, h], jnp.float32)
+    bw = jnp.exp(xr[:, :, 2]) * anc[None, :, 0, None, None] / input_size[0]
+    bh = jnp.exp(xr[:, :, 3]) * anc[None, :, 1, None, None] / input_size[1]
+    conf = jax.nn.sigmoid(xr[:, :, 4])
+    if iou_aware:
+        conf = conf ** (1.0 - iou_aware_factor) * iou_pred ** iou_aware_factor
+    probs = jax.nn.sigmoid(xr[:, :, 5:5 + class_num]) * conf[:, :, None]
+    imgh = img_size[:, 0].astype(jnp.float32)[:, None]
+    imgw = img_size[:, 1].astype(jnp.float32)[:, None]
+    flat = lambda t: t.reshape(n, -1)
+    x0 = (flat(bx) - flat(bw) / 2) * imgw
+    y0 = (flat(by) - flat(bh) / 2) * imgh
+    x1 = (flat(bx) + flat(bw) / 2) * imgw
+    y1 = (flat(by) + flat(bh) / 2) * imgh
+    if clip_bbox:
+        x0 = jnp.clip(x0, 0, imgw - 1)
+        x1 = jnp.clip(x1, 0, imgw - 1)
+        y0 = jnp.clip(y0, 0, imgh - 1)
+        y1 = jnp.clip(y1, 0, imgh - 1)
+    boxes = jnp.stack([x0, y0, x1, y1], axis=-1)
+    scores = probs.transpose(0, 1, 3, 4, 2).reshape(n, -1, class_num)
+    keep = (flat(conf) > conf_thresh)[..., None]
+    return boxes * keep, scores * keep
+
+
+def psroi_pool(x, boxes, boxes_num, output_channels, spatial_scale=1.0,
+               pooled_height=1, pooled_width=1):
+    """phi psroi_pool_kernel: position-sensitive average ROI pooling (R-FCN).
+    Channel c*ph*pw + i*pw + j pools bin (i, j) of output channel c."""
+    n, c, h, w = x.shape
+    ph, pw = pooled_height, pooled_width
+    assert c == output_channels * ph * pw
+    # boxes_num is static per trace (host ints) — same contract as
+    # roi_align's boxes_num
+    counts = _np.asarray(boxes_num)
+    batch_idx = jnp.asarray(_np.repeat(_np.arange(len(counts)), counts), jnp.int32)
+
+    def pool_one(b, box):
+        x0, y0, x1, y1 = box * spatial_scale
+        rh = jnp.maximum(y1 - y0, 0.1) / ph
+        rw = jnp.maximum(x1 - x0, 0.1) / pw
+        ys = jnp.arange(h, dtype=jnp.float32)
+        xs = jnp.arange(w, dtype=jnp.float32)
+        out = jnp.zeros((output_channels, ph, pw), x.dtype)
+        feat = x[b]
+        for i in range(ph):
+            for j in range(pw):
+                y_lo = jnp.floor(y0 + i * rh)
+                y_hi = jnp.ceil(y0 + (i + 1) * rh)
+                x_lo = jnp.floor(x0 + j * rw)
+                x_hi = jnp.ceil(x0 + (j + 1) * rw)
+                my = ((ys >= y_lo) & (ys < y_hi)).astype(x.dtype)
+                mx = ((xs >= x_lo) & (xs < x_hi)).astype(x.dtype)
+                mask = my[:, None] * mx[None, :]
+                area = jnp.maximum(jnp.sum(mask), 1.0)
+                chans = feat[(jnp.arange(output_channels) * ph + i) * pw + j]
+                out = out.at[:, i, j].set(jnp.sum(chans * mask[None], (1, 2)) / area)
+        return out
+
+    return jax.vmap(pool_one)(batch_idx, boxes)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None):
+    """phi distribute_fpn_proposals: assign each ROI to an FPN level by its
+    scale. Returns (per-level rois list, restore index) with STATIC shapes:
+    each level gets the full roi tensor with non-member rows zeroed (the
+    TPU-friendly masked formulation)."""
+    off = 1.0 if pixel_offset else 0.0
+    ws = fpn_rois[:, 2] - fpn_rois[:, 0] + off
+    hs = fpn_rois[:, 3] - fpn_rois[:, 1] + off
+    scale = jnp.sqrt(jnp.maximum(ws * hs, 1e-6))
+    lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    outs = []
+    for l in range(min_level, max_level + 1):
+        m = (lvl == l).astype(fpn_rois.dtype)[:, None]
+        outs.append(fpn_rois * m)
+    order = jnp.argsort(lvl, stable=True)
+    restore = jnp.argsort(order, stable=True)
+    return (*outs, restore.astype(jnp.int32))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=100, keep_top_k=100, use_gaussian=False,
+               gauss_sigma=2.0, background_label=0, normalized=True):
+    """phi matrix_nms_kernel (SOLOv2): soft decay of scores by pairwise IoU —
+    fully parallel, no sequential suppression loop; TPU-native NMS."""
+    c, m = scores.shape[0], scores.shape[1]
+    norm = 0.0 if normalized else 1.0
+    if 0 <= background_label < c:
+        scores = scores.at[background_label].set(0.0)
+
+    def area(b):
+        return jnp.maximum(b[:, 2] - b[:, 0] + norm, 0) * jnp.maximum(
+            b[:, 3] - b[:, 1] + norm, 0)
+
+    def iou(b):
+        lt = jnp.maximum(b[:, None, :2], b[None, :, :2])
+        rb = jnp.minimum(b[:, None, 2:], b[None, :, 2:])
+        wh = jnp.maximum(rb - lt + norm, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        a = area(b)
+        return inter / jnp.maximum(a[:, None] + a[None, :] - inter, 1e-10)
+
+    k = min(nms_top_k, m)
+
+    def per_class(cls_scores):
+        s, idx = jax.lax.top_k(cls_scores, k)
+        b = bboxes[idx]
+        m_iou = iou(b)
+        upper = jnp.triu(m_iou, k=1)        # iou[i, j] for i higher-scored
+        comp = jnp.max(upper, axis=0)       # compensate: max iou of i itself
+        if use_gaussian:
+            decay = jnp.exp(-(upper ** 2 - comp[:, None] ** 2) / gauss_sigma)
+        else:
+            decay = (1.0 - upper) / jnp.maximum(1.0 - comp[:, None], 1e-10)
+        # only rows i < j participate; pad the rest with 1 (no decay)
+        tri = jnp.triu(jnp.ones_like(upper), k=1) > 0
+        dec = jnp.min(jnp.where(tri, decay, 1.0), axis=0)
+        s2 = s * dec * (s > score_threshold)
+        s2 = s2 * (s2 > post_threshold)
+        return s2, idx, b
+
+    all_s, all_i, all_b = jax.vmap(per_class)(scores)
+    flat_s = all_s.reshape(-1)
+    cls_id = jnp.repeat(jnp.arange(c), k)
+    kk = min(keep_top_k, flat_s.shape[0])
+    top_s, top_pos = jax.lax.top_k(flat_s, kk)
+    out_boxes = all_b.reshape(-1, 4)[top_pos]
+    out = jnp.concatenate([cls_id[top_pos][:, None].astype(bboxes.dtype),
+                           top_s[:, None], out_boxes], axis=1)
+    valid = (top_s > 0).astype(bboxes.dtype)[:, None]
+    return out * valid, jnp.sum(top_s > 0).astype(jnp.int32)
+
+
+def multiclass_nms3(bboxes, scores, score_threshold=0.05, nms_top_k=400,
+                    keep_top_k=100, nms_threshold=0.45, normalized=True,
+                    nms_eta=1.0, background_label=-1, rois_num=None):
+    """phi multiclass_nms3: per-class hard NMS then global top-k. Static
+    shapes: returns [keep_top_k, 6] with zero rows past the valid count."""
+    c, m = scores.shape
+    k = min(nms_top_k, m)
+    norm = 0.0 if normalized else 1.0
+    if 0 <= background_label < c:
+        scores = scores.at[background_label].set(0.0)
+
+    def keep_mask(b):
+        """Greedy suppression keep-mask over score-sorted boxes."""
+        a = jnp.maximum(b[:, 2] - b[:, 0] + norm, 0) * jnp.maximum(
+            b[:, 3] - b[:, 1] + norm, 0)
+        lt = jnp.maximum(b[:, None, :2], b[None, :, :2])
+        rb = jnp.minimum(b[:, None, 2:], b[None, :, 2:])
+        wh = jnp.maximum(rb - lt + norm, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        iou = inter / jnp.maximum(a[:, None] + a[None, :] - inter, 1e-10)
+
+        def body(i, keep):
+            sup = keep[i] & (iou[i] > nms_threshold) & (jnp.arange(k) > i)
+            return keep & ~sup
+
+        return lax.fori_loop(0, k, body, jnp.ones((k,), bool))
+
+    def per_class(cls_scores):
+        s, idx = jax.lax.top_k(cls_scores, k)
+        b = bboxes[idx]
+        keep = keep_mask(b)
+        s2 = s * keep * (s > score_threshold)
+        return s2, b
+
+    all_s, all_b = jax.vmap(per_class)(scores)
+    flat_s = all_s.reshape(-1)
+    cls_id = jnp.repeat(jnp.arange(c), k)
+    kk = min(keep_top_k, flat_s.shape[0])
+    top_s, top_pos = jax.lax.top_k(flat_s, kk)
+    out = jnp.concatenate([
+        cls_id[top_pos][:, None].astype(bboxes.dtype),
+        top_s[:, None], all_b.reshape(-1, 4)[top_pos]], axis=1)
+    valid = (top_s > 0)
+    return out * valid[:, None].astype(bboxes.dtype), jnp.sum(valid).astype(jnp.int32)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCDHW"):
+    """Scatter pooled values back to argmax positions (phi unpool3d)."""
+    k = _ntuple(kernel_size, 3)
+    s = _ntuple(stride, 3) if stride is not None else k
+    p = _ntuple(padding, 3)
+    n, c, od, oh, ow = x.shape
+    if output_size is None:
+        d = (od - 1) * s[0] - 2 * p[0] + k[0]
+        h = (oh - 1) * s[1] - 2 * p[1] + k[1]
+        w = (ow - 1) * s[2] - 2 * p[2] + k[2]
+    else:
+        d, h, w = output_size[-3:]
+    flat = jnp.zeros((n, c, d * h * w), x.dtype)
+    idx = indices.reshape(n, c, -1).astype(jnp.int32)
+    vals = x.reshape(n, c, -1)
+    out = jax.vmap(jax.vmap(lambda f, i, v: f.at[i].set(v)))(flat, idx, vals)
+    return out.reshape(n, c, d, h, w)
+
+
+# reference-name aliases (phi yaml names)
+unpool = max_unpool2d
+unpool3d = max_unpool3d
+max_pool2d_with_index = max_pool2d_with_mask

@@ -1,0 +1,366 @@
+"""OpTests for the second round-3 op batch: detection ops, sequence/decoding
+ops, RNN-T loss, signal framing, quantized matmuls, metric ops, and the
+reference-name alias surface (phi yaml parity names)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops import api as F
+
+rng = np.random.default_rng(11)
+
+
+def f32(*shape):
+    return rng.standard_normal(shape).astype(np.float32)
+
+
+def t(x, sg=True):
+    return paddle.to_tensor(x, stop_gradient=sg)
+
+
+class TestMiscMath:
+    def test_squared_l2_and_clip_by_norm(self):
+        x = f32(4, 5)
+        np.testing.assert_allclose(float(F.squared_l2_norm(t(x)).item()),
+                                   (x ** 2).sum(), rtol=1e-5)
+        y = np.asarray(F.clip_by_norm(t(x), 1.0)._value)
+        np.testing.assert_allclose(np.sqrt((y ** 2).sum()), 1.0, rtol=1e-5)
+        small = x * 1e-3
+        np.testing.assert_allclose(
+            np.asarray(F.clip_by_norm(t(small), 1.0)._value), small, rtol=1e-6)
+
+    def test_fill_diagonal(self):
+        x = np.zeros((5, 3), np.float32)
+        out = np.asarray(F.fill_diagonal(t(x), 7.0, wrap=True)._value)
+        ref = x.copy()
+        np.fill_diagonal(ref, 7.0, wrap=True)
+        np.testing.assert_allclose(out, ref)
+
+    def test_fill_diagonal_tensor(self):
+        x = np.zeros((4, 4), np.float32)
+        y = np.arange(1.0, 5.0, dtype=np.float32)
+        out = np.asarray(F.fill_diagonal_tensor(t(x), t(y))._value)
+        np.testing.assert_allclose(np.diag(out), y)
+
+    def test_multiplex(self):
+        a, b = f32(4, 3), f32(4, 3)
+        idx = np.array([[0], [1], [1], [0]], np.int32)
+        out = np.asarray(F.multiplex([t(a), t(b)], t(idx))._value)
+        ref = np.where(idx == 0, a, b)
+        np.testing.assert_allclose(out, ref)
+
+    def test_temporal_shift(self):
+        x = f32(4, 8, 2, 2)  # nt=4 (n=2 segs of 2), c=8
+        out = np.asarray(F.temporal_shift(t(x), seg_num=2,
+                                          shift_ratio=0.25)._value)
+        xr = x.reshape(2, 2, 8, 2, 2)
+        # first quarter shifted backward: out[:, t, :2] = x[:, t+1, :2]
+        np.testing.assert_allclose(out.reshape(2, 2, 8, 2, 2)[:, 0, :2],
+                                   xr[:, 1, :2])
+        np.testing.assert_allclose(out.reshape(2, 2, 8, 2, 2)[:, 1, :2], 0.0)
+
+
+class TestDetectionOps:
+    def test_box_coder_decode(self):
+        priors = np.array([[0., 0., 10., 10.], [5., 5., 15., 15.]], np.float32)
+        deltas = np.zeros((2, 2, 4), np.float32)  # zero deltas -> priors back
+        out = np.asarray(F.box_coder(t(priors), None, t(deltas),
+                                     code_type="decode_center_size",
+                                     variance=[1., 1., 1., 1.])._value)
+        for i in range(2):
+            np.testing.assert_allclose(out[i, i], priors[i], atol=1e-4)
+
+    def test_prior_box_shapes_and_range(self):
+        feat = t(f32(1, 8, 4, 4))
+        img = t(f32(1, 3, 64, 64))
+        boxes, var = F.prior_box(feat, img, min_sizes=[16.0],
+                                 aspect_ratios=[1.0, 2.0], clip=True)
+        assert tuple(boxes.shape)[:2] == (4, 4)
+        b = np.asarray(boxes._value)
+        assert b.min() >= 0.0 and b.max() <= 1.0
+        assert tuple(var.shape) == tuple(boxes.shape)
+
+    def test_yolo_box_shapes(self):
+        cls = 3
+        x = t(f32(2, 2 * (5 + cls), 4, 4))
+        img = t(np.array([[64, 64], [32, 32]], np.int32))
+        boxes, scores = F.yolo_box(x, img, anchors=[10, 13, 16, 30],
+                                   class_num=cls, conf_thresh=0.0)
+        assert tuple(boxes.shape) == (2, 32, 4)
+        assert tuple(scores.shape) == (2, 32, cls)
+
+    def test_matrix_nms_keeps_best(self):
+        bboxes = np.array([[0, 0, 10, 10], [0, 0, 10, 10], [20, 20, 30, 30]],
+                          np.float32)
+        scores = np.array([[0.9, 0.85, 0.8]], np.float32)  # 1 class
+        out, n = F.matrix_nms(t(bboxes), t(scores), score_threshold=0.1,
+                              nms_top_k=3, keep_top_k=3, background_label=-1)
+        o = np.asarray(out._value)
+        # best box survives with full score; duplicate decays
+        assert abs(o[0, 1] - 0.9) < 1e-5
+        assert o[1, 1] < 0.85  # decayed (iou 1 duplicate) or different box
+        # default background_label=0 excludes class 0 entirely
+        _, n_bg = F.matrix_nms(t(bboxes), t(scores), score_threshold=0.1,
+                               nms_top_k=3, keep_top_k=3)
+        assert int(n_bg.item()) == 0
+
+    def test_multiclass_nms3_suppresses(self):
+        bboxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]],
+                          np.float32)
+        scores = np.array([[0.9, 0.8, 0.7]], np.float32)
+        out, n = F.multiclass_nms3(t(bboxes), t(scores), score_threshold=0.1,
+                                   nms_threshold=0.5, keep_top_k=3)
+        assert int(n.item()) == 2  # overlapping second box suppressed
+
+    def test_psroi_pool_constant(self):
+        oc, ph, pw = 2, 2, 2
+        x = np.full((1, oc * ph * pw, 8, 8), 3.0, np.float32)
+        boxes = np.array([[0., 0., 8., 8.]], np.float32)
+        out = F.psroi_pool(t(x), t(boxes), np.array([1]), oc,
+                           spatial_scale=1.0, pooled_height=ph,
+                           pooled_width=pw)
+        np.testing.assert_allclose(np.asarray(out._value), 3.0, atol=1e-5)
+
+    def test_distribute_fpn_proposals(self):
+        rois = np.array([[0, 0, 16, 16], [0, 0, 500, 500]], np.float32)
+        *outs, restore = F.distribute_fpn_proposals(
+            t(rois), min_level=2, max_level=5, refer_level=4,
+            refer_scale=224)
+        lvls = [np.asarray(o._value) for o in outs]
+        assert (lvls[0][0] != 0).any()   # small roi -> level 2
+        assert (lvls[3][1] != 0).any()   # big roi -> level 5
+
+    def test_depthwise_conv_matches_grouped(self):
+        x = f32(2, 4, 8, 8)
+        w = f32(4, 1, 3, 3)
+        out = np.asarray(F.depthwise_conv2d(t(x), t(w), padding=1)._value)
+        ref = np.asarray(F.conv2d(t(x), t(w), padding=1, groups=4)._value)
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+class TestSequenceOps:
+    def test_gather_tree(self):
+        # T=3, B=1, W=2 beams
+        ids = np.array([[[1, 2]], [[3, 4]], [[5, 6]]], np.int32)
+        parents = np.array([[[0, 0]], [[0, 0]], [[1, 0]]], np.int32)
+        out = np.asarray(F.gather_tree(t(ids), t(parents))._value)
+        # beam 0 at t=2 follows parent 1 at t=2 -> token 4 at t=1 -> parent 0
+        np.testing.assert_array_equal(out[:, 0, 0], [1, 4, 5])
+        np.testing.assert_array_equal(out[:, 0, 1], [1, 3, 6])
+
+    def test_viterbi_decode_matches_bruteforce(self):
+        B, T, N = 2, 4, 5  # last two tags are BOS/EOS
+        pots = f32(B, T, N)
+        trans = f32(N, N)
+        lengths = np.array([4, 3], np.int32)
+        score, path = F.viterbi_decode(t(pots), t(trans), t(lengths))
+        sv, pv = np.asarray(score._value), np.asarray(path._value)
+        import itertools
+
+        bos, eos = N - 2, N - 1
+        for b in range(B):
+            L = lengths[b]
+            best, best_path = -1e9, None
+            for tags in itertools.product(range(N), repeat=int(L)):
+                s = trans[bos, tags[0]] + pots[b, 0, tags[0]]
+                for i in range(1, L):
+                    s += trans[tags[i - 1], tags[i]] + pots[b, i, tags[i]]
+                s += trans[tags[-1], eos]
+                if s > best:
+                    best, best_path = s, tags
+            np.testing.assert_allclose(sv[b], best, rtol=1e-4)
+            np.testing.assert_array_equal(pv[b, :L], best_path)
+
+    def test_edit_distance(self):
+        hyps = np.array([[1, 2, 3, 0], [1, 1, 0, 0]], np.int32)
+        refs = np.array([[1, 3, 3, 0], [2, 2, 2, 0]], np.int32)
+        hl = np.array([3, 2], np.int32)
+        rl = np.array([3, 3], np.int32)
+        d = np.asarray(F.edit_distance(t(hyps), t(refs), t(hl), t(rl))._value)
+        assert d[0] == 1.0  # one substitution
+        assert d[1] == 3.0  # 2 subs + 1 insert
+
+    def test_frame_overlap_add_roundtrip(self):
+        x = f32(2, 16)
+        fr = F.frame(t(x), frame_length=4, hop_length=4)  # non-overlapping
+        assert tuple(fr.shape) == (2, 4, 4)
+        back = F.overlap_add(fr, hop_length=4)
+        np.testing.assert_allclose(np.asarray(back._value), x, atol=1e-6)
+
+    def test_rnnt_loss_matches_dp(self):
+        B, T, U, V = 2, 3, 2, 4
+        logits = f32(B, T, U + 1, V)
+        labels = np.array([[1, 2], [3, 1]], np.int32)
+        tl = np.array([3, 2], np.int32)
+        ul = np.array([2, 1], np.int32)
+        loss = np.asarray(F.rnnt_loss(t(logits), t(labels), t(tl), t(ul))._value)
+
+        def ref_one(lp, lab, T_, U_):
+            a = np.full((T_, U_ + 1), -np.inf)
+            a[0, 0] = 0.0
+            for i in range(T_):
+                for u in range(U_ + 1):
+                    if i == 0 and u == 0:
+                        continue
+                    cands = []
+                    if i > 0:
+                        cands.append(a[i - 1, u] + lp[i - 1, u, 0])
+                    if u > 0:
+                        cands.append(a[i, u - 1] + lp[i, u - 1, lab[u - 1]])
+                    a[i, u] = np.logaddexp.reduce(cands)
+            return -(a[T_ - 1, U_] + lp[T_ - 1, U_, 0])
+
+        from scipy.special import log_softmax  # available via scipy? no — use manual
+        lpn = logits - np.log(np.exp(logits - logits.max(-1, keepdims=True)).sum(-1, keepdims=True)) - logits.max(-1, keepdims=True) * 0
+        lpn = logits - np.log(np.sum(np.exp(logits - logits.max(-1, keepdims=True)), -1, keepdims=True)) - logits.max(-1, keepdims=True)
+        for b in range(B):
+            ref = ref_one(lpn[b], labels[b], int(tl[b]), int(ul[b]))
+            np.testing.assert_allclose(loss[b], ref, rtol=1e-4)
+
+    def test_class_center_sample(self):
+        lab = np.array([3, 7, 3], np.int64)
+        remap, sampled = F.class_center_sample(t(lab), 16, 8)
+        s = np.asarray(sampled._value)
+        assert 3 in s and 7 in s
+        r = np.asarray(remap._value)
+        assert (r >= 0).all() and (r < 8).all()
+        assert s[r[0]] == 3 and s[r[1]] == 7
+
+
+class TestLossOps:
+    def test_huber_loss(self):
+        import torch
+        import torch.nn.functional as TF
+
+        x, y = f32(8), f32(8)
+        out = np.asarray(F.huber_loss(t(x), t(y), delta=1.3)._value)
+        ref = TF.huber_loss(torch.tensor(x), torch.tensor(y), delta=1.3,
+                            reduction="none")
+        np.testing.assert_allclose(out, ref.numpy(), rtol=1e-5)
+
+    def test_sigmoid_ce_with_logits(self):
+        import torch
+        import torch.nn.functional as TF
+
+        x = f32(6)
+        lab = (rng.random(6) > 0.5).astype(np.float32)
+        out = np.asarray(F.sigmoid_cross_entropy_with_logits(t(x), t(lab))._value)
+        ref = TF.binary_cross_entropy_with_logits(
+            torch.tensor(x), torch.tensor(lab), reduction="none")
+        np.testing.assert_allclose(out, ref.numpy(), rtol=1e-5)
+
+    def test_margin_cross_entropy_zero_margin_is_scaled_ce(self):
+        logits = np.clip(f32(4, 10) * 0.3, -1, 1)
+        lab = np.array([0, 3, 5, 9], np.int64)
+        out = np.asarray(F.margin_cross_entropy(
+            t(logits), t(lab), margin1=1.0, margin2=0.0, margin3=0.0,
+            scale=10.0)._value).ravel()
+        z = logits * 10.0
+        logp = z - np.log(np.exp(z - z.max(-1, keepdims=True)).sum(-1, keepdims=True)) - z.max(-1, keepdims=True)
+        ref = -logp[np.arange(4), lab]
+        np.testing.assert_allclose(out, ref, rtol=1e-4)
+
+
+class TestNNExtras:
+    def test_spectral_norm_unit_sigma(self):
+        w = f32(6, 4)
+        u = f32(6)
+        v = f32(4)
+        out = np.asarray(F.spectral_norm(t(w), t(u), t(v), dim=0,
+                                         power_iters=50)._value)
+        assert abs(np.linalg.svd(out, compute_uv=False)[0] - 1.0) < 1e-3
+
+    def test_bilinear(self):
+        x1, x2 = f32(3, 4), f32(3, 5)
+        w = f32(2, 4, 5)
+        b = f32(2)
+        out = np.asarray(F.bilinear(t(x1), t(x2), t(w), t(b))._value)
+        ref = np.einsum("bi,oij,bj->bo", x1, w, x2) + b
+        np.testing.assert_allclose(out, ref, rtol=1e-4)
+
+    def test_pad3d(self):
+        x = f32(1, 2, 3, 4, 5)
+        out = F.pad3d(t(x), [1, 1, 2, 2, 0, 0], value=9.0)
+        assert tuple(out.shape) == (1, 2, 3, 8, 7)
+        v = np.asarray(out._value)
+        assert (v[:, :, :, :2, :] == 9.0).all()
+
+    def test_segment_pool(self):
+        x = f32(6, 3)
+        ids = np.array([0, 0, 1, 1, 1, 2], np.int32)
+        out = np.asarray(F.segment_pool(t(x), t(ids), "MEAN")._value)
+        np.testing.assert_allclose(out[1], x[2:5].mean(0), rtol=1e-5)
+        mx = np.asarray(F.segment_pool(t(x), t(ids), "MAX")._value)
+        np.testing.assert_allclose(mx[0], x[:2].max(0), rtol=1e-5)
+
+
+class TestQuantOps:
+    def test_weight_only_matmul_close_to_fp(self):
+        x = f32(4, 32)
+        w = f32(32, 16) * 0.1
+        qw, scales = F.quantize_weight_absmax(t(w))
+        out = np.asarray(F.weight_only_matmul(t(x), qw, scales)._value)
+        ref = x @ w
+        assert np.abs(out - ref).max() / np.abs(ref).max() < 0.02
+
+    def test_matmul_int8(self):
+        x = rng.integers(-127, 127, (4, 8)).astype(np.int8)
+        y = rng.integers(-127, 127, (8, 5)).astype(np.int8)
+        out = np.asarray(F.matmul_int8(t(x), t(y), 0.5, 0.25)._value)
+        ref = (x.astype(np.int64) @ y.astype(np.int64)).astype(np.float32) * 0.125
+        np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+    def test_llm_int8_outlier_path(self):
+        x = f32(4, 32) * 0.5
+        x[:, 3] = 100.0  # outlier column
+        w = f32(32, 8) * 0.05
+        qw, scales = F.quantize_weight_absmax(t(w))
+        out = np.asarray(F.llm_int8_matmul(t(x), qw, scales, threshold=6.0)._value)
+        ref = x @ w
+        assert np.abs(out - ref).max() / np.abs(ref).max() < 0.05
+
+
+class TestMetricOps:
+    def test_accuracy(self):
+        scores = np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]], np.float32)
+        lab = np.array([[1], [0], [0]], np.int64)
+        acc = float(F.accuracy(t(scores), t(lab)).item())
+        np.testing.assert_allclose(acc, 2.0 / 3.0, rtol=1e-5)
+
+    def test_auc_perfect_and_random(self):
+        p = np.array([0.9, 0.8, 0.2, 0.1], np.float32)
+        lab = np.array([1, 1, 0, 0], np.int64)
+        auc = float(F.auc(t(p), t(lab)).item())
+        np.testing.assert_allclose(auc, 1.0, atol=1e-2)
+        lab2 = np.array([0, 1, 0, 1], np.int64)
+        auc2 = float(F.auc(t(p), t(lab2)).item())
+        assert abs(auc2 - 0.5) < 0.3
+
+
+class TestRandomExtras:
+    def test_truncated_normal_bounds(self):
+        out = np.asarray(F.truncated_normal([2000], mean=1.0, std=0.5)._value)
+        assert out.min() >= 1.0 - 2 * 0.5 - 1e-5
+        assert out.max() <= 1.0 + 2 * 0.5 + 1e-5
+
+    def test_dirichlet_simplex(self):
+        out = np.asarray(F.dirichlet(t(np.full((8, 4), 2.0, np.float32)))._value)
+        np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)
+        assert (out >= 0).all()
+
+    def test_standard_gamma_positive(self):
+        out = np.asarray(F.standard_gamma(t(np.full((64,), 3.0, np.float32)))._value)
+        assert (out > 0).all()
+        assert abs(out.mean() - 3.0) < 1.0
+
+
+class TestAliases:
+    def test_reference_name_aliases(self):
+        from paddle_tpu.ops.registry import all_ops
+
+        ops = all_ops()
+        for name in ("bce_loss", "kldiv_loss", "logsigmoid", "tanh_shrink",
+                     "unpool", "unpool3d", "max_pool2d_with_index",
+                     "memory_efficient_attention", "elementwise_pow",
+                     "reverse", "mean_all"):
+            assert name in ops, name

@@ -98,7 +98,12 @@ from . import inference  # noqa: F401, E402
 from . import onnx  # noqa: F401, E402
 from . import incubate  # noqa: F401, E402
 from . import utils  # noqa: F401, E402
+from . import multiprocessing  # noqa: F401, E402
 from .framework.io import load, save  # noqa: F401, E402
+from .framework.containers import (  # noqa: F401, E402
+    SelectedRows, TensorArray, array_length, array_read, array_write,
+    create_array,
+)
 from .hapi.model import Model, summary  # noqa: F401, E402
 
 version = "0.1.0"

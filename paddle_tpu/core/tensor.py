@@ -212,6 +212,16 @@ class Tensor:
             f"stop_gradient={sg},\n       {body})"
         )
 
+    def __reduce__(self):
+        # pickle/deepcopy support: travel as the host numpy value. MUST
+        # preserve the concrete class (Parameter!) and all metadata —
+        # nn.Transformer deepcopies layers and the optimizer filters on
+        # p.trainable, so a lossy rebuild silently freezes cloned layers.
+        return (_rebuild_pickled_tensor,
+                (type(self), np.asarray(self._value), self.stop_gradient,
+                 self.name, self.persistable, self.trainable,
+                 dict(self.__dict__)))
+
     def block_until_ready(self):
         if hasattr(self._value, "block_until_ready"):
             self._value.block_until_ready()
@@ -254,4 +264,20 @@ def _unflatten_tensor(aux, vals):
     t.name = aux[1]
     t.persistable = False
     t.trainable = not aux[0]
+    return t
+
+
+def _rebuild_pickled_tensor(cls, arr, stop_gradient, name, persistable,
+                            trainable, extra):
+    # bypass subclass __init__ (Parameter's differs); restore slots directly
+    t = cls.__new__(cls)
+    t._value = jnp.asarray(arr)
+    t.stop_gradient = stop_gradient
+    t._grad_node = None
+    t._grad = None
+    t._grad_hooks = []
+    t.name = name
+    t.persistable = persistable
+    t.trainable = trainable
+    t.__dict__.update(extra)
     return t

@@ -51,6 +51,7 @@ from .collective import (  # noqa: F401
     scatter,
     send,
 )
+from .spawn import spawn  # noqa: F401
 from .env import (  # noqa: F401
     ParallelEnv,
     get_rank,

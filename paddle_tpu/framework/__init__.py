@@ -1,1 +1,11 @@
 from .io import load, save  # noqa: F401
+
+from .containers import (  # noqa: F401, E402
+    SelectedRows,
+    TensorArray,
+    array_length,
+    array_read,
+    array_write,
+    create_array,
+    merge_selected_rows,
+)

@@ -1,0 +1,125 @@
+"""TensorArray and SelectedRows (reference: paddle LoDTensorArray —
+python/paddle/tensor/array.py create_array/array_read/array_write/
+array_length — and paddle/phi/core/selected_rows.h + phi
+merge_selected_rows kernel).
+
+TPU-native notes: TensorArray is the dynamic-length companion to
+lax-structured control flow — under `jit.to_static` tracing, loops are
+unrolled or scanned with static trip counts, so the array materializes as a
+stacked tensor via .stack(). SelectedRows is the sparse-gradient row format
+the reference uses for embedding tables: rows + values, convertible to
+dense, with duplicate rows merged by summation (the gradient semantics).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops import api as F
+
+
+class TensorArray:
+    """Write-indexed list of same-rank Tensors (LoDTensorArray analog)."""
+
+    def __init__(self, initial: Optional[List[Tensor]] = None):
+        self._items: List[Optional[Tensor]] = list(initial or [])
+
+    def write(self, index: int, value: Tensor) -> "TensorArray":
+        i = int(index.item() if isinstance(index, Tensor) else index)
+        if i < len(self._items):
+            self._items[i] = value
+        else:
+            self._items.extend([None] * (i - len(self._items)))
+            self._items.append(value)
+        return self
+
+    def read(self, index) -> Tensor:
+        i = int(index.item() if isinstance(index, Tensor) else index)
+        v = self._items[i]
+        if v is None:
+            raise IndexError(f"TensorArray slot {i} was never written")
+        return v
+
+    def append(self, value: Tensor) -> "TensorArray":
+        self._items.append(value)
+        return self
+
+    def __len__(self):
+        return len(self._items)
+
+    def __iter__(self):
+        return iter(self._items)
+
+    def _dense_items(self, op):
+        holes = [i for i, v in enumerate(self._items) if v is None]
+        if holes:
+            raise ValueError(
+                f"TensorArray.{op}: slots {holes} were never written — "
+                "silently dropping holes would misalign positions")
+        return list(self._items)
+
+    def stack(self, axis: int = 0) -> Tensor:
+        return F.stack(self._dense_items("stack"), axis=axis)
+
+    def concat(self, axis: int = 0) -> Tensor:
+        return F.concat(self._dense_items("concat"), axis=axis)
+
+
+def create_array(dtype=None, initialized_list=None):
+    """paddle.tensor.create_array."""
+    return TensorArray(initialized_list)
+
+
+def array_write(x: Tensor, i, array: Optional[TensorArray] = None):
+    """paddle.tensor.array_write."""
+    if array is None:
+        array = TensorArray()
+    return array.write(i, x)
+
+
+def array_read(array: TensorArray, i) -> Tensor:
+    return array.read(i)
+
+
+def array_length(array: TensorArray):
+    # int32: jax's default index width (int64 needs jax_enable_x64 and would
+    # warn+truncate anyway); paddle's int64 contract is width-only
+    return Tensor(jnp.asarray(len(array), jnp.int32))
+
+
+class SelectedRows:
+    """Sparse row-slice tensor: `rows` index into a [height, ...] dense
+    space, `values` holds the selected slices (phi SelectedRows)."""
+
+    def __init__(self, rows, values: Tensor, height: int):
+        self.rows = jnp.asarray(
+            rows._value if isinstance(rows, Tensor) else rows, jnp.int32)
+        self.values = values if isinstance(values, Tensor) else Tensor(values)
+        self.height = int(height)
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.values.shape[1:])
+
+    def to_dense(self) -> Tensor:
+        out = jnp.zeros(self.shape, self.values._value.dtype)
+        return Tensor(out.at[self.rows].add(self.values._value))
+
+    def merge(self) -> "SelectedRows":
+        """phi merge_selected_rows: dedupe rows, summing duplicate slices
+        (the embedding sparse-grad accumulation rule)."""
+        uniq, inv = jnp.unique(self.rows, return_inverse=True,
+                               size=self.rows.shape[0],
+                               fill_value=self.height)
+        summed = jnp.zeros((uniq.shape[0],) + tuple(self.values.shape[1:]),
+                           self.values._value.dtype)
+        summed = summed.at[inv].add(self.values._value)
+        keep = uniq < self.height
+        n = int(jnp.sum(keep))
+        return SelectedRows(uniq[:n], Tensor(summed[:n]), self.height)
+
+
+def merge_selected_rows(x: SelectedRows) -> SelectedRows:
+    return x.merge()

@@ -10,3 +10,5 @@ from . import distributed  # noqa: F401, E402
 from . import asp  # noqa: F401, E402
 from . import optimizer  # noqa: F401, E402
 from .optimizer import LookAhead, ModelAverage  # noqa: F401, E402
+
+from .. import multiprocessing  # noqa: F401, E402 (reference: paddle.incubate.multiprocessing)

@@ -1,0 +1,41 @@
+"""Tensor-aware multiprocessing (reference:
+python/paddle/incubate/multiprocessing/ — ForkingPickler reductions so
+Tensors cross process boundaries, reductions.py:94 _reduce_tensor).
+
+TPU-native design: device arrays cannot be shared across processes (each
+process owns its PJRT client), so a Tensor crossing a process boundary
+travels as its HOST numpy value and rebuilds (device placement happens
+lazily at first use in the receiver), preserving the concrete class
+(Parameter included) and metadata. `import paddle_tpu.multiprocessing as mp`
+is a drop-in for the stdlib module with the reducers installed.
+
+Bulk input pipelines should NOT ship tensors through queues one message at a
+time — io.DataLoader's process mode moves batches through reusable
+shared-memory slot rings (io/worker.py), which is the high-throughput path.
+"""
+from __future__ import annotations
+
+import multiprocessing
+from multiprocessing import *  # noqa: F401,F403
+from multiprocessing.reduction import ForkingPickler
+
+import numpy as np
+
+from ..core.tensor import Tensor, _rebuild_pickled_tensor
+
+
+def _reduce_tensor(t: Tensor):
+    # same wire format as plain pickle (Tensor.__reduce__): inline numpy,
+    # class + metadata preserved
+    return t.__reduce__()
+
+
+def init_reductions():
+    from ..nn.layer import Parameter
+
+    # ForkingPickler dispatch is exact-class: register the subclass too
+    ForkingPickler.register(Tensor, _reduce_tensor)
+    ForkingPickler.register(Parameter, _reduce_tensor)
+
+
+init_reductions()

@@ -1,0 +1,157 @@
+"""spawn, multiprocessing tensor sharing, TensorArray, SelectedRows
+(reference: distributed/spawn.py:428, incubate/multiprocessing/reductions.py,
+python/paddle/tensor/array.py, phi selected_rows)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _rank_fn(scale):
+    import os
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    n = int(os.environ["PADDLE_TRAINERS_NUM"])
+    t = paddle.to_tensor(np.full((4,), float(rank) * scale, np.float32))
+    return rank, n, t
+
+
+def _boom():
+    raise ValueError("rank exploded")
+
+
+class TestSpawn:
+    def test_spawn_returns_per_rank_results(self):
+        import paddle_tpu.distributed as dist
+
+        results = dist.spawn(_rank_fn, args=(2.0,), nprocs=3)
+        assert len(results) == 3
+        for rank, (r, n, t) in enumerate(results):
+            assert r == rank and n == 3
+            np.testing.assert_allclose(np.asarray(t._value), rank * 2.0)
+
+    def test_spawn_propagates_errors(self):
+        import paddle_tpu.distributed as dist
+
+        with pytest.raises(RuntimeError, match="rank exploded"):
+            dist.spawn(_boom, nprocs=2)
+
+    def test_spawn_join_false(self):
+        import paddle_tpu.distributed as dist
+
+        ctx = dist.spawn(_rank_fn, args=(1.0,), nprocs=2, join=False)
+        assert len(ctx.processes) == 2
+        out = ctx.join()
+        assert sorted(r for r, _, _ in out) == [0, 1]
+
+
+class TestMultiprocessingTensors:
+    def test_forking_pickler_roundtrip(self):
+        """The mp-queue wire format: ForkingPickler bytes with the reducers
+        registered. Exercised in-process — exactly the bytes a queue would
+        carry — because real mp children under pytest re-execute the test
+        session (spawn main-module fixup) or risk fork-after-jax deadlocks."""
+        import io
+        import pickle as _pickle
+        from multiprocessing.reduction import ForkingPickler
+
+        import paddle_tpu.multiprocessing as mp  # noqa: F401 — registers reducers
+        from paddle_tpu.nn.layer import Parameter
+
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(16, 16).astype(np.float32))
+        p = Parameter(np.ones((3, 3), np.float32))
+        p.name = "w0"
+        for obj, cls in ((x, paddle.Tensor), (p, Parameter)):
+            buf = io.BytesIO()
+            ForkingPickler(buf).dump(obj)
+            out = _pickle.loads(buf.getvalue())
+            assert type(out) is cls
+            np.testing.assert_allclose(np.asarray(out._value),
+                                       np.asarray(obj._value), rtol=1e-6)
+        assert _pickle.loads(ForkingPickler.dumps(p)).name == "w0"
+
+    def test_plain_pickle_tensor(self):
+        import pickle as _pickle
+
+        x = paddle.to_tensor(np.arange(6.0, dtype=np.float32),
+                             stop_gradient=False)
+        y = _pickle.loads(_pickle.dumps(x))
+        assert isinstance(y, paddle.Tensor) and y.stop_gradient is False
+        np.testing.assert_allclose(np.asarray(y._value),
+                                   np.asarray(x._value))
+
+    def test_deepcopy_preserves_parameter(self):
+        """Regression: __reduce__ must keep the Parameter subclass and
+        trainable metadata — nn.Transformer deepcopies layers and the
+        optimizer filters on p.trainable."""
+        import copy
+
+        from paddle_tpu import nn, optimizer
+
+        layer = nn.Linear(4, 4)
+        clone = copy.deepcopy(layer)
+        for p in clone.parameters():
+            assert type(p).__name__ == "Parameter"
+            assert p.trainable and not p.stop_gradient
+        opt = optimizer.SGD(0.1, parameters=clone.parameters())
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        loss = (clone(x) ** 2).sum()
+        loss.backward()
+        before = np.asarray(clone.weight._value).copy()
+        opt.step()
+        assert np.abs(np.asarray(clone.weight._value) - before).max() > 0
+
+
+class TestTensorArray:
+    def test_write_read_stack(self):
+        arr = paddle.create_array()
+        for i in range(3):
+            paddle.array_write(paddle.to_tensor(np.full((2,), i, np.float32)),
+                               i, arr)
+        assert int(paddle.array_length(arr).item()) == 3
+        np.testing.assert_allclose(
+            np.asarray(paddle.array_read(arr, 1)._value), 1.0)
+        st = arr.stack()
+        assert tuple(st.shape) == (3, 2)
+
+    def test_out_of_order_write(self):
+        arr = paddle.create_array()
+        arr.write(2, paddle.to_tensor(np.ones((1,), np.float32)))
+        assert len(arr) == 3
+        with pytest.raises(IndexError):
+            arr.read(0)
+        with pytest.raises(ValueError, match="never written"):
+            arr.stack()
+
+    def test_in_to_static_loop(self):
+        from paddle_tpu import jit
+
+        @jit.to_static
+        def f(x):
+            arr = paddle.create_array()
+            for i in range(4):
+                paddle.array_write(x * float(i), i, arr)
+            return arr.stack()
+
+        out = f(paddle.to_tensor(np.ones((2,), np.float32)))
+        np.testing.assert_allclose(np.asarray(out._value)[:, 0], [0, 1, 2, 3])
+
+
+class TestSelectedRows:
+    def test_to_dense_and_merge(self):
+        vals = paddle.to_tensor(np.array([[1., 2.], [3., 4.], [5., 6.]],
+                                         np.float32))
+        sr = paddle.SelectedRows(np.array([1, 3, 1]), vals, height=5)
+        dense = np.asarray(sr.to_dense()._value)
+        np.testing.assert_allclose(dense[1], [6., 8.])  # duplicate summed
+        np.testing.assert_allclose(dense[3], [3., 4.])
+        np.testing.assert_allclose(dense[0], 0.0)
+
+        merged = sr.merge()
+        assert merged.rows.shape[0] == 2
+        np.testing.assert_allclose(np.asarray(merged.to_dense()._value), dense)

@@ -1,0 +1,124 @@
+"""Kernel autotuning (reference: paddle/phi/kernels/autotune/ — cache.h
+size-bounded caches + switch_autotune.cc step-gated tuning, and the Python
+knob paddle.incubate.autotune.set_config).
+
+TPU-native design: a config-tuned kernel is a pure function f(*args, **cfg).
+`autotune(candidates)` wraps it so the first call per (shape, dtype) key
+times every candidate on the REAL device (compile excluded: one warmup call
+per candidate, then timed repeats with block_until_ready) and caches the
+winner in a bounded LRU. Tuning is off by default (FLAGS_use_autotune);
+when off the first candidate — the hand-picked default — runs, so the
+decorator is zero-risk to wrap on.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Dict, Iterable, List, Optional
+
+import jax
+
+from . import flags
+
+flags.define_flag("use_autotune", False,
+                  "Time candidate kernel configs on first use and cache the winner.")
+flags.define_flag("autotune_cache_size", 512,
+                  "Max cached autotune decisions (LRU eviction).")
+
+_CACHE: "OrderedDict[tuple, dict]" = OrderedDict()
+_LOCK = threading.Lock()
+
+
+def clear_cache():
+    with _LOCK:
+        _CACHE.clear()
+
+
+def cache_info():
+    with _LOCK:
+        return {"entries": len(_CACHE), "keys": list(_CACHE)}
+
+
+def _block(x):
+    try:
+        jax.block_until_ready(x)
+    except Exception:  # non-array outputs
+        pass
+    return x
+
+
+def _time_once(fn, args, kwargs, cfg, repeats=3):
+    out = fn(*args, **kwargs, **cfg)  # warmup/compile
+    _block(out)
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        out = fn(*args, **kwargs, **cfg)
+    _block(out)
+    return (time.perf_counter() - t0) / repeats
+
+
+def autotune(candidates: Iterable[dict], key_extra: Callable = None):
+    """Decorator: tune fn's keyword config over `candidates` per input-shape
+    key. First candidate is the default used when tuning is disabled or a
+    candidate fails (e.g. a block size the lowering rejects)."""
+    cands: List[dict] = list(candidates)
+
+    def deco(fn):
+        def wrapper(*args, **kwargs):
+            key = (fn.__module__, fn.__qualname__,
+                   tuple((tuple(a.shape), str(a.dtype))
+                         for a in args if hasattr(a, "shape")),
+                   key_extra(*args, **kwargs) if key_extra else None)
+            traced = any(isinstance(a, jax.core.Tracer) for a in args)
+            if traced:
+                # inside a jit trace wall-clock timing is meaningless (it
+                # would measure trace overhead of abstract values and bake
+                # every candidate into the graph): use a cached winner from
+                # an eager run if one exists, else the default
+                entry = _CACHE.get(key)
+                return fn(*args, **kwargs, **(entry or cands[0]))
+            if not flags.get_flag("use_autotune"):
+                return fn(*args, **kwargs, **cands[0])
+            entry = _CACHE.get(key)
+            if entry is not None:
+                with _LOCK:
+                    try:
+                        _CACHE.move_to_end(key)
+                    except KeyError:
+                        pass
+                return fn(*args, **kwargs, **entry)
+            best, best_t = None, None
+            for cfg in cands:
+                try:
+                    t = _time_once(fn, args, kwargs, cfg)
+                except Exception:
+                    continue  # config invalid for these shapes
+                if best_t is None or t < best_t:
+                    best, best_t = cfg, t
+            if best is None:
+                best = cands[0]
+            with _LOCK:
+                _CACHE[key] = best
+                _CACHE.move_to_end(key)
+                limit = flags.get_flag("autotune_cache_size")
+                while limit > 0 and len(_CACHE) > limit:
+                    _CACHE.popitem(last=False)
+            return fn(*args, **kwargs, **best)
+
+        wrapper.__wrapped__ = fn
+        wrapper.candidates = cands
+        return wrapper
+
+    return deco
+
+
+def set_config(config: Optional[Dict] = None):
+    """paddle.incubate.autotune.set_config parity: {'kernel': {'enable':
+    bool, 'tuning_range': ...}} — enable flips FLAGS_use_autotune."""
+    if config is None:
+        flags.set_flags({"use_autotune": True})
+        return
+    kernel = config.get("kernel", {})
+    if "enable" in kernel:
+        flags.set_flags({"use_autotune": bool(kernel["enable"])})

@@ -1,0 +1,79 @@
+"""Cost model (reference: python/paddle/cost_model/cost_model.py — profile a
+program and report per-op/total costs for the auto-parallel planner).
+
+TPU-native design: XLA already carries an analytical cost model — a lowered
+executable exposes cost_analysis() (flops, bytes accessed, estimated
+seconds). CostModel wraps it: static costs come from the compiler (no
+execution), measured costs from timed runs of the compiled program. This is
+the cost source a mesh/parallelism planner should consume, instead of the
+reference's profiler-replay machinery.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict
+
+import jax
+
+from .core.tensor import Tensor
+
+
+def _unwrap(args):
+    return tuple(a._value if isinstance(a, Tensor) else a for a in args)
+
+
+class CostModel:
+    def _compile(self, fn, args, kwargs):
+        vals = _unwrap(args)
+
+        def pure(*vs):
+            out = fn(*(Tensor(v) for v in vs), **kwargs)
+            return jax.tree_util.tree_map(
+                lambda t: t._value if isinstance(t, Tensor) else t, out,
+                is_leaf=lambda t: isinstance(t, Tensor))
+
+        return jax.jit(pure).lower(*vals).compile(), vals
+
+    def static_cost(self, fn: Callable, *args, **kwargs) -> Dict[str, Any]:
+        """Compile-time cost analysis — no execution. fn is a Tensor/array
+        function; returns {'flops', 'bytes_accessed', 'optimal_seconds', ...}
+        from XLA's analytical model."""
+        compiled, _ = self._compile(fn, args, kwargs)
+        return self._analyze(compiled)
+
+    def _analyze(self, compiled) -> Dict[str, Any]:
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, list):  # per-device list on some backends
+            analysis = analysis[0] if analysis else {}
+        out = {
+            "flops": float(analysis.get("flops", 0.0)),
+            "bytes_accessed": float(analysis.get("bytes accessed", 0.0)),
+            "optimal_seconds": float(analysis.get("optimal_seconds", 0.0)),
+        }
+        out["raw"] = dict(analysis)
+        try:
+            mem = compiled.memory_analysis()
+            out["peak_bytes"] = int(
+                getattr(mem, "temp_size_in_bytes", 0)
+                + getattr(mem, "argument_size_in_bytes", 0)
+                + getattr(mem, "output_size_in_bytes", 0))
+        except Exception:  # pragma: no cover — backend-dependent
+            out["peak_bytes"] = 0
+        return out
+
+    def profile_measure(self, fn: Callable, *args, repeats: int = 5,
+                        **kwargs) -> Dict[str, Any]:
+        """Static costs + measured wall time — ONE compilation, reused for
+        both the analysis and the timed runs."""
+        compiled, vals = self._compile(fn, args, kwargs)
+        out = self._analyze(compiled)
+        jax.block_until_ready(compiled(*vals))  # warm
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            res = compiled(*vals)
+        jax.block_until_ready(res)
+        dt = (time.perf_counter() - t0) / repeats
+        out["measured_seconds"] = dt
+        if dt > 0 and out["flops"]:
+            out["achieved_flops_per_sec"] = out["flops"] / dt
+        return out

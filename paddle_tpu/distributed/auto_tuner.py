@@ -1,0 +1,94 @@
+"""Parallel-config auto-tuner (reference: python/paddle/distributed/
+auto_tuner — prune + search over dp/mp/pp degrees by launching trial jobs).
+
+TPU-native redesign: trials are COMPILATIONS, not jobs. Every candidate mesh
+factorization is lowered through GSPMD and ranked by XLA's analytical cost
+model (optimal_seconds, bytes accessed) and peak-memory analysis — hundreds
+of configs can be searched without touching the chips, and the result is
+exact about what the compiler will actually emit (collective placement
+included). Optionally each surviving config is measured with real runs.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+
+
+def factorizations(n: int, axes: Sequence[str]) -> List[Dict[str, int]]:
+    """All ways to split n devices over the named mesh axes."""
+    out = []
+
+    def rec(rem, i, acc):
+        if i == len(axes) - 1:
+            out.append({**acc, axes[i]: rem})
+            return
+        d = 1
+        while d <= rem:
+            if rem % d == 0:
+                rec(rem // d, i + 1, {**acc, axes[i]: d})
+            d += 1
+    rec(n, 0, {})
+    return out
+
+
+def tune(build_step: Callable, n_devices: Optional[int] = None,
+         axes: Sequence[str] = ("dp", "mp"), candidates=None,
+         measure: bool = False, top_k: int = 5) -> List[Dict[str, Any]]:
+    """Search parallel configs for a training step.
+
+    build_step(mesh) -> (fn, args): given a Mesh, return a jittable step
+    (pure function of arrays) and example args, with shardings applied.
+    Returns up to top_k reports sorted best-first:
+      {'config', 'optimal_seconds', 'flops', 'bytes_accessed', 'peak_bytes',
+       'error'?, 'measured_seconds'?}
+    """
+    from .mesh import build_mesh, get_mesh, set_mesh
+
+    n = n_devices or len(jax.devices())
+    cands = candidates or factorizations(n, axes)
+    prev = get_mesh()
+    reports = []
+    for cfg in cands:
+        report: Dict[str, Any] = {"config": dict(cfg)}
+        try:
+            mesh = build_mesh(**cfg, devices=jax.devices()[:n])
+            set_mesh(mesh)
+            fn, args = build_step(mesh)
+            compiled = jax.jit(fn).lower(*args).compile()
+            analysis = compiled.cost_analysis()
+            if isinstance(analysis, list):
+                analysis = analysis[0] if analysis else {}
+            report["optimal_seconds"] = float(analysis.get("optimal_seconds", 0.0))
+            report["flops"] = float(analysis.get("flops", 0.0))
+            report["bytes_accessed"] = float(analysis.get("bytes accessed", 0.0))
+            try:
+                mem = compiled.memory_analysis()
+                report["peak_bytes"] = int(
+                    getattr(mem, "temp_size_in_bytes", 0)
+                    + getattr(mem, "argument_size_in_bytes", 0))
+            except Exception:
+                report["peak_bytes"] = 0
+            if measure:
+                import time
+
+                jax.block_until_ready(compiled(*args))
+                t0 = time.perf_counter()
+                out = compiled(*args)
+                jax.block_until_ready(out)
+                report["measured_seconds"] = time.perf_counter() - t0
+        except Exception as e:  # config fails to build/compile -> pruned
+            report["error"] = f"{type(e).__name__}: {str(e)[:200]}"
+        finally:
+            set_mesh(prev)
+        reports.append(report)
+
+    def rank(r):
+        if "error" in r:
+            return (1, 0.0, 0.0)
+        key = r.get("measured_seconds", r["optimal_seconds"])
+        return (0, key, r.get("peak_bytes", 0))
+
+    reports.sort(key=rank)
+    return reports[:top_k]

@@ -1,0 +1,182 @@
+"""paddle.distributed.rpc (reference: python/paddle/distributed/rpc/
+__init__.py — init_rpc / rpc_sync / rpc_async / get_worker_info / shutdown
+over a C++ agent).
+
+TPU-native design: rendezvous through the native TCPStore (native/src/
+tcp_store.cc — the same store the collective bootstrap uses), then direct
+point-to-point calls over multiprocessing.connection (authenticated length-
+prefixed pickle; Tensor arguments travel as host numpy via
+Tensor.__reduce__). Each worker runs one daemon serve loop; rpc_async
+returns a concurrent.futures.Future. This is the control-plane RPC the
+reference uses for parameter-server-style coordination — bulk tensor traffic
+belongs on the compiled collective path, not here.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import traceback
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass
+from multiprocessing.connection import Client, Listener
+from typing import Any, Dict, Optional
+
+@dataclass
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+class _RpcAgent:
+    def __init__(self, name, rank, world_size, store, authkey):
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        self.store = store
+        self.authkey = authkey
+        self.listener = Listener(("127.0.0.1", 0), authkey=authkey)
+        self.port = self.listener.address[1]
+        self.workers: Dict[str, WorkerInfo] = {}
+        # separate pools: outbound async calls must never starve inbound
+        # serving (N mutual rpc_async calls on one shared pool deadlock —
+        # all threads block on recv while the peers' requests queue)
+        self._serve_pool = ThreadPoolExecutor(max_workers=8,
+                                              thread_name_prefix="rpc-serve")
+        self._client_pool = ThreadPoolExecutor(max_workers=8,
+                                               thread_name_prefix="rpc-call")
+        self._stop = threading.Event()
+        self._serve_thread = threading.Thread(target=self._serve, daemon=True)
+        self._serve_thread.start()
+
+    # --- serving ------------------------------------------------------------
+    def _serve(self):
+        while not self._stop.is_set():
+            try:
+                conn = self.listener.accept()
+            except OSError:  # listener closed
+                return
+            self._serve_pool.submit(self._handle, conn)
+
+    def _handle(self, conn):
+        try:
+            with conn:
+                while True:
+                    try:
+                        msg = conn.recv_bytes()
+                    except EOFError:
+                        return
+                    kind, payload = pickle.loads(msg)
+                    if kind == "stop":
+                        return
+                    fn, args, kwargs = payload
+                    try:
+                        out = ("ok", fn(*args, **(kwargs or {})))
+                    except Exception:  # noqa: BLE001 — cross-process
+                        out = ("err", traceback.format_exc())
+                    conn.send_bytes(pickle.dumps(out))
+        except Exception:  # pragma: no cover — connection teardown races
+            pass
+
+    # --- rendezvous ---------------------------------------------------------
+    def register(self):
+        info = WorkerInfo(self.name, self.rank, "127.0.0.1", self.port)
+        self.store.set(f"rpc/worker/{self.rank}",
+                       pickle.dumps((info.name, info.rank, info.ip, info.port)))
+        self.store.add("rpc/registered", 1)
+        self.store.wait_ge("rpc/registered", self.world_size)
+        for r in range(self.world_size):
+            name, rank, ip, port = pickle.loads(
+                self.store.get(f"rpc/worker/{r}"))
+            self.workers[name] = WorkerInfo(name, rank, ip, port)
+
+    # --- client side --------------------------------------------------------
+    def call(self, to: str, fn, args, kwargs, timeout=None):
+        info = self.workers[to]
+        conn = Client((info.ip, info.port), authkey=self.authkey)
+        try:
+            conn.send_bytes(pickle.dumps(("call", (fn, args, kwargs))))
+            if timeout is not None and not conn.poll(timeout):
+                raise TimeoutError(f"rpc to {to!r} timed out after {timeout}s")
+            kind, payload = pickle.loads(conn.recv_bytes())
+        finally:
+            conn.close()
+        if kind == "err":
+            raise RuntimeError(f"rpc on worker {to!r} failed:\n{payload}")
+        return payload
+
+    def shutdown(self):
+        self.store.barrier("rpc_shutdown", world_size=self.world_size)
+        self._stop.set()
+        self.listener.close()
+        self._serve_pool.shutdown(wait=False)
+        self._client_pool.shutdown(wait=False)
+
+
+_agent: Optional[_RpcAgent] = None
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None):
+    """Start this process's RPC agent and rendezvous with the other workers.
+    master_endpoint: 'host:port' of the rank-0 TCPStore (reference contract;
+    defaults to PADDLE_MASTER or a local ephemeral store for world_size 1)."""
+    global _agent
+    if _agent is not None:
+        raise RuntimeError("rpc already initialized")
+    from .. import native
+
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None else rank
+    world_size = (int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+                  if world_size is None else world_size)
+    ep = master_endpoint or os.environ.get("PADDLE_MASTER", "127.0.0.1:0")
+    host, port = ep.rsplit(":", 1)
+    store = native.TCPStore(host, int(port), is_master=(rank == 0),
+                            world_size=world_size)
+    if rank == 0:
+        import secrets
+
+        key = secrets.token_bytes(32)
+        store.set("rpc/authkey", key)
+    else:
+        key = store.get("rpc/authkey")
+    _agent = _RpcAgent(name, rank, world_size, store, key)
+    _agent.register()
+    return _agent
+
+
+def rpc_sync(to: str, fn, args=(), kwargs=None, timeout=None):
+    if _agent is None:
+        raise RuntimeError("call init_rpc first")
+    return _agent.call(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to: str, fn, args=(), kwargs=None, timeout=None) -> Future:
+    if _agent is None:
+        raise RuntimeError("call init_rpc first")
+    return _agent._client_pool.submit(_agent.call, to, fn, args, kwargs,
+                                      timeout)
+
+
+def get_worker_info(name: Optional[str] = None) -> WorkerInfo:
+    if _agent is None:
+        raise RuntimeError("call init_rpc first")
+    if name is None:
+        return _agent.workers[_agent.name]
+    return _agent.workers[name]
+
+
+def get_all_worker_infos():
+    if _agent is None:
+        raise RuntimeError("call init_rpc first")
+    return sorted(_agent.workers.values(), key=lambda w: w.rank)
+
+
+def shutdown():
+    global _agent
+    if _agent is not None:
+        _agent.shutdown()
+        _agent = None

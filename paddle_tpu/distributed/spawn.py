@@ -33,11 +33,17 @@ class ProcessContext:
         self._tmpdir = tmpdir
 
     def join(self, timeout=None):
+        import time as _time
+
         results = [None] * len(self.processes)
         errors = []
+        deadline = None if timeout is None else _time.monotonic() + timeout
         for i, p in enumerate(self.processes):
             try:
-                p.wait(timeout)
+                # one shared deadline across ALL ranks, not timeout-per-rank
+                left = None if deadline is None else max(
+                    deadline - _time.monotonic(), 0.01)
+                p.wait(left)
             except subprocess.TimeoutExpired:
                 p.kill()
                 errors.append((i, "timeout"))
@@ -95,6 +101,11 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, backend="cpu",
           timeout=None, **options):
     """Run func in `nprocs` processes; returns ProcessContext (join=False)
     or the list of per-rank return values (join=True)."""
+    if daemon or options:
+        import warnings
+
+        warnings.warn("spawn: daemon and extra options are accepted for API "
+                      "parity but have no effect on subprocess workers")
     if nprocs < 1:
         nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", 0)) or (
             os.cpu_count() or 1)

@@ -28,6 +28,10 @@ class TensorArray:
 
     def write(self, index: int, value: Tensor) -> "TensorArray":
         i = int(index.item() if isinstance(index, Tensor) else index)
+        if i < 0:
+            raise ValueError(
+                f"array_write index must be non-negative, got {i} (negative "
+                "python indexing would silently clobber existing slots)")
         if i < len(self._items):
             self._items[i] = value
         else:

@@ -12,3 +12,5 @@ from . import optimizer  # noqa: F401, E402
 from .optimizer import LookAhead, ModelAverage  # noqa: F401, E402
 
 from .. import multiprocessing  # noqa: F401, E402 (reference: paddle.incubate.multiprocessing)
+
+from ..core import autotune  # noqa: F401, E402 (paddle.incubate.autotune parity)

@@ -676,7 +676,7 @@ def scaled_dot_product_attention(
             dropout_p if training else 0.0, is_causal,
         )
     ):
-        from ..pallas.flash_attention import flash_attention as _flash
+        from ..pallas.flash_attention import flash_attention_tuned as _flash
 
         return _flash(
             query, key, value, scale, is_causal,

@@ -314,3 +314,28 @@ def supports(q_shape, k_shape, attn_mask, dropout_p, is_causal=False,
         and d <= 256
         and not (is_causal and sq != sk)
     )
+
+
+# ---- autotuned entry (reference: phi autotune cache + switch_autotune) ----
+from ...core.autotune import autotune as _autotune  # noqa: E402
+
+_BLOCK_CANDIDATES = [
+    {"block_q": DEFAULT_BLOCK_Q, "block_k": DEFAULT_BLOCK_K},  # default 1st
+    {"block_q": 256, "block_k": 256},
+    {"block_q": 512, "block_k": 256},
+    {"block_q": 256, "block_k": 512},
+    {"block_q": 512, "block_k": 512},
+]
+
+
+@_autotune(_BLOCK_CANDIDATES,
+           key_extra=lambda q, k, v, scale=None, causal=False,
+           interpret=False: bool(causal))
+def flash_attention_tuned(q, k, v, scale=None, causal=False, interpret=False,
+                          *, block_q, block_k):
+    """flash_attention with block sizes chosen by the autotune cache when
+    FLAGS_use_autotune is on (invalid candidates — seq not divisible by the
+    block — are skipped by the tuner); otherwise the hand-picked defaults."""
+    if q.shape[1] % block_q or k.shape[1] % block_k:
+        raise ValueError("block does not divide sequence")  # tuner skips
+    return flash_attention(q, k, v, scale, causal, block_q, block_k, interpret)

@@ -155,3 +155,65 @@ class TestSelectedRows:
         merged = sr.merge()
         assert merged.rows.shape[0] == 2
         np.testing.assert_allclose(np.asarray(merged.to_dense()._value), dense)
+
+
+def _double(x):
+    return x * 2
+
+
+def _add_tensors(a, b):
+    return a + b
+
+
+def _rpc_rank_fn(master_ep):
+    import os
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import rpc
+
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    rpc.init_rpc(f"worker{rank}", rank=rank, world_size=2,
+                 master_endpoint=master_ep)
+    peer = f"worker{1 - rank}"
+    out = rpc.rpc_sync(peer, _double, args=(10 + rank,))
+    t = rpc.rpc_sync(peer, _add_tensors, args=(
+        paddle.to_tensor(np.ones((3,), np.float32)),
+        paddle.to_tensor(np.full((3,), float(rank), np.float32))))
+    infos = [w.name for w in rpc.get_all_worker_infos()]
+    rpc.shutdown()
+    return out, np.asarray(t._value).tolist(), infos
+
+
+class TestRpc:
+    def test_single_worker_sync_async(self):
+        from paddle_tpu.distributed import rpc
+
+        rpc.init_rpc("me", rank=0, world_size=1,
+                     master_endpoint="127.0.0.1:0")
+        try:
+            assert rpc.rpc_sync("me", _double, args=(21,)) == 42
+            fut = rpc.rpc_async("me", _double, args=(5,))
+            assert fut.result(timeout=30) == 10
+            info = rpc.get_worker_info()
+            assert info.name == "me" and info.rank == 0
+            with pytest.raises(RuntimeError, match="rank exploded"):
+                rpc.rpc_sync("me", _boom)
+        finally:
+            rpc.shutdown()
+
+    def test_two_workers_cross_call(self):
+        import socket
+
+        import paddle_tpu.distributed as dist
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        results = dist.spawn(_rpc_rank_fn, args=(f"127.0.0.1:{port}",),
+                             nprocs=2, timeout=120)
+        for rank, (out, tvals, infos) in enumerate(results):
+            assert out == 2 * (10 + rank)       # own args, evaluated remotely
+            np.testing.assert_allclose(tvals, 1.0 + rank)
+            assert infos == ["worker0", "worker1"]

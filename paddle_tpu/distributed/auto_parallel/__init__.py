@@ -111,3 +111,39 @@ def shard_layer(layer, mesh: ProcessMesh, shard_fn=None, input_fn=None, output_f
 
 def get_placement(x):
     return getattr(x, "placements", None)
+
+
+def _register_shard_constraint():
+    from ...utils import register_custom_op
+
+    @register_custom_op(name="shard_constraint_op", cacheable=False)
+    def shard_constraint_op(x, *, spec_tuple=()):
+        """Constrain x's sharding on the current mesh (GSPMD
+        with_sharding_constraint; device_put when eager). The partition spec
+        travels as a hashable tuple attr."""
+        import jax as _jax
+        from jax.sharding import NamedSharding, PartitionSpec as _P
+
+        from ..mesh import get_mesh
+
+        mesh = get_mesh()
+        if mesh is None or all(s is None for s in spec_tuple):
+            return x
+        spec = _P(*spec_tuple)
+        if isinstance(x, _jax.core.Tracer):
+            return _jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, spec))
+        return _jax.device_put(x, NamedSharding(mesh, spec))
+
+
+_register_shard_constraint()
+
+
+def shard_constraint(x, spec):
+    """Tensor-level sharding constraint: annotate an activation with a
+    PartitionSpec on the current mesh (reference analog: the manual
+    scatter/gather calls in sequence_parallel_utils; GSPMD derives the
+    collective from the constraint)."""
+    from ...ops import api
+
+    return api.shard_constraint_op(x, spec_tuple=tuple(spec))

@@ -148,3 +148,90 @@ class ParallelCrossEntropy(Layer):
         out = Tensor(loss)
         out.stop_gradient = False
         return out
+
+
+def _seq_spec(ndim):
+    """Sequence dim is -2 for [..., s, h] activations (dim 0 for 2-D)."""
+    spec = [None] * ndim
+    spec[-2] = "mp"
+    return PartitionSpec(*spec)
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """Megatron sequence parallelism, input side (reference:
+    fleet/utils/sequence_parallel_utils.py:228 ColumnSequenceParallelLinear):
+    the input arrives SEQUENCE-sharded (activations live 1/mp per device
+    between blocks); all-gather the sequence, then column-parallel matmul.
+
+    Two execution styles, like the other layers in this module: under a
+    bound axis (shard_map) the gather is an explicit collective; otherwise
+    the input is constrained sequence-sharded and GSPMD emits the all-gather
+    on ICI (the reference issues it by hand)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=False, mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.group = mp_group
+        self.weight = self.create_parameter([in_features, out_features],
+                                            attr=weight_attr)
+        _annotate(self.weight, PartitionSpec(None, "mp"))
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            _annotate(self.bias, PartitionSpec("mp"))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        axis = _bound_axis(self.group)
+        if axis is not None:
+            # shard_map style: x is the local sequence shard; gather it
+            x = all_gather_concat(x, axis=-2, group=self.group)
+        else:
+            from ..auto_parallel import shard_constraint
+
+            x = shard_constraint(x, _seq_spec(len(x.shape)))
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output and (_bound_axis(self.group) is not None):
+            out = all_gather_concat(out, axis=-1, group=self.group)
+        return out
+
+
+class RowSequenceParallelLinear(Layer):
+    """Megatron sequence parallelism, output side (reference:
+    sequence_parallel_utils.py:340 RowSequenceParallelLinear): row-parallel
+    matmul whose partial sums REDUCE-SCATTER onto the sequence dim (instead
+    of all-reduce), leaving activations sequence-sharded for the next block.
+    Under a bound axis the reduce-scatter is explicit; otherwise GSPMD
+    derives it from the output constraint."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, mp_group=None,
+                 name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.group = mp_group
+        self.weight = self.create_parameter([in_features, out_features],
+                                            attr=weight_attr)
+        _annotate(self.weight, PartitionSpec("mp", None))
+        self.bias = self.create_parameter([out_features], is_bias=True) \
+            if has_bias else None
+
+    def forward(self, x):
+        axis = _bound_axis(self.group)
+        out = F.linear(x, self.weight, None)
+        if axis is not None:
+            # shard_map style: partial sums -> reduce-scatter over seq dim
+            out = reduce_scatter(out, group=self.group, axis=-2)
+        else:
+            from ..auto_parallel import shard_constraint
+
+            # partial sums + sequence-sharded constraint => reduce-scatter
+            out = shard_constraint(out, _seq_spec(len(out.shape)))
+        if self.bias is not None:
+            out = out + self.bias
+        return out

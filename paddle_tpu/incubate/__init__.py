@@ -9,7 +9,13 @@ from . import nn  # noqa: F401
 from . import distributed  # noqa: F401, E402
 from . import asp  # noqa: F401, E402
 from . import optimizer  # noqa: F401, E402
-from .optimizer import LookAhead, ModelAverage  # noqa: F401, E402
+from .optimizer import (  # noqa: F401, E402
+    DGCMomentum,
+    GradientMerge,
+    LarsMomentum,
+    LookAhead,
+    ModelAverage,
+)
 
 from .. import multiprocessing  # noqa: F401, E402 (reference: paddle.incubate.multiprocessing)
 

@@ -4,9 +4,11 @@ from __future__ import annotations
 
 from typing import Dict
 
+import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
+from ..optimizer.optimizer import Optimizer
 
 
 class LookAhead:
@@ -102,4 +104,137 @@ class ModelAverage:
         self.step()
 
 
-__all__ = ["LookAhead", "ModelAverage"]
+__all__ = ["LookAhead", "ModelAverage", "LarsMomentum", "DGCMomentum", "GradientMerge"]
+
+
+class LarsMomentum(Optimizer):
+    """LARS: layer-wise adaptive rate scaling with momentum (reference:
+    paddle.incubate.optimizer.LarsMomentumOptimizer / fleet lars
+    meta-optimizer, phi lars_momentum_kernel).
+
+    local_lr = lr * coeff * ||w|| / (||g|| + lambda * ||w||)
+    v <- mu * v + local_lr * (g + lambda * w);  w <- w - v
+
+    Subclasses the Optimizer base so the update is a pure _update rule:
+    grad_clip, the trainable filter, multi_precision master weights,
+    state_dict, and the compiled TrainStep all come from the base.
+    """
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, epsilon=1e-9,
+                 exclude_from_weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate=learning_rate, parameters=parameters,
+                         grad_clip=grad_clip, multi_precision=multi_precision)
+        self.mu = float(momentum)
+        self.coeff = float(lars_coeff)
+        self.wd = float(lars_weight_decay)
+        self.eps = float(epsilon)
+        self._exclude = tuple(exclude_from_weight_decay or ())
+
+    def _init_state(self, p_value):
+        return {"velocity": jnp.zeros(p_value.shape, jnp.float32)}
+
+    def _post_init_state(self, p, state):
+        excluded = any(tag in (p.name or "") for tag in self._exclude)
+        state["wd"] = jnp.asarray(0.0 if excluded else self.wd, jnp.float32)
+
+    def _update(self, p, g, state, lr):
+        w = p.astype(jnp.float32)
+        gf = g.astype(jnp.float32)
+        wd = state["wd"]
+        wn = jnp.sqrt(jnp.sum(w * w))
+        gn = jnp.sqrt(jnp.sum(gf * gf))
+        local_lr = jnp.where((wn > 0) & (gn > 0),
+                             lr * self.coeff * wn / (gn + wd * wn + self.eps),
+                             lr)
+        v = self.mu * state["velocity"] + local_lr * (gf + wd * w)
+        return (w - v).astype(p.dtype), {**state, "velocity": v}
+
+
+class DGCMomentum(Optimizer):
+    """Deep Gradient Compression momentum (reference:
+    fleet dgc meta-optimizer + phi dgc ops): momentum correction with
+    residual accumulation and top-k gradient sparsification. On TPU the
+    all-reduce is compiled into the step, so DGC's role is the update RULE:
+    only the top `1 - sparsity` fraction of accumulated-velocity magnitude
+    is applied each step; the rest stays in the residual and compounds.
+    Momentum is factor-masked at transmitted positions (DGC paper 3.2)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 sparsity=0.999, rampup_begin_step=0, weight_decay=0.0,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate=learning_rate, parameters=parameters,
+                         weight_decay=weight_decay, grad_clip=grad_clip,
+                         multi_precision=multi_precision)
+        self.mu = float(momentum)
+        self.sparsity = float(sparsity)
+        self.rampup_begin_step = int(rampup_begin_step)
+
+    def _init_state(self, p_value):
+        return {"u": jnp.zeros(p_value.shape, jnp.float32),
+                "v": jnp.zeros(p_value.shape, jnp.float32)}
+
+    def _update(self, p, g, state, lr):
+        gf = g.astype(jnp.float32)
+        u = self.mu * state["u"] + gf            # momentum correction
+        v = state["v"] + u                       # residual accumulation
+        if self._step_count >= self.rampup_begin_step and v.size > 1:
+            k = max(1, int(v.size * (1.0 - self.sparsity)))
+            absv = jnp.abs(v)
+            thresh = jax.lax.top_k(absv.ravel(), k)[0][-1]
+            # a zero threshold (fewer than k nonzero entries) must not
+            # select-and-clear everything: transmit strictly nonzero coords
+            mask = (absv >= thresh) & (absv > 0)
+            applied = jnp.where(mask, v, 0.0)
+            v = jnp.where(mask, 0.0, v)          # residual keeps the rest
+            u = jnp.where(mask, 0.0, u)          # momentum factor masking
+        else:
+            applied = v
+            v = jnp.zeros_like(v)
+        new_p = (p.astype(jnp.float32) - lr * applied).astype(p.dtype)
+        return new_p, {**state, "u": u, "v": v}
+
+
+class GradientMerge:
+    """Gradient-merge meta-optimizer (reference: fleet gradient_merge —
+    python/paddle/distributed/fleet/meta_optimizers/dygraph_optimizer):
+    accumulate grads for k_steps, then run one inner-optimizer step with the
+    averaged (or summed) gradient."""
+
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        self.inner_optimizer = inner_optimizer
+        self.k_steps = int(k_steps)
+        self.avg = bool(avg)
+        self._acc: Dict[int, object] = {}
+        self._count = 0
+
+    def __getattr__(self, name):
+        return getattr(self.inner_optimizer, name)
+
+    def step(self):
+        params = self.inner_optimizer._parameter_list
+        self._count += 1
+        for p in params:
+            if p.grad is None:
+                continue
+            g = p.grad._value if isinstance(p.grad, Tensor) else p.grad
+            acc = self._acc.get(id(p))
+            self._acc[id(p)] = g if acc is None else acc + g
+        if self._count < self.k_steps:
+            for p in params:
+                p.clear_grad()
+            return False
+        for p in params:
+            acc = self._acc.get(id(p))
+            if acc is None:
+                continue
+            p._grad = Tensor(acc / self.k_steps if self.avg else acc)
+        self.inner_optimizer.step()
+        self._acc.clear()
+        self._count = 0
+        return True
+
+    def clear_grad(self):
+        for p in self.inner_optimizer._parameter_list:
+            p.clear_grad()

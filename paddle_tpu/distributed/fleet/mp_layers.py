@@ -223,6 +223,10 @@ class RowSequenceParallelLinear(Layer):
 
     def forward(self, x):
         axis = _bound_axis(self.group)
+        if axis is not None and not self.input_is_parallel:
+            raise NotImplementedError(
+                "RowSequenceParallelLinear under a bound mp axis requires "
+                "input_is_parallel=True (split the input before the layer)")
         out = F.linear(x, self.weight, None)
         if axis is not None:
             # shard_map style: partial sums -> reduce-scatter over seq dim

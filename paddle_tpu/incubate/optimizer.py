@@ -173,27 +173,33 @@ class DGCMomentum(Optimizer):
 
     def _init_state(self, p_value):
         return {"u": jnp.zeros(p_value.shape, jnp.float32),
-                "v": jnp.zeros(p_value.shape, jnp.float32)}
+                "v": jnp.zeros(p_value.shape, jnp.float32),
+                "step": jnp.zeros((), jnp.int32)}
 
     def _update(self, p, g, state, lr):
         gf = g.astype(jnp.float32)
         u = self.mu * state["u"] + gf            # momentum correction
         v = state["v"] + u                       # residual accumulation
-        if self._step_count >= self.rampup_begin_step and v.size > 1:
+        if v.size > 1:
+            # rampup gate is a TRACED value (state['step']) so the compiled
+            # train step re-evaluates it every step instead of baking in the
+            # step-0 branch
+            ramp = state["step"] >= self.rampup_begin_step
             k = max(1, int(v.size * (1.0 - self.sparsity)))
             absv = jnp.abs(v)
             thresh = jax.lax.top_k(absv.ravel(), k)[0][-1]
             # a zero threshold (fewer than k nonzero entries) must not
             # select-and-clear everything: transmit strictly nonzero coords
-            mask = (absv >= thresh) & (absv > 0)
+            mask = ((absv >= thresh) & (absv > 0)) | ~ramp
             applied = jnp.where(mask, v, 0.0)
             v = jnp.where(mask, 0.0, v)          # residual keeps the rest
-            u = jnp.where(mask, 0.0, u)          # momentum factor masking
+            # momentum factor masking only once sparsifying
+            u = jnp.where(mask & ramp, 0.0, u)
         else:
             applied = v
             v = jnp.zeros_like(v)
         new_p = (p.astype(jnp.float32) - lr * applied).astype(p.dtype)
-        return new_p, {**state, "u": u, "v": v}
+        return new_p, {**state, "u": u, "v": v, "step": state["step"] + 1}
 
 
 class GradientMerge:
@@ -210,7 +216,16 @@ class GradientMerge:
         self._count = 0
 
     def __getattr__(self, name):
+        if name in ("minimize", "functional_update"):
+            # __getattr__ delegation would hand static-mode capture the INNER
+            # optimizer and silently skip merging
+            raise AttributeError(name)
         return getattr(self.inner_optimizer, name)
+
+    def minimize(self, loss, *a, **kw):
+        loss.backward()
+        self.step()
+        return None, []
 
     def step(self):
         params = self.inner_optimizer._parameter_list

@@ -1,0 +1,313 @@
+"""jaxpr -> ONNX GraphProto exporter.
+
+Reference surface: paddle.onnx.export (python/paddle/onnx/__init__.py ->
+paddle2onnx). TPU-native redesign: instead of walking a static Program, the
+model function is traced to a jaxpr (the same representation the compiler
+consumes) and each equation maps to an ONNX node; layer parameters captured
+by the trace become graph initializers. The emitted file uses a minimal
+hand-declared subset of the public ONNX schema (proto/onnx_minimal.proto —
+field numbers fixed by the spec, so any ONNX reader loads the result).
+
+Covered primitives target the vision/MLP zoo (conv, pooling, matmul,
+elementwise, softmax pieces, reshape/transpose/concat/slice/pad, cast,
+where). Unsupported primitives raise with the op name so callers can fall
+back to the StableHLO artifact.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.extend import core as jcore
+
+from .proto import onnx_minimal_pb2 as pb
+
+_DTYPE = {
+    np.dtype("float32"): pb.TensorProto.FLOAT,
+    np.dtype("float64"): pb.TensorProto.DOUBLE,
+    np.dtype("float16"): pb.TensorProto.FLOAT16,
+    np.dtype("int32"): pb.TensorProto.INT32,
+    np.dtype("int64"): pb.TensorProto.INT64,
+    np.dtype("int8"): pb.TensorProto.INT8,
+    np.dtype("uint8"): pb.TensorProto.UINT8,
+    np.dtype("bool"): pb.TensorProto.BOOL,
+}
+try:  # bfloat16 is an ml_dtypes extension type
+    import ml_dtypes
+
+    _DTYPE[np.dtype(ml_dtypes.bfloat16)] = pb.TensorProto.BFLOAT16
+except ImportError:  # pragma: no cover
+    pass
+
+
+def _onnx_dtype(dtype):
+    dt = _DTYPE.get(np.dtype(dtype))
+    if dt is None:
+        raise NotImplementedError(f"ONNX export: unsupported dtype {dtype}")
+    return dt
+
+
+class OnnxBuilder:
+    def __init__(self, graph_name="paddle_tpu_graph", opset_version=17):
+        self.model = pb.ModelProto()
+        self.model.ir_version = 8
+        self.model.producer_name = "paddle_tpu"
+        self.model.producer_version = "0.1"
+        op = self.model.opset_import.add()
+        op.domain = ""
+        op.version = int(opset_version)
+        self.graph = self.model.graph
+        self.graph.name = graph_name
+        self._n = 0
+
+    def fresh(self, hint="t"):
+        self._n += 1
+        return f"{hint}_{self._n}"
+
+    def node(self, op_type, inputs, outputs, **attrs):
+        n = self.graph.node.add()
+        n.op_type = op_type
+        n.name = self.fresh(op_type.lower())
+        n.input.extend(inputs)
+        n.output.extend(outputs)
+        for k, v in attrs.items():
+            a = n.attribute.add()
+            a.name = k
+            if isinstance(v, float):
+                a.type = pb.AttributeProto.FLOAT
+                a.f = v
+            elif isinstance(v, (bool, int, np.integer)):
+                a.type = pb.AttributeProto.INT
+                a.i = int(v)
+            elif isinstance(v, str):
+                a.type = pb.AttributeProto.STRING
+                a.s = v.encode()
+            elif isinstance(v, (list, tuple)):
+                if v and isinstance(v[0], float):
+                    a.type = pb.AttributeProto.FLOATS
+                    a.floats.extend(v)
+                else:
+                    a.type = pb.AttributeProto.INTS
+                    a.ints.extend(int(x) for x in v)
+            else:
+                raise TypeError(f"attr {k}={v!r}")
+        return outputs
+
+    def initializer(self, name, arr):
+        arr = np.asarray(arr)
+        t = self.graph.initializer.add()
+        t.name = name
+        t.dims.extend(arr.shape)
+        t.data_type = _onnx_dtype(arr.dtype)
+        t.raw_data = arr.tobytes()
+        return name
+
+    def const(self, arr, hint="const"):
+        return self.initializer(self.fresh(hint), arr)
+
+    def value_info(self, coll, name, shape, dtype):
+        vi = coll.add()
+        vi.name = name
+        vi.type.tensor_type.elem_type = _onnx_dtype(dtype)
+        for d in shape:
+            dim = vi.type.tensor_type.shape.dim.add()
+            if isinstance(d, str):
+                dim.dim_param = d  # dynamic axis
+            else:
+                dim.dim_value = int(d)
+
+
+def _export_eqn(b: OnnxBuilder, eqn, name_of):
+    p = eqn.primitive.name
+    ins = [name_of(v) for v in eqn.invars]
+    outs = [name_of(v) for v in eqn.outvars]
+    pr = eqn.params
+
+    simple = {
+        "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div",
+        "max": "Max", "min": "Min", "neg": "Neg", "exp": "Exp",
+        "log": "Log", "tanh": "Tanh", "logistic": "Sigmoid",
+        "sqrt": "Sqrt", "abs": "Abs", "sign": "Sign", "floor": "Floor",
+        "ceil": "Ceil", "pow": "Pow", "erf": "Erf",
+        "stop_gradient": "Identity", "copy": "Identity",
+    }
+    if p in simple:
+        b.node(simple[p], ins, outs)
+        return
+
+    compare = {"eq": "Equal", "lt": "Less", "gt": "Greater",
+               "le": "LessOrEqual", "ge": "GreaterOrEqual",
+               "and": "And", "or": "Or", "xor": "Xor", "not": "Not"}
+    if p in compare:
+        b.node(compare[p], ins, outs)
+        return
+    if p == "ne":
+        e = b.fresh("eq")
+        b.node("Equal", ins, [e])
+        b.node("Not", [e], outs)
+        return
+    if p == "is_finite":
+        isinf = b.fresh("isinf")
+        isnan = b.fresh("isnan")
+        bad = b.fresh("bad")
+        b.node("IsInf", [ins[0]], [isinf])
+        b.node("IsNaN", [ins[0]], [isnan])
+        b.node("Or", [isinf, isnan], [bad])
+        b.node("Not", [bad], outs)
+        return
+
+    if p == "integer_pow":
+        e = b.const(np.asarray(float(pr["y"]), np.float32))
+        b.node("Pow", [ins[0], e], outs)
+    elif p == "rsqrt":
+        s = b.fresh("sqrt")
+        b.node("Sqrt", ins, [s])
+        b.node("Reciprocal", [s], outs)
+    elif p == "convert_element_type":
+        b.node("Cast", ins, outs, to=_onnx_dtype(pr["new_dtype"]))
+    elif p == "reshape":
+        shape = b.const(np.asarray(pr["new_sizes"], np.int64), "shape")
+        b.node("Reshape", [ins[0], shape], outs)
+    elif p == "squeeze":
+        axes = b.const(np.asarray(pr["dimensions"], np.int64), "axes")
+        b.node("Squeeze", [ins[0], axes], outs)
+    elif p == "transpose":
+        b.node("Transpose", ins, outs, perm=list(pr["permutation"]))
+    elif p == "broadcast_in_dim":
+        # insert singleton dims at the mapped positions, then Expand
+        out_shape = list(pr["shape"])
+        bdims = list(pr["broadcast_dimensions"])
+        inter = [1] * len(out_shape)
+        for src, dst in enumerate(bdims):
+            inter[dst] = eqn.invars[0].aval.shape[src]
+        rs = b.fresh("rs")
+        shape1 = b.const(np.asarray(inter, np.int64), "shape")
+        b.node("Reshape", [ins[0], shape1], [rs])
+        shape2 = b.const(np.asarray(out_shape, np.int64), "shape")
+        b.node("Expand", [rs, shape2], outs)
+    elif p == "concatenate":
+        b.node("Concat", ins, outs, axis=int(pr["dimension"]))
+    elif p == "slice":
+        starts = b.const(np.asarray(pr["start_indices"], np.int64), "starts")
+        ends = b.const(np.asarray(pr["limit_indices"], np.int64), "ends")
+        axes = b.const(np.arange(len(pr["start_indices"]), dtype=np.int64), "axes")
+        strides = pr["strides"] or [1] * len(pr["start_indices"])
+        steps = b.const(np.asarray(strides, np.int64), "steps")
+        b.node("Slice", [ins[0], starts, ends, axes, steps], outs)
+    elif p == "pad":
+        cfg = pr["padding_config"]
+        if any(interior for _, _, interior in cfg):
+            raise NotImplementedError("interior padding")
+        pads = [lo for lo, _, _ in cfg] + [hi for _, hi, _ in cfg]
+        pt = b.const(np.asarray(pads, np.int64), "pads")
+        b.node("Pad", [ins[0], pt, ins[1]], outs, mode="constant")
+    elif p == "select_n":
+        if len(ins) != 3:
+            raise NotImplementedError("select_n with >2 cases")
+        # jax: select_n(pred, on_false, on_true); ONNX Where(cond, X=true, Y=false)
+        b.node("Where", [ins[0], ins[2], ins[1]], outs)
+    elif p in ("reduce_sum", "reduce_max", "reduce_min", "reduce_prod"):
+        op = {"reduce_sum": "ReduceSum", "reduce_max": "ReduceMax",
+              "reduce_min": "ReduceMin", "reduce_prod": "ReduceProd"}[p]
+        axes = b.const(np.asarray(pr["axes"], np.int64), "axes")
+        b.node(op, [ins[0], axes], outs, keepdims=0)
+    elif p == "dot_general":
+        ((lc, rc), (lb, rb)) = pr["dimension_numbers"]
+        lshape = eqn.invars[0].aval.shape
+        rshape = eqn.invars[1].aval.shape
+        std = (tuple(lc) == (len(lshape) - 1,) and tuple(rc) == (0,)
+               and not lb and not rb)
+        if not std:
+            raise NotImplementedError(f"dot_general {pr['dimension_numbers']}")
+        b.node("MatMul", ins, outs)
+    elif p == "conv_general_dilated":
+        dn = pr["dimension_numbers"]
+        nd = len(pr["window_strides"])
+        if dn.lhs_spec != tuple(range(nd + 2)) or dn.rhs_spec != tuple(range(nd + 2)):
+            raise NotImplementedError("conv layout != NCHW/OIHW")
+        pads = [lo for lo, _ in pr["padding"]] + [hi for _, hi in pr["padding"]]
+        b.node("Conv", ins, outs,
+               strides=list(pr["window_strides"]),
+               dilations=list(pr["rhs_dilation"]),
+               pads=pads, group=int(pr["feature_group_count"]))
+    elif p == "reduce_window_max":
+        wd = list(pr["window_dimensions"])
+        ws = list(pr["window_strides"])
+        padding = pr["padding"]
+        if wd[0] != 1 or wd[1] != 1:
+            raise NotImplementedError("pooling over batch/channel")
+        pads = ([lo for lo, _ in padding[2:]] + [hi for _, hi in padding[2:]])
+        b.node("MaxPool", ins, outs, kernel_shape=wd[2:], strides=ws[2:],
+               pads=pads)
+    elif p in ("pjit", "closed_call", "core_call", "remat", "checkpoint",
+               "custom_jvp_call", "custom_vjp_call", "custom_vjp_call_jaxpr"):
+        inner = pr.get("jaxpr") or pr.get("call_jaxpr") or pr.get("fun_jaxpr")
+        if inner is None:
+            raise NotImplementedError(f"call primitive {p} without jaxpr")
+        closed = inner if hasattr(inner, "jaxpr") else jcore.ClosedJaxpr(inner, [])
+        _inline_jaxpr(b, closed, ins, outs, name_of)
+    else:
+        raise NotImplementedError(f"ONNX export: unsupported primitive {p!r}")
+
+
+def _inline_jaxpr(b, closed, in_names, out_names, outer_name_of):
+    jaxpr = closed.jaxpr
+    local = {}
+    for v, n in zip(jaxpr.invars, in_names):
+        local[v] = n
+    for v, c in zip(jaxpr.constvars, closed.consts):
+        local[v] = b.const(np.asarray(c), "const")
+
+    def name_of(v):
+        if isinstance(v, jcore.Literal):
+            return b.const(np.asarray(v.val), "lit")
+        n = local.get(v)
+        if n is None:
+            n = b.fresh("v")
+            local[v] = n
+        return n
+
+    for eqn in jaxpr.eqns:
+        _export_eqn(b, eqn, name_of)
+    # bind inner outputs to the caller's names
+    for v, target in zip(jaxpr.outvars, out_names):
+        b.node("Identity", [name_of(v)], [target])
+
+
+def export_function(fn, example_args, path, graph_name="paddle_tpu_model",
+                    opset_version=17, input_dim_params=None):
+    """Trace fn over example_args and write an ONNX ModelProto to `path`.
+    Captured constants (layer parameters) become initializers.
+    input_dim_params: optional {input_index: {dim_index: name}} marking
+    dynamic axes (emitted as dim_param)."""
+    closed = jax.make_jaxpr(fn)(*example_args)
+    b = OnnxBuilder(graph_name, opset_version)
+    jaxpr = closed.jaxpr
+    env = {}
+    for i, v in enumerate(jaxpr.invars):
+        name = f"input_{i}"
+        env[v] = name
+        shape = list(v.aval.shape)
+        for di, dname in (input_dim_params or {}).get(i, {}).items():
+            shape[di] = dname
+        b.value_info(b.graph.input, name, shape, v.aval.dtype)
+    for v, c in zip(jaxpr.constvars, closed.consts):
+        env[v] = b.const(np.asarray(c), "param")
+
+    def name_of(v):
+        if isinstance(v, jcore.Literal):
+            return b.const(np.asarray(v.val), "lit")
+        n = env.get(v)
+        if n is None:
+            n = b.fresh("v")
+            env[v] = n
+        return n
+
+    for eqn in jaxpr.eqns:
+        _export_eqn(b, eqn, name_of)
+    for i, v in enumerate(jaxpr.outvars):
+        out_name = name_of(v)
+        b.value_info(b.graph.output, out_name, v.aval.shape, v.aval.dtype)
+    data = b.model.SerializeToString()
+    with open(path, "wb") as f:
+        f.write(data)
+    return path

@@ -124,15 +124,16 @@ def run_child(preset: str) -> int:
 
     t0 = time.time()
     loss = step(ids)
-    loss.block_until_ready()
+    first_loss = float(loss.item())  # forced device->host sync
     log(f"[{preset}] compile+first step: {time.time() - t0:.1f}s "
-        f"loss={float(loss.item()):.3f}")
-    step(ids).block_until_ready()  # warm
-
+        f"loss={first_loss:.3f}")
+    float(step(ids).item())  # warm
+    # sync via value fetch: block_until_ready has been observed returning
+    # early through tunneled transports, inflating throughput
     t0 = time.time()
     for _ in range(timed_steps):
         loss = step(ids)
-    loss.block_until_ready()
+    float(loss.item())
     dt = time.time() - t0
     sps = timed_steps / dt
     tokens_per_sec = sps * batch * seq

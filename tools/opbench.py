@@ -22,24 +22,39 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _sync(out):
+    """Force completion by FETCHING a value. block_until_ready has been
+    observed returning early through tunneled transports, which silently
+    turns every measurement into dispatch-throughput noise; a device->host
+    copy of one element cannot lie (FIFO queues mean it covers every launch
+    ahead of it too)."""
+    import jax
+    import numpy as _np
+
+    for leaf in jax.tree_util.tree_leaves(out):
+        # fetch from EVERY output leaf: the FIFO argument covers one device's
+        # queue, and different leaves may live on different devices
+        _np.asarray(jax.device_get(leaf)).ravel()[:1]
+
+
 def time_fn(fn, *args, reps=5, warmup=3, inner=20):
-    """Median over `reps` of (launch `inner` executions, block once) / inner.
-    Device queues are FIFO, so one trailing block covers the whole batch —
+    """Median over `reps` of (launch `inner` executions, sync once) / inner.
+    Device queues are FIFO, so one trailing fetch covers the whole batch —
     amortizing host dispatch latency that would otherwise floor every
-    measurement (a single launch+block measures the RPC round trip, not the
+    measurement (a single launch+sync measures the RPC round trip, not the
     kernel, on a tunneled chip)."""
     import jax
 
     f = jax.jit(fn)
     for _ in range(warmup):
-        jax.tree_util.tree_map(lambda x: x.block_until_ready(), f(*args))
+        _sync(f(*args))
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
         out = None
         for _ in range(inner):
             out = f(*args)
-        jax.tree_util.tree_map(lambda x: x.block_until_ready(), out)
+        _sync(out)
         times.append((time.perf_counter() - t0) * 1e6 / inner)
     return statistics.median(times)
 

@@ -84,3 +84,10 @@ def complex(real, imag):
 
 def vander(x, n=None, increasing=False):
     return jnp.vander(x, N=n, increasing=increasing)
+
+
+def fill(x, value):
+    """legacy fill op: x filled with `value` (same shape/dtype)."""
+    import jax.numpy as jnp
+
+    return jnp.full_like(x, value)

@@ -68,3 +68,36 @@ def fftshift(x, axes=None):
 
 def ifftshift(x, axes=None):
     return jnp.fft.ifftshift(x, axes=axes)
+
+
+# phi reference names: complex<->complex / real<->complex transforms
+def fft_c2c(x, axes=(-1,), normalization="backward", forward=True):
+    import jax.numpy as jnp
+
+    fn = jnp.fft.fftn if forward else jnp.fft.ifftn
+    return fn(x, axes=tuple(axes), norm=normalization)
+
+
+def fft_r2c(x, axes=(-1,), normalization="backward", forward=True,
+            onesided=True):
+    import jax.numpy as jnp
+
+    if onesided:
+        out = jnp.fft.rfftn(x, axes=tuple(axes), norm=normalization)
+    else:
+        out = jnp.fft.fftn(x, axes=tuple(axes), norm=normalization)
+    # forward=False is the ihfft-style path: conjugate spectrum
+    return out if forward else jnp.conj(out)
+
+
+def fft_c2r(x, axes=(-1,), normalization="backward", forward=False,
+            last_dim_size=0):
+    import jax.numpy as jnp
+
+    s = None
+    if last_dim_size:
+        s = [x.shape[a] for a in axes]
+        s[-1] = int(last_dim_size)
+    # forward=True is the hfft-style path: conjugate before the inverse
+    xin = jnp.conj(x) if forward else x
+    return jnp.fft.irfftn(xin, s=s, axes=tuple(axes), norm=normalization)

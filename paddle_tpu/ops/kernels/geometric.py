@@ -76,3 +76,9 @@ def segment_pool(x, segment_ids, pooltype="SUM"):
         out = init.at[ids].min(x)
         return jnp.where(jnp.isfinite(out), out, 0.0)
     raise ValueError(f"unknown pooltype {pooltype!r}")
+
+
+# phi reference names for the graph message-passing ops
+send_u_recv = graph_send_recv
+send_ue_recv = graph_send_ue_recv
+send_uv = graph_send_uv

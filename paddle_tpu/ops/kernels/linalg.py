@@ -320,3 +320,15 @@ def histogramdd(x, bins=10, ranges=None, density=False, weights=None):
     h, edges = jnp.histogramdd(x, bins=bins, range=ranges, density=density,
                                weights=weights)
     return h, list(edges)
+
+
+def p_norm(x, porder=2.0, axis=-1, epsilon=1e-12, keepdim=False, asvector=False):
+    """phi p_norm op (paddle.linalg.norm vector path)."""
+    if asvector:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.linalg.norm(x, ord=porder, axis=axis, keepdims=keepdim)
+
+
+def matrix_rank_tol(x, atol_tensor, use_default_tol=False, hermitian=False):
+    return matrix_rank(x, tol=atol_tensor, hermitian=hermitian)

@@ -382,3 +382,8 @@ def class_center_sample(label, num_classes, num_samples, seed=None):
     inv = jnp.full((num_classes,), -1, jnp.int32).at[sampled].set(
         jnp.arange(num_samples, dtype=jnp.int32))
     return inv[lab], sampled
+
+
+# phi reference names
+warpctc = ctc_loss
+warprnnt = rnnt_loss

@@ -609,3 +609,12 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
     if data_format == "NHWC":
         out = jnp.transpose(out, (0, 2, 3, 1))
     return out
+
+
+def split_with_num(x, num, axis=0):
+    """phi split_with_num: even split into `num` parts."""
+    return tuple(jnp.split(x, int(num), axis=int(axis)))
+
+
+def repeat_interleave_with_tensor_index(x, repeats, axis=None):
+    return repeat_interleave(x, repeats, axis=axis)

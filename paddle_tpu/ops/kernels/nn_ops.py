@@ -830,3 +830,106 @@ logsigmoid = log_sigmoid
 tanh_shrink = tanhshrink
 bce_loss = binary_cross_entropy
 kldiv_loss = kl_div
+
+
+# ----- phi reference-name surface (aliases/wrappers over existing kernels)
+def add_n(inputs):
+    """phi add_n_kernel: elementwise sum of a tensor list."""
+    out = inputs[0]
+    for t in inputs[1:]:
+        out = out + t
+    return out
+
+
+def shape(x):
+    """legacy shape op: the tensor's shape as an int32 tensor."""
+    return jnp.asarray(x.shape, jnp.int32)
+
+
+def linear_interp(x, size=None, scale_factor=None, align_corners=False,
+                  data_format="NCW"):
+    return interpolate(x, size=size, scale_factor=scale_factor, mode="linear",
+                       align_corners=align_corners, data_format=data_format)
+
+
+def bilinear_interp(x, size=None, scale_factor=None, align_corners=False,
+                    data_format="NCHW"):
+    return interpolate(x, size=size, scale_factor=scale_factor,
+                       mode="bilinear", align_corners=align_corners,
+                       data_format=data_format)
+
+
+def nearest_interp(x, size=None, scale_factor=None, align_corners=False,
+                   data_format="NCHW"):
+    return interpolate(x, size=size, scale_factor=scale_factor,
+                       mode="nearest", align_corners=align_corners,
+                       data_format=data_format)
+
+
+def bicubic_interp(x, size=None, scale_factor=None, align_corners=False,
+                   data_format="NCHW"):
+    return interpolate(x, size=size, scale_factor=scale_factor,
+                       mode="bicubic", align_corners=align_corners,
+                       data_format=data_format)
+
+
+def trilinear_interp(x, size=None, scale_factor=None, align_corners=False,
+                     data_format="NCDHW"):
+    return interpolate(x, size=size, scale_factor=scale_factor,
+                       mode="trilinear", align_corners=align_corners,
+                       data_format=data_format)
+
+
+def cross_entropy_with_softmax(logits, label, soft_label=False,
+                               ignore_index=-100, axis=-1):
+    return cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, axis=axis,
+                         reduction="none")
+
+
+def flash_attn(q, k, v, dropout=0.0, causal=False, return_softmax=False,
+               training=True):
+    """phi flash_attn op name for the fused attention path."""
+    return scaled_dot_product_attention(q, k, v, dropout_p=dropout,
+                                        is_causal=causal, training=training)
+
+
+def flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
+                        max_seqlen_k, scale=None, dropout=0.0, causal=False):
+    """Varlen attention over packed sequences (phi flash_attn_unpadded):
+    tokens from different sequences must not attend to each other. The
+    packed [total, h, d] inputs get a block-diagonal mask built from the
+    cumulative sequence offsets."""
+    total = q.shape[0]
+    pos = jnp.arange(total)
+    seg_q = jnp.searchsorted(cu_seqlens_q[1:], pos, side="right")
+    seg_k = jnp.searchsorted(cu_seqlens_k[1:], jnp.arange(k.shape[0]),
+                             side="right")
+    mask = seg_q[:, None] == seg_k[None, :]
+    if causal:
+        off_q = pos - jnp.take(cu_seqlens_q, seg_q)
+        off_k = jnp.arange(k.shape[0]) - jnp.take(cu_seqlens_k, seg_k)
+        mask = mask & (off_q[:, None] >= off_k[None, :])
+    out = scaled_dot_product_attention(
+        q[None], k[None], v[None], attn_mask=mask[None, None],
+        dropout_p=dropout, scale=scale, training=dropout > 0)
+    return out[0]
+
+
+def pool2d(x, kernel_size, stride=None, padding=0, pooling_type="max",
+           ceil_mode=False, exclusive=True, adaptive=False,
+           data_format="NCHW", global_pooling=False):
+    """legacy pool2d op: one entry dispatching on pooling_type."""
+    if global_pooling:
+        kernel_size = (x.shape[2], x.shape[3]) if data_format == "NCHW" \
+            else (x.shape[1], x.shape[2])
+        stride, padding = kernel_size, 0
+    if adaptive:
+        if pooling_type == "max":
+            return adaptive_max_pool2d(x, kernel_size, data_format)
+        return adaptive_avg_pool2d(x, kernel_size, data_format)
+    if pooling_type == "max":
+        return max_pool2d(x, kernel_size, stride, padding, ceil_mode,
+                          data_format)
+    return avg_pool2d(x, kernel_size, stride, padding, ceil_mode, exclusive,
+                      data_format)

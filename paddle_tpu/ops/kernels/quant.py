@@ -57,3 +57,7 @@ def llm_int8_matmul(x, qweight, scales, threshold=6.0):
     outl = jnp.matmul(x_out, qweight.astype(jnp.float32) * scales.astype(jnp.float32))
     out = reg + outl
     return out.reshape(x.shape[:-1] + (qweight.shape[1],))
+
+
+# phi reference name
+quant_for_compress = quantize_weight_absmax

@@ -117,3 +117,7 @@ def standard_gamma(alpha):
     from ...core.random import next_key
 
     return jax.random.gamma(next_key(), alpha)
+
+
+# phi reference name
+truncated_gaussian_random = truncated_normal

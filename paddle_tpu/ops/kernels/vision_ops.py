@@ -1051,3 +1051,30 @@ def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
 unpool = max_unpool2d
 unpool3d = max_unpool3d
 max_pool2d_with_index = max_pool2d_with_mask
+
+
+def pool3d(x, kernel_size, stride=None, padding=0, pooling_type="max",
+           ceil_mode=False, exclusive=True, adaptive=False,
+           data_format="NCDHW", global_pooling=False):
+    """legacy pool3d op: one entry dispatching on pooling_type."""
+    if global_pooling:
+        kernel_size = x.shape[1:4] if data_format == "NDHWC" else x.shape[2:5]
+        stride, padding = kernel_size, 0
+    if adaptive:
+        if pooling_type == "max":
+            return adaptive_max_pool3d(x, kernel_size)
+        return adaptive_avg_pool3d(x, kernel_size)
+    if pooling_type == "max":
+        return max_pool3d(x, kernel_size, stride, padding,
+                          ceil_mode=ceil_mode, data_format=data_format)
+    return avg_pool3d(x, kernel_size, stride, padding, exclusive=exclusive,
+                      ceil_mode=ceil_mode, data_format=data_format)
+
+
+def max_pool3d_with_index(x, kernel_size, stride=None, padding=0,
+                          ceil_mode=False):
+    return max_pool3d(x, kernel_size, stride, padding, return_mask=True,
+                      ceil_mode=ceil_mode)
+
+
+deformable_conv = deform_conv2d

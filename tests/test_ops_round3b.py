@@ -364,3 +364,54 @@ class TestAliases:
                      "memory_efficient_attention", "elementwise_pow",
                      "reverse", "mean_all"):
             assert name in ops, name
+
+
+class TestReferenceNameSurface:
+    def test_alias_registry_complete(self):
+        from paddle_tpu.ops.registry import all_ops
+
+        ops = all_ops()
+        for name in ("add_n", "shape", "bilinear_interp", "nearest_interp",
+                     "trilinear_interp", "cross_entropy_with_softmax",
+                     "flash_attn", "flash_attn_unpadded", "pool2d", "pool3d",
+                     "max_pool3d_with_index", "deformable_conv", "fft_c2c",
+                     "fft_r2c", "fft_c2r", "fill", "send_u_recv",
+                     "split_with_num", "p_norm", "matrix_rank_tol", "warpctc",
+                     "warprnnt", "truncated_gaussian_random",
+                     "quant_for_compress"):
+            assert name in ops, name
+
+    def test_add_n_and_pipeline_accumulate_path(self):
+        xs = [paddle.to_tensor(np.full((3,), float(i), np.float32))
+              for i in range(3)]
+        np.testing.assert_allclose(np.asarray(F.add_n(xs)._value), 3.0)
+
+    def test_interp_and_pool_aliases(self):
+        x = t(f32(1, 2, 8, 8))
+        out = F.bilinear_interp(x, size=[4, 4])
+        assert tuple(out.shape) == (1, 2, 4, 4)
+        ref = F.interpolate(x, size=[4, 4], mode="bilinear")
+        np.testing.assert_allclose(np.asarray(out._value),
+                                   np.asarray(ref._value))
+        p = F.pool2d(x, 2, pooling_type="avg")
+        np.testing.assert_allclose(np.asarray(p._value),
+                                   np.asarray(F.avg_pool2d(x, 2)._value))
+
+    def test_flash_attn_unpadded_blocks_cross_sequence(self):
+        # two packed sequences of length 2; tokens must not attend across
+        q = t(f32(4, 2, 8))
+        cu = t(np.array([0, 2, 4], np.int32))
+        out = F.flash_attn_unpadded(q, q, q, cu, cu, 2, 2)
+        # compare vs attending within each sequence independently
+        ref0 = F.memory_efficient_attention(
+            t(np.asarray(q._value)[None, :2]), t(np.asarray(q._value)[None, :2]),
+            t(np.asarray(q._value)[None, :2]))
+        np.testing.assert_allclose(np.asarray(out._value)[:2],
+                                   np.asarray(ref0._value)[0], rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_shape_and_fill(self):
+        x = t(f32(3, 5))
+        np.testing.assert_array_equal(np.asarray(F.shape(x)._value), [3, 5])
+        np.testing.assert_allclose(
+            np.asarray(F.fill(x, 7.0)._value), 7.0)

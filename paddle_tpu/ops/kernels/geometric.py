@@ -82,3 +82,57 @@ def segment_pool(x, segment_ids, pooltype="SUM"):
 send_u_recv = graph_send_recv
 send_ue_recv = graph_send_ue_recv
 send_uv = graph_send_uv
+
+
+def reindex_graph(x, neighbors, count):
+    """phi reindex_graph: compact global node ids to local 0..K-1 ids.
+    x: [B] center nodes; neighbors: [E] global ids (variable content,
+    static shape); count: [B] neighbors per center. Returns (reindexed
+    neighbors, reindex_dst, out_nodes) — eager-only (data-dependent size),
+    like the reference's sampling ops."""
+    import numpy as _np
+
+    xv = _np.asarray(x)
+    nv = _np.asarray(neighbors)
+    cv = _np.asarray(count)
+    uniq = list(dict.fromkeys(xv.tolist() + nv.tolist()))
+    lut = {g: i for i, g in enumerate(uniq)}
+    re_nb = _np.asarray([lut[g] for g in nv.tolist()], _np.int64)
+    dst = _np.repeat(_np.arange(len(xv), dtype=_np.int64), cv)
+    return (jnp.asarray(re_nb), jnp.asarray(dst),
+            jnp.asarray(_np.asarray(uniq, _np.int64)))
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size, return_eids=False):
+    """phi weighted_sample_neighbors: weighted sampling (without
+    replacement, Efraimidis-Spirakis keys) of up to sample_size neighbors
+    per input node from a CSC graph. Eager-only (data-dependent sizes)."""
+    import numpy as _np
+
+    from ...core.random import next_key
+
+    rowv = _np.asarray(row)
+    cp = _np.asarray(colptr)
+    wv = _np.asarray(edge_weight, _np.float64)
+    seeds = _np.asarray(jax.random.randint(
+        next_key(), (len(_np.asarray(input_nodes)),), 0, 2 ** 31 - 1))
+    out_nb, out_cnt, out_eid = [], [], []
+    for i, node in enumerate(_np.asarray(input_nodes).tolist()):
+        lo, hi = int(cp[node]), int(cp[node + 1])
+        deg = hi - lo
+        rng = _np.random.default_rng(int(seeds[i]))
+        if deg <= sample_size:
+            pick = _np.arange(lo, hi)
+        else:
+            w = _np.maximum(wv[lo:hi], 1e-12)
+            keys = rng.random(deg) ** (1.0 / w)   # E-S weighted reservoir
+            pick = lo + _np.argsort(-keys)[:sample_size]
+        out_nb.extend(rowv[pick].tolist())
+        out_eid.extend(pick.tolist())
+        out_cnt.append(len(pick))
+    res = (jnp.asarray(_np.asarray(out_nb, _np.int64)),
+           jnp.asarray(_np.asarray(out_cnt, _np.int64)))
+    if return_eids:
+        return res + (jnp.asarray(_np.asarray(out_eid, _np.int64)),)
+    return res

@@ -1078,3 +1078,180 @@ def max_pool3d_with_index(x, kernel_size, stride=None, padding=0,
 
 
 deformable_conv = deform_conv2d
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances=None,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False):
+    """phi generate_proposals (RPN): decode anchor deltas, clip to the image,
+    filter tiny boxes, NMS, keep post_nms_top_n. Static shapes: returns
+    [post_nms_top_n, 4] boxes + scores with zero rows past the valid count.
+    Single image (N=1 slice), like the phi kernel's per-image loop body."""
+    off = 1.0 if pixel_offset else 0.0
+    s = scores.reshape(-1)                       # [A*H*W]
+    d = bbox_deltas.reshape(-1, 4)
+    a = anchors.reshape(-1, 4)
+    if variances is not None:
+        d = d * variances.reshape(-1, 4)
+    aw = a[:, 2] - a[:, 0] + off
+    ah = a[:, 3] - a[:, 1] + off
+    acx = a[:, 0] + aw * 0.5
+    acy = a[:, 1] + ah * 0.5
+    cx = d[:, 0] * aw + acx
+    cy = d[:, 1] * ah + acy
+    w = jnp.exp(jnp.minimum(d[:, 2], 10.0)) * aw
+    h = jnp.exp(jnp.minimum(d[:, 3], 10.0)) * ah
+    imh, imw = img_size[0], img_size[1]
+    x0 = jnp.clip(cx - w * 0.5, 0, imw - off)
+    y0 = jnp.clip(cy - h * 0.5, 0, imh - off)
+    x1 = jnp.clip(cx + w * 0.5 - off, 0, imw - off)
+    y1 = jnp.clip(cy + h * 0.5 - off, 0, imh - off)
+    boxes = jnp.stack([x0, y0, x1, y1], axis=1)
+    valid = ((x1 - x0 + off) >= min_size) & ((y1 - y0 + off) >= min_size)
+    s = jnp.where(valid, s, -jnp.inf)
+
+    k = min(int(pre_nms_top_n), s.shape[0])
+    top_s, idx = jax.lax.top_k(s, k)
+    b = boxes[idx]
+
+    # greedy NMS keep-mask over score-sorted boxes
+    area = jnp.maximum(b[:, 2] - b[:, 0] + off, 0) * jnp.maximum(
+        b[:, 3] - b[:, 1] + off, 0)
+    lt = jnp.maximum(b[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(b[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt + off, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    iou = inter / jnp.maximum(area[:, None] + area[None, :] - inter, 1e-10)
+
+    def body(i, carry):
+        keep, th = carry
+        sup = keep[i] & (iou[i] > th) & (jnp.arange(k) > i)
+        # adaptive NMS (eta < 1): decay the threshold while it stays > 0.5
+        th = jnp.where((eta < 1.0) & (th > 0.5), th * eta, th)
+        return keep & ~sup, th
+
+    keep, _ = lax.fori_loop(0, k, body,
+                            (jnp.ones((k,), bool),
+                             jnp.asarray(nms_thresh, jnp.float32)))
+    keep = keep & jnp.isfinite(top_s)
+    final_s = jnp.where(keep, top_s, -jnp.inf)
+    kk = min(int(post_nms_top_n), k)
+    out_s, pos = jax.lax.top_k(final_s, kk)
+    out_b = b[pos]
+    ok = jnp.isfinite(out_s)
+    return (out_b * ok[:, None], jnp.where(ok, out_s, 0.0),
+            jnp.sum(ok).astype(jnp.int32))
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh=0.7, downsample_ratio=32, gt_score=None,
+              use_label_smooth=False, scale_x_y=1.0):
+    """phi yolov3_loss: coordinate + objectness + class loss for one YOLOv3
+    head. x: [N, mask*(5+C), H, W]; gt_box: [N, B, 4] (xywh, image-relative
+    0..1); gt_label: [N, B] int. Returns per-image loss [N]."""
+    n, _, h, w = x.shape
+    mask = list(anchor_mask)
+    an = len(mask)
+    anc_all = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
+    anc = anc_all[jnp.asarray(mask)]
+    xr = x.reshape(n, an, 5 + class_num, h, w)
+    input_size = downsample_ratio * jnp.asarray([w, h], jnp.float32)
+
+    px = (jax.nn.sigmoid(xr[:, :, 0]) - 0.5) * scale_x_y + 0.5
+    py = (jax.nn.sigmoid(xr[:, :, 1]) - 0.5) * scale_x_y + 0.5
+    pw = xr[:, :, 2]
+    ph = xr[:, :, 3]
+    pobj = xr[:, :, 4]
+    pcls = xr[:, :, 5:]
+
+    gx = gt_box[..., 0] * w                        # [N, B] in grid units
+    gy = gt_box[..., 1] * h
+    gw = gt_box[..., 2]                            # image-relative
+    gh = gt_box[..., 3]
+    gi = jnp.clip(gx.astype(jnp.int32), 0, w - 1)
+    gj = jnp.clip(gy.astype(jnp.int32), 0, h - 1)
+    valid = (gw > 0) & (gh > 0)
+
+    # responsible anchor: best iou of gt wh vs all anchors (shape-only iou)
+    gw_pix = gw * input_size[0]
+    gh_pix = gh * input_size[1]
+    inter = (jnp.minimum(gw_pix[..., None], anc_all[None, None, :, 0])
+             * jnp.minimum(gh_pix[..., None], anc_all[None, None, :, 1]))
+    union = (gw_pix * gh_pix)[..., None] + (anc_all[:, 0] * anc_all[:, 1])[None, None] - inter
+    best = jnp.argmax(inter / jnp.maximum(union, 1e-10), axis=-1)  # [N, B]
+    # local anchor slot in this head (-1 when the best anchor isn't masked here)
+    local = jnp.full(best.shape, -1, jnp.int32)
+    for li, m in enumerate(mask):
+        local = jnp.where(best == m, li, local)
+    resp = valid & (local >= 0)
+
+    tx = gx - jnp.floor(gx)
+    ty = gy - jnp.floor(gy)
+    tw = jnp.log(jnp.maximum(gw_pix / jnp.maximum(anc[jnp.clip(local, 0, an - 1), 0], 1e-10), 1e-10))
+    th = jnp.log(jnp.maximum(gh_pix / jnp.maximum(anc[jnp.clip(local, 0, an - 1), 1], 1e-10), 1e-10))
+    box_scale = 2.0 - gw * gh                      # small-box upweighting
+
+    bidx = jnp.arange(n)[:, None]
+    lidx = jnp.clip(local, 0, an - 1)
+
+    def at(pred):
+        return pred[bidx, lidx, gj, gi]            # [N, B]
+
+    score_w = (jnp.ones_like(gx) if gt_score is None
+               else gt_score.astype(jnp.float32))
+    rw = resp.astype(jnp.float32) * box_scale * score_w
+    delta = jnp.sum(rw * (jnp.abs(at(px) - tx) ** 2 + jnp.abs(at(py) - ty) ** 2
+                          + jnp.abs(at(pw) - tw) ** 2 + jnp.abs(at(ph) - th) ** 2),
+                    axis=1)
+
+    # objectness: positives at responsible cells; negatives elsewhere unless
+    # the cell's best iou with any gt exceeds ignore_thresh (decoded boxes)
+    obj_t = jnp.zeros((n, an, h, w))
+    obj_t = obj_t.at[bidx, lidx, gj, gi].add(
+        resp.astype(jnp.float32) * score_w)
+    obj_t = jnp.clip(obj_t, 0.0, 1.0)
+
+    cxg = (jnp.arange(w, dtype=jnp.float32) + 0.0)[None, None, None, :]
+    cyg = (jnp.arange(h, dtype=jnp.float32) + 0.0)[None, None, :, None]
+    bx = (px + cxg) / w
+    by = (py + cyg) / h
+    bw = jnp.exp(jnp.clip(pw, -10, 10)) * anc[None, :, 0, None, None] / input_size[0]
+    bh = jnp.exp(jnp.clip(ph, -10, 10)) * anc[None, :, 1, None, None] / input_size[1]
+    px0, py0 = bx - bw / 2, by - bh / 2
+    px1, py1 = bx + bw / 2, by + bh / 2
+    gx0 = (gt_box[..., 0] - gt_box[..., 2] / 2)
+    gy0 = (gt_box[..., 1] - gt_box[..., 3] / 2)
+    gx1 = (gt_box[..., 0] + gt_box[..., 2] / 2)
+    gy1 = (gt_box[..., 1] + gt_box[..., 3] / 2)
+    ix0 = jnp.maximum(px0[..., None], gx0[:, None, None, None, :])
+    iy0 = jnp.maximum(py0[..., None], gy0[:, None, None, None, :])
+    ix1 = jnp.minimum(px1[..., None], gx1[:, None, None, None, :])
+    iy1 = jnp.minimum(py1[..., None], gy1[:, None, None, None, :])
+    iw = jnp.maximum(ix1 - ix0, 0)
+    ih = jnp.maximum(iy1 - iy0, 0)
+    inter2 = iw * ih
+    area_p = bw * bh
+    area_g = (gt_box[..., 2] * gt_box[..., 3])[:, None, None, None, :]
+    iou2 = inter2 / jnp.maximum(area_p[..., None] + area_g - inter2, 1e-10)
+    iou2 = jnp.where(valid[:, None, None, None, :], iou2, 0.0)
+    best_iou = jnp.max(iou2, axis=-1)
+    noobj_mask = (best_iou < ignore_thresh) & (obj_t < 0.5)
+
+    def bce(logit, target):
+        return jnp.maximum(logit, 0) - logit * target + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+    obj_loss = jnp.sum(bce(pobj, obj_t) * (obj_t + noobj_mask), axis=(1, 2, 3))
+
+    # classification at responsible cells (label smoothing: phi uses
+    # target = onehot*(1-eps) + eps/C with eps = 1/C)
+    eps = (1.0 / max(class_num, 1)) if use_label_smooth else 0.0
+    lab = jnp.clip(gt_label.astype(jnp.int32), 0, class_num - 1)
+    cls_t = (jax.nn.one_hot(lab, class_num) * (1.0 - eps)
+             + eps / max(class_num, 1))
+    pcls_at = pcls[bidx, lidx, :, gj, gi]          # [N, B, C]
+    cls_loss = jnp.sum(
+        (resp.astype(jnp.float32) * score_w)[..., None] * bce(pcls_at, cls_t),
+        axis=(1, 2))
+
+    return delta + obj_loss + cls_loss

@@ -1,0 +1,99 @@
+"""Parameter-server training mode (reference: the fleet PS runtime —
+python/paddle/distributed/fleet/runtime/the_one_ps.py + paddle/fluid/
+distributed/ps/ — ~45k LoC of C++ table/accessor machinery).
+
+TPU-native scope: PS mode exists for sparse recsys workloads where the
+embedding tables exceed worker memory. This is a minimal, working PS over
+the framework's own primitives — the RPC layer (distributed/rpc.py, TCPStore
+rendezvous) for transport and SelectedRows for sparse gradient semantics:
+
+  * the SERVER process owns named parameter tables and applies updates with
+    a server-side SGD (dense) or sparse row updates (merge duplicate rows,
+    scale, subtract — the SelectedRows rule);
+  * WORKERS pull dense params / sparse rows by id, compute locally, and
+    push gradients.
+
+Dense-path throughput belongs on compiled collectives; this covers the
+API surface + sparse-table semantics, tested end to end over real
+processes.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class ParameterServer:
+    """Runs inside the server process; the rpc layer invokes its methods."""
+
+    _tables: Dict[str, np.ndarray] = {}
+    _lrs: Dict[str, float] = {}
+
+    @classmethod
+    def create_table(cls, name: str, shape, lr: float = 0.1, init=None):
+        if init is None:
+            rng = np.random.default_rng(abs(hash(name)) % (1 << 31))
+            init = (rng.standard_normal(shape) * 0.01).astype(np.float32)
+        cls._tables[name] = np.asarray(init, np.float32)
+        cls._lrs[name] = float(lr)
+        return tuple(cls._tables[name].shape)
+
+    @classmethod
+    def pull_dense(cls, name: str) -> np.ndarray:
+        return cls._tables[name]
+
+    @classmethod
+    def push_dense(cls, name: str, grad) -> None:
+        cls._tables[name] = cls._tables[name] - cls._lrs[name] * np.asarray(grad)
+
+    @classmethod
+    def pull_sparse(cls, name: str, ids) -> np.ndarray:
+        return cls._tables[name][np.asarray(ids, np.int64)]
+
+    @classmethod
+    def push_sparse(cls, name: str, ids, grads) -> None:
+        """SelectedRows update: duplicate ids accumulate before the step."""
+        ids = np.asarray(ids, np.int64)
+        grads = np.asarray(grads, np.float32)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        merged = np.zeros((len(uniq),) + grads.shape[1:], np.float32)
+        np.add.at(merged, inv, grads)
+        cls._tables[name][uniq] -= cls._lrs[name] * merged
+
+
+class PSWorker:
+    """Worker-side handle: pull/push against the server over rpc."""
+
+    def __init__(self, server_name: str = "ps0"):
+        self.server = server_name
+
+    def create_table(self, name, shape, lr=0.1, init=None):
+        from . import rpc
+
+        return rpc.rpc_sync(self.server, ParameterServer.create_table,
+                            args=(name, shape, lr, init))
+
+    def pull_dense(self, name):
+        from . import rpc
+
+        return rpc.rpc_sync(self.server, ParameterServer.pull_dense,
+                            args=(name,))
+
+    def push_dense(self, name, grad):
+        from . import rpc
+
+        rpc.rpc_sync(self.server, ParameterServer.push_dense,
+                     args=(name, np.asarray(grad)))
+
+    def pull_sparse(self, name, ids):
+        from . import rpc
+
+        return rpc.rpc_sync(self.server, ParameterServer.pull_sparse,
+                            args=(name, np.asarray(ids)))
+
+    def push_sparse(self, name, ids, grads):
+        from . import rpc
+
+        rpc.rpc_sync(self.server, ParameterServer.push_sparse,
+                     args=(name, np.asarray(ids), np.asarray(grads)))

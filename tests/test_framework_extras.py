@@ -217,3 +217,56 @@ class TestRpc:
             assert out == 2 * (10 + rank)       # own args, evaluated remotely
             np.testing.assert_allclose(tvals, 1.0 + rank)
             assert infos == ["worker0", "worker1"]
+
+
+def _ps_role(master_ep):
+    """Two-process PS world: rank 0 = server, rank 1 = worker training a tiny
+    embedding regression through pull/push (dense + sparse paths)."""
+    import os
+
+    import numpy as np
+
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.distributed.ps import ParameterServer, PSWorker
+
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    rpc.init_rpc(f"ps{rank}" if rank == 0 else f"trainer{rank}", rank=rank,
+                 world_size=2, master_endpoint=master_ep)
+    try:
+        if rank == 0:
+            # server idles; workers drive it through rpc. Barrier on shutdown.
+            return "server"
+        w = PSWorker("ps0")
+        shape = w.create_table("emb", (8, 4), lr=0.5,
+                               init=np.ones((8, 4), np.float32))
+        assert tuple(shape) == (8, 4)
+        # sparse: rows 1 and 1 (duplicate) and 3 get gradients
+        ids = np.array([1, 1, 3])
+        grads = np.ones((3, 4), np.float32)
+        w.push_sparse("emb", ids, grads)
+        rows = w.pull_sparse("emb", np.array([1, 3, 0]))
+        # row1: 1 - 0.5*2 = 0; row3: 1 - 0.5 = 0.5; row0 untouched
+        ok = (abs(rows[0][0]) < 1e-6 and abs(rows[1][0] - 0.5) < 1e-6
+              and abs(rows[2][0] - 1.0) < 1e-6)
+        # dense path
+        w.push_dense("emb", np.full((8, 4), 0.1, np.float32))
+        after = w.pull_dense("emb")
+        ok = ok and abs(after[2][0] - (1.0 - 0.05)) < 1e-6
+        return "ok" if ok else f"mismatch {rows}"
+    finally:
+        rpc.shutdown()
+
+
+class TestParameterServer:
+    def test_ps_sparse_and_dense_over_processes(self):
+        import socket
+
+        import paddle_tpu.distributed as dist
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        results = dist.spawn(_ps_role, args=(f"127.0.0.1:{port}",), nprocs=2,
+                             timeout=180)
+        assert results[0] == "server"
+        assert results[1] == "ok", results[1]

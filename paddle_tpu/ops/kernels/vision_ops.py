@@ -1255,3 +1255,33 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
         axis=(1, 2))
 
     return delta + obj_loss + cls_loss
+
+
+def read_file(filename):
+    """paddle.vision.ops.read_file: file bytes as a uint8 tensor."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    return jnp.frombuffer(data, jnp.uint8)
+
+
+def decode_jpeg(x, mode="unchanged"):
+    """phi decode_jpeg (host decode, like the reference's CPU libjpeg path;
+    the GPU nvjpeg variant has no TPU analog). x: uint8 byte tensor.
+    Returns [C, H, W] uint8. Eager-only (data-dependent output shape)."""
+    import io as _io
+
+    import numpy as np_
+    from PIL import Image
+
+    raw = bytes(np_.asarray(x).astype(np_.uint8).tobytes())
+    img = Image.open(_io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np_.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None, :, :]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return jnp.asarray(arr)

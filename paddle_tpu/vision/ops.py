@@ -64,3 +64,15 @@ def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_siz
     return jnp.stack([ox - ow * 0.5, oy - oh * 0.5,
                       ox + ow * 0.5 - (0.0 if box_normalized else 1.0),
                       oy + oh * 0.5 - (0.0 if box_normalized else 1.0)], axis=-1)
+
+
+def read_file(filename):
+    from ..ops import api
+
+    return api.read_file(filename)
+
+
+def decode_jpeg(x, mode="unchanged"):
+    from ..ops import api
+
+    return api.decode_jpeg(x, mode=mode)

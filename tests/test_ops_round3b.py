@@ -474,3 +474,25 @@ class TestProposalsAndGraphSampling:
         assert cv.tolist() == [2, 1]
         first = np.asarray(nb._value)[:2]
         assert 3 in first  # weight-100 neighbor should (almost) always sample
+
+
+class TestImageIO:
+    def test_decode_jpeg_roundtrip(self, tmp_path):
+        import io
+
+        from PIL import Image
+
+        arr = (np.linspace(0, 255, 32 * 32 * 3).reshape(32, 32, 3)
+               .astype(np.uint8))
+        buf = io.BytesIO()
+        Image.fromarray(arr).save(buf, format="JPEG", quality=95)
+        p = tmp_path / "t.jpg"
+        p.write_bytes(buf.getvalue())
+
+        from paddle_tpu.vision import ops as vops
+
+        data = vops.read_file(str(p))
+        img = vops.decode_jpeg(data, mode="rgb")
+        assert tuple(img.shape) == (3, 32, 32)
+        got = np.asarray(img._value).transpose(1, 2, 0).astype(np.float32)
+        assert np.abs(got - arr.astype(np.float32)).mean() < 4.0  # lossy

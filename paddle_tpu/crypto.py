@@ -1,0 +1,78 @@
+"""Model-file encryption (reference: paddle/fluid/framework/io/crypto/ —
+CipherFactory/CipherUtils, AES cipher over mbedtls, used to encrypt
+inference model files).
+
+TPU-native scope: same API shape, modern construction — AES-256-GCM
+(authenticated encryption; the reference's AES-CBC provides no integrity)
+via the `cryptography` package. Works on bytes and files; pairs with
+framework.io save/load for encrypted checkpoints.
+"""
+from __future__ import annotations
+
+import os
+
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+_NONCE = 12
+_MAGIC = b"PTPUENC1"
+
+
+class CipherUtils:
+    @staticmethod
+    def gen_key(length: int = 256) -> bytes:
+        if length not in (128, 192, 256):
+            raise ValueError("key length must be 128/192/256 bits")
+        return AESGCM.generate_key(bit_length=length)
+
+    @staticmethod
+    def gen_key_to_file(length: int, path: str) -> bytes:
+        key = CipherUtils.gen_key(length)
+        with open(path, "wb") as f:
+            f.write(key)
+        return key
+
+    @staticmethod
+    def read_key_from_file(path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+
+class Cipher:
+    """AES-GCM cipher (CipherFactory.create_cipher analog)."""
+
+    def encrypt(self, plaintext: bytes, key: bytes) -> bytes:
+        nonce = os.urandom(_NONCE)
+        ct = AESGCM(key).encrypt(nonce, plaintext, _MAGIC)
+        return _MAGIC + nonce + ct
+
+    def decrypt(self, ciphertext: bytes, key: bytes) -> bytes:
+        if not ciphertext.startswith(_MAGIC):
+            raise ValueError("not a paddle_tpu encrypted blob")
+        nonce = ciphertext[len(_MAGIC):len(_MAGIC) + _NONCE]
+        ct = ciphertext[len(_MAGIC) + _NONCE:]
+        return AESGCM(key).decrypt(nonce, ct, _MAGIC)
+
+    def encrypt_to_file(self, plaintext: bytes, key: bytes, path: str) -> None:
+        with open(path, "wb") as f:
+            f.write(self.encrypt(plaintext, key))
+
+    def decrypt_from_file(self, key: bytes, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return self.decrypt(f.read(), key)
+
+
+class CipherFactory:
+    @staticmethod
+    def create_cipher(config_file: str | None = None) -> Cipher:
+        return Cipher()
+
+
+def encrypt_file(in_path: str, out_path: str, key: bytes) -> None:
+    with open(in_path, "rb") as f:
+        Cipher().encrypt_to_file(f.read(), key, out_path)
+
+
+def decrypt_file(in_path: str, out_path: str, key: bytes) -> None:
+    data = Cipher().decrypt_from_file(key, in_path)
+    with open(out_path, "wb") as f:
+        f.write(data)

@@ -55,6 +55,7 @@ from .spawn import spawn  # noqa: F401
 from . import rpc  # noqa: F401
 from . import auto_tuner  # noqa: F401
 from . import ps  # noqa: F401
+from .fleet_executor import FleetExecutor, TaskNode  # noqa: F401
 from .env import (  # noqa: F401
     ParallelEnv,
     get_rank,

@@ -1,0 +1,107 @@
+"""Fleet executor: task-DAG orchestration (reference:
+paddle/fluid/distributed/fleet_executor/ — Carrier + Interceptor actors
+passing messages to drive TaskNode DAGs per micro-batch).
+
+TPU-native scope: on TPU the inner pipeline schedules are COMPILED programs
+(distributed/pipeline.py) — actors cannot beat the compiler inside a step.
+What remains genuinely host-side is the reference's outer orchestration:
+a DAG of host tasks (data loading, compiled train step, checkpointing,
+evaluation) executed per micro-batch/round with dependency-driven
+concurrency. This executor provides that: TaskNode declares a callable +
+upstream edges + run-per-round multiplicity; FleetExecutor.run executes
+`num_micro_batches` rounds, each respecting the DAG, with independent tasks
+running concurrently on a thread pool (host tasks block on IO, not the GIL).
+"""
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional
+
+
+class TaskNode:
+    """One node of the DAG (reference task_node.h). `fn(round, upstream
+    results dict) -> result`; `max_run_times` = how many rounds it runs."""
+
+    def __init__(self, name: str, fn: Callable[[int, Dict[str, Any]], Any],
+                 role: str = "compute", max_run_times: Optional[int] = None):
+        self.name = name
+        self.fn = fn
+        self.role = role
+        self.max_run_times = max_run_times
+        self.upstream: List[str] = []
+        self.downstream: List[str] = []
+
+    def add_upstream_task(self, other: "TaskNode"):
+        self.upstream.append(other.name)
+        other.downstream.append(self.name)
+        return self
+
+
+class FleetExecutor:
+    def __init__(self, task_nodes: List[TaskNode], max_workers: int = 8):
+        self.nodes = {t.name: t for t in task_nodes}
+        if len(self.nodes) != len(task_nodes):
+            raise ValueError("duplicate task names")
+        for t in task_nodes:
+            for up in t.upstream:
+                if up not in self.nodes:
+                    raise ValueError(f"{t.name}: unknown upstream {up!r}")
+        self._check_acyclic()
+        self.max_workers = max_workers
+
+    def _check_acyclic(self):
+        state: Dict[str, int] = {}
+
+        def visit(n):
+            if state.get(n) == 1:
+                raise ValueError(f"task DAG has a cycle through {n!r}")
+            if state.get(n) == 2:
+                return
+            state[n] = 1
+            for up in self.nodes[n].upstream:
+                visit(up)
+            state[n] = 2
+
+        for n in self.nodes:
+            visit(n)
+
+    def run(self, num_micro_batches: int = 1) -> Dict[str, List[Any]]:
+        """Execute the DAG for each round; returns per-task result lists.
+        Within a round, a task starts as soon as all its upstreams finished;
+        independent tasks run concurrently."""
+        results: Dict[str, List[Any]] = {n: [] for n in self.nodes}
+        with ThreadPoolExecutor(max_workers=self.max_workers) as pool:
+            for rnd in range(num_micro_batches):
+                done: Dict[str, Any] = {}
+                events: Dict[str, threading.Event] = {
+                    n: threading.Event() for n in self.nodes}
+                errors: List[BaseException] = []
+
+                def run_task(name, rnd=rnd, done=done, events=events,
+                             errors=errors):
+                    node = self.nodes[name]
+                    try:
+                        for up in node.upstream:
+                            events[up].wait()
+                            if errors:
+                                return
+                        if (node.max_run_times is not None
+                                and rnd >= node.max_run_times):
+                            done[name] = None
+                        else:
+                            ups = {u: done[u] for u in node.upstream}
+                            done[name] = node.fn(rnd, ups)
+                    except BaseException as e:  # noqa: BLE001
+                        errors.append(e)
+                    finally:
+                        events[name].set()
+
+                futures = [pool.submit(run_task, n) for n in self.nodes]
+                for f in futures:
+                    f.result()
+                if errors:
+                    raise errors[0]
+                for n in self.nodes:
+                    results[n].append(done.get(n))
+        return results

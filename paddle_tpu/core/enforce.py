@@ -1,0 +1,59 @@
+"""Typed error machinery (reference: paddle/phi/core/enforce.h — PADDLE_ENFORCE
+macros raising typed errors with formatted context + hints).
+
+TPU-native scope: Python exceptions with the reference's error taxonomy and
+enforce helpers, so framework code raises consistent, greppable error types
+instead of bare ValueError/RuntimeError.
+"""
+from __future__ import annotations
+
+
+class EnforceNotMet(RuntimeError):
+    """Base of all enforce failures (reference: platform::EnforceNotMet)."""
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    pass
+
+
+class NotFoundError(EnforceNotMet, KeyError):
+    pass
+
+
+class OutOfRangeError(EnforceNotMet, IndexError):
+    pass
+
+
+class AlreadyExistsError(EnforceNotMet):
+    pass
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    pass
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    pass
+
+
+def enforce(cond, message="", error_cls=InvalidArgumentError):
+    """PADDLE_ENFORCE: raise error_cls(message) unless cond."""
+    if not cond:
+        raise error_cls(message)
+
+
+def enforce_eq(a, b, message="", error_cls=InvalidArgumentError):
+    if a != b:
+        raise error_cls(f"{message} (expected {a!r} == {b!r})")
+
+
+def enforce_not_none(value, message="", error_cls=NotFoundError):
+    if value is None:
+        raise error_cls(message)
+    return value
+
+
+def enforce_shape_match(shape_a, shape_b, message=""):
+    if tuple(shape_a) != tuple(shape_b):
+        raise InvalidArgumentError(
+            f"{message} (shape mismatch: {tuple(shape_a)} vs {tuple(shape_b)})")

@@ -68,7 +68,19 @@ def set_flags(flags: Dict[str, Any]):
 
 
 # --- core flags (analogs of the reference's most-used ones) ---
-define_flag("check_nan_inf", False, "Check every op output for NaN/Inf (eager mode).")
+def _sync_debug_nans(on):
+    # extend the per-op eager check into COMPILED programs: jax re-runs any
+    # jitted computation that produced a NaN in op-by-op mode and raises at
+    # the offending primitive (reference: full check_nan_inf instrumentation
+    # of generated kernels, paddle/fluid/framework/details/nan_inf_utils)
+    import jax
+
+    jax.config.update("jax_debug_nans", bool(on))
+
+
+define_flag("check_nan_inf", False,
+            "Check op outputs for NaN/Inf — eager per-op AND inside compiled "
+            "programs (jax_debug_nans).", on_change=_sync_debug_nans)
 define_flag("eager_op_jit", True, "Compile+cache single-op programs in eager mode.")
 define_flag("low_precision_op_list", False, "Record ops executed in low precision.")
 define_flag("benchmark", False, "Synchronize after every op (timing mode).")

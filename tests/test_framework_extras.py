@@ -381,3 +381,42 @@ class TestFleetExecutor:
         t = TaskNode("t", lambda r, u: r, max_run_times=2)
         res = FleetExecutor([t]).run(4)
         assert res["t"] == [0, 1, None, None]
+
+
+class TestEnforceAndNanCheck:
+    def test_enforce_taxonomy(self):
+        from paddle_tpu.core import enforce as E
+
+        with pytest.raises(E.InvalidArgumentError):
+            E.enforce(False, "bad arg")
+        with pytest.raises(E.EnforceNotMet):
+            E.enforce_eq(1, 2, "mismatch")
+        with pytest.raises(E.NotFoundError):
+            E.enforce_not_none(None, "missing")
+        assert E.enforce_not_none(5) == 5
+        with pytest.raises(E.InvalidArgumentError, match="shape mismatch"):
+            E.enforce_shape_match((2, 3), (3, 2))
+        # typed errors remain catchable as their builtin bases
+        with pytest.raises(ValueError):
+            E.enforce(False)
+
+    def test_check_nan_inf_covers_compiled_programs(self):
+        import jax
+
+        from paddle_tpu.core import flags
+
+        from paddle_tpu import jit as pjit
+
+        flags.set_flags({"check_nan_inf": True})
+        try:
+            assert jax.config.jax_debug_nans
+
+            @pjit.to_static
+            def f(x):
+                return (x - x) / (x - x)  # 0/0 -> NaN inside the compiled program
+
+            with pytest.raises(FloatingPointError):
+                f(paddle.to_tensor(np.ones((4,), np.float32))).numpy()
+        finally:
+            flags.set_flags({"check_nan_inf": False})
+            assert not jax.config.jax_debug_nans

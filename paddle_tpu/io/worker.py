@@ -261,11 +261,20 @@ class WorkerPool:
                 old.close()
         seg = self._attached.get(seg_name)
         if seg is None:
-            # attach-only mapping. The worker owns creation and unlink; the
-            # tracker's name set coalesces the child's register with this
-            # attach-register, and the worker's unlink removes it — balanced,
-            # and a killed worker's segments still get tracker leak-cleanup.
+            # attach-only mapping. Ownership: the WORKER's tracker (forked
+            # children get their own resource_tracker) covers creation and is
+            # balanced by the worker's unlink; the PARENT's attach here
+            # registers with the PARENT tracker (3.12 registers on attach),
+            # which nothing would ever balance — unregister it, or parent
+            # exit spews 'No such file or directory' unlink warnings for
+            # every segment the worker already unlinked.
             seg = shared_memory.SharedMemory(name=seg_name)
+            try:
+                from multiprocessing import resource_tracker
+
+                resource_tracker.unregister(seg._name, "shared_memory")
+            except Exception:  # pragma: no cover
+                pass
             self._attached[seg_name] = seg
             self._slot_names[key] = seg_name
         out = _unpack(payload, seg.buf, to_tensor)

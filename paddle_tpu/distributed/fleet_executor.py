@@ -69,37 +69,63 @@ class FleetExecutor:
     def run(self, num_micro_batches: int = 1) -> Dict[str, List[Any]]:
         """Execute the DAG for each round; returns per-task result lists.
         Within a round, a task starts as soon as all its upstreams finished;
-        independent tasks run concurrently."""
+        independent tasks run concurrently.
+
+        Scheduling is completion-driven: a task is submitted to the pool only
+        once every upstream has finished, so no worker thread ever blocks
+        holding a pool slot and the executor cannot deadlock regardless of
+        declaration order or `max_workers` (a pre-submit design deadlocked on
+        a 3-node chain declared in reverse with max_workers=2).
+        """
         results: Dict[str, List[Any]] = {n: [] for n in self.nodes}
+        # Adjacency is derived from upstream edges of the nodes actually in
+        # THIS executor (node.downstream may reference nodes outside a
+        # subgraph run; following it blind would corrupt the bookkeeping).
+        downstream: Dict[str, List[str]] = {n: [] for n in self.nodes}
+        for n, t in self.nodes.items():
+            for up in t.upstream:
+                downstream[up].append(n)
         with ThreadPoolExecutor(max_workers=self.max_workers) as pool:
             for rnd in range(num_micro_batches):
                 done: Dict[str, Any] = {}
-                events: Dict[str, threading.Event] = {
-                    n: threading.Event() for n in self.nodes}
                 errors: List[BaseException] = []
+                pending = {n: len(t.upstream) for n, t in self.nodes.items()}
+                lock = threading.Lock()
+                all_done = threading.Event()
+                remaining = [len(self.nodes)]
 
-                def run_task(name, rnd=rnd, done=done, events=events,
-                             errors=errors):
+                def run_task(name, rnd=rnd, done=done, errors=errors,
+                             pending=pending, lock=lock, all_done=all_done,
+                             remaining=remaining):
                     node = self.nodes[name]
+                    result = None
                     try:
-                        for up in node.upstream:
-                            events[up].wait()
-                            if errors:
-                                return
-                        if (node.max_run_times is not None
-                                and rnd >= node.max_run_times):
-                            done[name] = None
-                        else:
-                            ups = {u: done[u] for u in node.upstream}
-                            done[name] = node.fn(rnd, ups)
+                        if not errors:
+                            if (node.max_run_times is None
+                                    or rnd < node.max_run_times):
+                                ups = {u: done[u] for u in node.upstream}
+                                result = node.fn(rnd, ups)
                     except BaseException as e:  # noqa: BLE001
                         errors.append(e)
-                    finally:
-                        events[name].set()
+                    ready = []
+                    with lock:
+                        done[name] = result
+                        remaining[0] -= 1
+                        if remaining[0] == 0:
+                            all_done.set()
+                        for down in downstream[name]:
+                            pending[down] -= 1
+                            if pending[down] == 0:
+                                ready.append(down)
+                    for down in ready:
+                        pool.submit(run_task, down)
 
-                futures = [pool.submit(run_task, n) for n in self.nodes]
-                for f in futures:
-                    f.result()
+                if not self.nodes:
+                    all_done.set()
+                roots = [n for n, c in pending.items() if c == 0]
+                for n in roots:
+                    pool.submit(run_task, n)
+                all_done.wait()
                 if errors:
                     raise errors[0]
                 for n in self.nodes:

@@ -19,37 +19,57 @@ processes.
 """
 from __future__ import annotations
 
+import threading
 from typing import Dict, Optional
 
 import numpy as np
 
 
 class ParameterServer:
-    """Runs inside the server process; the rpc layer invokes its methods."""
+    """Runs inside the server process; the rpc layer invokes its methods.
+
+    The rpc agent serves requests on a thread pool and numpy releases the
+    GIL, so table mutation is guarded by a per-table lock — the analog of
+    the reference PS tables' locked accessors — or concurrent pushes from
+    two workers could both read the old table and silently drop an update.
+    """
 
     _tables: Dict[str, np.ndarray] = {}
     _lrs: Dict[str, float] = {}
+    _locks: Dict[str, threading.Lock] = {}
+    _meta_lock = threading.Lock()
 
     @classmethod
     def create_table(cls, name: str, shape, lr: float = 0.1, init=None):
         if init is None:
             rng = np.random.default_rng(abs(hash(name)) % (1 << 31))
             init = (rng.standard_normal(shape) * 0.01).astype(np.float32)
-        cls._tables[name] = np.asarray(init, np.float32)
-        cls._lrs[name] = float(lr)
+        with cls._meta_lock:
+            cls._tables[name] = np.asarray(init, np.float32)
+            cls._lrs[name] = float(lr)
+            cls._locks.setdefault(name, threading.Lock())
         return tuple(cls._tables[name].shape)
 
     @classmethod
+    def _lock(cls, name: str) -> threading.Lock:
+        with cls._meta_lock:
+            return cls._locks.setdefault(name, threading.Lock())
+
+    @classmethod
     def pull_dense(cls, name: str) -> np.ndarray:
-        return cls._tables[name]
+        with cls._lock(name):
+            return cls._tables[name].copy()
 
     @classmethod
     def push_dense(cls, name: str, grad) -> None:
-        cls._tables[name] = cls._tables[name] - cls._lrs[name] * np.asarray(grad)
+        with cls._lock(name):
+            cls._tables[name] = (
+                cls._tables[name] - cls._lrs[name] * np.asarray(grad))
 
     @classmethod
     def pull_sparse(cls, name: str, ids) -> np.ndarray:
-        return cls._tables[name][np.asarray(ids, np.int64)]
+        with cls._lock(name):
+            return cls._tables[name][np.asarray(ids, np.int64)]
 
     @classmethod
     def push_sparse(cls, name: str, ids, grads) -> None:
@@ -59,7 +79,8 @@ class ParameterServer:
         uniq, inv = np.unique(ids, return_inverse=True)
         merged = np.zeros((len(uniq),) + grads.shape[1:], np.float32)
         np.add.at(merged, inv, grads)
-        cls._tables[name][uniq] -= cls._lrs[name] * merged
+        with cls._lock(name):
+            cls._tables[name][uniq] -= cls._lrs[name] * merged
 
 
 class PSWorker:

@@ -136,13 +136,42 @@ def init_rpc(name: str, rank: Optional[int] = None,
     host, port = ep.rsplit(":", 1)
     store = native.TCPStore(host, int(port), is_master=(rank == 0),
                             world_size=world_size)
-    if rank == 0:
+    # Trust model: agents bind to 127.0.0.1, so the RPC surface (which
+    # executes pickled callables) is reachable by local users only. The
+    # authkey gates that surface; prefer an out-of-band shared secret via
+    # PADDLE_RPC_AUTHKEY so it never transits the rendezvous store — the
+    # store fallback is for the single-machine default where the store is
+    # itself loopback-only.
+    # Rank 0 always publishes to the store: either the generated key, or a
+    # marker that the key is env-provided — so a mixed configuration (env
+    # var visible to some ranks but not others, e.g. stripped by ssh or a
+    # container runtime) fails fast with a diagnostic instead of hanging in
+    # a blocking store.get or dying later with opaque auth errors.
+    _ENV_MARKER = b"__PADDLE_RPC_AUTHKEY_FROM_ENV__"
+    env_key = os.environ.get("PADDLE_RPC_AUTHKEY")
+    if env_key:
+        import hashlib
+
+        key = hashlib.sha256(env_key.encode()).digest()
+        if rank == 0:
+            store.set("rpc/authkey", _ENV_MARKER)
+        elif store.get("rpc/authkey") != _ENV_MARKER:
+            raise RuntimeError(
+                "PADDLE_RPC_AUTHKEY is set on this worker but rank 0 "
+                "generated its key via the store; set the env var on all "
+                "ranks or none")
+    elif rank == 0:
         import secrets
 
         key = secrets.token_bytes(32)
         store.set("rpc/authkey", key)
     else:
         key = store.get("rpc/authkey")
+        if key == _ENV_MARKER:
+            raise RuntimeError(
+                "rank 0 derives the RPC authkey from PADDLE_RPC_AUTHKEY but "
+                "that env var is not set on this worker; export it on all "
+                "ranks")
     _agent = _RpcAgent(name, rank, world_size, store, key)
     _agent.register()
     return _agent

@@ -261,20 +261,19 @@ class WorkerPool:
                 old.close()
         seg = self._attached.get(seg_name)
         if seg is None:
-            # attach-only mapping. Ownership: the WORKER's tracker (forked
-            # children get their own resource_tracker) covers creation and is
-            # balanced by the worker's unlink; the PARENT's attach here
-            # registers with the PARENT tracker (3.12 registers on attach),
-            # which nothing would ever balance — unregister it, or parent
-            # exit spews 'No such file or directory' unlink warnings for
-            # every segment the worker already unlinked.
-            seg = shared_memory.SharedMemory(name=seg_name)
+            # attach-only mapping. Ownership: segment creation is tracked and
+            # balanced by the WORKER's unlink. On 3.13+ `track=False` keeps
+            # this attach out of the tracker entirely. On 3.12 attach
+            # registers implicitly — but the pool's queues start the tracker
+            # BEFORE the fork, so parent and workers share one tracker whose
+            # name cache is a set: the duplicate register is idempotent and
+            # the worker's unlink balances it. An extra parent-side
+            # unregister here would make the shared tracker print KeyError
+            # tracebacks at teardown (advisor r3), so none is issued.
             try:
-                from multiprocessing import resource_tracker
-
-                resource_tracker.unregister(seg._name, "shared_memory")
-            except Exception:  # pragma: no cover
-                pass
+                seg = shared_memory.SharedMemory(name=seg_name, track=False)
+            except TypeError:  # pre-3.13: no track parameter
+                seg = shared_memory.SharedMemory(name=seg_name)
             self._attached[seg_name] = seg
             self._slot_names[key] = seg_name
         out = _unpack(payload, seg.buf, to_tensor)

@@ -382,6 +382,45 @@ class TestFleetExecutor:
         res = FleetExecutor([t]).run(4)
         assert res["t"] == [0, 1, None, None]
 
+    def test_reverse_declaration_small_pool_no_deadlock(self):
+        # Regression (advisor r3): a chain declared downstream-first with a
+        # pool smaller than the node count deadlocked the pre-submit
+        # scheduler — every slot held a thread waiting on an upstream that
+        # could never be scheduled. Completion-driven scheduling must finish.
+        from paddle_tpu.distributed import FleetExecutor, TaskNode
+
+        a = TaskNode("a", lambda r, u: 1)
+        b = TaskNode("b", lambda r, u: u["a"] + 1)
+        c = TaskNode("c", lambda r, u: u["b"] + 1)
+        b.add_upstream_task(a)
+        c.add_upstream_task(b)
+        ex = FleetExecutor([c, b, a], max_workers=2)
+
+        import threading
+
+        out: dict = {}
+
+        def go():
+            out["res"] = ex.run(num_micro_batches=3)
+
+        th = threading.Thread(target=go, daemon=True)
+        th.start()
+        th.join(timeout=20)
+        assert not th.is_alive(), "FleetExecutor.run deadlocked"
+        assert out["res"]["c"] == [3, 3, 3]
+
+    def test_wide_dag_exceeding_pool(self):
+        from paddle_tpu.distributed import FleetExecutor, TaskNode
+
+        sink = TaskNode("sink", lambda r, u: sum(u.values()))
+        nodes = []
+        for i in range(10):
+            n = TaskNode(f"n{i}", lambda r, u, i=i: i)
+            sink.add_upstream_task(n)
+            nodes.append(n)
+        res = FleetExecutor([sink] + nodes, max_workers=3).run(2)
+        assert res["sink"] == [45, 45]
+
 
 class TestEnforceAndNanCheck:
     def test_enforce_taxonomy(self):

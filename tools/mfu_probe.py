@@ -3,20 +3,28 @@ several knob settings and print per-config tokens/s + MFU.
 
 Usage: python tools/mfu_probe.py [config ...]
 Configs: baseline flashoff batch16 seq2048 o2 o2b16 o2b32flash
+
+Every completed measurement is ALSO appended immediately as a JSON line to
+MFU_PROBE.jsonl at the repo root (override with MFU_PROBE_OUT), so a tunnel
+death mid-run cannot erase evidence already gathered.
 """
 from __future__ import annotations
 
+import json
 import os
 import sys
 import time
 
 import numpy as np
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+OUT_PATH = os.environ.get("MFU_PROBE_OUT",
+                          os.path.join(_REPO, "MFU_PROBE.jsonl"))
 
 
 def measure(name, hidden=1024, layers=24, heads=16, batch=8, seq=1024,
-            steps=5, flash=True, o2=False):
+            steps=5, flash=True, o2=False, recompute=False):
     import jax
 
     import paddle_tpu as paddle
@@ -28,16 +36,19 @@ def measure(name, hidden=1024, layers=24, heads=16, batch=8, seq=1024,
     _flags.set_flags({"use_flash_attention": flash})
     cfg = GPTConfig(vocab_size=50304, hidden_size=hidden, num_layers=layers,
                     num_heads=heads, max_position_embeddings=max(seq, 1024),
-                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                    recompute=recompute)
     paddle.seed(0)
     model = GPTForCausalLM(cfg)
     n_params = sum(int(np.prod(q.shape)) for q in model.parameters())
     opt = optimizer.AdamW(1e-4, parameters=model.parameters(), weight_decay=0.01)
+    level = "O1"
     if o2:
         model, opt = amp.decorate(model, opt, level="O2", dtype="bfloat16")
+        level = "O2"
 
     def loss_fn(ids):
-        with amp.auto_cast(level="O1", dtype="bfloat16"):
+        with amp.auto_cast(level=level, dtype="bfloat16"):
             return model(ids, labels=ids)
 
     step = TrainStep(model, loss_fn, opt)
@@ -62,6 +73,15 @@ def measure(name, hidden=1024, layers=24, heads=16, batch=8, seq=1024,
           f"flash={int(flash)} o2={int(o2)} compile={compile_s:.0f}s "
           f"step={dt*1000:.1f}ms tok/s={tps:,.0f} MFU={mfu:.3f}",
           flush=True)
+    with open(OUT_PATH, "a") as f:
+        f.write(json.dumps({
+            "config": name, "backend": jax.default_backend(),
+            "params_millions": round(n_params / 1e6, 1),
+            "batch": batch, "seq": seq, "flash": flash, "o2": o2,
+            "recompute": recompute, "compile_s": round(compile_s, 1),
+            "step_ms": round(dt * 1000, 2), "tokens_per_sec": round(tps, 1),
+            "mfu": round(mfu, 4), "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        }) + "\n")
     del step, model, opt
     return mfu
 
@@ -75,7 +95,10 @@ CONFIGS = {
     "o2": dict(o2=True),
     "o2b16": dict(o2=True, batch=16),
     "o2b32": dict(o2=True, batch=32),
+    "o2b32r": dict(o2=True, batch=32, recompute=True),
     "o2b16flashoff": dict(o2=True, batch=16, flash=False),
+    "o2b64r": dict(o2=True, batch=64, recompute=True),
+    "o2s2048b16r": dict(o2=True, batch=16, seq=2048, recompute=True),
 }
 
 

@@ -138,4 +138,45 @@ def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
 
 
-__all__ = ["Config", "Predictor", "PredictorTensor", "create_predictor"]
+class GenerationPredictor:
+    """Serving wrapper over the KV-cache decode path (reference: the
+    serving predictors built on fused_multi_transformer's cache-KV ops,
+    paddle/fluid/operators/fused/fused_multi_transformer_op.cu).
+
+    Wraps a CausalLM (models/generation.GenerationMixin) so deployment code
+    gets the Predictor-style surface while decoding runs the compiled
+    single-token step with donated caches. Construct from a live model, or
+    from a checkpoint prefix saved with paddle.save(model.state_dict(), ...)
+    plus a builder that recreates the architecture.
+    """
+
+    def __init__(self, model=None, model_path: Optional[str] = None,
+                 model_builder=None, **default_gen_kwargs):
+        if model is None:
+            if model_path is None or model_builder is None:
+                raise ValueError(
+                    "pass a live model, or model_path + model_builder")
+            from ..framework.io import load as fw_load
+
+            model = model_builder()
+            model.set_state_dict(fw_load(model_path))
+        if not hasattr(model, "generate"):
+            raise TypeError("model must provide generate() "
+                            "(models.generation.GenerationMixin)")
+        model.eval()
+        self.model = model
+        self.default_gen_kwargs = default_gen_kwargs
+
+    def generate(self, input_ids: np.ndarray, **gen_kwargs) -> np.ndarray:
+        kw = dict(self.default_gen_kwargs)
+        kw.update(gen_kwargs)
+        out = self.model.generate(Tensor(jnp.asarray(input_ids)), **kw)
+        return np.asarray(out._value)
+
+    def run(self, inputs: List[np.ndarray]) -> List[np.ndarray]:
+        """Predictor-style entry: inputs[0] = int token ids [b, s]."""
+        return [self.generate(inputs[0])]
+
+
+__all__ = ["Config", "Predictor", "PredictorTensor", "create_predictor",
+           "GenerationPredictor"]

@@ -189,6 +189,21 @@ def save(layer, path, input_spec=None, **config):
     else:
         raise TypeError("jit.save expects a Layer or a to_static function")
     np.savez(path + ".pdiparams.npz", **{k: np.asarray(v._value) for k, v in state.items()})
+    # compat sidecar (reference: op_version.yaml consumed at program load) —
+    # lets future loaders detect op-surface drift instead of misbehaving
+    import json
+
+    from .. import __version__ as _fw_version
+    from ..ops import op_version as _opv
+
+    snap = _opv.surface_snapshot()
+    with open(path + ".pdmeta.json", "w") as f:
+        json.dump({
+            "framework_version": _fw_version,
+            "jax_version": jax.__version__,
+            "op_surface": snap,
+            "op_surface_fingerprint": _opv.surface_fingerprint(snap),
+        }, f)
     if input_spec is not None:
         from jax import export as jexport
 
@@ -208,9 +223,13 @@ def save(layer, path, input_spec=None, **config):
 
 
 def load(path, **config):
-    """paddle.jit.load analog: returns a callable running the exported program."""
+    """paddle.jit.load analog: returns a callable running the exported
+    program. Validates the .pdmeta.json compat sidecar when present: missing
+    ops raise, op version bumps warn (reference: op_version registry checks
+    at program load)."""
     from jax import export as jexport
 
+    check_artifact_compat(path)
     with open(path + ".pdmodel", "rb") as f:
         exported = jexport.deserialize(f.read())
 
@@ -220,6 +239,30 @@ def load(path, **config):
         return jax.tree_util.tree_map(lambda x: Tensor(x), out)
 
     return run
+
+
+def check_artifact_compat(path):
+    """Validate a saved artifact's op-surface snapshot against the live
+    registry (no-op for pre-sidecar artifacts). Raises RuntimeError for ops
+    that no longer exist; warns on version bumps."""
+    import json
+    import warnings
+
+    meta_path = path + ".pdmeta.json"
+    if not os.path.exists(meta_path):
+        return None
+    with open(meta_path) as f:
+        meta = json.load(f)
+    from ..ops import op_version as _opv
+
+    errors, warns = _opv.check_compat(meta.get("op_surface", {}))
+    if errors:
+        raise RuntimeError(
+            f"artifact {path!r} is incompatible with this op surface: "
+            + "; ".join(errors))
+    for w in warns:
+        warnings.warn(f"artifact {path!r}: {w}", stacklevel=3)
+    return meta
 
 
 def not_to_static(fn):

@@ -1,0 +1,188 @@
+"""Autoregressive generation with static-shape KV caches.
+
+Reference analog: the serving decode path built on the cache-KV variant of
+fused_multi_transformer (paddle/fluid/operators/fused/
+fused_multi_transformer_op.cu) plus the sampling ops (phi top_p_sampling).
+
+TPU-first design:
+  * KV caches are STATIC [b, max_len, kv_heads, head_dim] buffers per layer;
+    each decode step writes at `pos` via dynamic_update_slice inside the op
+    (ops/kernels/nn_ops.cached_multihead_attention) and masks invalid tail
+    positions — so the single-token decode step is ONE compiled XLA program
+    reused for every token, with cache buffers donated (updated in place in
+    HBM, no reallocation).
+  * prefill is a second compiled program per prompt length: it runs the full
+    prompt through the same cached path at pos=0, filling the cache in one
+    pass.
+  * sampling (greedy / temperature / top-k / top-p) happens INSIDE the
+    compiled step — no device->host round-trip per token except the optional
+    EOS check.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as _random
+from ..core.tensor import Tensor
+
+
+def init_kv_cache(batch: int, max_len: int, num_layers: int,
+                  num_kv_heads: int, head_dim: int, dtype=jnp.float32):
+    """Allocate the per-layer static KV ring: list of (k, v) arrays."""
+    return [
+        (jnp.zeros((batch, max_len, num_kv_heads, head_dim), dtype),
+         jnp.zeros((batch, max_len, num_kv_heads, head_dim), dtype))
+        for _ in range(num_layers)
+    ]
+
+
+def _sample_inside_jit(logits, do_sample, temperature, top_k, top_p, seed):
+    """logits: [b, vocab] (last position). Returns ids [b] int32."""
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32)
+    if temperature and temperature != 1.0:
+        logits = logits / temperature
+    if top_k:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p and top_p < 1.0:
+        from ..ops.kernels.random import nucleus_keep_mask
+
+        order = jnp.argsort(-logits, axis=-1)
+        sorted_l = jnp.take_along_axis(logits, order, axis=-1)
+        keep_sorted = nucleus_keep_mask(
+            jax.nn.softmax(sorted_l, axis=-1), top_p)
+        # scatter the keep mask back to vocab order
+        keep = jnp.zeros_like(keep_sorted).at[
+            jnp.arange(logits.shape[0])[:, None], order].set(keep_sorted)
+        logits = jnp.where(keep, logits, -jnp.inf)
+    key = jax.random.fold_in(jax.random.PRNGKey(0), seed)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+class GenerationMixin:
+    """Adds `generate()` to a CausalLM whose forward supports
+    `forward(input_ids, caches=..., pos=...) -> (logits, caches)`.
+
+    Subclass contract (GPTForCausalLM / LlamaForCausalLM):
+      * `_decode_geometry() -> (num_layers, num_kv_heads, head_dim, max_pos)`
+      * forward threading as above with static-shape caches.
+    """
+
+    def _cache_dtype(self):
+        p = next(iter(self.parameters()))
+        return p._value.dtype
+
+    def _functional_forward(self):
+        """A pure fn(param_vals, buffer_vals, ids, caches, pos) ->
+        (logits, caches) over this module, safe to jit."""
+        params = list(self.parameters())
+        buffers = list(self.buffers())
+
+        def fn(param_vals, buffer_vals, ids, caches, pos):
+            saved_p = [(p._value, p.stop_gradient) for p in params]
+            saved_b = [b._value for b in buffers]
+            try:
+                for p, v in zip(params, param_vals):
+                    p._value = v
+                    p.stop_gradient = True
+                for b, v in zip(buffers, buffer_vals):
+                    b._value = v
+                caches_t = [(Tensor(k), Tensor(v)) for k, v in caches]
+                logits, new_caches = self.forward(
+                    Tensor(ids), caches=caches_t, pos=Tensor(pos))
+                return logits._value, [
+                    (k._value, v._value) for k, v in new_caches]
+            finally:
+                for p, (v, sg) in zip(params, saved_p):
+                    p._value, p.stop_gradient = v, sg
+                for b, v in zip(buffers, saved_b):
+                    b._value = v
+
+        return fn, params, buffers
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 do_sample: bool = False, temperature: float = 1.0,
+                 top_k: int = 0, top_p: float = 1.0,
+                 eos_token_id: Optional[int] = None,
+                 seed: int = 0):
+        """Greedy/sampled autoregressive decoding. Returns the full sequence
+        (prompt + generated) as an int32 Tensor [b, s0 + n_new], where n_new
+        is max_new_tokens CAPPED at the model's context window
+        (max_position_embeddings - prompt_len); the returned tail is also
+        truncated early when every row has emitted eos_token_id."""
+        was_training = self.training
+        self.eval()
+        try:
+            return self._generate_impl(
+                input_ids, max_new_tokens, do_sample, float(temperature),
+                int(top_k), float(top_p), eos_token_id, seed)
+        finally:
+            if was_training:
+                self.train()
+
+    def _generate_impl(self, input_ids, max_new_tokens, do_sample,
+                       temperature, top_k, top_p, eos_token_id, seed):
+        ids = input_ids._value if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
+        ids = ids.astype(jnp.int32)
+        b, s0 = ids.shape
+        n_layers, n_kv, hd, max_pos = self._decode_geometry()
+        max_len = min(int(max_pos), s0 + max_new_tokens)
+        n_new = max_len - s0
+        if n_new <= 0:
+            raise ValueError(
+                f"prompt length {s0} leaves no room under "
+                f"max_position_embeddings={max_pos}")
+        caches = init_kv_cache(b, max_len, n_layers, n_kv, hd,
+                               self._cache_dtype())
+
+        fn, params, buffers = self._functional_forward()
+        param_vals = [p._value for p in params]
+        buffer_vals = [b_._value for b_ in buffers]
+        sample_cfg = (bool(do_sample), temperature, top_k, top_p)
+
+        def prefill(pv, bv, ids, caches, step_seed):
+            logits, caches = fn(pv, bv, ids, caches, jnp.asarray(0, jnp.int32))
+            nxt = _sample_inside_jit(logits[:, -1, :], *sample_cfg, step_seed)
+            return nxt, caches
+
+        def decode(pv, bv, tok, caches, pos, step_seed):
+            logits, caches = fn(pv, bv, tok[:, None], caches, pos)
+            nxt = _sample_inside_jit(logits[:, -1, :], *sample_cfg, step_seed)
+            return nxt, caches
+
+        # one compiled program per (prompt_len); one for all decode steps.
+        # cache buffers are donated so decode updates KV in place in HBM.
+        key_pre = ("_gen_prefill", s0, b, max_len, sample_cfg)
+        key_dec = ("_gen_decode", b, max_len, sample_cfg)
+        cache = getattr(self, "_gen_exec_cache", None)
+        if cache is None:
+            cache = self._gen_exec_cache = {}
+        if key_pre not in cache:
+            cache[key_pre] = jax.jit(prefill, donate_argnums=(3,))
+        if key_dec not in cache:
+            cache[key_dec] = jax.jit(decode, donate_argnums=(3,))
+
+        tok, caches = cache[key_pre](param_vals, buffer_vals, ids, caches,
+                                     jnp.asarray(seed, jnp.int32))
+        out: List = [tok]
+        eos_rows = None
+        if eos_token_id is not None:
+            eos_rows = np.asarray(jax.device_get(tok)) == eos_token_id
+        for t in range(1, n_new):
+            if eos_rows is not None and eos_rows.all():
+                break
+            tok, caches = cache[key_dec](
+                param_vals, buffer_vals, tok, caches,
+                jnp.asarray(s0 + t - 1, jnp.int32),
+                jnp.asarray(seed + t, jnp.int32))
+            out.append(tok)
+            if eos_rows is not None:
+                eos_rows |= np.asarray(jax.device_get(tok)) == eos_token_id
+        return Tensor(jnp.concatenate(
+            [ids] + [o[:, None] for o in out], axis=1))

@@ -26,6 +26,7 @@ from ..distributed.fleet.mp_layers import (
 )
 from ..nn import functional as F
 from ..ops import api
+from .generation import GenerationMixin
 
 
 @dataclass
@@ -86,13 +87,25 @@ class CausalSelfAttention(nn.Layer):
                 "parallelism (the ring/Ulysses kernels are deterministic); "
                 "set attention_dropout_prob=0")
 
-    def forward(self, x, rope=None):
+    def forward(self, x, rope=None, cache=None, pos=None):
         b, s, h = x.shape
         qkv = self.qkv_proj(x)
         qkv = api.reshape(qkv, [b, s, self.num_heads, 3 * self.head_dim])
         q, k, v = api.split(qkv, 3, axis=-1)
         if rope is not None:
             q, k = api.rotary_position_embedding(q, k, rope[0], rope[1])
+        if cache is not None:
+            if self.sequence_parallel:
+                raise NotImplementedError(
+                    "KV-cache decoding under sequence_parallel is not "
+                    "supported; gather the sequence (sequence_parallel=None) "
+                    "for generation")
+            # decode path: static-shape KV ring updated in place, causal
+            # masking against the absolute position (models/generation.py)
+            out, new_k, new_v = api.cached_multihead_attention(
+                q, k, v, cache[0], cache[1], pos)
+            out = api.reshape(out, [b, s, h])
+            return self.resid_dropout(self.out_proj(out)), (new_k, new_v)
         if self.sequence_parallel:
             # long-context path: sequence sharded over the 'sep' mesh axis,
             # ring/Ulysses attention as one registered op (context_parallel)
@@ -128,7 +141,13 @@ class GPTBlock(nn.Layer):
         self.ln_2 = nn.LayerNorm(config.hidden_size)
         self.mlp = GPTMLP(config)
 
-    def forward(self, x, rope=None):
+    def forward(self, x, rope=None, cache=None, pos=None):
+        if cache is not None:
+            a, new_cache = self.attn(self.ln_1(x), rope=rope, cache=cache,
+                                     pos=pos)
+            x = x + a
+            x = x + self.mlp(self.ln_2(x))
+            return x, new_cache
         x = x + self.attn(self.ln_1(x), rope=rope)
         x = x + self.mlp(self.ln_2(x))
         return x
@@ -158,15 +177,36 @@ class GPTModel(nn.Layer):
             return Tensor(jnp.cos(emb)), Tensor(jnp.sin(emb))
         return None
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, caches=None, pos=None):
         b, s = input_ids.shape
         h = self.wte(input_ids)
         rope = None
+        if caches is not None:
+            import jax.numpy as jnp
+            from jax import lax
+
+            pos_v = pos._value if isinstance(pos, Tensor) else jnp.asarray(pos)
+            pos_v = pos_v.astype(jnp.int32).reshape(())
+            if self.config.use_rotary:
+                cos, sin = self._rope(self.config.max_position_embeddings)
+                rope = (Tensor(lax.dynamic_slice(
+                            cos._value, (pos_v, 0), (s, cos.shape[-1]))),
+                        Tensor(lax.dynamic_slice(
+                            sin._value, (pos_v, 0), (s, sin.shape[-1]))))
+            else:
+                p = api.arange(0, s, 1, dtype="int32") + Tensor(pos_v)
+                h = h + self.wpe(p)
+            h = self.drop(h)
+            new_caches = []
+            for block, cache in zip(self.blocks, caches):
+                h, nc = block(h, rope=rope, cache=cache, pos=Tensor(pos_v))
+                new_caches.append(nc)
+            return self.ln_f(h), new_caches
         if self.config.use_rotary:
             rope = self._rope(s)
         else:
-            pos = api.arange(0, s, 1, dtype="int32")
-            h = h + self.wpe(pos)
+            p = api.arange(0, s, 1, dtype="int32")
+            h = h + self.wpe(p)
         h = self.drop(h)
         for block in self.blocks:
             if self.config.recompute and self.training:
@@ -179,7 +219,7 @@ class GPTModel(nn.Layer):
         return self.ln_f(h)
 
 
-class GPTForCausalLM(nn.Layer):
+class GPTForCausalLM(nn.Layer, GenerationMixin):
     def __init__(self, config: GPTConfig):
         super().__init__()
         self.config = config
@@ -188,12 +228,22 @@ class GPTForCausalLM(nn.Layer):
             self.lm_head = ColumnParallelLinear(config.hidden_size, config.vocab_size,
                                                 has_bias=False, gather_output=True)
 
-    def forward(self, input_ids, labels=None):
-        h = self.gpt(input_ids)
+    def _decode_geometry(self):
+        c = self.config
+        return (c.num_layers, c.num_heads, c.hidden_size // c.num_heads,
+                c.max_position_embeddings)
+
+    def _head(self, h):
         if self.config.tie_word_embeddings:
-            logits = api.matmul(h, self.gpt.wte.weight, transpose_y=True)
-        else:
-            logits = self.lm_head(h)
+            return api.matmul(h, self.gpt.wte.weight, transpose_y=True)
+        return self.lm_head(h)
+
+    def forward(self, input_ids, labels=None, caches=None, pos=None):
+        if caches is not None:
+            h, new_caches = self.gpt(input_ids, caches=caches, pos=pos)
+            return self._head(h), new_caches
+        h = self.gpt(input_ids)
+        logits = self._head(h)
         if labels is not None:
             loss = F.cross_entropy(
                 api.reshape(logits, [-1, self.config.vocab_size]),

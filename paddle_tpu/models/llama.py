@@ -26,6 +26,7 @@ from ..distributed.fleet.mp_layers import (
 )
 from ..nn import functional as F
 from ..ops import api
+from .generation import GenerationMixin
 
 
 @dataclass
@@ -88,12 +89,19 @@ class LlamaAttention(nn.Layer):
         self.o_proj = RowParallelLinear(c.num_heads * self.head_dim, c.hidden_size,
                                         has_bias=False, input_is_parallel=True)
 
-    def forward(self, x, rope):
+    def forward(self, x, rope, cache=None, pos=None):
         b, s, h = x.shape
         q = api.reshape(self.q_proj(x), [b, s, self.num_heads, self.head_dim])
         k = api.reshape(self.k_proj(x), [b, s, self.num_kv_heads, self.head_dim])
         v = api.reshape(self.v_proj(x), [b, s, self.num_kv_heads, self.head_dim])
         q, k = api.rotary_position_embedding(q, k, rope[0], rope[1])
+        if cache is not None:
+            # GQA caches keep the UNREPEATED kv heads (HBM = kv_heads/d of
+            # MHA); the cached op broadcasts per q-head group at compute time
+            out, new_k, new_v = api.cached_multihead_attention(
+                q, k, v, cache[0], cache[1], pos)
+            out = api.reshape(out, [b, s, self.num_heads * self.head_dim])
+            return self.o_proj(out), (new_k, new_v)
         if self.num_kv_heads != self.num_heads:
             rep = self.num_heads // self.num_kv_heads
             k = api.repeat_interleave(k, rep, axis=2)
@@ -129,7 +137,13 @@ class LlamaDecoderLayer(nn.Layer):
                                                    epsilon=config.rms_norm_eps)
         self.mlp = LlamaMLP(config)
 
-    def forward(self, x, rope):
+    def forward(self, x, rope, cache=None, pos=None):
+        if cache is not None:
+            a, new_cache = self.self_attn(self.input_layernorm(x), rope,
+                                          cache=cache, pos=pos)
+            x = x + a
+            x = x + self.mlp(self.post_attention_layernorm(x))
+            return x, new_cache
         x = x + self.self_attn(self.input_layernorm(x), rope)
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x
@@ -147,8 +161,24 @@ class LlamaModel(nn.Layer):
         self._rope = _rope_tables(head_dim, config.max_position_embeddings,
                                   config.rope_theta)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, caches=None, pos=None):
         s = input_ids.shape[1]
+        if caches is not None:
+            from jax import lax
+
+            pos_v = pos._value if isinstance(pos, Tensor) else jnp.asarray(pos)
+            pos_v = pos_v.astype(jnp.int32).reshape(())
+            d = self._rope[0].shape[-1]
+            cos = Tensor(lax.dynamic_slice(self._rope[0]._value,
+                                           (pos_v, 0), (s, d)))
+            sin = Tensor(lax.dynamic_slice(self._rope[1]._value,
+                                           (pos_v, 0), (s, d)))
+            h = self.embed_tokens(input_ids)
+            new_caches = []
+            for layer, cache in zip(self.layers, caches):
+                h, nc = layer(h, (cos, sin), cache=cache, pos=Tensor(pos_v))
+                new_caches.append(nc)
+            return self.norm(h), new_caches
         cos = Tensor(self._rope[0]._value[:s])
         sin = Tensor(self._rope[1]._value[:s])
         h = self.embed_tokens(input_ids)
@@ -163,7 +193,7 @@ class LlamaModel(nn.Layer):
         return self.norm(h)
 
 
-class LlamaForCausalLM(nn.Layer):
+class LlamaForCausalLM(nn.Layer, GenerationMixin):
     def __init__(self, config: LlamaConfig):
         super().__init__()
         self.config = config
@@ -174,12 +204,22 @@ class LlamaForCausalLM(nn.Layer):
             self.lm_head = ColumnParallelLinear(config.hidden_size, config.vocab_size,
                                                 has_bias=False)
 
-    def forward(self, input_ids, labels=None):
-        h = self.model(input_ids)
+    def _decode_geometry(self):
+        c = self.config
+        return (c.num_layers, c.num_key_value_heads,
+                c.hidden_size // c.num_heads, c.max_position_embeddings)
+
+    def _head(self, h):
         if self.lm_head is None:
-            logits = api.matmul(h, api.t(self.model.embed_tokens.weight))
-        else:
-            logits = self.lm_head(h)
+            return api.matmul(h, api.t(self.model.embed_tokens.weight))
+        return self.lm_head(h)
+
+    def forward(self, input_ids, labels=None, caches=None, pos=None):
+        if caches is not None:
+            h, new_caches = self.model(input_ids, caches=caches, pos=pos)
+            return self._head(h), new_caches
+        h = self.model(input_ids)
+        logits = self._head(h)
         if labels is not None:
             b, s, v = logits.shape
             shift_logits = api.reshape(logits[:, :-1, :], [-1, v])

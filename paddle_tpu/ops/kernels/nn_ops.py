@@ -742,6 +742,46 @@ def rotary_position_embedding(q, k, cos, sin, rotate_half=True):
     return q_out, k_out
 
 
+# ------------------------------------------------- cached decode attention
+def cached_multihead_attention(q, k, v, k_cache, v_cache, pos, scale=None):
+    """Cache-carrying attention for autoregressive decoding (reference: the
+    cache-KV path of fused_multi_transformer —
+    paddle/fluid/operators/fused/fused_multi_transformer_op.cu — which fuses
+    cache write + masked attention per step).
+
+    TPU-first: caches are STATIC-shape rings [b, max_len, kv_heads, d]; the
+    new K/V of this step is written at [pos, pos+sq) with a dynamic slice and
+    attention masks out positions >= pos+sq, so a single compiled program
+    serves every decode step (no shape-polymorphic recompiles). GQA caches
+    store unrepeated KV heads and broadcast at compute time.
+
+    q: [b, sq, hq, d]; k,v: [b, sq, hkv, d]; pos: scalar int32 (tokens
+    already in the cache). Returns (out [b, sq, hq, d], k_cache, v_cache).
+    """
+    b, sq, hq, d = q.shape
+    max_len = k_cache.shape[1]
+    hkv = k_cache.shape[2]
+    pos = jnp.asarray(pos, jnp.int32).reshape(())
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, k.astype(k_cache.dtype), (0, pos, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0))
+    k_all, v_all = k_cache, v_cache
+    if hkv != hq:
+        rep = hq // hkv
+        k_all = jnp.repeat(k_all, rep, axis=2)
+        v_all = jnp.repeat(v_all, rep, axis=2)
+    # rows: new queries at absolute positions pos..pos+sq-1; each sees keys
+    # at absolute positions <= its own (causal over the valid prefix)
+    mask = (jnp.arange(max_len)[None, :]
+            <= pos + jnp.arange(sq)[:, None])  # [sq, max_len]
+    out = scaled_dot_product_attention(
+        q, k_all.astype(q.dtype), v_all.astype(q.dtype),
+        attn_mask=mask[None, None], is_causal=False, training=False,
+        scale=scale)
+    return out, k_cache, v_cache
+
+
 def softsign(x):
     return x / (1.0 + jnp.abs(x))
 

@@ -119,5 +119,37 @@ def standard_gamma(alpha):
     return jax.random.gamma(next_key(), alpha)
 
 
+def nucleus_keep_mask(sorted_probs, ps):
+    """Top-p keep mask over DESCENDING-sorted probabilities: keeps the
+    smallest prefix whose mass reaches ps (always at least the argmax).
+    Shared by the top_p_sampling op and models/generation sampling."""
+    sorted_probs = sorted_probs.astype(jnp.float32)
+    cum_before = jnp.cumsum(sorted_probs, axis=-1) - sorted_probs  # exclusive
+    return cum_before < jnp.asarray(ps, jnp.float32)
+
+
+def top_p_sampling(x, ps, seed=-1):
+    """Nucleus sampling (reference: phi top_p_sampling op,
+    paddle/phi/kernels/gpu/top_p_sampling_kernel.cu — the serving-side
+    sampling primitive). x: probabilities [b, vocab]; ps: scalar or [b]/[b,1]
+    per-row threshold; seed < 0 (the reference's sentinel) draws from the
+    process generator, seed >= 0 is reproducible. Keeps the smallest prefix
+    of descending-probability tokens whose mass reaches ps (always at least
+    the argmax), renormalizes, samples one token per row. Returns
+    (probs [b,1], ids [b,1]) like the reference's (out, ids) pair.
+    """
+    key = _random.next_key() if seed < 0 else jax.random.PRNGKey(seed)
+    ps = jnp.asarray(ps, jnp.float32).reshape(-1, 1) if jnp.ndim(ps) else ps
+    order = jnp.argsort(-x, axis=-1)
+    sorted_p = jnp.take_along_axis(x, order, axis=-1).astype(jnp.float32)
+    keep = nucleus_keep_mask(sorted_p, ps)
+    logits = jnp.where(keep, jnp.log(jnp.clip(sorted_p, 1e-30, None)),
+                       -jnp.inf)
+    pick = jax.random.categorical(key, logits, axis=-1)[..., None]  # [b,1]
+    ids = jnp.take_along_axis(order, pick, axis=-1)
+    out = jnp.take_along_axis(x, ids, axis=-1)
+    return out, ids.astype(jnp.int64)
+
+
 # phi reference name
 truncated_gaussian_random = truncated_normal

@@ -1,0 +1,70 @@
+"""Op version / compatibility registry.
+
+Reference analog: paddle/phi/api/yaml/op_version.yaml (per-op version bumps
+with change notes, consumed by the OpVersionRegistrar at
+paddle/fluid/framework/op_version_registry.h) and op_compat.yaml — the layer
+that lets old serialized programs detect incompatible op-surface changes
+instead of silently misbehaving.
+
+TPU-native shape: every yaml-declared op starts at version 1; a semantic
+change to a kernel registers a bump here with a note. Saved artifacts
+(jit.save .pdmeta.json sidecar) embed the op-surface snapshot; loaders call
+`check_compat` to fail fast on missing ops and warn on version bumps.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Dict, List, Tuple
+
+# op name -> (version, [notes]); ops absent here are at version 1.
+_BUMPS: Dict[str, Tuple[int, List[str]]] = {}
+
+
+def register_op_version(op: str, version: int, note: str) -> None:
+    """Record that `op`'s semantics changed at `version` (monotonic)."""
+    cur, notes = _BUMPS.get(op, (1, []))
+    if version <= cur and notes:
+        raise ValueError(
+            f"op {op!r} version must increase (have {cur}, got {version})")
+    _BUMPS[op] = (max(version, cur), notes + [note])
+
+
+def op_version(op: str) -> int:
+    return _BUMPS.get(op, (1, []))[0]
+
+
+def version_notes(op: str) -> List[str]:
+    return list(_BUMPS.get(op, (1, []))[1])
+
+
+def surface_snapshot() -> Dict[str, int]:
+    """The full op surface with versions — embedded in saved artifacts."""
+    from .registry import all_ops
+
+    return {name: op_version(name) for name in sorted(all_ops())}
+
+
+def surface_fingerprint(snapshot: Dict[str, int] = None) -> str:
+    snap = surface_snapshot() if snapshot is None else snapshot
+    blob = json.dumps(snap, sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def check_compat(saved_snapshot: Dict[str, int]) -> Tuple[List[str], List[str]]:
+    """Compare a saved artifact's op surface against the live registry.
+
+    Returns (errors, warnings): errors are ops the artifact used that no
+    longer exist; warnings are version bumps since the artifact was saved
+    (the artifact may rely on the old semantics — see version_notes).
+    """
+    live = surface_snapshot()
+    errors, warnings = [], []
+    for op, ver in saved_snapshot.items():
+        if op not in live:
+            errors.append(f"op {op!r} (saved at v{ver}) no longer exists")
+        elif live[op] > ver:
+            notes = "; ".join(version_notes(op))
+            warnings.append(
+                f"op {op!r} changed v{ver} -> v{live[op]}: {notes}")
+    return errors, warnings

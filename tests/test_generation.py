@@ -1,0 +1,198 @@
+"""KV-cache generation tests (VERDICT r3 item 5).
+
+Reference behavior being matched: the cache-KV decode path of
+fused_multi_transformer (paddle/fluid/operators/fused/
+fused_multi_transformer_op.cu) — incremental decoding must produce exactly
+the tokens the full-sequence forward would.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import (
+    GPTConfig,
+    GPTForCausalLM,
+    LlamaConfig,
+    LlamaForCausalLM,
+)
+from paddle_tpu.ops import api
+
+
+def _greedy_reference(model, ids, n_new):
+    """Reference decoding: full forward per step, argmax last position."""
+    full = ids.copy()
+    for _ in range(n_new):
+        logits = model(paddle.to_tensor(full)).numpy()
+        nxt = logits[:, -1, :].argmax(-1).astype(np.int32)
+        full = np.concatenate([full, nxt[:, None]], axis=1)
+    return full
+
+
+class TestGreedyDecodeParity:
+    def test_gpt_learned_positions(self):
+        cfg = GPTConfig.tiny()
+        m = GPTForCausalLM(cfg)
+        m.eval()
+        ids = np.random.randint(0, cfg.vocab_size, (2, 7)).astype(np.int32)
+        out = m.generate(paddle.to_tensor(ids), max_new_tokens=6)
+        assert np.array_equal(out.numpy(), _greedy_reference(m, ids, 6))
+
+    def test_gpt_rotary(self):
+        cfg = GPTConfig.tiny()
+        cfg.use_rotary = True
+        m = GPTForCausalLM(cfg)
+        m.eval()
+        ids = np.random.randint(0, cfg.vocab_size, (1, 5)).astype(np.int32)
+        out = m.generate(paddle.to_tensor(ids), max_new_tokens=5)
+        assert np.array_equal(out.numpy(), _greedy_reference(m, ids, 5))
+
+    def test_llama_gqa(self):
+        cfg = LlamaConfig.tiny()  # num_kv_heads=2 < num_heads=4: GQA cache
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        ids = np.random.randint(0, cfg.vocab_size, (2, 5)).astype(np.int32)
+        out = m.generate(paddle.to_tensor(ids), max_new_tokens=4)
+        assert np.array_equal(out.numpy(), _greedy_reference(m, ids, 4))
+
+    def test_prompt_longer_than_window_raises(self):
+        cfg = GPTConfig.tiny()
+        m = GPTForCausalLM(cfg)
+        m.eval()
+        ids = np.zeros((1, cfg.max_position_embeddings), np.int32)
+        with pytest.raises(ValueError, match="no room"):
+            m.generate(paddle.to_tensor(ids), max_new_tokens=4)
+
+
+class TestSampling:
+    def test_sampled_decode_shapes_and_determinism(self):
+        cfg = GPTConfig.tiny()
+        m = GPTForCausalLM(cfg)
+        m.eval()
+        ids = np.random.randint(0, cfg.vocab_size, (2, 4)).astype(np.int32)
+        a = m.generate(paddle.to_tensor(ids), max_new_tokens=5,
+                       do_sample=True, temperature=0.7, top_k=10, top_p=0.9,
+                       seed=11)
+        b = m.generate(paddle.to_tensor(ids), max_new_tokens=5,
+                       do_sample=True, temperature=0.7, top_k=10, top_p=0.9,
+                       seed=11)
+        assert tuple(a.shape) == (2, 9)
+        assert np.array_equal(a.numpy(), b.numpy())  # same seed -> same draw
+        assert np.array_equal(a.numpy()[:, :4], ids)  # prompt preserved
+        # different seed reaches the CACHED compiled prefill/decode but must
+        # draw differently (seed is a traced arg, not baked at trace time)
+        c = m.generate(paddle.to_tensor(ids), max_new_tokens=5,
+                       do_sample=True, temperature=0.7, top_k=10, top_p=0.9,
+                       seed=12)
+        assert not np.array_equal(a.numpy(), c.numpy())
+
+    def test_eos_early_stop(self):
+        cfg = GPTConfig.tiny()
+        m = GPTForCausalLM(cfg)
+        m.eval()
+        rng = np.random.default_rng(7)
+        ids = rng.integers(0, cfg.vocab_size, (1, 4)).astype(np.int32)
+        ref = _greedy_reference(m, ids, 8)
+        eos = int(ref[0, 5])  # force early stop after 2 new tokens
+        if int(ref[0, 4]) == eos:  # would stop one step earlier — re-pick
+            pytest.skip("first two generated tokens collide for this seed")
+        out = m.generate(paddle.to_tensor(ids), max_new_tokens=8,
+                         eos_token_id=eos)
+        assert out.shape[1] == 6  # prompt 4 + 2 new (second one is EOS)
+        assert np.array_equal(out.numpy(), ref[:, :6])
+
+    def test_top_p_sampling_op(self):
+        probs = np.zeros((2, 16), np.float32)
+        probs[0, 3] = 0.95
+        probs[0, 1:] += 0.05 / 15
+        probs[1, 7] = 1.0
+        out, ids = api.top_p_sampling(paddle.to_tensor(probs / probs.sum(-1, keepdims=True)), 0.5)
+        # p=0.5 keeps only the top token in both rows
+        assert ids.numpy().ravel().tolist() == [3, 7]
+        assert tuple(out.shape) == (2, 1)
+
+
+class TestInferenceWiring:
+    def test_generation_predictor(self):
+        from paddle_tpu.inference import GenerationPredictor
+
+        cfg = GPTConfig.tiny()
+        m = GPTForCausalLM(cfg)
+        pred = GenerationPredictor(m, max_new_tokens=4)
+        ids = np.random.randint(0, cfg.vocab_size, (1, 5)).astype(np.int32)
+        out = pred.run([ids])[0]
+        assert out.shape == (1, 9)
+        assert np.array_equal(out, _greedy_reference(m, ids, 4))
+
+    def test_artifact_compat_sidecar(self, tmp_path):
+        """Missing Missing-#7 parity: op_version.yaml-style guard — an
+        artifact whose op surface no longer exists must fail to load, a
+        version bump must warn (reference op_version_registry.h checks)."""
+        import json
+        import warnings
+
+        import paddle_tpu.jit as jit
+        from paddle_tpu.nn import Linear
+        from paddle_tpu.ops import op_version
+
+        p = str(tmp_path / "m")
+        jit.save(Linear(4, 4), p, input_spec=[jit.InputSpec([1, 4], "float32")])
+        meta_path = p + ".pdmeta.json"
+        assert json.load(open(meta_path))["op_surface"]["matmul"] >= 1
+        jit.load(p)  # clean load validates silently
+
+        meta = json.load(open(meta_path))
+        meta["op_surface"]["op_that_never_existed"] = 1
+        json.dump(meta, open(meta_path, "w"))
+        with pytest.raises(RuntimeError, match="no longer exists"):
+            jit.load(p)
+
+        meta["op_surface"].pop("op_that_never_existed")
+        meta["op_surface"]["matmul"] = 0  # saved before a (synthetic) bump
+        json.dump(meta, open(meta_path, "w"))
+        with warnings.catch_warnings(record=True) as ws:
+            warnings.simplefilter("always")
+            jit.load(p)
+        assert any("matmul" in str(w.message) for w in ws)
+
+    def test_op_version_registry(self):
+        from paddle_tpu.ops import op_version as ov
+
+        assert ov.op_version("matmul") >= 1
+        snap = ov.surface_snapshot()
+        assert len(snap) > 500  # the yaml surface
+        assert ov.surface_fingerprint(snap) == ov.surface_fingerprint(snap)
+        errs, warns = ov.check_compat(snap)
+        assert errs == [] and warns == []
+
+
+class TestCachedAttentionOp:
+    def test_incremental_matches_causal(self):
+        """cached_multihead_attention over steps == one causal attention."""
+        rng = np.random.default_rng(0)
+        b, s, hq, hkv, d = 2, 6, 4, 2, 8
+        q = rng.standard_normal((b, s, hq, d)).astype(np.float32)
+        k = rng.standard_normal((b, s, hkv, d)).astype(np.float32)
+        v = rng.standard_normal((b, s, hkv, d)).astype(np.float32)
+
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops.kernels.nn_ops import (
+            cached_multihead_attention,
+            scaled_dot_product_attention,
+        )
+
+        kr = np.repeat(k, hq // hkv, axis=2)
+        vr = np.repeat(v, hq // hkv, axis=2)
+        ref = scaled_dot_product_attention(
+            jnp.asarray(q), jnp.asarray(kr), jnp.asarray(vr), is_causal=True)
+
+        kc = jnp.zeros((b, s, hkv, d), jnp.float32)
+        vc = jnp.zeros((b, s, hkv, d), jnp.float32)
+        outs = []
+        for t in range(s):
+            o, kc, vc = cached_multihead_attention(
+                jnp.asarray(q[:, t:t + 1]), jnp.asarray(k[:, t:t + 1]),
+                jnp.asarray(v[:, t:t + 1]), kc, vc, t)
+            outs.append(np.asarray(o))
+        got = np.concatenate(outs, axis=1)
+        np.testing.assert_allclose(got, np.asarray(ref), rtol=2e-5, atol=2e-5)

@@ -1,0 +1,27 @@
+"""Import FIRST in any ad-hoc script that must stay off the TPU tunnel.
+
+The driver sitecustomize registers the axon TPU platform at jax import and
+env vars are read too early, so (same trick as tests/conftest.py) reset via
+jax.config and clear initialized backends. Usage:
+
+    import tools.cpu_force  # noqa: F401  (before importing paddle_tpu)
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+if _xb.backends_are_initialized():
+    import jax.extend.backend as _jeb
+
+    _jeb.clear_backends()
+    jax.config.update("jax_platforms", "cpu")
+
+assert jax.default_backend() == "cpu", jax.default_backend()

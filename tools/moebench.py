@@ -44,7 +44,9 @@ def bench_mode(mode, tokens, d_model, num_experts, d_hidden, steps=5):
     t0 = time.perf_counter()
     for _ in range(steps):
         out = one()
-    out._value.block_until_ready()
+    # sync by VALUE FETCH: block_until_ready has been observed returning
+    # early through the tunneled transport (see tools/mfu_probe.py)
+    float(np.asarray(out._value).ravel()[0])
     return (time.perf_counter() - t0) / steps
 
 
@@ -53,6 +55,7 @@ def main():
     ap.add_argument("--tokens", type=int, default=4096)
     ap.add_argument("--d-model", type=int, default=256)
     ap.add_argument("--d-hidden", type=int, default=512)
+    ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
     import jax
@@ -70,11 +73,15 @@ def main():
             crossover = E
         print(f"E={E:3d} dense={dense*1e3:8.2f}ms sparse={sparse*1e3:8.2f}ms "
               f"ratio={ratio:.2f}", file=sys.stderr, flush=True)
-    print(json.dumps({
+    result = json.dumps({
         "backend": jax.default_backend(),
         "tokens": args.tokens, "d_model": args.d_model,
         "rows": rows, "sparse_wins_from_experts": crossover,
-    }))
+    })
+    print(result)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(result + "\n")
 
 
 if __name__ == "__main__":

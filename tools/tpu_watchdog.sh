@@ -1,0 +1,39 @@
+#!/bin/bash
+# Patient TPU-tunnel watchdog: probe with long cool-downs (a wedged holder
+# can block the tunnel for hours; stacked retries make it worse), and the
+# moment the chip answers, run the round's full evidence harvest
+# sequentially in THIS process slot (one chip process at a time):
+#   1. mfu_probe ablations  -> MFU_PROBE.jsonl (persisted per measurement)
+#   2. opbench              -> OPBENCH_r04.json
+#   3. moebench             -> MOEBENCH_r04.json
+cd /root/repo || exit 1
+LOG=tools/tpu_watchdog.log
+echo "=== watchdog start $(date -u +%FT%TZ)" >> "$LOG"
+for i in $(seq 1 40); do
+  # skip the attempt if some other process is already on the chip
+  if pgrep -f "mfu_probe|opbench|moebench|tpu_smoke" | grep -qv $$; then
+    echo "[$(date -u +%T)] chip busy (another tool), waiting" >> "$LOG"
+    sleep 600; continue
+  fi
+  timeout 240 python -c "
+import jax, jax.numpy as jnp
+assert jax.default_backend() not in ('cpu',), jax.default_backend()
+x = jax.jit(jnp.dot)(jnp.ones((128,128), jnp.bfloat16), jnp.ones((128,128), jnp.bfloat16))
+print('probe ok', float(x[0,0]))" >> "$LOG" 2>&1
+  rc=$?
+  echo "[$(date -u +%T)] probe attempt $i rc=$rc" >> "$LOG"
+  if [ $rc -eq 0 ]; then
+    echo "[$(date -u +%T)] chip alive -> harvesting" >> "$LOG"
+    timeout 7200 python tools/mfu_probe.py baseline o2 o2b16 o2b32 o2b32r flashoff >> "$LOG" 2>&1
+    echo "[$(date -u +%T)] mfu_probe rc=$?" >> "$LOG"
+    timeout 3600 python tools/opbench.py --out OPBENCH_r04.json >> "$LOG" 2>&1
+    echo "[$(date -u +%T)] opbench rc=$?" >> "$LOG"
+    timeout 2400 python tools/moebench.py --out MOEBENCH_r04.json >> "$LOG" 2>&1
+    echo "[$(date -u +%T)] moebench rc=$?" >> "$LOG"
+    echo "=== harvest done $(date -u +%FT%TZ)" >> "$LOG"
+    exit 0
+  fi
+  sleep 900  # 15 min cool-down between probes
+done
+echo "=== watchdog gave up $(date -u +%FT%TZ)" >> "$LOG"
+exit 1

@@ -181,8 +181,13 @@ class GenerationMixin:
                 param_vals, buffer_vals, tok, caches,
                 jnp.asarray(s0 + t - 1, jnp.int32),
                 jnp.asarray(seed + t, jnp.int32))
-            out.append(tok)
             if eos_rows is not None:
-                eos_rows |= np.asarray(jax.device_get(tok)) == eos_token_id
+                # rows already finished are padded with EOS, not with the
+                # model's (meaningless) continuation samples
+                tok_np = np.where(eos_rows, np.int32(eos_token_id),
+                                  np.asarray(jax.device_get(tok)))
+                eos_rows |= tok_np == eos_token_id
+                tok = jnp.asarray(tok_np)
+            out.append(tok)
         return Tensor(jnp.concatenate(
             [ids] + [o[:, None] for o in out], axis=1))

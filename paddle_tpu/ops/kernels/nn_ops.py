@@ -456,18 +456,33 @@ def adaptive_max_pool2d(x, output_size, data_format="NCHW"):
 
 
 def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False, data_format="NCHW"):
-    if data_format == "NCHW":
-        n, c, h, w = x.shape
-        if size is None:
-            sf = _pair(scale_factor)
-            size = (int(h * sf[0]), int(w * sf[1]))
-        method = {"nearest": "nearest", "bilinear": "bilinear", "bicubic": "bicubic", "area": "linear"}[mode]
-        return jax.image.resize(x, (n, c, size[0], size[1]), method=method)
-    n, h, w, c = x.shape
+    """Reference F.interpolate: 3-D (linear, NCW), 4-D (bilinear/bicubic,
+    NCHW) and 5-D (trilinear, NCDHW) resampling, channel-first or -last."""
+    if align_corners and mode != "nearest":
+        # jax.image.resize samples on the half-pixel grid only; silently
+        # returning the wrong grid would fail reference parity invisibly
+        raise NotImplementedError(
+            "align_corners=True is not supported (XLA resize uses "
+            "half-pixel sampling); use align_corners=False")
+    nsp = x.ndim - 2
+    channel_last = data_format in ("NWC", "NHWC", "NDHWC")
+    if channel_last:
+        n, c, sp = x.shape[0], x.shape[-1], x.shape[1:-1]
+    else:
+        n, c, sp = x.shape[0], x.shape[1], x.shape[2:]
     if size is None:
-        sf = _pair(scale_factor)
-        size = (int(h * sf[0]), int(w * sf[1]))
-    return jax.image.resize(x, (n, size[0], size[1], c), method=mode)
+        sf = (tuple(scale_factor) if isinstance(scale_factor, (list, tuple))
+              else (scale_factor,) * nsp)
+        size = tuple(int(s * f) for s, f in zip(sp, sf))
+    elif isinstance(size, (list, tuple)):
+        size = tuple(int(s) for s in size)
+    else:
+        size = (int(size),) * nsp
+    method = {"nearest": "nearest", "linear": "linear", "bilinear": "bilinear",
+              "trilinear": "trilinear", "bicubic": "bicubic",
+              "cubic": "bicubic", "area": "linear"}[mode]
+    target = (n, *size, c) if channel_last else (n, c, *size)
+    return jax.image.resize(x, target, method=method)
 
 
 def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):

@@ -148,7 +148,11 @@ def top_p_sampling(x, ps, seed=-1):
     pick = jax.random.categorical(key, logits, axis=-1)[..., None]  # [b,1]
     ids = jnp.take_along_axis(order, pick, axis=-1)
     out = jnp.take_along_axis(x, ids, axis=-1)
-    return out, ids.astype(jnp.int64)
+    # int64 only when x64 is enabled — an unconditional astype(int64) under
+    # default jax truncates to int32 and warns on every decode step
+    if jax.config.jax_enable_x64:
+        ids = ids.astype(jnp.int64)
+    return out, ids
 
 
 # phi reference name

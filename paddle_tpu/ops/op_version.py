@@ -22,12 +22,17 @@ _BUMPS: Dict[str, Tuple[int, List[str]]] = {}
 
 
 def register_op_version(op: str, version: int, note: str) -> None:
-    """Record that `op`'s semantics changed at `version` (monotonic)."""
-    cur, notes = _BUMPS.get(op, (1, []))
-    if version <= cur and notes:
+    """Record that `op`'s semantics changed at `version` (strictly
+    monotonic; every op implicitly starts at version 1, so the first bump
+    is version 2 — registering <= the current version raises, because a
+    bump that doesn't raise the version would never surface in
+    check_compat, which is the silent drift this registry exists to
+    catch)."""
+    cur, _notes = _BUMPS.get(op, (1, []))
+    if version <= cur:
         raise ValueError(
             f"op {op!r} version must increase (have {cur}, got {version})")
-    _BUMPS[op] = (max(version, cur), notes + [note])
+    _BUMPS[op] = (version, _notes + [note])
 
 
 def op_version(op: str) -> int:

@@ -10,8 +10,9 @@ cd /root/repo || exit 1
 LOG=tools/tpu_watchdog.log
 echo "=== watchdog start $(date -u +%FT%TZ)" >> "$LOG"
 for i in $(seq 1 40); do
-  # skip the attempt if some other process is already on the chip
-  if pgrep -f "mfu_probe|opbench|moebench|tpu_smoke" | grep -qv $$; then
+  # skip the attempt if some other process is already on the chip (the
+  # watchdog's own cmdline never matches this pattern)
+  if pgrep -f "mfu_probe|opbench|moebench|tpu_smoke" > /dev/null; then
     echo "[$(date -u +%T)] chip busy (another tool), waiting" >> "$LOG"
     sleep 600; continue
   fi

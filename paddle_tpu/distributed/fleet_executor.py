@@ -21,14 +21,18 @@ from typing import Any, Callable, Dict, List, Optional
 
 class TaskNode:
     """One node of the DAG (reference task_node.h). `fn(round, upstream
-    results dict) -> result`; `max_run_times` = how many rounds it runs."""
+    results dict) -> result`; `max_run_times` = how many rounds it runs;
+    `rank` places the task on a host for DistFleetExecutor (reference:
+    TaskNode::rank_ routing Carrier placement)."""
 
     def __init__(self, name: str, fn: Callable[[int, Dict[str, Any]], Any],
-                 role: str = "compute", max_run_times: Optional[int] = None):
+                 role: str = "compute", max_run_times: Optional[int] = None,
+                 rank: int = 0):
         self.name = name
         self.fn = fn
         self.role = role
         self.max_run_times = max_run_times
+        self.rank = rank
         self.upstream: List[str] = []
         self.downstream: List[str] = []
 
@@ -129,5 +133,185 @@ class FleetExecutor:
                 if errors:
                     raise errors[0]
                 for n in self.nodes:
+                    results[n].append(done.get(n))
+        return results
+
+
+# -------------------------------------------------------- multi-host runtime
+class _MessageBus:
+    """Per-process inbox for cross-rank task results (reference:
+    fleet_executor's brpc MessageBus carrying results between Carriers —
+    paddle/fluid/distributed/fleet_executor/message_bus.cc). Here the
+    transport is the framework's own RPC layer; `deliver` is the RPC-invoked
+    entry on the consumer side."""
+
+    _lock = threading.Lock()
+    _cv = threading.Condition(_lock)
+    _store: Dict[Any, Any] = {}
+
+    @classmethod
+    def deliver(cls, key, value: Any) -> None:
+        with cls._cv:
+            cls._store[key] = value
+            cls._cv.notify_all()
+
+    @classmethod
+    def wait(cls, key, timeout: float = 120.0):
+        with cls._cv:
+            import time as _time
+
+            end = _time.monotonic() + timeout
+            while key not in cls._store:
+                left = end - _time.monotonic()
+                if left <= 0:
+                    raise TimeoutError(
+                        f"fleet executor: no result for {key!r} after "
+                        f"{timeout}s")
+                cls._cv.wait(left)
+            # no pop: several local consumers may read the same remote
+            # result; entries are cleared by reset() at end of run
+            return cls._store[key]
+
+    @classmethod
+    def reset(cls, run_id=None) -> None:
+        """Clear entries — only this run's when run_id is given (a faster
+        rank may already have delivered results for the NEXT run)."""
+        with cls._cv:
+            if run_id is None:
+                cls._store.clear()
+            else:
+                for k in [k for k in cls._store if k[0] == run_id]:
+                    del cls._store[k]
+
+
+class DistFleetExecutor(FleetExecutor):
+    """Task DAG spanning hosts: each rank executes ITS tasks (node.rank) with
+    the completion-driven scheduler; results crossing a rank boundary ride
+    the RPC layer to the consumer's message bus. Call `run` on EVERY rank
+    (after distributed.rpc.init_rpc) — the per-rank return holds this rank's
+    task results.
+
+    Reference: Carrier (carrier.cc) running its rank's interceptors +
+    MessageBus for inter-rank edges; the TPU-native executor keeps compiled
+    per-step programs intact and orchestrates only host-level work.
+    """
+
+    # per-process run counter: every rank constructs/runs executors in the
+    # same (SPMD) program order, so the counter agrees across ranks and
+    # isolates bus entries of successive runs from each other
+    _run_counter = [0]
+
+    def __init__(self, task_nodes: List[TaskNode], rank: int,
+                 max_workers: int = 8, result_timeout: float = 120.0):
+        super().__init__(task_nodes, max_workers=max_workers)
+        self.rank = rank
+        self.result_timeout = result_timeout
+
+    def _worker_name(self, rank: int) -> str:
+        from . import rpc
+
+        for info in rpc.get_all_worker_infos():
+            if info.rank == rank:
+                return info.name
+        raise RuntimeError(f"no rpc worker with rank {rank}")
+
+    def run(self, num_micro_batches: int = 1) -> Dict[str, List[Any]]:
+        from . import rpc
+
+        DistFleetExecutor._run_counter[0] += 1
+        run_id = DistFleetExecutor._run_counter[0]
+        try:
+            return self._run(num_micro_batches, run_id, rpc)
+        finally:
+            _MessageBus.reset(run_id)
+
+    def _run(self, num_micro_batches, run_id, rpc):
+        local = {n: t for n, t in self.nodes.items() if t.rank == self.rank}
+        results: Dict[str, List[Any]] = {n: [] for n in local}
+        with ThreadPoolExecutor(max_workers=self.max_workers) as pool:
+            for rnd in range(num_micro_batches):
+                done: Dict[str, Any] = {}
+                errors: List[BaseException] = []
+                lock = threading.Lock()
+                all_done = threading.Event()
+                remaining = [len(local)]
+                pending = {n: len(t.upstream) for n, t in local.items()}
+                down_local: Dict[str, List[str]] = {n: [] for n in local}
+                for n, t in local.items():
+                    for up in t.upstream:
+                        if up in local:
+                            down_local[up].append(n)
+
+                def run_task(name, rnd=rnd, done=done, errors=errors,
+                             pending=pending, lock=lock, all_done=all_done,
+                             remaining=remaining, down_local=down_local):
+                    node = self.nodes[name]
+                    result = None
+                    try:
+                        if not errors:
+                            ups = {}
+                            for up in node.upstream:
+                                if up in done:
+                                    ups[up] = done[up]
+                                else:  # remote upstream: await the bus
+                                    ups[up] = _MessageBus.wait(
+                                        (run_id, rnd, up),
+                                        self.result_timeout)
+                            if (node.max_run_times is None
+                                    or rnd < node.max_run_times):
+                                result = node.fn(rnd, ups)
+                    except BaseException as e:  # noqa: BLE001
+                        errors.append(e)
+                    # push to remote consumers (once per consuming rank)
+                    remote_ranks = {self.nodes[d].rank
+                                    for d in node.downstream
+                                    if d in self.nodes
+                                    and self.nodes[d].rank != self.rank}
+                    for rr in remote_ranks:
+                        try:
+                            rpc.rpc_sync(self._worker_name(rr),
+                                         _MessageBus.deliver,
+                                         args=((run_id, rnd, name), result))
+                        except Exception as e:  # noqa: BLE001
+                            errors.append(e)
+                    ready = []
+                    with lock:
+                        done[name] = result
+                        remaining[0] -= 1
+                        if remaining[0] == 0:
+                            all_done.set()
+                        for d in down_local[name]:
+                            pending[d] -= 1
+                            if pending[d] == 0:
+                                ready.append(d)
+                    for d in ready:
+                        submit(d)
+
+                def submit(name):
+                    # tasks with remote upstreams block in _MessageBus.wait;
+                    # give them their own thread so they never hold a pool
+                    # slot hostage (cross-rank slot-starvation deadlock)
+                    if any(u not in local for u in self.nodes[name].upstream):
+                        threading.Thread(target=run_task, args=(name,),
+                                         daemon=True).start()
+                    else:
+                        pool.submit(run_task, name)
+
+                if not local:
+                    all_done.set()
+                # pending counts LOCAL upstreams only; remote ones are
+                # awaited inside the task thread via the message bus
+                roots = []
+                for n, t in local.items():
+                    remote_ups = sum(1 for u in t.upstream if u not in local)
+                    pending[n] -= remote_ups
+                    if pending[n] == 0:
+                        roots.append(n)
+                for n in roots:
+                    submit(n)
+                all_done.wait()
+                if errors:
+                    raise errors[0]
+                for n in local:
                     results[n].append(done.get(n))
         return results

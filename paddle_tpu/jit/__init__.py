@@ -101,24 +101,56 @@ class StaticFunction:
 
     def _build(self):
         self._params = _collect_params(self._fn, self._layers)
-        runner = _Functionalized(self._fn, self._params)
+        # dy2static: rewrite tensor-dependent Python if/while/for onto
+        # cond/while ops (jit/dy2static.py); falls back to plain tracing
+        # when the source can't be transformed
+        from .dy2static import convert_control_flow
 
-        def pure(param_vals, seed, args, kwargs):
+        fn = convert_control_flow(self._fn)
+        runner = _Functionalized(fn, self._params)
+
+        def pure(param_vals, seed, dyn_vals, static_key):
+            treedef, dyn_idx, static_leaves = static_key
+            leaves = list(static_leaves)
+            for i, v in zip(dyn_idx, dyn_vals):
+                leaves[i] = v
+            args, kwargs = jax.tree_util.tree_unflatten(treedef, leaves)
             return runner(param_vals, seed, args, kwargs)
 
-        self._jitted = jax.jit(pure, static_argnames=())
+        self._jitted = jax.jit(pure, static_argnums=(3,))
+
+    def _split_args(self, args, kwargs):
+        """Tensors/arrays trace; plain-Python leaves (bool/int/str/...) are
+        STATIC — baked per value with one compiled program each, the
+        reference's Program-cache-keyed-on-python-args semantics (so
+        `if flag:` on a python bool keeps exact Python behavior)."""
+        leaves, treedef = jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        dyn_idx, dyn_vals, static_leaves = [], [], []
+        for i, leaf in enumerate(leaves):
+            v = leaf._value if isinstance(leaf, Tensor) else leaf
+            is_dyn = isinstance(v, (jax.Array, np.ndarray))
+            if not is_dyn:
+                try:
+                    hash(v)
+                except TypeError:
+                    is_dyn = True  # unhashable: fall back to tracing it
+            if is_dyn:
+                dyn_idx.append(i)
+                dyn_vals.append(v)
+                static_leaves.append(None)
+            else:
+                static_leaves.append(v)
+        return (dyn_vals,
+                (treedef, tuple(dyn_idx), tuple(static_leaves)))
 
     def __call__(self, *args, **kwargs):
         if self._jitted is None:
             self._build()
-        arg_vals = jax.tree_util.tree_map(
-            lambda x: x._value if isinstance(x, Tensor) else x,
-            (args, kwargs),
-            is_leaf=lambda x: isinstance(x, Tensor),
-        )
+        dyn_vals, static_key = self._split_args(args, kwargs)
         param_vals = [p._value for p in self._params]
         seed = jnp.asarray(np.random.randint(0, 2 ** 31 - 1), jnp.int32)
-        out = self._jitted(param_vals, seed, arg_vals[0], arg_vals[1])
+        out = self._jitted(param_vals, seed, dyn_vals, static_key)
         return jax.tree_util.tree_map(
             lambda x: Tensor(x) if isinstance(x, jax.Array) else x, out
         )
@@ -133,14 +165,10 @@ class StaticFunction:
         """Return the jax lowering (StableHLO access for save/inspection)."""
         if self._jitted is None:
             self._build()
-        arg_vals = jax.tree_util.tree_map(
-            lambda x: x._value if isinstance(x, Tensor) else x,
-            (args, kwargs),
-            is_leaf=lambda x: isinstance(x, Tensor),
-        )
+        dyn_vals, static_key = self._split_args(args, kwargs)
         param_vals = [p._value for p in self._params]
         seed = jnp.asarray(0, jnp.int32)
-        return self._jitted.lower(param_vals, seed, arg_vals[0], arg_vals[1])
+        return self._jitted.lower(param_vals, seed, dyn_vals, static_key)
 
 
 def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):

@@ -1,0 +1,452 @@
+"""dy2static control-flow translation: Python if/while/for over tensors ->
+structured XLA control flow.
+
+Reference: python/paddle/jit/dy2static/ — ProgramTranslator rewrites user
+source with ~20 AST transformers (ifelse_transformer.py,
+loop_transformer.py, convert_operators.py convert_ifelse/convert_while_loop)
+so tensor-dependent Python control flow becomes cond/while ops.
+
+TPU-native shape of the same idea, one transformer instead of twenty:
+
+  * every `if` / `while` / `for-over-range` is rewritten to a call into the
+    runtime converters below, which dispatch ON THE ACTUAL CONDITION VALUE
+    at trace time — plain Python values keep exact Python semantics
+    (including side effects and early exits), Tensor/tracer conditions
+    lower to structured control flow;
+  * `if` with a tensor predicate evaluates BOTH branches and merges each
+    output with `where(pred, t, f)` — differentiable through the
+    framework's autograd (branches are pure in a traced program, so this
+    is semantics-preserving; XLA dedups/fuses the select);
+  * `while` with a tensor condition lowers to the while_loop op
+    (lax.while_loop) — forward-only, matching the reference's while_op;
+  * statements containing break/continue/return inside the rewritten
+    region are left untouched (trace-time Python semantics), the same
+    fallback contract as the reference's unsupported-syntax paths.
+
+Variables assigned in only one branch (or only inside a loop) use an
+UNDEFINED sentinel; using such a variable afterwards raises the same
+"undefined after control flow" class of error the reference's
+create_undefined_variable produces.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import types
+from typing import Any, Callable, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class _Undefined:
+    """Sentinel for names not defined on some control-flow path (reference
+    dy2static UndefinedVar). Any meaningful use raises."""
+
+    def __init__(self, name: str):
+        self._name = name
+
+    def _raise(self, *a, **k):
+        raise NameError(
+            f"variable {self._name!r} is not defined on every control-flow "
+            "path converted by to_static; initialize it before the "
+            "if/while block")
+
+    __call__ = __bool__ = __iter__ = __len__ = _raise
+    __add__ = __radd__ = __mul__ = __getattr__ = __getitem__ = _raise
+
+    def __repr__(self):
+        return f"<undefined {self._name!r}>"
+
+
+def _is_dynamic(x) -> bool:
+    if isinstance(x, Tensor):
+        x = x._value
+    return isinstance(x, jax.core.Tracer) or isinstance(x, jax.Array)
+
+
+def _to_val(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def convert_ifelse(pred, true_fn, false_fn, names: Tuple[str, ...]):
+    """Runtime dispatch for a rewritten `if`. Returns the tuple of merged
+    outputs for `names`."""
+    if not _is_dynamic(pred):
+        return true_fn() if pred else false_fn()
+    t_out = true_fn()
+    f_out = false_fn()
+    from ..ops import api
+
+    merged = []
+    for name, t, f in zip(names, t_out, f_out):
+        if isinstance(t, _Undefined) and isinstance(f, _Undefined):
+            merged.append(t)  # untouched on both paths: stays undefined
+        elif isinstance(t, _Undefined) or isinstance(f, _Undefined):
+            # a tensor predicate needs BOTH paths to produce a value
+            raise NameError(
+                f"variable {name!r} is assigned on only one branch of a "
+                "tensor-dependent if; initialize it before the branch "
+                "(to_static if-conversion)")
+        elif isinstance(t, (Tensor, jax.Array)) or isinstance(f, (Tensor, jax.Array)):
+            merged.append(api.where(pred, t, f))
+        elif t is f or t == f:
+            merged.append(t)
+        else:
+            raise TypeError(
+                f"to_static if-conversion: variable {name!r} takes "
+                f"non-tensor, unequal values in the two branches "
+                f"({t!r} vs {f!r}); tensor conditions require tensor "
+                "(or identical) outputs")
+    return tuple(merged)
+
+
+def convert_while(cond_fn, body_fn, init: Tuple[Any, ...],
+                  names: Tuple[str, ...]):
+    """Runtime dispatch for a rewritten `while`."""
+    first = cond_fn(*init)
+    if not _is_dynamic(first):
+        vs = tuple(init)
+        while cond_fn(*vs):
+            vs = tuple(body_fn(*vs))
+        return vs
+    # tensor path: loop-carried vars are those defined at entry; names
+    # first assigned inside the loop are per-iteration temporaries
+    carried = [i for i, v in enumerate(init)
+               if not isinstance(v, _Undefined)]
+    temps = [i for i in range(len(init)) if i not in set(carried)]
+    from ..ops.kernels.control_flow import while_loop as wl
+
+    def expand(vals):
+        full: List[Any] = [None] * len(init)
+        for j, i in enumerate(carried):
+            full[i] = Tensor(vals[j])
+        for i in temps:
+            full[i] = init[i]  # the sentinel; assigned in body before use
+        return full
+
+    def c(*vals):
+        r = cond_fn(*expand(list(vals)))
+        return _to_val(r)
+
+    def b(*vals):
+        out = body_fn(*expand(list(vals)))
+        return [_to_val(out[i]) for i in carried]
+
+    init_vals = [_to_val(init[i]) for i in carried]
+    init_vals = [v if isinstance(v, jax.Array) or isinstance(v, jax.core.Tracer)
+                 else jnp.asarray(v) for v in init_vals]
+    final = wl(c, b, init_vals)
+    out: List[Any] = [None] * len(init)
+    for j, i in enumerate(carried):
+        out[i] = Tensor(final[j])
+    for i in temps:
+        out[i] = _Undefined(names[i])
+    return tuple(out)
+
+
+# --------------------------------------------------------------- AST pass
+def _assigned_names(stmts) -> set:
+    names = set()
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, node):
+            if isinstance(node.ctx, ast.Store):
+                names.add(node.id)
+
+        def visit_FunctionDef(self, node):
+            names.add(node.name)  # don't descend: inner scopes are theirs
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, node):
+            pass
+
+    for s in stmts:
+        V().visit(s)
+    return names
+
+
+def _has_jump(stmts) -> bool:
+    """True when the region can't be lifted into nested branch/body
+    functions: control-flow escapes (break/continue/return) or `del`
+    (deleting a would-be output local breaks the generated return)."""
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.found = False
+            self.loop_depth = 0
+
+        def visit_Break(self, n):
+            if self.loop_depth == 0:
+                self.found = True
+
+        def visit_Continue(self, n):
+            if self.loop_depth == 0:
+                self.found = True
+
+        def visit_Delete(self, n):
+            self.found = True
+
+        def visit_Return(self, n):
+            self.found = True  # returns escape regardless of nesting
+
+        def visit_FunctionDef(self, n):
+            pass  # jumps inside nested defs don't count
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, n):
+            pass
+
+        def _loop(self, n):
+            # break/continue bound to the INNER loop are fine, but a
+            # return inside it still escapes the region
+            self.loop_depth += 1
+            self.generic_visit(n)
+            self.loop_depth -= 1
+
+        visit_While = visit_For = _loop
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _capture_stmt(tmp: str, name: str) -> ast.Try:
+    """try: tmp = name\nexcept NameError: tmp = __d2s_undef(name)"""
+    return ast.Try(
+        body=[ast.Assign(targets=[_name(tmp, ast.Store())],
+                         value=_name(name))],
+        handlers=[ast.ExceptHandler(
+            type=ast.Tuple(elts=[_name("NameError"),
+                                 _name("UnboundLocalError")], ctx=ast.Load()),
+            name=None,
+            body=[ast.Assign(
+                targets=[_name(tmp, ast.Store())],
+                value=ast.Call(func=_name("__d2s_undef"),
+                               args=[ast.Constant(name)], keywords=[]))])],
+        orelse=[], finalbody=[])
+
+
+class ControlFlowTransformer(ast.NodeTransformer):
+    """Rewrites If/While/For-range into convert_ifelse/convert_while calls."""
+
+    def __init__(self):
+        self._n = 0
+
+    def _fresh(self, base):
+        self._n += 1
+        return f"__d2s_{base}{self._n}"
+
+    # -- if ---------------------------------------------------------------
+    def visit_If(self, node: ast.If):
+        self.generic_visit(node)
+        if _has_jump(node.body) or _has_jump(node.orelse):
+            return node
+        outs = sorted(n for n in (_assigned_names(node.body)
+                                  | _assigned_names(node.orelse))
+                      if not n.startswith("__d2s_"))
+        if not outs:
+            return node
+        ret = ast.Return(value=ast.Tuple(
+            elts=[_name(o) for o in outs], ctx=ast.Load()))
+        pre: List[ast.stmt] = []
+        args = []
+        caps = []
+        for o in outs:
+            tmp = self._fresh("cap_")
+            caps.append(tmp)
+            pre.append(_capture_stmt(tmp, o))
+            args.append(ast.arg(arg=o))
+        defaults = [_name(c) for c in caps]
+        tname, fname = self._fresh("true"), self._fresh("false")
+
+        def mk(fn_name, body):
+            return ast.FunctionDef(
+                name=fn_name,
+                args=ast.arguments(posonlyargs=[], args=list(args),
+                                   vararg=None, kwonlyargs=[],
+                                   kw_defaults=[], kwarg=None,
+                                   defaults=list(defaults)),
+                body=(body or [ast.Pass()]) + [ret],
+                decorator_list=[], returns=None)
+
+        call = ast.Assign(
+            targets=[ast.Tuple(elts=[_name(o, ast.Store()) for o in outs],
+                               ctx=ast.Store())],
+            value=ast.Call(
+                func=_name("__d2s_ifelse"),
+                args=[node.test, _name(tname), _name(fname),
+                      ast.Tuple(elts=[ast.Constant(o) for o in outs],
+                                ctx=ast.Load())],
+                keywords=[]))
+        # single-name tuple unpack needs a trailing comma semantic — ast
+        # Tuple handles it; keep as-is
+        return pre + [mk(tname, node.body), mk(fname, node.orelse), call]
+
+    # -- while ------------------------------------------------------------
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)
+        if node.orelse or _has_jump(node.body):
+            return node
+        outs = sorted(n for n in _assigned_names(node.body)
+                      if not n.startswith("__d2s_"))
+        if not outs:
+            return node
+        pre: List[ast.stmt] = []
+        caps = []
+        for o in outs:
+            tmp = self._fresh("cap_")
+            caps.append(tmp)
+            pre.append(_capture_stmt(tmp, o))
+        init = ast.Tuple(elts=[_name(c) for c in caps], ctx=ast.Load())
+        args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=o) for o in outs],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[])
+        cname, bname = self._fresh("cond"), self._fresh("body")
+        cond_def = ast.FunctionDef(
+            name=cname, args=args,
+            body=[ast.Return(value=node.test)], decorator_list=[],
+            returns=None)
+        body_def = ast.FunctionDef(
+            name=bname, args=args,
+            body=list(node.body) + [ast.Return(value=ast.Tuple(
+                elts=[_name(o) for o in outs], ctx=ast.Load()))],
+            decorator_list=[], returns=None)
+        call = ast.Assign(
+            targets=[ast.Tuple(elts=[_name(o, ast.Store()) for o in outs],
+                               ctx=ast.Store())],
+            value=ast.Call(
+                func=_name("__d2s_while"),
+                args=[_name(cname), _name(bname), init,
+                      ast.Tuple(elts=[ast.Constant(o) for o in outs],
+                                ctx=ast.Load())],
+                keywords=[]))
+        return pre + [cond_def, body_def, call]
+
+    # -- for i in range(...) ----------------------------------------------
+    def visit_For(self, node: ast.For):
+        self.generic_visit(node)
+        if (node.orelse or _has_jump(node.body)
+                or not isinstance(node.target, ast.Name)
+                or not isinstance(node.iter, ast.Call)
+                or not isinstance(node.iter.func, ast.Name)
+                or node.iter.func.id != "range"
+                or not 1 <= len(node.iter.args) <= 3
+                or node.iter.keywords):
+            return node
+        a = node.iter.args
+        start = a[0] if len(a) >= 2 else ast.Constant(0)
+        stop = a[1] if len(a) >= 2 else a[0]
+        step = a[2] if len(a) == 3 else None
+        # the desugared test is `ctr < stop`, valid only for a KNOWN
+        # positive step: a negative or runtime-variable step must keep
+        # Python range semantics untransformed
+        if step is not None and not (
+                isinstance(step, ast.Constant)
+                and isinstance(step.value, int) and step.value > 0):
+            return node
+        step = step or ast.Constant(1)
+        i = node.target.id
+        # counter is separate from the loop variable: `i` is bound FROM the
+        # counter at each iteration head, so after the loop it holds the
+        # last yielded value (not the overshot bound) and an empty range
+        # leaves it untouched — exact Python for-semantics
+        ctr = f"_d2s_ctr{self._n}"
+        self._n += 1
+        stop_name, step_name = self._fresh("stop"), self._fresh("step")
+        pre = [
+            ast.Assign(targets=[_name(ctr, ast.Store())], value=start),
+            ast.Assign(targets=[_name(stop_name, ast.Store())], value=stop),
+            ast.Assign(targets=[_name(step_name, ast.Store())], value=step),
+        ]
+        test = ast.Compare(left=_name(ctr), ops=[ast.Lt()],
+                           comparators=[_name(stop_name)])
+        body = ([ast.Assign(targets=[_name(i, ast.Store())],
+                            value=_name(ctr))]
+                + list(node.body)
+                + [ast.Assign(targets=[_name(ctr, ast.Store())],
+                              value=ast.BinOp(left=_name(ctr), op=ast.Add(),
+                                              right=_name(step_name)))])
+        wh = ast.While(test=test, body=body, orelse=[])
+        out = self.visit_While(wh)
+        return pre + (out if isinstance(out, list) else [out])
+
+
+@functools.lru_cache(maxsize=256)
+def _transform_function(func):
+    """Source->AST->rewritten function object. Raises on any failure; the
+    caller (to_static) falls back to plain tracing."""
+    src = textwrap.dedent(inspect.getsource(func))
+    tree = ast.parse(src)
+    fdef = tree.body[0]
+    if not isinstance(fdef, ast.FunctionDef):
+        raise TypeError("not a def (lambda/exec source): plain tracing")
+    # drop decorators (e.g. @to_static itself) — we re-wrap manually
+    fdef.decorator_list = []
+    new = ControlFlowTransformer().visit(tree)
+    ast.fix_missing_locations(new)
+
+    freevars = func.__code__.co_freevars
+    if freevars:
+        # re-establish the closure: wrap in a maker taking the freevars
+        maker = ast.FunctionDef(
+            name="__d2s_maker",
+            args=ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg=v) for v in freevars],
+                vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+                defaults=[]),
+            body=new.body + [ast.Return(value=_name(fdef.name))],
+            decorator_list=[], returns=None)
+        mod = ast.Module(body=[maker], type_ignores=[])
+        ast.fix_missing_locations(mod)
+        code = compile(mod, filename=f"<dy2static {func.__qualname__}>",
+                       mode="exec")
+        ns = dict(_runtime_globals(func))
+        exec(code, ns)
+        cells = [c.cell_contents for c in func.__closure__]
+        return ns["__d2s_maker"](*cells)
+    code = compile(new, filename=f"<dy2static {func.__qualname__}>",
+                   mode="exec")
+    ns = dict(_runtime_globals(func))
+    exec(code, ns)
+    return ns[fdef.name]
+
+
+def _runtime_globals(func):
+    g = dict(func.__globals__)
+    g["__d2s_ifelse"] = convert_ifelse
+    g["__d2s_while"] = convert_while
+    g["__d2s_undef"] = _Undefined
+    return g
+
+
+def convert_control_flow(fn: Callable) -> Callable:
+    """Public entry: return `fn` with tensor-dependent Python control flow
+    rewritten onto cond/while ops. Bound methods are rebound; on any
+    transform failure (no source, exotic syntax) the original function is
+    returned unchanged — plain tracing remains the fallback, as in the
+    reference's ProgramTranslator error paths."""
+    if getattr(fn, "_not_to_static", False):
+        return fn
+    target = fn.__func__ if inspect.ismethod(fn) else fn
+    if not isinstance(target, types.FunctionType):
+        return fn
+    try:
+        new = _transform_function(target)
+    except (OSError, TypeError, SyntaxError, ValueError, AttributeError,
+            IndexError):
+        return fn
+    if inspect.ismethod(fn):
+        return new.__get__(fn.__self__, type(fn.__self__))
+    return new

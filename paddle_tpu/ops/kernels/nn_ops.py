@@ -951,15 +951,44 @@ def flash_attn(q, k, v, dropout=0.0, causal=False, return_softmax=False,
 
 def flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
                         max_seqlen_k, scale=None, dropout=0.0, causal=False):
-    """Varlen attention over packed sequences (phi flash_attn_unpadded):
-    tokens from different sequences must not attend to each other. The
-    packed [total, h, d] inputs get a block-diagonal mask built from the
-    cumulative sequence offsets."""
+    """Varlen attention over packed sequences (phi flash_attn_unpadded,
+    paddle/phi/kernels/gpu/flash_attn_kernel.cu varlen entries): tokens from
+    different sequences must not attend to each other.
+
+    Streaming path: when self-attention packing applies (identical q/k
+    offsets) and shapes tile, the segment-id Pallas kernel
+    (ops/pallas/flash_attention.flash_attention_segmented) runs the
+    block-diagonal mask with O(block) memory; otherwise a dense mask over
+    the packed [total, total] scores is the fallback."""
     total = q.shape[0]
     pos = jnp.arange(total)
     seg_q = jnp.searchsorted(cu_seqlens_q[1:], pos, side="right")
     seg_k = jnp.searchsorted(cu_seqlens_k[1:], jnp.arange(k.shape[0]),
                              side="right")
+
+    from ...core import flags as _flags
+    from .. import pallas as _pallas
+
+    # identity, not shape: equal-shape but different-valued offsets would
+    # silently mis-segment K (values are traced, so only the self-attention
+    # same-object case is provably safe)
+    same_packing = (q.shape[0] == k.shape[0]
+                    and cu_seqlens_q is cu_seqlens_k)
+    if (
+        _flags.get_flag("use_flash_attention")
+        and _pallas.pallas_enabled()
+        and same_packing
+        and dropout == 0.0
+        and total % 128 == 0
+        and q.shape[-1] <= 256
+    ):
+        from ..pallas.flash_attention import flash_attention_segmented
+
+        out = flash_attention_segmented(
+            q[None], k[None], v[None], seg_q[None].astype(jnp.int32),
+            scale, causal, interpret=_pallas.interpret_mode())
+        return out[0]
+
     mask = seg_q[:, None] == seg_k[None, :]
     if causal:
         off_q = pos - jnp.take(cu_seqlens_q, seg_q)

@@ -312,6 +312,271 @@ def _flash_bwd_rule(scale, causal, block_q, block_k, interpret, res, g):
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
+# ----------------------------------------------- varlen (segmented) flash
+# Reference: phi flash_attn_unpadded / flash_attn_varlen
+# (paddle/phi/kernels/gpu/flash_attn_kernel.cu varlen entries) — packed
+# sequences with a block-diagonal mask. TPU-native shape: SEGMENT IDS
+# (splash-attention style) — the kernels stream K/V blocks exactly like the
+# dense flash kernels and add a seg_q == seg_k visibility test, so packed
+# pretraining batches keep O(block) memory instead of a [total, total]
+# mask.
+def _fwd_seg_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref, o_ref, lse_ref,
+                    *, scale, causal, block_k, sk):
+    qi = pl.program_id(1)
+    block_q = q_ref.shape[0]
+    d = q_ref.shape[1]
+    q = q_ref[:].astype(jnp.float32) * scale
+    seg_q = sq_ref[:]  # [block_q, 1] int32
+
+    def body(j, carry):
+        m_prev, l_prev, acc = carry
+        k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        seg_k = sk_ref[pl.ds(j * block_k, block_k), :]  # [block_k, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        live = seg_q == seg_k.reshape(1, block_k)  # [block_q, block_k]
+        if causal:
+            q_ids = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_ids = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            live = live & (q_ids >= k_ids)
+        s = jnp.where(live, s, NEG_INF)
+        m_cur = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        p = jnp.where(live, p, 0.0)  # fully-masked rows stay exactly zero
+        l_new = l_prev * alpha + jnp.sum(p, axis=1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc
+
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, sk // block_k, body, (m0, l0, acc0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[:] = (acc / l[:, None]).astype(o_ref.dtype)
+    lse_ref[:] = (m + jnp.log(l))[:, None]
+
+
+def _bwd_seg_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref, do_ref, lse_ref,
+                    delta_ref, dq_ref, *, scale, causal, block_k, sk):
+    qi = pl.program_id(1)
+    block_q = q_ref.shape[0]
+    d = q_ref.shape[1]
+    q = q_ref[:].astype(jnp.float32) * scale
+    do = do_ref[:].astype(jnp.float32)
+    lse = lse_ref[:]
+    delta = delta_ref[:]
+    seg_q = sq_ref[:]
+
+    def body(j, dq):
+        k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        seg_k = sk_ref[pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        live = seg_q == seg_k.reshape(1, block_k)
+        if causal:
+            q_ids = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_ids = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            live = live & (q_ids >= k_ids)
+        p = jnp.where(live, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, sk // block_k, body,
+                           jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[:] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _dkv_seg_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, *, scale, causal, block_q, sq):
+    ki = pl.program_id(1)
+    block_k = k_ref.shape[0]
+    d = k_ref.shape[1]
+    k = k_ref[:].astype(jnp.float32)
+    v = v_ref[:].astype(jnp.float32)
+    seg_k = sk_ref[:]  # [block_k, 1]
+
+    def body(j, carry):
+        dk, dv = carry
+        q = q_ref[pl.ds(j * block_q, block_q), :].astype(jnp.float32) * scale
+        do = do_ref[pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(j * block_q, block_q), :]
+        delta = delta_ref[pl.ds(j * block_q, block_q), :]
+        seg_q = sq_ref[pl.ds(j * block_q, block_q), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        live = seg_q == seg_k.reshape(1, block_k)
+        if causal:
+            q_ids = j * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_ids = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            live = live & (q_ids >= k_ids)
+        p = jnp.where(live, jnp.exp(s - lse), 0.0)
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, sq // block_q, body, (dk0, dv0))
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+def _seg_fwd(q, k, v, seg, scale, causal, block_q, block_k, interpret):
+    b, sq, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    sk = k.shape[1]
+    bh = b * h
+    qr = q.transpose(0, 2, 1, 3).reshape(bh, sq, d)
+    kr = k.transpose(0, 2, 1, 3).reshape(bh, sk, d)
+    vr = v.transpose(0, 2, 1, 3).reshape(bh, sk, d)
+    segr = seg.astype(jnp.int32).reshape(b, sq, 1)
+
+    seg_block = pl.BlockSpec((None, block_q, 1),
+                             lambda i, j, h=h: (i // h, j, 0))
+    seg_full = pl.BlockSpec((None, sk, 1), lambda i, j, h=h: (i // h, 0, 0))
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_seg_kernel, scale=scale, causal=causal,
+                          block_k=block_k, sk=sk),
+        grid=(bh, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+            seg_block,
+            seg_full,
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            _sds((bh, sq, d), q.dtype, qr),
+            _sds((bh, sq, 1), jnp.float32, qr),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr, segr, segr)
+    o = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    return o, (qr, kr, vr, segr, out, lse)
+
+
+def _seg_bwd(scale, causal, block_q, block_k, interpret, res, g):
+    qr, kr, vr, segr, outr, lse = res
+    bh, sq, d = qr.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    sk = kr.shape[1]
+    b = segr.shape[0]
+    h = bh // b
+    do = g.transpose(0, 2, 1, 3).reshape(bh, sq, d)
+    delta = jnp.sum(do.astype(jnp.float32) * outr.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+
+    seg_block_q = pl.BlockSpec((None, block_q, 1),
+                               lambda i, j, h=h: (i // h, j, 0))
+    seg_full_q = pl.BlockSpec((None, sq, 1), lambda i, j, h=h: (i // h, 0, 0))
+    seg_full_k = pl.BlockSpec((None, sk, 1), lambda i, j, h=h: (i // h, 0, 0))
+    seg_block_k = pl.BlockSpec((None, block_k, 1),
+                               lambda i, j, h=h: (i // h, j, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_seg_kernel, scale=scale, causal=causal,
+                          block_k=block_k, sk=sk),
+        grid=(bh, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+            seg_block_q,
+            seg_full_k,
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda i, j: (i, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=_sds((bh, sq, d), qr.dtype, qr),
+        interpret=interpret,
+    )(qr, kr, vr, segr, segr, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_seg_kernel, scale=scale, causal=causal,
+                          block_q=block_q, sq=sq),
+        grid=(bh, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((None, sq, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+            seg_full_q,
+            seg_block_k,
+            pl.BlockSpec((None, sq, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, sq, 1), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, sq, 1), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            _sds((bh, sk, d), kr.dtype, qr),
+            _sds((bh, sk, d), vr.dtype, qr),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr, segr, segr, do, lse, delta)
+
+    un = lambda x, s: x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    return un(dq, sq), un(dk, sk), un(dv, sk), None
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def flash_attention_segmented(
+    q, k, v, segment_ids, scale=None, causal=False,
+    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K, interpret=False,
+):
+    """Varlen flash attention via segment ids: q/k/v [b, s, h, d],
+    segment_ids [b, s] int32 — tokens attend only within their segment
+    (block-diagonal mask), streamed with O(block) memory. Differentiable."""
+    o, _ = _seg_fwd(q, k, v, segment_ids, scale, causal, block_q, block_k,
+                    interpret)
+    return o
+
+
+def _seg_fwd_rule(q, k, v, segment_ids, scale, causal, block_q, block_k,
+                  interpret):
+    return _seg_fwd(q, k, v, segment_ids, scale, causal, block_q, block_k,
+                    interpret)
+
+
+flash_attention_segmented.defvjp(_seg_fwd_rule, _seg_bwd)
+
+
 # --------------------------------------------- (o, lse) entry for ring CP
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention_with_lse(

@@ -188,6 +188,8 @@ class Profiler:
         self._benchmark = _Benchmark()
         self._recording = False
         self._device_trace_dir = None
+        self._last_device_dir = None   # kept after stop for export merge
+        self._clock_sync = None        # (host steady_ns, epoch_ns) pair
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -238,6 +240,10 @@ class Profiler:
 
     # -- recording ---------------------------------------------------------
     def _start_record(self):
+        # a fresh session must not inherit the previous session's device
+        # dump or clock pair — export() would merge stale device lanes
+        self._last_device_dir = None
+        self._clock_sync = None
         if native.available():
             native.trace_clear()
             native.trace_enable(True)
@@ -249,6 +255,12 @@ class Profiler:
                 self._device_trace_dir = os.environ.get(
                     "PADDLE_TPU_TRACE_DIR", "/tmp/paddle_tpu_xplane")
                 jax.profiler.start_trace(self._device_trace_dir)
+                # clock-correspondence sample: host spans are steady_clock
+                # ns, xplane timestamps are epoch ns — one paired reading
+                # lets export() place both on a single axis
+                steady = (native.trace_now_ns() if native.available()
+                          else time.monotonic_ns())
+                self._clock_sync = (steady, time.time_ns())
             except Exception:
                 self._device_trace_dir = None
         self._recording = True
@@ -262,6 +274,7 @@ class Profiler:
                 import jax
 
                 jax.profiler.stop_trace()
+                self._last_device_dir = self._device_trace_dir
             except Exception:
                 pass
             self._device_trace_dir = None
@@ -269,13 +282,14 @@ class Profiler:
 
     # -- export / stats ----------------------------------------------------
     def export(self, path: str, format: str = "json"):
-        events = []
-        for s in self._spans:
-            events.append({
-                "name": s["name"], "ph": "X", "pid": os.getpid(),
-                "tid": s["tid"], "ts": s["begin_ns"] / 1e3,
-                "dur": (s["end_ns"] - s["begin_ns"]) / 1e3, "cat": "host",
-            })
+        """One chrome trace: host spans + the XLA device timeline (parsed
+        from the jax.profiler xplane protobufs) on a shared time axis —
+        the reference's host+CUPTI merged chrome_tracing_logger, TPU-style
+        (SURVEY §5.1)."""
+        from .xplane import merged_chrome_trace
+
+        events = merged_chrome_trace(self._spans, self._last_device_dir,
+                                     self._clock_sync)
         with open(path, "w") as f:
             json.dump({"traceEvents": events,
                        "displayTimeUnit": "ms"}, f)

@@ -1,0 +1,112 @@
+"""Device-timeline reader: parse the XPlane protobufs jax.profiler writes
+and merge them with the native host-span trace into ONE chrome trace.
+
+Reference: the reference profiler merges host-side RecordEvents with the
+CUPTI device timeline into a single chrome trace
+(paddle/fluid/platform/profiler/chrome_tracing_logger.cc); on TPU the
+device timeline comes from XLA's profiler (xplane), so the merge reads the
+public XSpace schema via the checked-in minimal protobuf
+(xplane_minimal.proto).
+
+Clock mapping: host spans carry steady_clock ns (native/src/tracer.cc
+now_ns); xplane line timestamps are epoch ns (TSL NowNanos). The profiler
+records a (steady_ns, epoch_ns) pair at record start; device events map
+onto the host timeline via that correspondence (same process, sub-ms skew).
+"""
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+def find_xplane_files(trace_dir: str) -> List[str]:
+    """jax.profiler writes <dir>/plugins/profile/<run>/<host>.xplane.pb,
+    one timestamped <run> per session. Only the NEWEST run belongs to the
+    profiler session that exported — older runs (or other processes using
+    the same dir) must not leak stale device lanes into the merge."""
+    runs = sorted(glob.glob(os.path.join(trace_dir, "plugins", "profile",
+                                         "*")),
+                  key=os.path.getmtime)
+    if not runs:
+        return []
+    return sorted(glob.glob(os.path.join(runs[-1], "*.xplane.pb")))
+
+
+def load_xspace(path: str):
+    from . import xplane_minimal_pb2 as pb
+
+    space = pb.XSpace()
+    with open(path, "rb") as f:
+        space.ParseFromString(f.read())
+    return space
+
+
+def device_events(trace_dir: str) -> Iterator[Dict]:
+    """Yield {plane, line, name, start_ns (epoch), dur_ns} for every event
+    on every plane of every xplane file under trace_dir."""
+    for path in find_xplane_files(trace_dir):
+        space = load_xspace(path)
+        for plane in space.planes:
+            meta = {m_id: m.display_name or m.name
+                    for m_id, m in plane.event_metadata.items()}
+            for line in plane.lines:
+                lname = line.display_name or line.name or f"line{line.id}"
+                for ev in line.events:
+                    yield {
+                        "plane": plane.name,
+                        "line": lname,
+                        "name": meta.get(ev.metadata_id,
+                                         f"event{ev.metadata_id}"),
+                        "start_ns": line.timestamp_ns + ev.offset_ps // 1000,
+                        "dur_ns": max(ev.duration_ps // 1000, 1),
+                    }
+
+
+def merged_chrome_trace(host_spans: List[Dict],
+                        trace_dir: Optional[str],
+                        sync: Optional[Tuple[int, int]]) -> List[Dict]:
+    """Build chrome-trace events: host spans on pid 'host', device planes on
+    one pid per plane, all on the host steady-clock axis (µs).
+
+    sync = (steady_ns, epoch_ns) captured together at record start."""
+    events: List[Dict] = []
+    pid = os.getpid()
+    for s in host_spans:
+        events.append({
+            "name": s["name"], "ph": "X", "pid": pid, "tid": s["tid"],
+            "ts": s["begin_ns"] / 1e3,
+            "dur": (s["end_ns"] - s["begin_ns"]) / 1e3, "cat": "host",
+        })
+    events.append({"name": "process_name", "ph": "M", "pid": pid,
+                   "args": {"name": "host"}})
+    if trace_dir is None:
+        return events
+    steady0, epoch0 = sync if sync else (0, 0)
+    # group per plane: XLA planes disagree on time base (host planes use
+    # epoch ns, some device planes are session-relative). Epoch-based lines
+    # map exactly through the sync pair; anything else anchors its earliest
+    # event at record start — lanes stay internally exact either way.
+    per_plane: Dict[str, List[Dict]] = {}
+    for ev in device_events(trace_dir):
+        per_plane.setdefault(ev["plane"], []).append(ev)
+    plane_pid = pid + 1000
+    for plane, evs in per_plane.items():
+        events.append({"name": "process_name", "ph": "M", "pid": plane_pid,
+                       "args": {"name": f"device:{plane}"}})
+        base = min(e["start_ns"] for e in evs)
+        epoch_based = sync and abs(base - epoch0) < 3600 * 1e9  # within 1h
+        for ev in evs:
+            if epoch_based:
+                start_steady = ev["start_ns"] - epoch0 + steady0
+            elif sync:
+                start_steady = ev["start_ns"] - base + steady0
+            else:
+                start_steady = ev["start_ns"] - base
+            events.append({
+                "name": ev["name"], "ph": "X", "pid": plane_pid,
+                "tid": ev["line"], "ts": start_steady / 1e3,
+                "dur": ev["dur_ns"] / 1e3, "cat": "device",
+            })
+        plane_pid += 1
+    return events

@@ -1,0 +1,100 @@
+"""Auto-parallel Engine tests (VERDICT r3 item 7).
+
+Acceptance (from the verdict): GPT on the 8-device mesh reaches
+manual-placement loss parity with NO hand annotations.
+Reference: auto_parallel/static/engine.py Engine.fit + spmd_rules.
+"""
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.auto_parallel import Engine, plan_parameter_specs
+from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+
+def _batches(cfg, n, batch=4, seq=32, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        (paddle.to_tensor(
+            rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)),)
+        for _ in range(n)
+    ]
+
+
+class TestPlanRules:
+    def test_gpt_placements_follow_megatron_pairing(self):
+        cfg = GPTConfig.tiny()
+        paddle.seed(0)
+        m = GPTForCausalLM(cfg)
+        mesh = dist.build_mesh(dp=2, mp=4)
+        specs = plan_parameter_specs(m, mesh)
+        # vocab-parallel embedding
+        wte = [k for k in specs if "wte" in k and "weight" in k]
+        assert wte and specs[wte[0]] == P("mp", None)
+        # column for fan-out (qkv 128->384), row for fan-in (fc_out 512->128)
+        qkv = [k for k in specs if "qkv_proj.weight" in k][0]
+        assert specs[qkv] == P(None, "mp")
+        fco = [k for k in specs if "fc_out.weight" in k][0]
+        assert specs[fco] == P("mp", None)
+        # 1-D params replicate
+        ln = [k for k in specs if "ln_1.weight" in k][0]
+        assert specs[ln] == P()
+
+
+class TestEngineFit:
+    def test_unannotated_gpt_matches_manual_placement_loss(self):
+        cfg = GPTConfig.tiny()
+        cfg.hidden_dropout_prob = 0.0
+        cfg.attention_dropout_prob = 0.0
+        batches = _batches(cfg, 4)
+
+        # --- engine: no annotations, fixed mesh (dp=2, mp=4) ---
+        paddle.seed(0)
+        m1 = GPTForCausalLM(cfg)
+        opt1 = paddle.optimizer.AdamW(1e-3, parameters=m1.parameters())
+        eng = Engine(m1, optimizer=opt1, mesh=dist.build_mesh(dp=2, mp=4))
+        hist = eng.fit(batches, epochs=1)
+        assert len(hist["loss"]) == 4
+
+        # --- manual: same model trained single-mesh replicated ---
+        paddle.seed(0)
+        m2 = GPTForCausalLM(cfg)
+        opt2 = paddle.optimizer.AdamW(1e-3, parameters=m2.parameters())
+        from paddle_tpu.jit.trainer import TrainStep
+
+        step = TrainStep(m2, lambda ids: m2(ids, labels=ids), opt2)
+        manual = [float(step(b[0]).item()) for b in batches]
+
+        np.testing.assert_allclose(hist["loss"], manual, rtol=2e-3, atol=2e-3)
+        # the engine really sharded: >1 addressable shard on a 2-D param
+        plan = eng.plan["parameter_specs"]
+        assert any(tuple(s) != () and any(x is not None for x in s)
+                   for s in plan.values())
+
+    def test_engine_auto_mesh_selection(self):
+        cfg = GPTConfig.tiny()
+        cfg.hidden_dropout_prob = 0.0
+        cfg.attention_dropout_prob = 0.0
+        paddle.seed(0)
+        m = GPTForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+        eng = Engine(m, optimizer=opt)  # no mesh given: tuner picks degrees
+        hist = eng.fit(_batches(cfg, 2), epochs=1)
+        assert len(hist["loss"]) == 2
+        assert np.isfinite(hist["loss"]).all()
+        cfgd = eng.plan["mesh_config"]
+        assert cfgd is not None and cfgd["dp"] * cfgd["mp"] == len(jax.devices())
+
+    def test_engine_evaluate(self):
+        cfg = GPTConfig.tiny()
+        paddle.seed(0)
+        m = GPTForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+        eng = Engine(m, optimizer=opt, mesh=dist.build_mesh(dp=2, mp=4))
+        batches = _batches(cfg, 2)
+        eng.fit(batches, epochs=1)
+        res = eng.evaluate(batches)
+        assert np.isfinite(res["loss"])

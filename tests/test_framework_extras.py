@@ -272,6 +272,58 @@ class TestParameterServer:
         assert results[1] == "ok", results[1]
 
 
+def _dist_dag_role(master_ep):
+    """Two-process fleet-executor world with cross-rank dependency edges:
+      rank0: load -> [compute0]          compute0 feeds rank1's join
+      rank1: compute1(load from rank0) -> join(compute0, compute1)
+    """
+    import os
+
+    from paddle_tpu.distributed import DistFleetExecutor, TaskNode, rpc
+
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    rpc.init_rpc(f"fe{rank}", rank=rank, world_size=2,
+                 master_endpoint=master_ep)
+    try:
+        load = TaskNode("load", lambda r, u: 10 + r, rank=0)
+        c0 = TaskNode("compute0", lambda r, u: u["load"] * 2, rank=0)
+        c1 = TaskNode("compute1", lambda r, u: u["load"] + 1, rank=1)
+        join = TaskNode("join", lambda r, u: u["compute0"] + u["compute1"],
+                        rank=1)
+        c0.add_upstream_task(load)
+        c1.add_upstream_task(load)          # cross-rank edge 0 -> 1
+        join.add_upstream_task(c0)          # cross-rank edge 0 -> 1
+        join.add_upstream_task(c1)
+        ex = DistFleetExecutor([load, c0, c1, join], rank=rank,
+                               result_timeout=60)
+        res = ex.run(num_micro_batches=2)
+        if rank == 0:
+            assert res["load"] == [10, 11], res
+            assert res["compute0"] == [20, 22], res
+            return "rank0-ok"
+        # round r: join = (10+r)*2 + (10+r) + 1
+        assert res["compute1"] == [11, 12], res
+        assert res["join"] == [31, 34], res
+        return "rank1-ok"
+    finally:
+        rpc.shutdown()
+
+
+class TestDistFleetExecutor:
+    def test_cross_process_dag(self):
+        import socket
+
+        import paddle_tpu.distributed as dist
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        results = dist.spawn(_dist_dag_role, args=(f"127.0.0.1:{port}",),
+                             nprocs=2, timeout=180)
+        assert results[0] == "rank0-ok", results[0]
+        assert results[1] == "rank1-ok", results[1]
+
+
 class TestCrypto:
     def test_roundtrip_bytes_and_files(self, tmp_path):
         from paddle_tpu.crypto import Cipher, CipherFactory, CipherUtils

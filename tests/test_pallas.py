@@ -57,6 +57,80 @@ def test_flash_attention_grads(causal):
         np.testing.assert_allclose(a, b, atol=0.15, rtol=5e-2)
 
 
+class TestSegmentedFlash:
+    """Varlen (packed-sequence) flash via segment ids — VERDICT r3
+    Missing #5. Oracle: dense attention under the block-diagonal mask."""
+
+    def _data(self, seed=0):
+        rng = np.random.default_rng(seed)
+        b, s, h, d = 2, 64, 2, 16
+        mk = lambda: jnp.asarray(rng.standard_normal((b, s, h, d)),
+                                 jnp.float32)
+        seg = np.zeros((b, s), np.int32)
+        seg[:, 20:44] = 1
+        seg[:, 44:] = 2
+        return mk(), mk(), mk(), jnp.asarray(seg), seg
+
+    def _dense(self, q, k, v, seg_np, causal):
+        s = q.shape[1]
+        scale = 1.0 / np.sqrt(q.shape[-1])
+        sm = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        live = (seg_np[:, :, None] == seg_np[:, None, :])[:, None]
+        if causal:
+            ids = np.arange(s)
+            live = live & (ids[:, None] >= ids[None, :])[None, None]
+        sm = jnp.where(jnp.asarray(live), sm, -1e30)
+        return jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(sm, -1), v)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_forward_and_grads(self, causal):
+        from paddle_tpu.ops.pallas.flash_attention import (
+            flash_attention_segmented,
+        )
+
+        q, k, v, segj, seg_np = self._data()
+        scale = 1.0 / np.sqrt(q.shape[-1])
+        o = flash_attention_segmented(q, k, v, segj, scale, causal, 16, 16,
+                                      True)
+        ref = self._dense(q, k, v, seg_np, causal)
+        np.testing.assert_allclose(o, ref, atol=2e-5, rtol=2e-5)
+
+        rng = np.random.default_rng(9)
+        wo = jnp.asarray(rng.standard_normal(q.shape), jnp.float32)
+        gf = jax.grad(
+            lambda q, k, v: jnp.sum(flash_attention_segmented(
+                q, k, v, segj, scale, causal, 16, 16, True) * wo),
+            argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(
+            lambda q, k, v: jnp.sum(self._dense(q, k, v, seg_np, causal) * wo),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gd):
+            np.testing.assert_allclose(a, b, atol=2e-4, rtol=2e-4)
+
+    def test_flash_attn_unpadded_routes_through_kernel(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.ops import api
+
+        rng = np.random.default_rng(3)
+        total = 128
+        mk = lambda: paddle.to_tensor(
+            rng.standard_normal((total, 2, 16)).astype(np.float32))
+        qp, kp, vp = mk(), mk(), mk()
+        cu = paddle.to_tensor(np.array([0, 50, 90, 128], np.int32))
+        paddle.set_flags({"use_flash_attention": True,
+                          "pallas_interpret": True})
+        try:
+            out_flash = api.flash_attn_unpadded(qp, kp, vp, cu, cu, 50, 50,
+                                                causal=True)
+        finally:
+            paddle.set_flags({"use_flash_attention": False,
+                              "pallas_interpret": False})
+        out_dense = api.flash_attn_unpadded(qp, kp, vp, cu, cu, 50, 50,
+                                            causal=True)
+        np.testing.assert_allclose(out_flash.numpy(), out_dense.numpy(),
+                                   atol=3e-5, rtol=3e-5)
+
+
 def test_flash_attention_bf16():
     q, k, v = _qkv(2)
     qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))

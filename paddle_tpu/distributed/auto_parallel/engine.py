@@ -112,22 +112,25 @@ class Engine:
             batch_sh = NamedSharding(
                 mesh, P("dp") if mesh.shape.get("dp", 1) > 1 else P())
             ids = jax.device_put(np.asarray(sample_ids), batch_sh)
+            lbl = (jax.device_put(np.asarray(sample_labels), batch_sh)
+                   if sample_labels is not None else None)
 
-            def fwd(params, ids):
+            def fwd(params, ids, lbl):
                 saved = []
                 for (nm, p), v in zip(engine.model.named_parameters(),
                                       params):
                     saved.append(p._value)
                     p._value = v
                 try:
-                    loss = engine._loss_of(Tensor(ids), None)
+                    loss = engine._loss_of(
+                        Tensor(ids), Tensor(lbl) if lbl is not None else None)
                     return loss._value
                 finally:
                     for (nm, p), v in zip(engine.model.named_parameters(),
                                           saved):
                         p._value = v
 
-            return fwd, (placed, ids)
+            return fwd, (placed, ids, lbl)
 
         reports = auto_tuner.tune(build_step, n_devices=n,
                                   axes=("dp", "mp"), top_k=1)
@@ -151,9 +154,13 @@ class Engine:
         labels = sample_batch[1] if (isinstance(sample_batch, (tuple, list))
                                      and len(sample_batch) > 1) else None
         if self.mesh is None:
+            lbl_np = None
+            if labels is not None:
+                lbl_np = np.asarray(
+                    labels._value if isinstance(labels, Tensor) else labels)
             self.mesh, self._chosen_config = self._choose_mesh(
                 np.asarray(ids._value if isinstance(ids, Tensor) else ids),
-                labels)
+                lbl_np)
         set_mesh(self.mesh)
         self._plan = plan_parameter_specs(self.model, self.mesh)
         _apply_specs(self.model, self.mesh, self._plan)
@@ -180,6 +187,10 @@ class Engine:
             steps_per_epoch: Optional[int] = None) -> Dict[str, List[float]]:
         """train_data: an iterable of (ids, labels) or (ids,) batches (a
         DataLoader works). Returns {'loss': [...]} history per step."""
+        if self.optimizer is None:
+            raise ValueError(
+                "Engine.fit requires an optimizer; this Engine was built "
+                "without one (evaluate/predict only)")
         history: Dict[str, List[float]] = {"loss": []}
         for _ in range(epochs):
             for step_i, batch in enumerate(train_data):

@@ -138,6 +138,15 @@ class FleetExecutor:
 
 
 # -------------------------------------------------------- multi-host runtime
+class _RemoteTaskError:
+    """Delivered instead of a result when the producer task raised, so the
+    consumer rank FAILS too instead of silently computing on None (SPMD
+    ranks must not desynchronize)."""
+
+    def __init__(self, text: str):
+        self.text = text
+
+
 class _MessageBus:
     """Per-process inbox for cross-rank task results (reference:
     fleet_executor's brpc MessageBus carrying results between Carriers —
@@ -248,7 +257,13 @@ class DistFleetExecutor(FleetExecutor):
                     node = self.nodes[name]
                     result = None
                     try:
-                        if not errors:
+                        if errors:
+                            # skipped after a local failure: consumers on
+                            # other ranks must fail too, not see None
+                            result = _RemoteTaskError(
+                                "skipped: an earlier task failed on rank "
+                                f"{self.rank}")
+                        else:
                             ups = {}
                             for up in node.upstream:
                                 if up in done:
@@ -257,11 +272,17 @@ class DistFleetExecutor(FleetExecutor):
                                     ups[up] = _MessageBus.wait(
                                         (run_id, rnd, up),
                                         self.result_timeout)
+                                if isinstance(ups[up], _RemoteTaskError):
+                                    raise RuntimeError(
+                                        f"upstream task {up!r} failed on "
+                                        f"its rank:\n{ups[up].text}")
                             if (node.max_run_times is None
                                     or rnd < node.max_run_times):
                                 result = node.fn(rnd, ups)
                     except BaseException as e:  # noqa: BLE001
                         errors.append(e)
+                        result = _RemoteTaskError(
+                            f"{type(e).__name__}: {e}")
                     # push to remote consumers (once per consuming rank)
                     remote_ranks = {self.nodes[d].rank
                                     for d in node.downstream

@@ -412,23 +412,36 @@ def _transform_function(func):
         ast.fix_missing_locations(mod)
         code = compile(mod, filename=f"<dy2static {func.__qualname__}>",
                        mode="exec")
-        ns = dict(_runtime_globals(func))
-        exec(code, ns)
+        ns: dict = {}
+        exec(code, _runtime_globals(func), ns)
         cells = [c.cell_contents for c in func.__closure__]
-        return ns["__d2s_maker"](*cells)
+        return _rebind(ns["__d2s_maker"](*cells), func)
     code = compile(new, filename=f"<dy2static {func.__qualname__}>",
                    mode="exec")
-    ns = dict(_runtime_globals(func))
-    exec(code, ns)
-    return ns[fdef.name]
+    ns = {}
+    exec(code, _runtime_globals(func), ns)
+    return _rebind(ns[fdef.name], func)
 
 
 def _runtime_globals(func):
-    g = dict(func.__globals__)
+    """The ORIGINAL module globals plus the three reserved converter names
+    (injected, dunder-prefixed). Using the real dict — not a snapshot —
+    keeps `global` writes and later module-level rebindings visible,
+    matching eager semantics; the temp function definition itself is kept
+    out of it via a separate exec locals namespace."""
+    g = func.__globals__
     g["__d2s_ifelse"] = convert_ifelse
     g["__d2s_while"] = convert_while
     g["__d2s_undef"] = _Undefined
     return g
+
+
+def _rebind(fn, orig):
+    """Give the generated function the original's identity metadata."""
+    fn.__name__ = orig.__name__
+    fn.__qualname__ = orig.__qualname__
+    fn.__doc__ = orig.__doc__
+    return fn
 
 
 def convert_control_flow(fn: Callable) -> Callable:

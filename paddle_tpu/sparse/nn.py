@@ -53,3 +53,136 @@ class Softmax:
         e = jnp.exp(v - rowmax[rows])
         denom = jax.ops.segment_sum(e, rows, num_segments=x._shape[0])
         return SparseCsrTensor(x._crows, x._cols, e / denom[rows], x._shape)
+
+
+# ------------------------------------------------- conv / pool / norm layers
+from ..nn.layer import Layer as _Layer  # noqa: E402
+
+
+class _SparseConvNd(_Layer):
+    """Reference: sparse/nn/layer/conv.py Conv3D/SubmConv3D — channels-last
+    COO input, kernel [*k, C_in, C_out]. An nn.Layer so the weights are
+    visible to parameters()/optimizers/Engine, seeded by paddle.seed."""
+
+    _ndim = 3
+    _subm = False
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, bias_attr=None):
+        import numpy as np
+
+        super().__init__()
+        d = self._ndim
+        k = kernel_size if isinstance(kernel_size, (tuple, list)) \
+            else (kernel_size,) * d
+        self.weight = self.create_parameter(
+            list(k) + [in_channels, out_channels])
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter([out_channels], is_bias=True)
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+
+    def forward(self, x):
+        from .conv import sparse_conv, subm_conv
+
+        if self._subm:
+            return subm_conv(x, self.weight, self.bias,
+                             dilation=self.dilation)
+        return sparse_conv(x, self.weight, self.bias, stride=self.stride,
+                           padding=self.padding, dilation=self.dilation)
+
+
+class Conv3D(_SparseConvNd):
+    _ndim, _subm = 3, False
+
+
+class SubmConv3D(_SparseConvNd):
+    _ndim, _subm = 3, True
+
+
+class Conv2D(_SparseConvNd):
+    _ndim, _subm = 2, False
+
+
+class SubmConv2D(_SparseConvNd):
+    _ndim, _subm = 2, True
+
+
+class MaxPool3D:
+    """Reference: sparse/nn/layer/pooling.py MaxPool3D over COO sites."""
+
+    def __init__(self, kernel_size, stride=None, padding=0):
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+
+    def __call__(self, x):
+        from .conv import sparse_max_pool
+
+        return sparse_max_pool(x, self.kernel_size, self.stride,
+                               self.padding)
+
+
+class BatchNorm(_Layer):
+    """Reference: sparse/nn/layer/norm.py BatchNorm — statistics over
+    ACTIVE sites' values only."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5):
+        import numpy as np
+
+        from ..core.tensor import Tensor
+
+        from ..nn import initializer as I
+
+        super().__init__()
+        self.weight = self.create_parameter(
+            [num_features], default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter([num_features], is_bias=True)
+        self._mean = Tensor(np.zeros(num_features, np.float32))
+        self._variance = Tensor(np.ones(num_features, np.float32))
+        self.momentum = momentum
+        self.epsilon = epsilon
+
+    def forward(self, x):
+        from .conv import sparse_batch_norm
+
+        out, new_m, new_v = sparse_batch_norm(
+            x, self._mean, self._variance, self.weight, self.bias,
+            training=self.training, momentum=self.momentum,
+            epsilon=self.epsilon)
+        if self.training:
+            self._mean, self._variance = new_m, new_v
+        return out
+
+
+class functional:
+    """sparse.nn.functional namespace (reference
+    python/paddle/sparse/nn/functional/)."""
+
+    @staticmethod
+    def attention(query, key, value, sparse_mask, key_padding_mask=None,
+                  attn_mask=None):
+        from .conv import sparse_attention
+
+        return sparse_attention(query, key, value, sparse_mask)
+
+    @staticmethod
+    def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                    key=None):
+        from .conv import subm_conv
+
+        return subm_conv(x, weight, bias, stride, padding, dilation)
+
+    @staticmethod
+    def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1):
+        from .conv import sparse_conv
+
+        return sparse_conv(x, weight, bias, stride, padding, dilation)
+
+    @staticmethod
+    def max_pool3d(x, kernel_size, stride=None, padding=0):
+        from .conv import sparse_max_pool
+
+        return sparse_max_pool(x, kernel_size, stride, padding)

@@ -119,4 +119,12 @@ class Conll05st(Dataset):
         return len(self.words)
 
 
-__all__ = ["viterbi_decode", "Imdb", "Conll05st"]
+from . import strings  # noqa: E402
+from .strings import (  # noqa: E402
+    StringTensor,
+    Vocab,
+    tokenize,
+)
+
+__all__ = ["viterbi_decode", "Imdb", "Conll05st", "strings", "StringTensor",
+           "Vocab", "tokenize"]

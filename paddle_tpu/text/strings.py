@@ -1,0 +1,169 @@
+"""StringTensor + string kernels.
+
+Reference: paddle/phi/core/string_tensor.h (a TensorBase holding pstring
+elements) and paddle/phi/kernels/strings/ — strings_lower_upper_kernel.h
+(ASCII + UTF-8 case mapping via case_utils.h/unicode.h), strings_copy,
+strings_empty.
+
+TPU-native placement: strings are HOST data (no accelerator dtype exists);
+a StringTensor is a shaped numpy object array living on the host, and the
+string kernels are vectorized host ops. The boundary to device compute is
+explicit: `encode`/`lookup` produce int32 Tensors (token ids) that enter
+the jax world, which is exactly how the reference's data pipeline feeds
+string features into kernels.
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+class StringTensor:
+    """Shaped host tensor of python strings (reference StringTensor)."""
+
+    def __init__(self, data, shape: Optional[Sequence[int]] = None):
+        arr = np.asarray(data, dtype=object)
+        if shape is not None:
+            arr = arr.reshape(tuple(shape))
+        self._data = arr
+
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    def numel(self) -> int:
+        return int(self._data.size)
+
+    def numpy(self) -> np.ndarray:
+        return self._data
+
+    def tolist(self):
+        return self._data.tolist()
+
+    def reshape(self, shape):
+        return StringTensor(self._data.reshape(tuple(shape)))
+
+    def __getitem__(self, i):
+        out = self._data[i]
+        return StringTensor(out) if isinstance(out, np.ndarray) else out
+
+    def __eq__(self, other):
+        o = other._data if isinstance(other, StringTensor) else other
+        return Tensor(np.asarray(self._data == o))
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, data={self._data!r})"
+
+
+def _map(x: StringTensor, fn) -> StringTensor:
+    flat = [fn(s) for s in x._data.ravel()]
+    return StringTensor(np.asarray(flat, object).reshape(x._data.shape))
+
+
+def to_string_tensor(data) -> StringTensor:
+    return data if isinstance(data, StringTensor) else StringTensor(data)
+
+
+# -------------------------------------------------------- string kernels
+def lower(x, use_utf8_encoding: bool = True) -> StringTensor:
+    """strings_lower_upper_kernel StringLowerKernel: python str.lower is
+    the full Unicode case map; ASCII-only mode mirrors the reference's
+    non-utf8 path."""
+    x = to_string_tensor(x)
+    if use_utf8_encoding:
+        return _map(x, str.lower)
+    return _map(x, lambda s: "".join(
+        c.lower() if ord(c) < 128 else c for c in s))
+
+
+def upper(x, use_utf8_encoding: bool = True) -> StringTensor:
+    x = to_string_tensor(x)
+    if use_utf8_encoding:
+        return _map(x, str.upper)
+    return _map(x, lambda s: "".join(
+        c.upper() if ord(c) < 128 else c for c in s))
+
+
+def length(x) -> Tensor:
+    x = to_string_tensor(x)
+    return Tensor(np.asarray([len(s) for s in x._data.ravel()],
+                             np.int64).reshape(x._data.shape))
+
+
+def strip(x) -> StringTensor:
+    return _map(to_string_tensor(x), str.strip)
+
+
+def join(x, sep: str = "") -> str:
+    return sep.join(to_string_tensor(x)._data.ravel().tolist())
+
+
+def split(x, sep: Optional[str] = None) -> List[List[str]]:
+    x = to_string_tensor(x)
+    return [s.split(sep) for s in x._data.ravel()]
+
+
+def concat(xs: Iterable, axis: int = 0) -> StringTensor:
+    arrs = [to_string_tensor(x)._data for x in xs]
+    return StringTensor(np.concatenate(arrs, axis=axis))
+
+
+def starts_with(x, prefix: str) -> Tensor:
+    x = to_string_tensor(x)
+    return Tensor(np.asarray([s.startswith(prefix)
+                              for s in x._data.ravel()],
+                             bool).reshape(x._data.shape))
+
+
+# ------------------------------------------------- string -> id boundary
+class Vocab:
+    """Token <-> id mapping (reference: the tokenizer-side vocab consumed
+    by faster_tokenizer; minimal core without the C++ tokenizer runtime)."""
+
+    def __init__(self, tokens: Sequence[str], unk_token: str = "[UNK]"):
+        self.unk_token = unk_token
+        toks = list(tokens)
+        if unk_token not in toks:
+            toks = [unk_token] + toks
+        self._id = {t: i for i, t in enumerate(toks)}
+        self._tok = toks
+
+    def __len__(self):
+        return len(self._tok)
+
+    def lookup(self, tokens) -> Tensor:
+        """tokens: StringTensor/list of tokens -> int32 ids Tensor."""
+        st = to_string_tensor(tokens)
+        unk = self._id[self.unk_token]
+        ids = np.asarray([self._id.get(s, unk) for s in st._data.ravel()],
+                         np.int32).reshape(st._data.shape)
+        return Tensor(ids)
+
+    def to_tokens(self, ids) -> StringTensor:
+        arr = np.asarray(ids._value if isinstance(ids, Tensor) else ids)
+        flat = [self._tok[int(i)] for i in arr.ravel()]
+        return StringTensor(np.asarray(flat, object).reshape(arr.shape))
+
+
+def tokenize(x, vocab: Vocab, lowercase: bool = True,
+             max_len: Optional[int] = None, pad_token: str = "[PAD]"):
+    """Whitespace tokenize + vocab lookup: StringTensor [b] -> ids
+    [b, max_len] int32 Tensor (the host half of the reference's
+    to-device text pipeline)."""
+    x = to_string_tensor(x)
+    rows = []
+    for s in x._data.ravel():
+        toks = (s.lower() if lowercase else s).split()
+        rows.append(toks)
+    if max_len is None:
+        max_len = max((len(r) for r in rows), default=0)
+    pad_id = vocab._id.get(pad_token, vocab._id[vocab.unk_token])
+    unk = vocab._id[vocab.unk_token]
+    out = np.full((len(rows), max_len), pad_id, np.int32)
+    for i, r in enumerate(rows):
+        for j, t in enumerate(r[:max_len]):
+            out[i, j] = vocab._id.get(t, unk)
+    return Tensor(out)

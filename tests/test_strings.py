@@ -1,0 +1,65 @@
+"""StringTensor + strings kernels (VERDICT r3 Missing #6).
+
+Reference: phi/core/string_tensor.h + phi/kernels/strings/
+(strings_lower_upper_kernel.h ASCII and UTF-8 case paths).
+"""
+import numpy as np
+
+from paddle_tpu.text import StringTensor, Vocab, strings, tokenize
+
+
+class TestStringTensor:
+    def test_shape_and_indexing(self):
+        st = StringTensor([["ab", "CD"], ["eF", "gh"]])
+        assert st.shape == (2, 2) and st.numel() == 4
+        assert st[0, 1] == "CD"
+        assert st[1].tolist() == ["eF", "gh"]
+        r = st.reshape([4])
+        assert r.shape == (4,)
+
+    def test_eq_produces_bool_tensor(self):
+        a = StringTensor(["x", "y", "z"])
+        b = StringTensor(["x", "q", "z"])
+        assert (a == b).numpy().tolist() == [True, False, True]
+
+
+class TestCaseKernels:
+    def test_lower_upper_utf8(self):
+        st = StringTensor(["HeLLo", "WÖRLD", "ß"])
+        assert strings.lower(st).tolist() == ["hello", "wörld", "ß"]
+        assert strings.upper(st).tolist() == ["HELLO", "WÖRLD", "SS"]
+
+    def test_lower_ascii_only_mode(self):
+        # non-utf8 path: ASCII letters fold, non-ASCII pass through
+        st = StringTensor(["AbÖ"])
+        assert strings.lower(st, use_utf8_encoding=False).tolist() == ["abÖ"]
+
+    def test_length_strip_split_concat(self):
+        st = StringTensor([" a b ", "cc"])
+        assert strings.length(st).numpy().tolist() == [5, 2]
+        assert strings.strip(st).tolist() == ["a b", "cc"]
+        assert strings.split(st) == [["a", "b"], ["cc"]]
+        both = strings.concat([st, StringTensor(["z"])])
+        assert both.shape == (3,)
+        assert strings.starts_with(st, " ").numpy().tolist() == [True, False]
+
+
+class TestVocabTokenize:
+    def test_lookup_roundtrip_and_unk(self):
+        v = Vocab(["[PAD]", "the", "cat"], unk_token="[UNK]")
+        ids = v.lookup(StringTensor(["the", "dog", "cat"]))
+        arr = ids.numpy()
+        assert arr.dtype == np.int32
+        assert arr[1] == 0  # UNK id (prepended)
+        toks = v.to_tokens(ids)
+        assert toks.tolist() == ["the", "[UNK]", "cat"]
+
+    def test_tokenize_pads_and_ids(self):
+        v = Vocab(["[PAD]", "the", "cat", "sat"])
+        out = tokenize(StringTensor(["The cat sat", "the cat"]), v,
+                       max_len=4)
+        arr = out.numpy()
+        assert arr.shape == (2, 4)
+        pad = v._id["[PAD]"]
+        assert arr[1, 2] == pad and arr[1, 3] == pad
+        assert (arr[0, :3] != pad).all()

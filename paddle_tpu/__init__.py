@@ -75,6 +75,7 @@ def add_n(inputs):
 
 
 from . import amp  # noqa: F401, E402
+from . import device  # noqa: F401, E402
 from . import nn  # noqa: F401, E402
 from . import optimizer  # noqa: F401, E402
 from . import io  # noqa: F401, E402

@@ -1,0 +1,140 @@
+"""Device memory/introspection surface.
+
+Reference: python/paddle/device/ — cuda.max_memory_allocated,
+memory_allocated, memory_reserved, empty_cache, synchronize, plus
+device_count/get_device. The reference reads its own allocator's pool
+stats; on TPU the allocator IS PJRT's, so the stats come from the
+device's memory_stats() (HBM pool counters XLA maintains) and the live
+jax.Array buffers — the "pool/stats surface for device memory" the
+round-3 inventory flagged as missing.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+
+
+def _dev(device=None) -> jax.Device:
+    if isinstance(device, jax.Device):
+        return device
+    devs = jax.devices()
+    if device is None:
+        return devs[0]
+    if isinstance(device, int):
+        return devs[device]
+    if isinstance(device, str):  # 'tpu:0' style
+        idx = int(device.split(":")[1]) if ":" in device else 0
+        return devs[idx]
+    place = getattr(device, "jax_device", None)
+    if callable(place):
+        return place()
+    raise TypeError(f"cannot resolve device from {device!r}")
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+def get_device() -> str:
+    d = jax.devices()[0]
+    return f"{d.platform}:{d.id}"
+
+
+def memory_stats(device=None) -> Dict[str, int]:
+    """Raw PJRT pool counters (bytes_in_use, peak_bytes_in_use,
+    bytes_limit, ... as the backend reports them); empty dict when the
+    backend exposes none (CPU)."""
+    d = _dev(device)
+    try:
+        return dict(d.memory_stats() or {})
+    except Exception:
+        return {}
+
+
+def memory_allocated(device=None) -> int:
+    """Bytes currently allocated on the device (reference
+    paddle.device.cuda.memory_allocated). Falls back to summing live
+    jax.Array buffers when the backend has no pool counters."""
+    stats = memory_stats(device)
+    if "bytes_in_use" in stats:
+        return int(stats["bytes_in_use"])
+    d = _dev(device)
+    total = 0
+    for arr in jax.live_arrays():
+        for sh in arr.addressable_shards:
+            if sh.device == d:
+                total += int(sh.data.nbytes)
+    return total
+
+
+def max_memory_allocated(device=None) -> int:
+    """Peak allocated bytes (reference cuda.max_memory_allocated)."""
+    stats = memory_stats(device)
+    return int(stats.get("peak_bytes_in_use", memory_allocated(device)))
+
+
+def memory_reserved(device=None) -> int:
+    """Pool-reserved bytes (reference cuda.memory_reserved); the PJRT
+    bytes_limit is the closest TPU analog of the reserved pool size."""
+    stats = memory_stats(device)
+    return int(stats.get("bytes_reserved",
+                         stats.get("bytes_limit", 0)))
+
+
+def max_memory_reserved(device=None) -> int:
+    stats = memory_stats(device)
+    return int(stats.get("peak_bytes_reserved", memory_reserved(device)))
+
+
+def empty_cache() -> None:
+    """Release framework-held dead buffers (reference cuda.empty_cache).
+    PJRT frees eagerly; a gc pass drops any Python-side dead references."""
+    import gc
+
+    gc.collect()
+
+
+def synchronize(device=None) -> None:
+    """Block until all queued device work is complete (reference
+    device.synchronize)."""
+    for arr in jax.live_arrays():
+        try:
+            arr.block_until_ready()
+        except Exception:
+            pass
+
+
+class cuda:
+    """paddle.device.cuda API-compat namespace: deployment code written
+    against the reference's CUDA memory surface works unchanged, resolving
+    to the accelerator that actually exists."""
+
+    max_memory_allocated = staticmethod(
+        lambda device=None: max_memory_allocated(device))
+    memory_allocated = staticmethod(
+        lambda device=None: memory_allocated(device))
+    max_memory_reserved = staticmethod(
+        lambda device=None: max_memory_reserved(device))
+    memory_reserved = staticmethod(
+        lambda device=None: memory_reserved(device))
+    empty_cache = staticmethod(lambda: empty_cache())
+    synchronize = staticmethod(lambda device=None: synchronize(device))
+    device_count = staticmethod(lambda: device_count())
+
+
+def live_buffer_report(device=None, top_k: int = 10) -> List[Dict]:
+    """Debug surface: the largest live device buffers (shape/dtype/bytes) —
+    what the reference's allocator debug dump provides for leak hunts."""
+    d = _dev(device)
+    rows = []
+    for arr in jax.live_arrays():
+        try:
+            if any(sh.device == d for sh in arr.addressable_shards):
+                rows.append({"shape": tuple(arr.shape),
+                             "dtype": str(arr.dtype),
+                             "nbytes": int(arr.nbytes)})
+        except Exception:
+            continue
+    rows.sort(key=lambda r: -r["nbytes"])
+    return rows[:top_k]

@@ -25,6 +25,49 @@ from typing import Dict, Optional
 import numpy as np
 
 
+class _Accessor:
+    """Per-table update rule (reference: the PS table accessors —
+    paddle/fluid/distributed/ps/table/ sparse_sgd_rule.cc SparseNaiveSGDRule
+    / SparseAdaGradSGDRule / SparseAdamSGDRule — which own the optimizer
+    state server-side). Rows-only state updates for sparse pushes."""
+
+    def __init__(self, kind: str, lr: float, shape, decay: float = 0.0,
+                 beta1=0.9, beta2=0.999, eps=1e-8):
+        if kind not in ("sgd", "adagrad", "adam"):
+            raise ValueError(f"unknown accessor {kind!r}")
+        self.kind = kind
+        self.lr = float(lr)
+        self.decay = float(decay)  # l2 decay folded into the gradient
+        self.b1, self.b2, self.eps = beta1, beta2, eps
+        if kind == "adagrad":
+            self.g2 = np.zeros(shape, np.float32)
+        elif kind == "adam":
+            self.m1 = np.zeros(shape, np.float32)
+            self.m2 = np.zeros(shape, np.float32)
+            self.b1p = np.ones((), np.float32)
+            self.b2p = np.ones((), np.float32)
+
+    def apply_dense(self, table, grad):
+        return self.apply_rows(table, slice(None), grad)
+
+    def apply_rows(self, table, rows, grad):
+        g = grad + self.decay * table[rows] if self.decay else grad
+        if self.kind == "sgd":
+            table[rows] -= self.lr * g
+        elif self.kind == "adagrad":
+            self.g2[rows] += g * g
+            table[rows] -= self.lr * g / (np.sqrt(self.g2[rows]) + self.eps)
+        else:  # adam (lazy over rows, reference SparseAdamSGDRule)
+            self.b1p *= self.b1
+            self.b2p *= self.b2
+            self.m1[rows] = self.b1 * self.m1[rows] + (1 - self.b1) * g
+            self.m2[rows] = self.b2 * self.m2[rows] + (1 - self.b2) * g * g
+            m1h = self.m1[rows] / (1 - self.b1p)
+            m2h = self.m2[rows] / (1 - self.b2p)
+            table[rows] -= self.lr * m1h / (np.sqrt(m2h) + self.eps)
+        return table
+
+
 class ParameterServer:
     """Runs inside the server process; the rpc layer invokes its methods.
 
@@ -35,18 +78,22 @@ class ParameterServer:
     """
 
     _tables: Dict[str, np.ndarray] = {}
-    _lrs: Dict[str, float] = {}
+    _accessors: Dict[str, _Accessor] = {}
     _locks: Dict[str, threading.Lock] = {}
     _meta_lock = threading.Lock()
 
     @classmethod
-    def create_table(cls, name: str, shape, lr: float = 0.1, init=None):
+    def create_table(cls, name: str, shape, lr: float = 0.1, init=None,
+                     optimizer: str = "sgd", decay: float = 0.0):
+        """Reference the_one_ps table config: each table carries its own
+        accessor (optimizer rule + state) and decay."""
         if init is None:
             rng = np.random.default_rng(abs(hash(name)) % (1 << 31))
             init = (rng.standard_normal(shape) * 0.01).astype(np.float32)
         with cls._meta_lock:
             cls._tables[name] = np.asarray(init, np.float32)
-            cls._lrs[name] = float(lr)
+            cls._accessors[name] = _Accessor(
+                optimizer, lr, cls._tables[name].shape, decay)
             cls._locks.setdefault(name, threading.Lock())
         return tuple(cls._tables[name].shape)
 
@@ -63,8 +110,8 @@ class ParameterServer:
     @classmethod
     def push_dense(cls, name: str, grad) -> None:
         with cls._lock(name):
-            cls._tables[name] = (
-                cls._tables[name] - cls._lrs[name] * np.asarray(grad))
+            cls._accessors[name].apply_dense(
+                cls._tables[name], np.asarray(grad, np.float32))
 
     @classmethod
     def pull_sparse(cls, name: str, ids) -> np.ndarray:
@@ -80,7 +127,16 @@ class ParameterServer:
         merged = np.zeros((len(uniq),) + grads.shape[1:], np.float32)
         np.add.at(merged, inv, grads)
         with cls._lock(name):
-            cls._tables[name][uniq] -= cls._lrs[name] * merged
+            cls._accessors[name].apply_rows(cls._tables[name], uniq, merged)
+
+    @classmethod
+    def table_stats(cls, name: str) -> Dict[str, float]:
+        """Accessor/stat surface (reference table->Pull/GetTableStat)."""
+        with cls._lock(name):
+            t = cls._tables[name]
+            acc = cls._accessors[name]
+            return {"shape": tuple(t.shape), "optimizer": acc.kind,
+                    "lr": acc.lr, "l2_norm": float(np.linalg.norm(t))}
 
 
 class PSWorker:
@@ -89,11 +145,18 @@ class PSWorker:
     def __init__(self, server_name: str = "ps0"):
         self.server = server_name
 
-    def create_table(self, name, shape, lr=0.1, init=None):
+    def create_table(self, name, shape, lr=0.1, init=None,
+                     optimizer="sgd", decay=0.0):
         from . import rpc
 
         return rpc.rpc_sync(self.server, ParameterServer.create_table,
-                            args=(name, shape, lr, init))
+                            args=(name, shape, lr, init, optimizer, decay))
+
+    def table_stats(self, name):
+        from . import rpc
+
+        return rpc.rpc_sync(self.server, ParameterServer.table_stats,
+                            args=(name,))
 
     def pull_dense(self, name):
         from . import rpc

@@ -13,6 +13,7 @@ from .optimizer import (  # noqa: F401, E402
     DGCMomentum,
     GradientMerge,
     LarsMomentum,
+    LocalSGD,
     LookAhead,
     ModelAverage,
 )

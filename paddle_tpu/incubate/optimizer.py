@@ -253,3 +253,64 @@ class GradientMerge:
     def clear_grad(self):
         for p in self.inner_optimizer._parameter_list:
             p.clear_grad()
+
+
+class LocalSGD:
+    """LocalSGD meta-optimizer (reference: fleet meta_optimizers/
+    localsgd_optimizer.py — workers take k local steps, then parameters are
+    averaged across the data-parallel group; adaptive variant shrinks k as
+    training converges).
+
+    TPU-native: the averaging is a compiled psum over the 'dp' mesh axis
+    (or a host all-reduce via the collective API when called eagerly);
+    between syncs the inner optimizer runs purely locally, cutting
+    inter-sync communication by k x vs per-step DP all-reduce.
+    """
+
+    def __init__(self, inner_optimizer, k_steps=4, group=None,
+                 begin_step=0):
+        self.inner_optimizer = inner_optimizer
+        self.k_steps = int(k_steps)
+        self.group = group
+        self.begin_step = int(begin_step)
+        self._count = 0
+
+    def __getattr__(self, item):
+        return getattr(self.inner_optimizer, item)
+
+    def minimize(self, loss):
+        loss.backward()
+        self.step()
+        return None, []
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._count += 1
+        if (self._count >= self.begin_step
+                and self._count % self.k_steps == 0):
+            self._average_parameters()
+            return True
+        return False
+
+    def _average_parameters(self):
+        from .. import distributed as dist
+
+        group = self.group
+        try:
+            n = dist.get_world_size(group) if group is not None \
+                else dist.get_world_size()
+        except Exception:
+            n = 1
+        if n <= 1:
+            return
+        for p in self.inner_optimizer._parameter_list:
+            t = Tensor(p._value)
+            dist.all_reduce(t, group=group)
+            p._value = (t._value / n).astype(p._value.dtype)
+
+    def clear_grad(self):
+        for p in self.inner_optimizer._parameter_list:
+            p.clear_grad()
+
+    def state_dict(self):
+        return self.inner_optimizer.state_dict()

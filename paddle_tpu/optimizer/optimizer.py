@@ -73,13 +73,58 @@ class Optimizer:
         """Hook for subclasses needing the param identity (e.g. AdamW's
         apply_decay_param_fun consults p.name)."""
 
+    def _update_sparse(self, p: Tensor, sr, state, lr):
+        """SelectedRows-gradient update (reference: the selected-rows
+        sgd/adam kernels, phi/kernels/selected_rows/). Default: densify and
+        run the dense rule — exact for every optimizer; SGD and lazy Adam
+        override with rows-only kernels that never materialize the dense
+        [height, width] gradient."""
+        gv = sr.to_dense()._value
+        if "master" in state:
+            import jax.numpy as jnp
+
+            new_master, new_state = self._update(
+                state["master"], gv.astype(jnp.float32), state, lr)
+            new_state["master"] = new_master
+            p._value = new_master.astype(p.dtype)
+            return new_state
+        new_p, new_state = self._update(p._value, gv, state, lr)
+        p._value = new_p
+        return new_state
+
     # ---- step --------------------------------------------------------------
     @no_grad()
     def step(self):
+        from ..framework.containers import SelectedRows
+
         lr = self.get_lr()
         params_grads = [(p, p.grad) for p in self._parameter_list if p.grad is not None and p.trainable]
+        sparse_pairs = [(p, g) for p, g in params_grads
+                        if isinstance(g, SelectedRows)]
+        params_grads = [(p, g) for p, g in params_grads
+                        if not isinstance(g, SelectedRows)]
         if self._grad_clip is not None:
+            # SelectedRows grads bypass clipping (reference: clip ops are
+            # dense; sparse tables clip per-accessor if at all)
             params_grads = self._grad_clip(params_grads)
+        for p, sr in sparse_pairs:
+            state = self._get_state(p)
+            if self._coupled_wd:
+                # coupled L2 touches EVERY row (wd * p is dense) — exactness
+                # requires the densified path
+                gv = sr.to_dense()._value
+                gv = gv + self._coupled_wd * p._value.astype(gv.dtype)
+                if "master" in state:
+                    new_master, new_state = self._update(
+                        state["master"], gv.astype(jnp.float32), state, lr)
+                    new_state["master"] = new_master
+                    p._value = new_master.astype(p.dtype)
+                else:
+                    new_p, new_state = self._update(p._value, gv, state, lr)
+                    p._value = new_p
+                self._state[id(p)] = new_state
+                continue
+            self._state[id(p)] = self._update_sparse(p, sr.merge(), state, lr)
         for p, g in params_grads:
             gv = g._value if isinstance(g, Tensor) else g
             state = self._get_state(p)

@@ -16,6 +16,22 @@ class SGD(Optimizer):
     def _update(self, p, g, state, lr):
         return p - lr * g.astype(p.dtype), {}
 
+    def _update_sparse(self, p, sr, state, lr):
+        """Rows-only SGD (reference phi/kernels/selected_rows/
+        sgd_kernel: update touches only the selected rows, never the
+        full table). multi_precision: the fp32 master is the source of
+        truth — update its rows and re-cast the parameter from it."""
+        rows = sr.rows
+        if "master" in state:
+            vals32 = sr.values._value.astype(jnp.float32)
+            state = dict(state)
+            state["master"] = state["master"].at[rows].add(-lr * vals32)
+            p._value = state["master"].astype(p.dtype)
+            return state
+        vals = sr.values._value.astype(p._value.dtype)
+        p._value = p._value.at[rows].add(-lr * vals)
+        return state
+
 
 class Momentum(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
@@ -43,6 +59,7 @@ class Adam(Optimizer):
                  lazy_mode=False, multi_precision=False, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._lazy_mode = lazy_mode
 
     def _init_state(self, p_value):
         return {
@@ -69,6 +86,46 @@ class Adam(Optimizer):
     def _update(self, p, g, state, lr):
         return self._adam_step(p, g, state, lr)
 
+    def _sparse_decoupled_wd(self, state):
+        return 0.0  # AdamW overrides with its per-param decoupled decay
+
+    def _update_sparse(self, p, sr, state, lr):
+        """Lazy-mode sparse Adam (reference adam lazy_mode + the
+        selected-rows adam kernel): moments of UNtouched rows stay frozen;
+        touched rows get the full adam rule (including decoupled decay and
+        multi_precision master rows). Without lazy_mode the exact dense
+        semantics (all moments decay every step) require densification —
+        the base-class fallback."""
+        if not getattr(self, "_lazy_mode", False):
+            return super()._update_sparse(p, sr, state, lr)
+        rows = sr.rows
+        g32 = sr.values._value.astype(jnp.float32)
+        b1p = state["beta1_pow"] * self._beta1
+        b2p = state["beta2_pow"] * self._beta2
+        m1r = self._beta1 * state["moment1"][rows] + (1 - self._beta1) * g32
+        m2r = (self._beta2 * state["moment2"][rows]
+               + (1 - self._beta2) * jnp.square(g32))
+        m1_hat = m1r / (1 - b1p)
+        m2_hat = m2r / (1 - b2p)
+        wd = self._sparse_decoupled_wd(state)
+        new_state = dict(state)  # preserve master/wd_on/any subclass keys
+        new_state.update(
+            moment1=state["moment1"].at[rows].set(m1r),
+            moment2=state["moment2"].at[rows].set(m2r),
+            beta1_pow=b1p, beta2_pow=b2p)
+        if "master" in state:
+            m = state["master"]
+            mrows = m[rows] * (1.0 - lr * wd)
+            mrows = mrows - lr * m1_hat / (jnp.sqrt(m2_hat) + self._eps)
+            new_state["master"] = m.at[rows].set(mrows)
+            p._value = new_state["master"].astype(p.dtype)
+            return new_state
+        pv = p._value
+        prows = pv[rows].astype(jnp.float32) * (1.0 - lr * wd)
+        prows = prows - lr * m1_hat / (jnp.sqrt(m2_hat) + self._eps)
+        p._value = pv.at[rows].set(prows.astype(pv.dtype))
+        return new_state
+
 
 class AdamW(Adam):
     """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py)."""
@@ -92,6 +149,9 @@ class AdamW(Adam):
         new_p, ns = self._adam_step(p, g, state, lr, decoupled_wd=wd)
         ns["wd_on"] = state.get("wd_on", 1.0)
         return new_p, ns
+
+    def _sparse_decoupled_wd(self, state):
+        return self._decoupled_wd * state.get("wd_on", 1.0)
 
     def step(self):
         """Eager step with the fused Pallas path on TPU: all params of one

@@ -68,8 +68,19 @@ def subm_conv(x: SparseCooTensor, weight, bias=None, stride=1, padding=0,
               dilation=1) -> SparseCooTensor:
     """Submanifold sparse convolution (reference Conv3dCoo with subm=True):
     output sites == input sites, so no site dilation across layers. weight:
-    [*kernel, C_in, C_out]; x: COO (N, *spatial, C_in) channels-last."""
+    [*kernel, C_in, C_out]; x: COO (N, *spatial, C_in) channels-last.
+
+    Submanifold convs are DEFINED at stride 1 with site-preserving
+    padding; non-default stride/padding would silently change semantics,
+    so they are rejected (use sparse_conv for strided downsampling)."""
     w = weight._value if isinstance(weight, Tensor) else jnp.asarray(weight)
+    d_chk = w.ndim - 2
+    if _tuplize(stride, d_chk) != (1,) * d_chk or \
+            _tuplize(padding, d_chk) != (0,) * d_chk:
+        raise ValueError(
+            "subm_conv is stride-1/site-preserving by definition; got "
+            f"stride={stride}, padding={padding} — use sparse_conv for "
+            "strided convolution")
     d = w.ndim - 2
     ksize = w.shape[:d]
     dil = _tuplize(dilation, d)

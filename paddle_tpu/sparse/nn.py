@@ -80,6 +80,10 @@ class _SparseConvNd(_Layer):
         self.bias = None
         if bias_attr is not False:
             self.bias = self.create_parameter([out_channels], is_bias=True)
+        if self._subm and (stride != 1 or padding != 0):
+            raise ValueError(
+                "SubmConv is stride-1/site-preserving; use Conv for "
+                "strided downsampling")
         self.stride = stride
         self.padding = padding
         self.dilation = dilation
@@ -140,8 +144,12 @@ class BatchNorm(_Layer):
         self.weight = self.create_parameter(
             [num_features], default_initializer=I.Constant(1.0))
         self.bias = self.create_parameter([num_features], is_bias=True)
-        self._mean = Tensor(np.zeros(num_features, np.float32))
-        self._variance = Tensor(np.ones(num_features, np.float32))
+        # registered buffers: running stats must survive state_dict
+        # round-trips and follow Engine buffer placement, like dense BN
+        self.register_buffer("_mean",
+                             Tensor(np.zeros(num_features, np.float32)))
+        self.register_buffer("_variance",
+                             Tensor(np.ones(num_features, np.float32)))
         self.momentum = momentum
         self.epsilon = epsilon
 

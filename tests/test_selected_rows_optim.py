@@ -1,0 +1,138 @@
+"""SelectedRows optimizer kernels + PS accessors (VERDICT r3 partials
+#15/#48). Reference: phi/kernels/selected_rows/ (sgd, adam w/ lazy_mode)
+and fluid/distributed/ps/table sparse SGD rules.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import SelectedRows
+
+
+def _param(h=6, w=4, seed=0):
+    rng = np.random.default_rng(seed)
+    p = paddle.to_tensor(rng.standard_normal((h, w)).astype(np.float32),
+                         stop_gradient=False)
+    return p
+
+
+def _sparse_grad(rows, w=4, seed=1, h=6):
+    rng = np.random.default_rng(seed)
+    vals = rng.standard_normal((len(rows), w)).astype(np.float32)
+    return SelectedRows(np.asarray(rows, np.int32),
+                        paddle.to_tensor(vals), h)
+
+
+class TestSparseSGD:
+    def test_rows_only_update_with_duplicate_merge(self):
+        p = _param()
+        before = p.numpy().copy()
+        sr = _sparse_grad([1, 1, 3])
+        p._grad = sr
+        opt = paddle.optimizer.SGD(0.5, parameters=[p])
+        opt.step()
+        after = p.numpy()
+        vals = np.asarray(sr.values._value)
+        # duplicate rows accumulate (SelectedRows merge rule)
+        np.testing.assert_allclose(after[1], before[1] - 0.5 * (vals[0] + vals[1]),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(after[3], before[3] - 0.5 * vals[2],
+                                   rtol=1e-5, atol=1e-6)
+        # untouched rows unchanged
+        for r in (0, 2, 4, 5):
+            np.testing.assert_array_equal(after[r], before[r])
+
+
+class TestSparseAdam:
+    def test_lazy_mode_freezes_untouched_moments(self):
+        p = _param(seed=2)
+        opt = paddle.optimizer.Adam(0.1, parameters=[p], lazy_mode=True)
+        p._grad = _sparse_grad([0, 2], seed=3)
+        opt.step()
+        st = opt._state[id(p)]
+        m1 = np.asarray(st["moment1"])
+        assert np.abs(m1[[0, 2]]).sum() > 0
+        assert np.abs(m1[[1, 3, 4, 5]]).sum() == 0  # untouched rows frozen
+        before = p.numpy().copy()
+        p._grad = _sparse_grad([1], seed=4)
+        opt.step()
+        after = p.numpy()
+        assert not np.allclose(after[1], before[1])
+        np.testing.assert_array_equal(after[0], before[0])  # not re-updated
+
+    def test_dense_fallback_matches_densified_grad(self):
+        # non-lazy adam on a sparse grad == adam on the densified grad
+        pa, pb = _param(seed=5), _param(seed=5)
+        sr = _sparse_grad([1, 4], seed=6)
+        oa = paddle.optimizer.Adam(0.05, parameters=[pa])
+        ob = paddle.optimizer.Adam(0.05, parameters=[pb])
+        pa._grad = sr
+        pb._grad = sr.to_dense()
+        oa.step()
+        ob.step()
+        np.testing.assert_allclose(pa.numpy(), pb.numpy(), rtol=1e-6)
+
+
+class TestPSAccessors:
+    def test_adagrad_and_adam_accessors_in_process(self):
+        from paddle_tpu.distributed.ps import ParameterServer as PS
+
+        init = np.ones((4, 2), np.float32)
+        PS.create_table("t_ada", (4, 2), lr=0.5, init=init.copy(),
+                        optimizer="adagrad")
+        g = np.full((2, 2), 2.0, np.float32)
+        PS.push_sparse("t_ada", np.array([0, 1]), g)
+        t = PS.pull_sparse("t_ada", np.array([0, 1, 2]))
+        # adagrad: x - lr*g/(sqrt(g^2)+eps) = 1 - 0.5*2/2 = 0.5
+        np.testing.assert_allclose(t[:2], 0.5, atol=1e-4)
+        np.testing.assert_allclose(t[2], 1.0)
+
+        PS.create_table("t_adam", (4, 2), lr=0.1, init=init.copy(),
+                        optimizer="adam")
+        PS.push_dense("t_adam", np.full((4, 2), 1.0, np.float32))
+        t = PS.pull_dense("t_adam")
+        # first adam step moves by ~lr regardless of grad scale
+        np.testing.assert_allclose(t, 1.0 - 0.1, atol=1e-3)
+
+        stats = PS.table_stats("t_adam")
+        assert stats["optimizer"] == "adam" and stats["shape"] == (4, 2)
+
+    def test_decay_folds_into_gradient(self):
+        from paddle_tpu.distributed.ps import ParameterServer as PS
+
+        init = np.full((2, 2), 2.0, np.float32)
+        PS.create_table("t_l2", (2, 2), lr=0.1, init=init.copy(),
+                        optimizer="sgd", decay=0.5)
+        PS.push_dense("t_l2", np.zeros((2, 2), np.float32))
+        t = PS.pull_dense("t_l2")
+        # g' = 0 + 0.5*2 = 1 -> x = 2 - 0.1 = 1.9
+        np.testing.assert_allclose(t, 1.9, atol=1e-6)
+
+
+class TestLocalSGD:
+    def test_sync_cadence_and_local_steps(self):
+        from paddle_tpu.incubate import LocalSGD
+
+        p = _param(seed=9)
+        inner = paddle.optimizer.SGD(0.1, parameters=[p])
+        opt = LocalSGD(inner, k_steps=3)
+        synced = []
+        opt._average_parameters = lambda: synced.append(opt._count)
+        for i in range(7):
+            p._grad = paddle.to_tensor(np.ones((6, 4), np.float32))
+            opt.step()
+            opt.clear_grad()
+        # averaging fires exactly at steps 3 and 6
+        assert synced == [3, 6]
+        # local SGD really stepped every time
+        np.testing.assert_allclose(
+            p.numpy(), _param(seed=9).numpy() - 0.7, atol=1e-5)
+
+    def test_world1_average_is_identity(self):
+        from paddle_tpu.incubate import LocalSGD
+
+        p = _param(seed=10)
+        before = p.numpy().copy()
+        opt = LocalSGD(paddle.optimizer.SGD(0.1, parameters=[p]), k_steps=1)
+        opt._average_parameters()
+        np.testing.assert_array_equal(p.numpy(), before)

@@ -46,6 +46,26 @@ def get_worker_info() -> Optional[WorkerInfo]:
     return getattr(_worker_info, "info", None)
 
 
+def _stack(arrays):
+    """np.stack with the native collate hot loop (native/src/feed.cc
+    pt_feed_stack) for big batches — the C++ feed path of the reference's
+    reader pipeline."""
+    first = arrays[0]
+    total = first.nbytes * len(arrays)
+    # shape/dtype uniformity guard: np.stack fails loud on ragged batches;
+    # the native path must too (it copies first.nbytes from every pointer)
+    uniform = all(a.shape == first.shape and a.dtype == first.dtype
+                  for a in arrays)
+    if uniform and total >= (1 << 20):
+        from .. import native
+
+        if native.available():
+            out = np.empty((len(arrays),) + first.shape, first.dtype)
+            native.feed_stack(arrays, out)
+            return out
+    return np.stack(arrays)
+
+
 def default_collate_fn(batch):
     sample = batch[0]
     if isinstance(sample, (tuple, list)):
@@ -53,9 +73,9 @@ def default_collate_fn(batch):
     if isinstance(sample, dict):
         return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
     if isinstance(sample, Tensor):
-        return Tensor(np.stack([np.asarray(s._value) for s in batch]))
+        return Tensor(_stack([np.asarray(s._value) for s in batch]))
     if isinstance(sample, np.ndarray):
-        return Tensor(np.stack(batch))
+        return Tensor(_stack(batch))
     if isinstance(sample, (int, float, np.integer, np.floating)):
         return Tensor(np.asarray(batch))
     return batch

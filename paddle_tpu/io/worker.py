@@ -93,8 +93,12 @@ def _tree_arrays(tree, out):
 
 
 def _pack(tree, seg):
-    """Replace large arrays with _ShmRef into `seg` (sequential offsets)."""
+    """Replace large arrays with _ShmRef into `seg` (sequential offsets).
+    The copy wall runs through the native feed path (native/src/feed.cc,
+    one batched call, multithreaded memcpy) when the library is present —
+    the reference's C++ reader pipeline role; numpy otherwise."""
     offset = [0]
+    pending = []  # arrays to copy, in offset order
 
     def rec(t):
         if isinstance(t, tuple):
@@ -105,12 +109,23 @@ def _pack(tree, seg):
             return {k: rec(v) for k, v in t.items()}
         if isinstance(t, np.ndarray) and t.nbytes >= _SHM_MIN_BYTES:
             o = offset[0]
-            np.ndarray(t.shape, t.dtype, buffer=seg.buf, offset=o)[...] = t
+            pending.append(t)
             offset[0] = o + t.nbytes
             return _ShmRef(o, t.shape, t.dtype)
         return t
 
-    return rec(tree)
+    out = rec(tree)
+    if pending:
+        from .. import native
+
+        if native.available():
+            native.feed_pack(pending, seg.buf)
+        else:
+            o = 0
+            for t in pending:
+                np.ndarray(t.shape, t.dtype, buffer=seg.buf, offset=o)[...] = t
+                o += t.nbytes
+    return out
 
 
 def _unpack(tree, buf, to_tensor):
@@ -121,8 +136,14 @@ def _unpack(tree, buf, to_tensor):
     if isinstance(tree, dict):
         return {k: _unpack(v, buf, to_tensor) for k, v in tree.items()}
     if isinstance(tree, _ShmRef):
-        arr = np.ndarray(tree.shape, tree.dtype, buffer=buf,
-                         offset=tree.offset).copy()
+        from .. import native
+
+        if native.available():
+            arr = native.feed_copy_out(buf, tree.offset, tree.shape,
+                                       tree.dtype)
+        else:
+            arr = np.ndarray(tree.shape, tree.dtype, buffer=buf,
+                             offset=tree.offset).copy()
         return to_tensor(arr)
     if isinstance(tree, np.ndarray):
         return to_tensor(tree)

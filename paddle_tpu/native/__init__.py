@@ -24,7 +24,7 @@ import threading
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC_DIR = os.path.join(_HERE, "src")
 _LIB_DIR = os.path.join(_HERE, "_lib")
-_SOURCES = ("tcp_store.cc", "tracer.cc", "arena.cc")
+_SOURCES = ("tcp_store.cc", "tracer.cc", "arena.cc", "feed.cc")
 
 _lib = None
 _lib_err: str | None = None
@@ -94,6 +94,16 @@ def _bind(lib: ctypes.CDLL) -> None:
         c.POINTER(c.c_uint64), c.POINTER(c.c_int64),
     ]
     lib.pt_trace_get_span.restype = c.c_int
+    # feed (native data-pipeline copies)
+    lib.pt_feed_pack.argtypes = [
+        c.POINTER(c.c_void_p), c.POINTER(c.c_uint64), c.c_int, c.c_void_p,
+    ]
+    lib.pt_feed_pack.restype = c.c_uint64
+    lib.pt_feed_stack.argtypes = [
+        c.POINTER(c.c_void_p), c.c_uint64, c.c_int, c.c_void_p,
+    ]
+    lib.pt_feed_stack.restype = c.c_uint64
+    lib.pt_feed_copy.argtypes = [c.c_void_p, c.c_void_p, c.c_uint64]
     # arena
     lib.pt_arena_create.argtypes = [c.c_uint64]
     lib.pt_arena_create.restype = c.c_void_p
@@ -390,3 +400,59 @@ class HostArena:
             self.close()
         except Exception:  # noqa: BLE001,S110 — interpreter teardown
             pass
+
+
+# ---- native feed path (reference: the C++ reader/data pipeline) -----------
+def feed_pack(arrays, dst_buf) -> int:
+    """Copy `arrays` (contiguous numpy) into `dst_buf` (writable buffer,
+    e.g. a SharedMemory.buf) at sequential offsets with one native call.
+    Returns total bytes written."""
+    import numpy as np
+
+    lib = get_lib()
+    n = len(arrays)
+    srcs = (ctypes.c_void_p * n)()
+    sizes = (ctypes.c_uint64 * n)()
+    keepalive = []
+    total = 0
+    for i, a in enumerate(arrays):
+        a = np.ascontiguousarray(a)
+        keepalive.append(a)
+        srcs[i] = a.ctypes.data
+        sizes[i] = a.nbytes
+        total += a.nbytes
+    if total > len(dst_buf):
+        raise ValueError(
+            f"feed_pack: {total} bytes do not fit the {len(dst_buf)}-byte "
+            "destination buffer")
+    dst = (ctypes.c_char * len(dst_buf)).from_buffer(dst_buf)
+    return int(lib.pt_feed_pack(srcs, sizes, n, ctypes.addressof(dst)))
+
+
+def feed_stack(samples, out) -> None:
+    """Collate equal-shape samples into the preallocated `out` batch array
+    (out.shape[0] == len(samples)) with one native call."""
+    import numpy as np
+
+    lib = get_lib()
+    m = len(samples)
+    ptrs = (ctypes.c_void_p * m)()
+    keepalive = []
+    for i, s in enumerate(samples):
+        s = np.ascontiguousarray(s)
+        keepalive.append(s)
+        ptrs[i] = s.ctypes.data
+    lib.pt_feed_stack(ptrs, keepalive[0].nbytes, m,
+                      out.ctypes.data_as(ctypes.c_void_p))
+
+
+def feed_copy_out(buf, offset, shape, dtype):
+    """Copy a packed region out of a shm buffer into a fresh array."""
+    import numpy as np
+
+    lib = get_lib()
+    out = np.empty(shape, dtype)
+    base = ctypes.addressof((ctypes.c_char * len(buf)).from_buffer(buf))
+    lib.pt_feed_copy(ctypes.c_void_p(base + offset),
+                     out.ctypes.data_as(ctypes.c_void_p), out.nbytes)
+    return out

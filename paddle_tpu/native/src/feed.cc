@@ -1,0 +1,79 @@
+// Native feed path: batch packing/stacking for the DataLoader's
+// shared-memory slot rings.
+//
+// Reference analog: the C++ data pipeline feeding the executor
+// (paddle/fluid/operators/reader/ + the DataLoader's C++ workers) — the
+// copy-into-shared-memory hot loop runs native there, not in Python.
+// Here: pt_feed_pack copies a batch's tensor buffers into a shm segment
+// at sequential offsets (multithreaded for large batches), and
+// pt_feed_stack collates equal-shape samples into one contiguous batch
+// buffer — the two memcpy walls of the input pipeline.
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint64_t kParallelThreshold = 8ull << 20;  // 8 MiB
+constexpr int kMaxThreads = 4;
+
+void copy_range(char* dst, const char* src, uint64_t n) {
+  std::memcpy(dst, src, n);
+}
+
+void parallel_copy(char* dst, const char* src, uint64_t n) {
+  if (n < kParallelThreshold) {
+    std::memcpy(dst, src, n);
+    return;
+  }
+  unsigned hw = std::thread::hardware_concurrency();
+  int nthreads = hw > 1 ? (hw > (unsigned)kMaxThreads ? kMaxThreads : (int)hw)
+                        : 1;
+  if (nthreads <= 1) {
+    std::memcpy(dst, src, n);
+    return;
+  }
+  std::vector<std::thread> ts;
+  uint64_t chunk = n / nthreads;
+  for (int t = 0; t < nthreads; ++t) {
+    uint64_t off = (uint64_t)t * chunk;
+    uint64_t len = (t == nthreads - 1) ? n - off : chunk;
+    ts.emplace_back(copy_range, dst + off, src + off, len);
+  }
+  for (auto& th : ts) th.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// Copy n buffers into dst at sequential offsets. Returns total bytes.
+uint64_t pt_feed_pack(const void** srcs, const uint64_t* sizes, int n,
+                      void* dst) {
+  uint64_t off = 0;
+  for (int i = 0; i < n; ++i) {
+    parallel_copy(static_cast<char*>(dst) + off,
+                  static_cast<const char*>(srcs[i]), sizes[i]);
+    off += sizes[i];
+  }
+  return off;
+}
+
+// Stack m equal-size samples contiguously into dst (the collate hot loop).
+uint64_t pt_feed_stack(const void** samples, uint64_t sample_bytes, int m,
+                       void* dst) {
+  for (int i = 0; i < m; ++i) {
+    parallel_copy(static_cast<char*>(dst) + (uint64_t)i * sample_bytes,
+                  static_cast<const char*>(samples[i]), sample_bytes);
+  }
+  return (uint64_t)m * sample_bytes;
+}
+
+// Copy out of a shm segment (unpack side).
+void pt_feed_copy(const void* src, void* dst, uint64_t nbytes) {
+  parallel_copy(static_cast<char*>(dst), static_cast<const char*>(src),
+                nbytes);
+}
+
+}  // extern "C"

@@ -110,19 +110,17 @@ class Optimizer:
         for p, sr in sparse_pairs:
             state = self._get_state(p)
             if self._coupled_wd:
-                # coupled L2 touches EVERY row (wd * p is dense) — exactness
-                # requires the densified path
+                # coupled L2 touches EVERY row (wd * p is dense): route
+                # through the base densify path by handing it a full-height
+                # SelectedRows carrying grad + wd*p
+                from ..framework.containers import SelectedRows as _SR
+
                 gv = sr.to_dense()._value
                 gv = gv + self._coupled_wd * p._value.astype(gv.dtype)
-                if "master" in state:
-                    new_master, new_state = self._update(
-                        state["master"], gv.astype(jnp.float32), state, lr)
-                    new_state["master"] = new_master
-                    p._value = new_master.astype(p.dtype)
-                else:
-                    new_p, new_state = self._update(p._value, gv, state, lr)
-                    p._value = new_p
-                self._state[id(p)] = new_state
+                h = sr.height
+                sr = _SR(jnp.arange(h, dtype=jnp.int32), Tensor(gv), h)
+                self._state[id(p)] = Optimizer._update_sparse(
+                    self, p, sr, state, lr)
                 continue
             self._state[id(p)] = self._update_sparse(p, sr.merge(), state, lr)
         for p, g in params_grads:

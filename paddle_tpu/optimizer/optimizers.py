@@ -162,11 +162,15 @@ class AdamW(Adam):
 
         from ..core import flags as _flags
 
+        from ..framework.containers import SelectedRows
+
         if not (
             _flags.get_flag("use_fused_adamw")
             and jax.default_backend() == "tpu"
             and not self._multi_precision
-        ):
+        ) or any(isinstance(p.grad, SelectedRows)
+                 for p in self._parameter_list):
+            # SelectedRows grads take the base class's sparse routing
             return super().step()
 
         from ..core.autograd import no_grad

@@ -26,3 +26,5 @@ __all__ = [
     "QuantedLinear",
     "QuantedConv2D",
 ]
+
+from .weight_only import quantize_for_generation  # noqa: E402,F401

@@ -80,7 +80,11 @@ class _SparseConvNd(_Layer):
         self.bias = None
         if bias_attr is not False:
             self.bias = self.create_parameter([out_channels], is_bias=True)
-        if self._subm and (stride != 1 or padding != 0):
+        def _norm(v):
+            return tuple(v) if isinstance(v, (tuple, list)) else (v,) * d
+
+        if self._subm and (_norm(stride) != (1,) * d
+                           or _norm(padding) != (0,) * d):
             raise ValueError(
                 "SubmConv is stride-1/site-preserving; use Conv for "
                 "strided downsampling")

@@ -256,20 +256,65 @@ def _probe_tpu(timeout_s=240, attempts=3) -> bool:
     return False
 
 
+def _measured_best_preset():
+    """If tools/mfu_probe.py has produced chip measurements this round
+    (MFU_PROBE.jsonl), lead with the preset matching the best-measured
+    config instead of the static guess."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "MFU_PROBE.jsonl")
+    best = None
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if row.get("backend") in ("cpu", None):
+                    continue
+                if best is None or row["mfu"] > best["mfu"]:
+                    best = row
+    except OSError:
+        return None
+    if best is None:
+        return None
+    # map the measured knobs onto the closest declared preset; the flash
+    # knob rides along as env (a flash-OFF measurement must not promote a
+    # flash-ON run of the same shape)
+    for name, p in PRESETS.items():
+        if name == "cpu":
+            continue
+        if (p.get("o2", False) == best.get("o2", False)
+                and p["batch"] == best.get("batch")
+                and p.get("recompute", False) == best.get("recompute", False)
+                and p["seq"] == best.get("seq")):
+            env = None
+            if not best.get("flash", True):
+                env = {"FLAGS_use_flash_attention": "0"}
+            log(f"measured-best preset: {name} (mfu={best['mfu']}, "
+                f"flash={best.get('flash', True)})")
+            return name, env
+    return None
+
+
 def main() -> int:
     """Parent: probe the accelerator, then try presets in order inside
     timeout-bounded subprocesses; ALWAYS print one JSON line."""
     attempts = []
     force_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
     if not force_cpu and _probe_tpu():
-        attempts += [("large_o2b32", None, None), ("large_o2b16", None, None),
-                     ("large", None, None), ("medium", None, None),
-                     ("small", None, None),
-                     # A Pallas kernel bug must never erase the round's TPU
-                     # evidence: retry once with flash attention off so the
-                     # XLA sdpa path still produces a genuine TPU number
-                     # (VERDICT r02 weak #2).
-                     ("small", None, {"FLAGS_use_flash_attention": "0"})]
+        order = ["large_o2b32", "large_o2b16", "large", "medium", "small"]
+        best = _measured_best_preset()
+        if best is not None and best[0] in order:
+            name, env = best
+            order.remove(name)
+            attempts.append((name, None, env))
+        attempts += [(name, None, None) for name in order]
+        # A Pallas kernel bug must never erase the round's TPU
+        # evidence: retry once with flash attention off so the
+        # XLA sdpa path still produces a genuine TPU number
+        # (VERDICT r02 weak #2).
+        attempts += [("small", None, {"FLAGS_use_flash_attention": "0"})]
     attempts += [("cpu", "cpu", None)]
 
     last_err = ""

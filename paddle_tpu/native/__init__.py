@@ -43,9 +43,16 @@ def _build() -> str:
     os.makedirs(_LIB_DIR, exist_ok=True)
     so_path = os.path.join(_LIB_DIR, f"libpt_native_{_source_hash()}.so")
     if os.path.exists(so_path):
-        return so_path
+        try:  # a cached file must actually load (a racer may have
+            ctypes.CDLL(so_path)  # published a corrupt link product)
+            return so_path
+        except OSError:
+            pass
     srcs = [os.path.join(_SRC_DIR, s) for s in _SOURCES]
-    tmp = so_path + ".tmp"
+    # per-PID tmp: DataLoader workers may build concurrently across
+    # PROCESSES (the threading lock cannot serialize them); each links its
+    # own file and os.replace publishes atomically, last writer wins
+    tmp = so_path + f".{os.getpid()}.tmp"
     cmd = [
         "g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-pthread",
         "-Wall", *srcs, "-o", tmp,
@@ -440,8 +447,18 @@ def feed_stack(samples, out) -> None:
     keepalive = []
     for i, s in enumerate(samples):
         s = np.ascontiguousarray(s)
+        if s.shape != samples[0].shape or s.dtype != samples[0].dtype:
+            raise ValueError(
+                "feed_stack: samples must share shape/dtype "
+                f"(sample {i}: {s.shape}/{s.dtype} vs "
+                f"{samples[0].shape}/{samples[0].dtype})")
         keepalive.append(s)
         ptrs[i] = s.ctypes.data
+    if not out.flags.c_contiguous or out.shape[0] != m \
+            or out.nbytes != m * keepalive[0].nbytes:
+        raise ValueError(
+            "feed_stack: out must be C-contiguous [m, *sample.shape] "
+            f"(got shape {out.shape}, nbytes {out.nbytes})")
     lib.pt_feed_stack(ptrs, keepalive[0].nbytes, m,
                       out.ctypes.data_as(ctypes.c_void_p))
 

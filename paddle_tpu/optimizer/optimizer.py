@@ -73,13 +73,10 @@ class Optimizer:
         """Hook for subclasses needing the param identity (e.g. AdamW's
         apply_decay_param_fun consults p.name)."""
 
-    def _update_sparse(self, p: Tensor, sr, state, lr):
-        """SelectedRows-gradient update (reference: the selected-rows
-        sgd/adam kernels, phi/kernels/selected_rows/). Default: densify and
-        run the dense rule — exact for every optimizer; SGD and lazy Adam
-        override with rows-only kernels that never materialize the dense
-        [height, width] gradient."""
-        gv = sr.to_dense()._value
+    def _apply_dense(self, p: Tensor, gv, state, lr):
+        """Run the dense update rule on gradient values `gv`, routing
+        through the fp32 master when present. Shared by the dense step
+        loop, the sparse densify fallback, and the coupled-wd sparse path."""
         if "master" in state:
             import jax.numpy as jnp
 
@@ -91,6 +88,14 @@ class Optimizer:
         new_p, new_state = self._update(p._value, gv, state, lr)
         p._value = new_p
         return new_state
+
+    def _update_sparse(self, p: Tensor, sr, state, lr):
+        """SelectedRows-gradient update (reference: the selected-rows
+        sgd/adam kernels, phi/kernels/selected_rows/). Default: densify and
+        run the dense rule — exact for every optimizer; SGD and lazy Adam
+        override with rows-only kernels that never materialize the dense
+        [height, width] gradient."""
+        return self._apply_dense(p, sr.to_dense()._value, state, lr)
 
     # ---- step --------------------------------------------------------------
     @no_grad()
@@ -110,17 +115,11 @@ class Optimizer:
         for p, sr in sparse_pairs:
             state = self._get_state(p)
             if self._coupled_wd:
-                # coupled L2 touches EVERY row (wd * p is dense): route
-                # through the base densify path by handing it a full-height
-                # SelectedRows carrying grad + wd*p
-                from ..framework.containers import SelectedRows as _SR
-
+                # coupled L2 touches EVERY row (wd * p is dense): densify
+                # once and run the shared dense rule
                 gv = sr.to_dense()._value
                 gv = gv + self._coupled_wd * p._value.astype(gv.dtype)
-                h = sr.height
-                sr = _SR(jnp.arange(h, dtype=jnp.int32), Tensor(gv), h)
-                self._state[id(p)] = Optimizer._update_sparse(
-                    self, p, sr, state, lr)
+                self._state[id(p)] = self._apply_dense(p, gv, state, lr)
                 continue
             self._state[id(p)] = self._update_sparse(p, sr.merge(), state, lr)
         for p, g in params_grads:

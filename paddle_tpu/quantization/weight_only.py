@@ -33,6 +33,17 @@ def _quantize_linear_like(layer, kind: str) -> None:
     layer.weight = None
     layer.register_buffer("quant_weight", Tensor(q))
     layer.register_buffer("quant_scales", Tensor(s.astype(jnp.float32)))
+    # the int8 tables inherit the fp weight's TP layout, or a TP serving
+    # run would replicate every table and lose the sharded matmul
+    from ..distributed.mesh import annotate_param
+    from jax.sharding import PartitionSpec as P
+
+    if kind == "column":
+        annotate_param(layer.quant_weight, P(None, "mp"))
+        annotate_param(layer.quant_scales, P("mp"))
+    elif kind == "row":
+        annotate_param(layer.quant_weight, P("mp", None))
+        annotate_param(layer.quant_scales, P())
 
     if kind == "column":
         def fwd(self, x):

@@ -1,0 +1,96 @@
+"""Autoregressive decode throughput: tokens/s for the compiled KV-cache
+single-token step, fp vs int8 weight-only.
+
+Usage: python tools/decodebench.py [--preset small|large] [--out FILE]
+
+Reference process analog: the serving benchmarks around
+fused_multi_transformer (fp16/int8) — per-token latency of the cached
+decode step at a given batch/context.
+
+Appends one JSON line per measured config to DECODEBENCH.jsonl (or --out)
+the moment it is measured, same evidence discipline as mfu_probe.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+PRESETS = {
+    # ~15M params — CI-sized
+    "small": dict(hidden=256, layers=4, heads=8, vocab=8192,
+                  batch=8, prompt=128, new=64, max_pos=512),
+    # ~355M params — the bench.py flagship class
+    "large": dict(hidden=1024, layers=24, heads=16, vocab=50304,
+                  batch=8, prompt=512, new=128, max_pos=1024),
+}
+
+
+def measure(name, quant, hidden, layers, heads, vocab, batch, prompt, new,
+            max_pos, out_path):
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+                    num_heads=heads, max_position_embeddings=max_pos,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    if quant:
+        from paddle_tpu.quantization import quantize_for_generation
+
+        quantize_for_generation(model)
+    ids = paddle.to_tensor(np.random.default_rng(0).integers(
+        0, vocab, (batch, prompt)).astype(np.int32))
+
+    t0 = time.time()
+    out = model.generate(ids, max_new_tokens=new)
+    # value fetch = real sync (tunnel transports lie to block_until_ready)
+    _ = int(np.asarray(out._value)[0, -1])
+    first = time.time() - t0
+    # second run reuses every compiled program: pure decode throughput
+    t0 = time.time()
+    out = model.generate(ids, max_new_tokens=new)
+    _ = int(np.asarray(out._value)[0, -1])
+    dt = time.time() - t0
+    tps = batch * new / dt
+    row = {
+        "config": name, "quant": "int8" if quant else "fp",
+        "backend": jax.default_backend(),
+        "batch": batch, "prompt": prompt, "new_tokens": new,
+        "decode_tokens_per_sec": round(tps, 1),
+        "ms_per_token": round(1e3 * dt / new, 3),
+        "first_call_s": round(first, 1),
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    print(json.dumps(row), flush=True)
+    with open(out_path, "a") as f:
+        f.write(json.dumps(row) + "\n")
+    return row
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", default="small", choices=sorted(PRESETS))
+    ap.add_argument("--out", default=os.path.join(_REPO, "DECODEBENCH.jsonl"))
+    ap.add_argument("--skip-int8", action="store_true")
+    args = ap.parse_args()
+    p = PRESETS[args.preset]
+    measure(args.preset, False, out_path=args.out, **p)
+    if not args.skip_int8:
+        measure(args.preset, True, out_path=args.out, **p)
+
+
+if __name__ == "__main__":
+    main()

@@ -31,6 +31,8 @@ print('probe ok', float(x[0,0]))" >> "$LOG" 2>&1
     echo "[$(date -u +%T)] opbench rc=$?" >> "$LOG"
     timeout 2400 python tools/moebench.py --out MOEBENCH_r04.json >> "$LOG" 2>&1
     echo "[$(date -u +%T)] moebench rc=$?" >> "$LOG"
+    timeout 2400 python tools/decodebench.py --preset large >> "$LOG" 2>&1
+    echo "[$(date -u +%T)] decodebench rc=$?" >> "$LOG"
     echo "=== harvest done $(date -u +%FT%TZ)" >> "$LOG"
     exit 0
   fi

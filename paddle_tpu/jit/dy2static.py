@@ -94,7 +94,13 @@ def convert_ifelse(pred, true_fn, false_fn, names: Tuple[str, ...]):
                 "(to_static if-conversion)")
         elif isinstance(t, (Tensor, jax.Array)) or isinstance(f, (Tensor, jax.Array)):
             merged.append(api.where(pred, t, f))
-        elif t is f or t == f:
+        elif t is f:
+            merged.append(t)
+        elif isinstance(t, (bool, int, float)) and isinstance(f, (bool, int, float)):
+            # scalar outputs (e.g. the lowered break/continue flags) merge
+            # into a tensor select, same as tensor outputs
+            merged.append(t if t == f else api.where(pred, t, f))
+        elif t == f:
             merged.append(t)
         else:
             raise TypeError(
@@ -149,6 +155,24 @@ def convert_while(cond_fn, body_fn, init: Tuple[Any, ...],
     return tuple(out)
 
 
+def and_not(cond, brk):
+    """`cond and not brk` for the lowered loop test — tensor-aware (the
+    break flag becomes a tensor when set under a tensor-dependent if)."""
+    if _is_dynamic(cond) or _is_dynamic(brk):
+        return Tensor(jnp.logical_and(
+            jnp.asarray(_to_val(cond)),
+            jnp.logical_not(jnp.asarray(_to_val(brk)))))
+    return bool(cond) and not brk
+
+
+def not_or(a, b):
+    """`not (a or b)` for the lowered jump guards — tensor-aware."""
+    if _is_dynamic(a) or _is_dynamic(b):
+        return Tensor(jnp.logical_not(jnp.logical_or(
+            jnp.asarray(_to_val(a)), jnp.asarray(_to_val(b)))))
+    return not (bool(a) or bool(b))
+
+
 # --------------------------------------------------------------- AST pass
 def _assigned_names(stmts) -> set:
     names = set()
@@ -171,28 +195,29 @@ def _assigned_names(stmts) -> set:
     return names
 
 
-def _has_jump(stmts) -> bool:
-    """True when the region can't be lifted into nested branch/body
-    functions: control-flow escapes (break/continue/return) or `del`
-    (deleting a would-be output local breaks the generated return)."""
+def _scan_jumps(stmts):
+    """(has_escape, has_loop_jump): escapes are return/del (never
+    transformable); loop jumps are break/continue bound to THIS level
+    (lowered to flags for loops, untransformable for bare ifs)."""
     class V(ast.NodeVisitor):
         def __init__(self):
-            self.found = False
+            self.escape = False
+            self.jump = False
             self.loop_depth = 0
 
         def visit_Break(self, n):
             if self.loop_depth == 0:
-                self.found = True
+                self.jump = True
 
         def visit_Continue(self, n):
             if self.loop_depth == 0:
-                self.found = True
+                self.jump = True
 
         def visit_Delete(self, n):
-            self.found = True
+            self.escape = True
 
         def visit_Return(self, n):
-            self.found = True  # returns escape regardless of nesting
+            self.escape = True  # returns escape regardless of nesting
 
         def visit_FunctionDef(self, n):
             pass  # jumps inside nested defs don't count
@@ -214,7 +239,12 @@ def _has_jump(stmts) -> bool:
     v = V()
     for s in stmts:
         v.visit(s)
-    return v.found
+    return v.escape, v.jump
+
+
+def _has_jump(stmts) -> bool:
+    escape, jump = _scan_jumps(stmts)
+    return escape or jump
 
 
 def _name(id_, ctx=None):
@@ -293,8 +323,82 @@ class ControlFlowTransformer(ast.NodeTransformer):
         # Tuple handles it; keep as-is
         return pre + [mk(tname, node.body), mk(fname, node.orelse), call]
 
+    # -- break/continue lowering (reference break_continue_transformer.py:
+    # jumps become flag assignments, trailing statements get flag guards,
+    # the loop test gains `and not brk`) --------------------------------
+    def _lower_jump_block(self, stmts):
+        """Rewrite break/continue in `stmts` into flag sets + guards.
+        Returns (brk_name, cont_name, new_stmts) or None when there is
+        nothing to lower (or the block escapes via return/del). Flag names
+        are loop-carried variables, so they survive the while conversion
+        — including as where-merged TENSORS when set under a tensor if."""
+        escape, jump = _scan_jumps(stmts)
+        if escape or not jump:
+            return None
+        brk = f"_d2s_brk{self._n}"
+        cont = f"_d2s_cont{self._n}"
+        self._n += 1
+
+        def set_flag(name):
+            return ast.Assign(targets=[_name(name, ast.Store())],
+                              value=ast.Constant(True))
+
+        def guard(rest):
+            # `not (brk or cont)` via a runtime helper: the flags may be
+            # TENSORS (set under a tensor-if), and python `not` on a traced
+            # value would fail
+            test = ast.Call(func=_name("__d2s_not_or"),
+                            args=[_name(brk), _name(cont)], keywords=[])
+            return ast.If(test=test, body=rest, orelse=[])
+
+        def rw_stmts(stmts):
+            out = []
+            for i, s in enumerate(stmts):
+                repl, may_jump = rw_stmt(s)
+                out.extend(repl)
+                if may_jump and i + 1 < len(stmts):
+                    out.append(guard(rw_stmts(stmts[i + 1:])))
+                    return out
+            return out
+
+        def rw_stmt(s):
+            if isinstance(s, ast.Break):
+                return [set_flag(brk)], True
+            if isinstance(s, ast.Continue):
+                return [set_flag(cont)], True
+            if isinstance(s, ast.If):
+                _, jb = _scan_jumps(s.body)
+                _, jo = _scan_jumps(s.orelse)
+                if jb or jo:
+                    return [ast.If(test=s.test, body=rw_stmts(s.body),
+                                   orelse=rw_stmts(s.orelse) if s.orelse
+                                   else [])], True
+            return [s], False  # nested loops own their jumps
+
+        new_body = ([ast.Assign(targets=[_name(cont, ast.Store())],
+                                value=ast.Constant(False))]
+                    + rw_stmts(stmts))
+        _, still = _scan_jumps(new_body)
+        if still:
+            # a jump hides inside a compound statement rw_stmt doesn't
+            # rewrite (try/with): bail so the loop stays untransformed —
+            # re-lowering the same body would recurse forever
+            return None
+        return brk, cont, new_body
+
     # -- while ------------------------------------------------------------
     def visit_While(self, node: ast.While):
+        if not node.orelse:
+            low = self._lower_jump_block(node.body)
+            if low is not None:
+                brk, _cont, body = low
+                pre = ast.Assign(targets=[_name(brk, ast.Store())],
+                                 value=ast.Constant(False))
+                test = ast.Call(func=_name("__d2s_and_not"),
+                                args=[node.test, _name(brk)], keywords=[])
+                out = self.visit_While(ast.While(test=test, body=body,
+                                                 orelse=[]))
+                return [pre] + (out if isinstance(out, list) else [out])
         self.generic_visit(node)
         if node.orelse or _has_jump(node.body):
             return node
@@ -336,14 +440,15 @@ class ControlFlowTransformer(ast.NodeTransformer):
 
     # -- for i in range(...) ----------------------------------------------
     def visit_For(self, node: ast.For):
-        self.generic_visit(node)
-        if (node.orelse or _has_jump(node.body)
+        escape, _jump = _scan_jumps(node.body)
+        if (node.orelse or escape
                 or not isinstance(node.target, ast.Name)
                 or not isinstance(node.iter, ast.Call)
                 or not isinstance(node.iter.func, ast.Name)
                 or node.iter.func.id != "range"
                 or not 1 <= len(node.iter.args) <= 3
                 or node.iter.keywords):
+            self.generic_visit(node)
             return node
         a = node.iter.args
         start = a[0] if len(a) >= 2 else ast.Constant(0)
@@ -351,12 +456,30 @@ class ControlFlowTransformer(ast.NodeTransformer):
         step = a[2] if len(a) == 3 else None
         # the desugared test is `ctr < stop`, valid only for a KNOWN
         # positive step: a negative or runtime-variable step must keep
-        # Python range semantics untransformed
+        # Python range semantics untransformed (checked BEFORE any jump
+        # lowering — a lowered-but-untransformed loop would never break)
         if step is not None and not (
                 isinstance(step, ast.Constant)
                 and isinstance(step.value, int) and step.value > 0):
+            self.generic_visit(node)
             return node
         step = step or ast.Constant(1)
+        # break/continue lower BEFORE the while desugar, so the counter
+        # increment appended below stays OUTSIDE the continue guard (a
+        # for-continue advances the iteration; a guarded increment would
+        # loop forever)
+        brk = None
+        low = self._lower_jump_block(node.body)
+        if low is not None:
+            brk, _cont, lowered = low
+            node = ast.For(target=node.target, iter=node.iter,
+                           body=lowered, orelse=[])
+        elif _jump:
+            # jumps present but not lowerable (inside try/with): keep the
+            # original Python for — a desugared while would mis-handle them
+            self.generic_visit(node)
+            return node
+        self.generic_visit(node)
         i = node.target.id
         # counter is separate from the loop variable: `i` is bound FROM the
         # counter at each iteration head, so after the loop it holds the
@@ -372,6 +495,11 @@ class ControlFlowTransformer(ast.NodeTransformer):
         ]
         test = ast.Compare(left=_name(ctr), ops=[ast.Lt()],
                            comparators=[_name(stop_name)])
+        if brk is not None:
+            pre.append(ast.Assign(targets=[_name(brk, ast.Store())],
+                                  value=ast.Constant(False)))
+            test = ast.Call(func=_name("__d2s_and_not"),
+                            args=[test, _name(brk)], keywords=[])
         body = ([ast.Assign(targets=[_name(i, ast.Store())],
                             value=_name(ctr))]
                 + list(node.body)
@@ -433,6 +561,8 @@ def _runtime_globals(func):
     g["__d2s_ifelse"] = convert_ifelse
     g["__d2s_while"] = convert_while
     g["__d2s_undef"] = _Undefined
+    g["__d2s_and_not"] = and_not
+    g["__d2s_not_or"] = not_or
     return g
 
 

@@ -141,6 +141,93 @@ class TestTensorWhile:
             f(_t([-1.0]))
 
 
+class TestBreakContinue:
+    """break/continue lowering (reference break_continue_transformer.py:
+    jumps become flags, trailing statements get guards)."""
+
+    def test_python_break_still_python(self):
+        @jit.to_static
+        def f(x):
+            out = x
+            for i in range(10):
+                if i >= 3:
+                    break
+                out = out + 1
+            return out
+
+        assert np.allclose(f(_t([0.0])).numpy(), [3])
+
+    def test_python_continue(self):
+        @jit.to_static
+        def f(x):
+            out = x
+            for i in range(6):
+                if i % 2 == 0:
+                    continue
+                out = out + i
+            return out
+
+        assert np.allclose(f(_t([0.0])).numpy(), [1 + 3 + 5])
+
+    def test_tensor_break_in_while(self):
+        @jit.to_static
+        def f(x):
+            s = x * 0.0
+            i = _t(0.0)
+            while i.sum() < 100:
+                s = s + x
+                i = i + 1
+                if s.sum() > 6:
+                    break
+            return s
+
+        # x=[1,2]: s grows by 3 per iter; s.sum()>6 after 3 iters -> [3,6]
+        assert np.allclose(f(_t([1.0, 2.0])).numpy(), [3, 6])
+
+    def test_tensor_continue_skips_tail(self):
+        @jit.to_static
+        def f(x):
+            s = x * 0.0
+            bonus = x * 0.0
+            i = _t(0.0)
+            while i.sum() < 4:
+                i = i + 1
+                s = s + x
+                if s.sum() > 100:
+                    continue
+                bonus = bonus + 1
+            return bonus
+
+        # s.sum() stays <= 12: continue never fires, bonus counts all iters
+        assert np.allclose(f(_t([1.0, 2.0])).numpy(), [4, 4])
+
+    def test_tensor_break_in_for_range(self):
+        @jit.to_static
+        def f(x, n):
+            acc = x * 0.0
+            for i in range(n):
+                acc = acc + x
+                if acc.sum() > 8:
+                    break
+            return acc
+
+        n = paddle.to_tensor(np.int32(100))
+        # x=[1,2]: acc.sum() grows 3/iter; breaks after 3 iters -> [3,6]
+        assert np.allclose(f(_t([1.0, 2.0]), n).numpy(), [3, 6])
+
+    def test_break_flag_keeps_loop_var_semantics(self):
+        @jit.to_static
+        def f(x):
+            last = -1
+            for i in range(10):
+                if i == 4:
+                    break
+                last = i
+            return x + last
+
+        assert np.allclose(f(_t([0.0])).numpy(), [3])
+
+
 class TestDy2staticInModel:
     def test_layer_with_data_dependent_clipping(self):
         from paddle_tpu import nn

@@ -262,6 +262,12 @@ def _measured_best_preset():
     config instead of the static guess."""
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "MFU_PROBE.jsonl")
+    # the jsonl is append-only across rounds: only rows measured recently
+    # (same round, ~same code) may steer this round's preset order. 18h
+    # covers a full round; a wall-clock window avoids the HEAD-commit-time
+    # alternative discarding measurements taken before this round's commits.
+    cutoff = time.strftime("%Y-%m-%dT%H:%M:%S",
+                           time.localtime(time.time() - 18 * 3600))
     best = None
     try:
         with open(path) as f:
@@ -271,6 +277,8 @@ def _measured_best_preset():
                 except json.JSONDecodeError:
                     continue
                 if row.get("backend") in ("cpu", None):
+                    continue
+                if row.get("mfu") is None or row.get("ts", "") < cutoff:
                     continue
                 if best is None or row["mfu"] > best["mfu"]:
                     best = row

@@ -14,7 +14,9 @@ running concurrently on a thread pool (host tasks block on IO, not the GIL).
 """
 from __future__ import annotations
 
+import hashlib
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional
 
@@ -205,9 +207,9 @@ class DistFleetExecutor(FleetExecutor):
     per-step programs intact and orchestrates only host-level work.
     """
 
-    # per-process run counter: every rank constructs/runs executors in the
-    # same (SPMD) program order, so the counter agrees across ranks and
-    # isolates bus entries of successive runs from each other
+    # fallback run counter (store-less single-process runs only); the
+    # normal path rendezvouses the run id through the rpc store so ranks
+    # never have to agree on global executor-construction order
     _run_counter = [0]
 
     def __init__(self, task_nodes: List[TaskNode], rank: int,
@@ -224,11 +226,58 @@ class DistFleetExecutor(FleetExecutor):
                 return info.name
         raise RuntimeError(f"no rpc worker with rank {rank}")
 
+    def _dag_key(self) -> str:
+        sig = "|".join(sorted(f"{n}:{t.rank}" for n, t in self.nodes.items()))
+        return hashlib.sha1(sig.encode()).hexdigest()[:12]
+
+    def _rendezvous_run_id(self, rpc) -> int:
+        """Globally-unique run id agreed through the rendezvous store: the
+        DAG's lowest rank allocates it from an atomic store counter and
+        publishes it under (dag_key, k), where k is this rank's entry
+        sequence for this DAG — itself persisted in the store (per-rank
+        atomic counter), so a restarted rank resumes at its true position
+        instead of rereading run 0's stale key. Other ranks poll that key
+        with a deadline: a desynchronized rank (retry, extra executor,
+        missed runs after restart) gets a visible RuntimeError instead of
+        silently consuming another run's results under a colliding id."""
+        agent = getattr(rpc, "_agent", None)
+        store = getattr(agent, "store", None)
+        if store is None:  # single-process / tests without an rpc agent
+            DistFleetExecutor._run_counter[0] += 1
+            return DistFleetExecutor._run_counter[0]
+        dag = self._dag_key()
+        k = store.add(f"fleet_exec/{dag}/seq/{self.rank}", 1) - 1
+        root = min(t.rank for t in self.nodes.values())
+        key = f"fleet_exec/{dag}/{k}"
+        if self.rank == root:
+            rid = store.add("fleet_exec/next_run_id", 1)
+            store.set(key, str(rid))
+            if k >= 2:
+                # bound store growth: by the time root enters run k every
+                # rank has consumed run k-2's key (a rank two full runs
+                # behind would already have tripped the deadline below)
+                try:
+                    store.delete(f"fleet_exec/{dag}/{k - 2}")
+                except Exception:
+                    pass
+            return rid
+        deadline = time.monotonic() + self.result_timeout
+        while True:
+            v = store.get(key, blocking=False)
+            if v is not None:
+                return int(v)
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"fleet_exec rendezvous timed out after "
+                    f"{self.result_timeout}s waiting for {key}: rank "
+                    f"{self.rank} (entry {k}) is desynchronized with the "
+                    f"DAG root (rank {root})")
+            time.sleep(0.05)
+
     def run(self, num_micro_batches: int = 1) -> Dict[str, List[Any]]:
         from . import rpc
 
-        DistFleetExecutor._run_counter[0] += 1
-        run_id = DistFleetExecutor._run_counter[0]
+        run_id = self._rendezvous_run_id(rpc)
         try:
             return self._run(num_micro_batches, run_id, rpc)
         finally:

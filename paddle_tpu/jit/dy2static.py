@@ -19,9 +19,12 @@ TPU-native shape of the same idea, one transformer instead of twenty:
     is semantics-preserving; XLA dedups/fuses the select);
   * `while` with a tensor condition lowers to the while_loop op
     (lax.while_loop) — forward-only, matching the reference's while_op;
-  * statements containing break/continue/return inside the rewritten
-    region are left untouched (trace-time Python semantics), the same
-    fallback contract as the reference's unsupported-syntax paths.
+  * break/continue lower to loop-carried flags + guards (reference
+    break_continue_transformer.py), and tensor-dependent `return` lowers
+    to a flag + return-value slot threaded through loops (reference
+    return_transformer.py) — precondition: every path ends in
+    `return <value>`; unlowerable return-in-loop constructs warn and fall
+    back to trace-time semantics (failing loudly on tensor predicates).
 
 Variables assigned in only one branch (or only inside a loop) use an
 UNDEFINED sentinel; using such a variable afterwards raises the same
@@ -31,10 +34,11 @@ create_undefined_variable produces.
 from __future__ import annotations
 
 import ast
-import functools
 import inspect
 import textwrap
 import types
+import warnings
+import weakref
 from typing import Any, Callable, List, Tuple
 
 import jax
@@ -63,6 +67,27 @@ class _Undefined:
         return f"<undefined {self._name!r}>"
 
 
+class _RetUnset:
+    """Sentinel for a lowered return-value slot no return site has written
+    yet. Unlike _Undefined it is merge-transparent: selecting the unset
+    side of a where-merge is provably dead (the return FLAG is False
+    exactly where the value is unset, and the final `return` is only
+    reached after every path has set the flag — _lower_returns statically
+    requires all paths to terminate in a value return), so the merge simply
+    takes the other side."""
+
+    def __repr__(self):
+        return "<return-value unset>"
+
+
+RET_UNSET = _RetUnset()
+
+
+def ret_final(v):
+    """Unwrap the lowered return slot at function exit."""
+    return None if v is RET_UNSET else v
+
+
 def _is_dynamic(x) -> bool:
     if isinstance(x, Tensor):
         x = x._value
@@ -84,14 +109,15 @@ def convert_ifelse(pred, true_fn, false_fn, names: Tuple[str, ...]):
 
     merged = []
     for name, t, f in zip(names, t_out, f_out):
-        if isinstance(t, _Undefined) and isinstance(f, _Undefined):
-            merged.append(t)  # untouched on both paths: stays undefined
-        elif isinstance(t, _Undefined) or isinstance(f, _Undefined):
-            # a tensor predicate needs BOTH paths to produce a value
-            raise NameError(
-                f"variable {name!r} is assigned on only one branch of a "
-                "tensor-dependent if; initialize it before the branch "
-                "(to_static if-conversion)")
+        if isinstance(t, _Undefined) or isinstance(f, _Undefined):
+            # assigned on only one path: defer the error to USE (reference
+            # create_undefined_variable semantics) — branch-local temps
+            # that are never read after the merge stay legal
+            merged.append(t if isinstance(t, _Undefined) else _Undefined(name))
+        elif t is RET_UNSET:
+            merged.append(f)  # unset return slot: dead side, take the other
+        elif f is RET_UNSET:
+            merged.append(t)
         elif isinstance(t, (Tensor, jax.Array)) or isinstance(f, (Tensor, jax.Array)):
             merged.append(api.where(pred, t, f))
         elif t is f:
@@ -117,14 +143,56 @@ def convert_while(cond_fn, body_fn, init: Tuple[Any, ...],
     first = cond_fn(*init)
     if not _is_dynamic(first):
         vs = tuple(init)
-        while cond_fn(*vs):
+        while True:
+            c = cond_fn(*vs)
+            if _is_dynamic(c):
+                # the test became tensor-dependent mid-loop (e.g. a
+                # break/return flag set under a tensor `if` turned into a
+                # traced value): the iterations run so far are unrolled
+                # into the trace; the remainder lowers to while_loop
+                return _tensor_while(cond_fn, body_fn, vs, names)
+            if not c:
+                return vs
             vs = tuple(body_fn(*vs))
-        return vs
+    return _tensor_while(cond_fn, body_fn, init, names)
+
+
+def _tensor_while(cond_fn, body_fn, init, names):
     # tensor path: loop-carried vars are those defined at entry; names
     # first assigned inside the loop are per-iteration temporaries
+    init = list(init)
+    if any(v is RET_UNSET for v in init):
+        # lowered return slots carry across iterations but have no type
+        # until a return site writes them. Probe the body ABSTRACTLY (no
+        # device compute) to learn each slot's type, then seed the carry
+        # with typed zeros — dead until its flag is set.
+        def _probe_thunk():
+            out = body_fn(*init)
+            return tuple(
+                None if (o is RET_UNSET or isinstance(o, _Undefined))
+                else _to_val(o) for o in out)
+
+        try:
+            probe = jax.eval_shape(_probe_thunk)
+        except Exception:
+            # fallback: concrete probe (dead code under jit, one extra
+            # body evaluation in eager)
+            probe = tuple(
+                None if (o is RET_UNSET or isinstance(o, _Undefined))
+                else _to_val(o) for o in body_fn(*init))
+        for i, v in enumerate(init):
+            if v is not RET_UNSET:
+                continue
+            pv = probe[i]
+            if pv is None:
+                continue  # slot never written in this loop: pass through
+            init[i] = Tensor(jnp.zeros(getattr(pv, "shape", ()),
+                                       getattr(pv, "dtype", None)))
     carried = [i for i, v in enumerate(init)
-               if not isinstance(v, _Undefined)]
-    temps = [i for i in range(len(init)) if i not in set(carried)]
+               if not isinstance(v, _Undefined) and v is not RET_UNSET]
+    passthrough = [i for i, v in enumerate(init) if v is RET_UNSET]
+    temps = [i for i in range(len(init))
+             if i not in set(carried) and i not in set(passthrough)]
     from ..ops.kernels.control_flow import while_loop as wl
 
     def expand(vals):
@@ -133,6 +201,8 @@ def convert_while(cond_fn, body_fn, init: Tuple[Any, ...],
             full[i] = Tensor(vals[j])
         for i in temps:
             full[i] = init[i]  # the sentinel; assigned in body before use
+        for i in passthrough:
+            full[i] = RET_UNSET  # never written in this loop
         return full
 
     def c(*vals):
@@ -152,6 +222,8 @@ def convert_while(cond_fn, body_fn, init: Tuple[Any, ...],
         out[i] = Tensor(final[j])
     for i in temps:
         out[i] = _Undefined(names[i])
+    for i in passthrough:
+        out[i] = RET_UNSET
     return tuple(out)
 
 
@@ -171,6 +243,13 @@ def not_or(a, b):
         return Tensor(jnp.logical_not(jnp.logical_or(
             jnp.asarray(_to_val(a)), jnp.asarray(_to_val(b)))))
     return not (bool(a) or bool(b))
+
+
+def not_(a):
+    """`not a` for the lowered return guards — tensor-aware."""
+    if _is_dynamic(a):
+        return Tensor(jnp.logical_not(jnp.asarray(_to_val(a))))
+    return not bool(a)
 
 
 # --------------------------------------------------------------- AST pass
@@ -247,6 +326,52 @@ def _has_jump(stmts) -> bool:
     return escape or jump
 
 
+def _has_inplace_store(stmts) -> bool:
+    """True when any statement stores through a subscript or attribute
+    (`y[i] = v`, `y.a = v`, `y[i] += v`). Such mutations execute at trace
+    time regardless of the predicate, so a tensor-dependent `if` containing
+    one must stay untransformed — the untransformed form fails loudly on a
+    tracer bool instead of silently applying the mutation on both paths
+    (Tensor.__setitem__ rebinds the underlying value in place)."""
+    found = False
+
+    class V(ast.NodeVisitor):
+        def _check(self, tgt):
+            nonlocal found
+            if isinstance(tgt, (ast.Subscript, ast.Attribute)):
+                found = True
+            elif isinstance(tgt, (ast.Tuple, ast.List)):
+                for e in tgt.elts:
+                    self._check(e)
+            elif isinstance(tgt, ast.Starred):
+                self._check(tgt.value)
+
+        def visit_Assign(self, n):
+            for t in n.targets:
+                self._check(t)
+            self.generic_visit(n)
+
+        def visit_AugAssign(self, n):
+            self._check(n.target)
+            self.generic_visit(n)
+
+        def visit_AnnAssign(self, n):
+            self._check(n.target)
+            self.generic_visit(n)
+
+        def visit_FunctionDef(self, n):
+            pass  # inner scopes run only when called
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, n):
+            pass
+
+    for s in stmts:
+        V().visit(s)
+    return found
+
+
 def _name(id_, ctx=None):
     return ast.Name(id=id_, ctx=ctx or ast.Load())
 
@@ -267,6 +392,227 @@ def _capture_stmt(tmp: str, name: str) -> ast.Try:
         orelse=[], finalbody=[])
 
 
+# ----------------------------------------------------- return lowering
+def _terminates(stmts) -> bool:
+    """True when every path through `stmts` ends in `return <value>` or
+    `raise` — the static precondition for return lowering (a fall-off-end
+    path would have to yield None, which a where-merged return slot cannot
+    express)."""
+    if not stmts:
+        return False
+    last = stmts[-1]
+    if isinstance(last, ast.Return):
+        return last.value is not None
+    if isinstance(last, ast.Raise):
+        return True
+    if isinstance(last, ast.If):
+        return (bool(last.orelse) and _terminates(last.body)
+                and _terminates(last.orelse))
+    if isinstance(last, ast.Try):
+        return (_terminates(last.body) or _terminates(last.finalbody)) and \
+            all(_terminates(h.body) for h in last.handlers)
+    return False
+
+
+class _ReturnScan(ast.NodeVisitor):
+    """Shared returns-visitor: finds `return` statements, tracking loop
+    depth, never descending into nested function scopes."""
+
+    def __init__(self):
+        self.any_return = False
+        self.in_loop_return = False
+        self.loop_depth = 0
+
+    def visit_Return(self, n):
+        self.any_return = True
+        if self.loop_depth > 0:
+            self.in_loop_return = True
+
+    def _loop(self, n):
+        self.loop_depth += 1
+        self.generic_visit(n)
+        self.loop_depth -= 1
+
+    visit_While = visit_For = _loop
+
+    def visit_FunctionDef(self, n):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, n):
+        pass
+
+
+def _scan_returns(stmts) -> "_ReturnScan":
+    v = _ReturnScan()
+    for s in stmts:
+        v.visit(s)
+    return v
+
+
+def _returns_in_loops(stmts) -> bool:
+    """Any `return` nested inside a For/While (excluding nested defs)?"""
+    return _scan_returns(stmts).in_loop_return
+
+
+def _has_conditional_return(stmts) -> bool:
+    """Any `return` below the top statement level (inside if/loop/try
+    bodies, excluding nested defs)?"""
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.found = False
+            self.depth = 0
+
+        def visit_Return(self, n):
+            if self.depth > 0:
+                self.found = True
+
+        def _nest(self, n):
+            self.depth += 1
+            self.generic_visit(n)
+            self.depth -= 1
+
+        visit_If = visit_While = visit_For = _nest
+        visit_Try = visit_With = visit_AsyncWith = _nest
+
+        def visit_FunctionDef(self, n):
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, n):
+            pass
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
+def _lower_returns(fdef: ast.FunctionDef) -> bool:
+    """Rewrite `return` sites into flag+value assignments so tensor-
+    dependent early returns (the reference's return_transformer.py case)
+    lower through the existing if/while machinery:
+
+      * `return e` inside a loop  -> flag=True; val=e; break   (the break
+        then rides the existing break-flag lowering);
+      * `return e` elsewhere      -> flag=True; val=e, with following
+        statements guarded by `if not flag:`;
+      * after an inner loop that may return, `if flag: break` propagates
+        the exit outward;
+      * function ends with `return __d2s_ret_final(val)`.
+
+    Returns True when the rewrite was applied; warns (once, naming the
+    construct) only when an unlowerable RETURN-IN-LOOP would otherwise
+    silently unroll under tracing."""
+    in_loop_returns = _returns_in_loops(fdef.body)
+
+    def bail(construct: str) -> bool:
+        if in_loop_returns:
+            warnings.warn(
+                f"to_static({fdef.name}): cannot lower tensor-dependent "
+                f"return inside a loop ({construct}); falling back to "
+                "trace-time semantics — a tensor-dependent return in a "
+                "loop will unroll or fail at trace time", stacklevel=4)
+        return False
+
+    if not _terminates(fdef.body):
+        return bail("a path falls off the function end or ends in a bare "
+                    "return; every path must end in `return <value>`")
+    rflag, rval = "_d2s_rflag", "_d2s_rval"
+
+    def set_stmts(value_expr):
+        return [
+            ast.Assign(targets=[_name(rflag, ast.Store())],
+                       value=ast.Constant(True)),
+            ast.Assign(targets=[_name(rval, ast.Store())],
+                       value=value_expr),
+        ]
+
+    unsupported = []
+
+    def rewrite(stmts, in_loop):
+        """Returns (new_stmts, may_return)."""
+        out = []
+        for idx, s in enumerate(stmts):
+            if isinstance(s, ast.Return):
+                if s.value is None:
+                    unsupported.append("bare `return`")
+                    return stmts, False
+                out.extend(set_stmts(s.value))
+                if in_loop:
+                    out.append(ast.Break())
+                # statements after an unconditional return are dead
+                return out, True
+            if isinstance(s, ast.If):
+                b, rb = rewrite(s.body, in_loop)
+                o, ro = rewrite(s.orelse, in_loop)
+                if rb or ro:
+                    out.append(ast.If(test=s.test, body=b, orelse=o))
+                    rest, _r = rewrite(stmts[idx + 1:], in_loop)
+                    if rest:
+                        if in_loop:
+                            # the break machinery guards trailing
+                            # statements after the flag-set if
+                            out.extend(rest)
+                        else:
+                            out.append(ast.If(
+                                test=ast.Call(func=_name("__d2s_not"),
+                                              args=[_name(rflag)],
+                                              keywords=[]),
+                                body=rest, orelse=[]))
+                    return out, True
+                out.append(s)
+                continue
+            if isinstance(s, (ast.While, ast.For)):
+                body, r = rewrite(s.body, True)
+                if r:
+                    if s.orelse:
+                        unsupported.append("loop `else` with return")
+                        return stmts, False
+                    if isinstance(s, ast.While):
+                        out.append(ast.While(test=s.test, body=body,
+                                             orelse=[]))
+                    else:
+                        out.append(ast.For(target=s.target, iter=s.iter,
+                                           body=body, orelse=[]))
+                    # propagate the exit outward, then guard the rest
+                    rest, _r = rewrite(stmts[idx + 1:], in_loop)
+                    if in_loop:
+                        out.append(ast.If(test=_name(rflag),
+                                          body=[ast.Break()], orelse=[]))
+                        out.extend(rest)
+                    elif rest:
+                        out.append(ast.If(
+                            test=ast.Call(func=_name("__d2s_not"),
+                                          args=[_name(rflag)], keywords=[]),
+                            body=rest, orelse=[]))
+                    return out, True
+                out.append(s)
+                continue
+            if isinstance(s, (ast.Try, ast.With, ast.AsyncWith)):
+                if _scan_returns([s]).any_return:
+                    unsupported.append(
+                        f"`return` inside {type(s).__name__.lower()}")
+                    return stmts, False
+            out.append(s)
+        return out, False
+
+    new_body, _ = rewrite(fdef.body, False)
+    if unsupported:
+        return bail(unsupported[0])
+    fdef.body = (
+        [ast.Assign(targets=[_name(rflag, ast.Store())],
+                    value=ast.Constant(False)),
+         ast.Assign(targets=[_name(rval, ast.Store())],
+                    value=_name("__d2s_ret_unset"))]
+        + new_body
+        + [ast.Return(value=ast.Call(func=_name("__d2s_ret_final"),
+                                     args=[_name(rval)], keywords=[]))])
+    return True
+
+
 class ControlFlowTransformer(ast.NodeTransformer):
     """Rewrites If/While/For-range into convert_ifelse/convert_while calls."""
 
@@ -281,6 +627,10 @@ class ControlFlowTransformer(ast.NodeTransformer):
     def visit_If(self, node: ast.If):
         self.generic_visit(node)
         if _has_jump(node.body) or _has_jump(node.orelse):
+            return node
+        if _has_inplace_store(node.body) or _has_inplace_store(node.orelse):
+            # in-place stores can't be pred-gated by the where-merge; leave
+            # the `if` untransformed so a tensor predicate fails loudly
             return node
         outs = sorted(n for n in (_assigned_names(node.body)
                                   | _assigned_names(node.orelse))
@@ -402,6 +752,12 @@ class ControlFlowTransformer(ast.NodeTransformer):
         self.generic_visit(node)
         if node.orelse or _has_jump(node.body):
             return node
+        if _has_inplace_store(node.body):
+            # same hazard as the `if` case: a subscript/attribute store in
+            # a while_loop-traced body escapes the loop as a leaked tracer
+            # (or applies once at trace time); keep Python semantics so a
+            # tensor condition fails loudly instead
+            return node
         outs = sorted(n for n in _assigned_names(node.body)
                       if not n.startswith("__d2s_"))
         if not outs:
@@ -511,10 +867,26 @@ class ControlFlowTransformer(ast.NodeTransformer):
         return pre + (out if isinstance(out, list) else [out])
 
 
-@functools.lru_cache(maxsize=256)
+_transform_cache = weakref.WeakKeyDictionary()
+
+
 def _transform_function(func):
-    """Source->AST->rewritten function object. Raises on any failure; the
-    caller (to_static) falls back to plain tracing."""
+    """Source->AST->rewritten function object (weak-cached per function so
+    transformed code doesn't pin user modules alive). Raises on any
+    failure; the caller (to_static) falls back to plain tracing."""
+    try:
+        return _transform_cache[func]
+    except (KeyError, TypeError):
+        pass
+    out = _transform_function_uncached(func)
+    try:
+        _transform_cache[func] = out
+    except TypeError:
+        pass  # non-weakrefable callables just re-transform
+    return out
+
+
+def _transform_function_uncached(func):
     src = textwrap.dedent(inspect.getsource(func))
     tree = ast.parse(src)
     fdef = tree.body[0]
@@ -522,6 +894,8 @@ def _transform_function(func):
         raise TypeError("not a def (lambda/exec source): plain tracing")
     # drop decorators (e.g. @to_static itself) — we re-wrap manually
     fdef.decorator_list = []
+    if _has_conditional_return(fdef.body):
+        _lower_returns(fdef)
     new = ControlFlowTransformer().visit(tree)
     ast.fix_missing_locations(new)
 
@@ -541,28 +915,54 @@ def _transform_function(func):
         code = compile(mod, filename=f"<dy2static {func.__qualname__}>",
                        mode="exec")
         ns: dict = {}
-        exec(code, _runtime_globals(func), ns)
+        exec(code, _runtime_globals(func, _uses_global_stmt(new)), ns)
         cells = [c.cell_contents for c in func.__closure__]
         return _rebind(ns["__d2s_maker"](*cells), func)
     code = compile(new, filename=f"<dy2static {func.__qualname__}>",
                    mode="exec")
     ns = {}
-    exec(code, _runtime_globals(func), ns)
+    exec(code, _runtime_globals(func, _uses_global_stmt(new)), ns)
     return _rebind(ns[fdef.name], func)
 
 
-def _runtime_globals(func):
-    """The ORIGINAL module globals plus the three reserved converter names
-    (injected, dunder-prefixed). Using the real dict — not a snapshot —
-    keeps `global` writes and later module-level rebindings visible,
-    matching eager semantics; the temp function definition itself is kept
-    out of it via a separate exec locals namespace."""
-    g = func.__globals__
+class _ChainGlobals(dict):
+    """Exec-globals for generated code: the reserved __d2s_* converter names
+    live HERE (never injected into the user's module); every other read
+    falls back to the original module globals at LOOKUP time, so later
+    module-level rebindings stay visible. NOTE: STORE_GLOBAL bypasses
+    dict-subclass __setitem__, so `global` writes would land invisibly in
+    this mapping — functions containing a `global` statement therefore
+    never use this path (see _runtime_globals)."""
+
+    def __init__(self, base):
+        super().__init__()
+        self._base = base
+
+    def __missing__(self, key):
+        return self._base[key]
+
+
+def _uses_global_stmt(tree) -> bool:
+    return any(isinstance(n, ast.Global) for n in ast.walk(tree))
+
+
+def _runtime_globals(func, uses_global: bool = False):
+    """Chained globals by default (no module pollution); functions that
+    declare `global` get the REAL module dict — STORE_GLOBAL writes must
+    reach the module — at the cost of injecting the reserved __d2s_*
+    names there."""
+    if uses_global:
+        g = func.__globals__
+    else:
+        g = _ChainGlobals(func.__globals__)
     g["__d2s_ifelse"] = convert_ifelse
     g["__d2s_while"] = convert_while
     g["__d2s_undef"] = _Undefined
     g["__d2s_and_not"] = and_not
     g["__d2s_not_or"] = not_or
+    g["__d2s_not"] = not_
+    g["__d2s_ret_unset"] = RET_UNSET
+    g["__d2s_ret_final"] = ret_final
     return g
 
 

@@ -250,3 +250,233 @@ class TestDy2staticInModel:
         big = _t(np.full((2, 4), 1e4))
         out2 = m.forward(big)
         assert np.isfinite(out2.numpy()).all()
+
+
+class TestInplaceStoreGuard:
+    """ADVICE r4 (medium): a tensor-predicate `if` whose branch stores
+    through a subscript/attribute must NOT be where-merged (the mutation
+    would apply unconditionally at trace time); it stays untransformed and
+    fails loudly on the tracer bool."""
+
+    def test_subscript_store_in_tensor_if_raises(self):
+        @jit.to_static
+        def f(x):
+            y = x + 0
+            if x.sum() > 0:
+                y[0] = 99.0
+            return y
+
+        with pytest.raises(Exception):
+            f(_t([-1.0, 2.0]))
+
+    def test_augassign_subscript_in_tensor_if_raises(self):
+        @jit.to_static
+        def f(x):
+            y = x + 0
+            if x.sum() > 0:
+                y[0] += 1.0
+            return y
+
+        with pytest.raises(Exception):
+            f(_t([1.0, 2.0]))
+
+    def test_eager_mutation_keeps_python_semantics(self):
+        # eager path: a concrete tensor predicate is "dynamic", so before
+        # the guard convert_ifelse executed BOTH branches and the subscript
+        # store applied even when the predicate was False. Untransformed,
+        # the concrete bool keeps exact Python semantics.
+        from paddle_tpu.jit.dy2static import convert_control_flow
+
+        def f(x):
+            y = x + 0
+            if x.sum() > 0:
+                y[0] = 99.0
+            return y
+
+        g = convert_control_flow(f)
+        assert np.allclose(g(_t([1.0, 2.0])).numpy(), [99, 2])
+        assert np.allclose(g(_t([-5.0, 2.0])).numpy(), [-5, 2])
+
+    def test_name_assign_still_transformed(self):
+        @jit.to_static
+        def f(x):
+            y = x
+            if x.sum() > 0:
+                y = x * 2
+            return y
+
+        assert np.allclose(f(_t([3.0])).numpy(), [6])
+
+
+class TestGlobalsHygiene:
+    """ADVICE r4 (low): transforming a function must not inject __d2s_*
+    converter names into the user's module globals."""
+
+    def test_no_module_pollution(self):
+        @jit.to_static
+        def f(x):
+            if x.sum() > 0:
+                y = x * 2
+            else:
+                y = x - 1
+            return y
+
+        f(_t([1.0]))
+        import sys
+
+        mod_globals = sys.modules[__name__].__dict__
+        leaked = [k for k in mod_globals if k.startswith("__d2s_")]
+        assert leaked == []
+
+
+class TestReturnLowering:
+    """Tensor-dependent `return` lowering (VERDICT r4 item 9; reference
+    return_transformer.py). Dygraph-vs-static parity over mixed
+    break/return/nested-loop functions."""
+
+    def _parity(self, fn, *args):
+        eager = fn(*[paddle.to_tensor(a) for a in args]).numpy()
+        static = jit.to_static(fn)(*[paddle.to_tensor(a) for a in args]).numpy()
+        assert np.allclose(eager, static), (eager, static)
+        return static
+
+    def test_return_in_for_canonical(self):
+        def f(x):
+            for i in range(10):
+                if x.sum() > i:
+                    return x * 2
+            z = x - 1
+            return z
+
+        self._parity(f, np.asarray([3.0], np.float32))       # early return
+        self._parity(f, np.asarray([-100.0], np.float32))    # falls through
+
+    def test_return_in_while(self):
+        def f(x):
+            n = x.sum()
+            while n < 100:
+                n = n * 2
+                if n > 50:
+                    return x + n
+            return x - 1
+
+        self._parity(f, np.asarray([2.0], np.float32))
+        self._parity(f, np.asarray([200.0], np.float32))
+
+    def test_return_in_nested_loops(self):
+        def f(x):
+            acc = x * 0
+            for i in range(4):
+                for j in range(4):
+                    acc = acc + 1
+                    if acc.sum() > 9:
+                        return acc * 10
+            return acc
+
+        # 2-elem input: acc.sum() grows 2/iter; crosses 9 after 5 iters
+        self._parity(f, np.asarray([1.0, 1.0], np.float32))
+        # 1-elem: never crosses in 16 iters -> returns acc
+        self._parity(f, np.asarray([0.0], np.float32))
+
+    def test_mixed_break_and_return(self):
+        def f(x):
+            acc = x * 0
+            for i in range(8):
+                if acc.sum() > 12:
+                    return acc + 100
+                if acc.sum() > 6:
+                    break
+                acc = acc + x
+            return acc - 1
+
+        self._parity(f, np.asarray([1.0, 1.0], np.float32))
+        self._parity(f, np.asarray([4.0, 4.0], np.float32))
+
+    def test_return_both_branches_toplevel_if(self):
+        def f(x):
+            if x.sum() > 0:
+                return x * 2
+            else:
+                return x - 1
+
+        self._parity(f, np.asarray([5.0], np.float32))
+        self._parity(f, np.asarray([-5.0], np.float32))
+
+    def test_return_grad_flows(self):
+        # eager path: the where-merged return slot is differentiable
+        from paddle_tpu.jit.dy2static import convert_control_flow
+
+        def f(x):
+            if x.sum() > 0:
+                return (x * x).sum()
+            return (x * 3).sum()
+
+        g = convert_control_flow(f)
+        x = paddle.to_tensor(np.asarray([2.0], np.float32),
+                             stop_gradient=False)
+        out = g(x)
+        out.backward()
+        assert np.allclose(x.grad.numpy(), [4.0])  # d(x^2)/dx at 2
+
+    def test_bare_return_in_loop_warns_and_falls_back(self):
+        import warnings as _w
+
+        def f(x):
+            for i in range(3):
+                if x.sum() > 100:
+                    return
+            return x
+
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            g = jit.to_static(f)
+            # untransformed fallback: the tensor predicate fails LOUDLY
+            # at trace time instead of silently mis-lowering
+            with pytest.raises(Exception):
+                g(_t([1.0]))
+        assert any("bare `return`" in str(r.message) for r in rec)
+
+    def test_fall_off_end_warns(self):
+        import warnings as _w
+
+        def f(x):
+            for i in range(3):
+                if x.sum() > 100:
+                    return x * 2
+            # falls off the end -> unlowerable
+
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            g = jit.to_static(f)
+            with pytest.raises(Exception):
+                g(_t([1.0]))
+        assert any("falls off" in str(r.message) for r in rec)
+
+    def test_python_pred_returns_unchanged(self):
+        def f(x, k):
+            for i in range(6):
+                if i == k:
+                    return x + i
+            return x - 1
+
+        g = jit.to_static(f)
+        assert np.allclose(g(_t([0.0]), 3).numpy(), [3])
+        assert np.allclose(g(_t([0.0]), 99).numpy(), [-1])
+
+
+class TestWhileInplaceGuard:
+    def test_subscript_store_in_tensor_while_raises(self):
+        # before the guard this leaked a while_loop tracer (or applied the
+        # store once at trace time); untransformed it fails loudly
+        from paddle_tpu.jit.dy2static import convert_control_flow
+
+        def f(x, n):
+            y = x + 0
+            while n.sum() < 3:
+                y[0] = y[0] + 10.0
+                n = n + 1
+            return y
+
+        g = jit.to_static(f)
+        with pytest.raises(Exception):
+            g(_t([1.0, 2.0]), _t([0.0]))

@@ -4,8 +4,8 @@
 # moment the chip answers, run the round's full evidence harvest
 # sequentially in THIS process slot (one chip process at a time):
 #   1. mfu_probe ablations  -> MFU_PROBE.jsonl (persisted per measurement)
-#   2. opbench              -> OPBENCH_r04.json
-#   3. moebench             -> MOEBENCH_r04.json
+#   2. opbench              -> OPBENCH_r05.json
+#   3. moebench             -> MOEBENCH_r05.json
 cd /root/repo || exit 1
 LOG=tools/tpu_watchdog.log
 echo "=== watchdog start $(date -u +%FT%TZ)" >> "$LOG"
@@ -27,9 +27,9 @@ print('probe ok', float(x[0,0]))" >> "$LOG" 2>&1
     echo "[$(date -u +%T)] chip alive -> harvesting" >> "$LOG"
     timeout 7200 python tools/mfu_probe.py baseline o2 o2b16 o2b32 o2b32r flashoff >> "$LOG" 2>&1
     echo "[$(date -u +%T)] mfu_probe rc=$?" >> "$LOG"
-    timeout 3600 python tools/opbench.py --out OPBENCH_r04.json >> "$LOG" 2>&1
+    timeout 3600 python tools/opbench.py --out OPBENCH_r05.json >> "$LOG" 2>&1
     echo "[$(date -u +%T)] opbench rc=$?" >> "$LOG"
-    timeout 2400 python tools/moebench.py --out MOEBENCH_r04.json >> "$LOG" 2>&1
+    timeout 2400 python tools/moebench.py --out MOEBENCH_r05.json >> "$LOG" 2>&1
     echo "[$(date -u +%T)] moebench rc=$?" >> "$LOG"
     timeout 2400 python tools/decodebench.py --preset large >> "$LOG" 2>&1
     echo "[$(date -u +%T)] decodebench rc=$?" >> "$LOG"

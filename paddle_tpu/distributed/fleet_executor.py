@@ -211,6 +211,8 @@ class DistFleetExecutor(FleetExecutor):
     # normal path rendezvouses the run id through the rpc store so ranks
     # never have to agree on global executor-construction order
     _run_counter = [0]
+    # root-side list of published-but-not-yet-GC'd rendezvous keys per DAG
+    _pending_keys: Dict[str, List[int]] = {}
 
     def __init__(self, task_nodes: List[TaskNode], rank: int,
                  max_workers: int = 8, result_timeout: float = 120.0):
@@ -249,22 +251,40 @@ class DistFleetExecutor(FleetExecutor):
         k = store.add(f"fleet_exec/{dag}/seq/{self.rank}", 1) - 1
         root = min(t.rank for t in self.nodes.values())
         key = f"fleet_exec/{dag}/{k}"
+        try:
+            n_readers = len(rpc.get_all_worker_infos()) - 1
+        except Exception:
+            n_readers = 0
         if self.rank == root:
             rid = store.add("fleet_exec/next_run_id", 1)
             store.set(key, str(rid))
-            if k >= 2:
-                # bound store growth: by the time root enters run k every
-                # rank has consumed run k-2's key (a rank two full runs
-                # behind would already have tripped the deadline below)
+            # GC fully-consumed keys: a reader acks after its read, so a
+            # key is deleted only once every rank has read it — a slow
+            # rank can lag arbitrarily without its key disappearing. (A
+            # root restart forgets its pending list and leaks at most the
+            # keys outstanding at that moment — bounded.)
+            pend = DistFleetExecutor._pending_keys.setdefault(dag, [])
+            pend.append(k)
+            while pend and n_readers > 0:
+                j = pend[0]
+                acks = store.get(f"fleet_exec/{dag}/{j}/acks",
+                                 blocking=False)
+                if acks is None or int(acks) < n_readers:
+                    break
+                # acks == n_readers: every rank has read, so no further
+                # acks can arrive — both keys are safe to delete
                 try:
-                    store.delete(f"fleet_exec/{dag}/{k - 2}")
+                    store.delete(f"fleet_exec/{dag}/{j}")
+                    store.delete(f"fleet_exec/{dag}/{j}/acks")
                 except Exception:
                     pass
+                pend.pop(0)
             return rid
         deadline = time.monotonic() + self.result_timeout
         while True:
             v = store.get(key, blocking=False)
             if v is not None:
+                store.add(f"fleet_exec/{dag}/{k}/acks", 1)
                 return int(v)
             if time.monotonic() > deadline:
                 raise RuntimeError(

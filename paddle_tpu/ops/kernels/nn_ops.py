@@ -683,21 +683,42 @@ def scaled_dot_product_attention(
     from .. import pallas as _pallas
     from ..pallas.flash_attention import supports as _flash_supports
 
-    if (
+    flash_ok = (
         _flags.get_flag("use_flash_attention")
-        and _pallas.pallas_enabled()
         and _flash_supports(
             query.shape, key.shape, attn_mask,
             dropout_p if training else 0.0, is_causal,
         )
-    ):
+    )
+    if flash_ok and _pallas.interpret_mode():
         from ..pallas.flash_attention import flash_attention_tuned as _flash
 
-        return _flash(
-            query, key, value, scale, is_causal,
-            interpret=_pallas.interpret_mode(),
-        )
+        return _flash(query, key, value, scale, is_causal, interpret=True)
+    if flash_ok:
+        # the pallas-vs-XLA choice happens at LOWERING time inside the
+        # kernel's custom vjp (lax.platform_dependent): a program lowered
+        # for 'tpu' — including jax.export from a CPU host — embeds the
+        # Mosaic kernel, while the same trace stays runnable on CPU.
+        # Block-size autotuning only on a real TPU backend: timing the
+        # dense fallback (where blocks are no-ops) would cache a noise
+        # winner that later steers the TPU export.
+        if jax.default_backend() == "tpu":
+            from ..pallas.flash_attention import (
+                flash_attention_platform_tuned as _flash_pd)
 
+            return _flash_pd(query, key, value, scale, is_causal)
+        from ..pallas.flash_attention import (
+            flash_attention_platform as _flash_pd)
+
+        return _flash_pd(query, key, value, scale, is_causal)
+    return _sdpa_xla(query, key, value, attn_mask, dropout_p, is_causal,
+                     training, scale)
+
+
+def _sdpa_xla(query, key, value, attn_mask, dropout_p, is_causal, training,
+              scale):
+    b, sq, h, d = query.shape
+    sk = key.shape[1]
     q = jnp.einsum("bqhd->bhqd", query)
     k = jnp.einsum("bkhd->bhkd", key)
     v = jnp.einsum("bkhd->bhkd", value)
@@ -731,17 +752,22 @@ def rotary_position_embedding(q, k, cos, sin, rotate_half=True):
             c.ndim == 4 and c.shape[0] == 1 and c.shape[2] == 1
         )
 
-    if (
+    fused_ok = (
         rotate_half
         and _seq_major(cos)
         and _seq_major(sin)
         and q.shape[1] == (cos.shape[1] if cos.ndim == 4 else cos.shape[0])
-        and _pallas.pallas_enabled()
-    ):
+    )
+    if fused_ok:
         from ..pallas.rope import fused_rope as _fused
 
+        # kernel on TPU, XLA composition elsewhere — the choice happens at
+        # lowering time inside _rope_one's custom vjp (see ops/pallas/rope)
         return _fused(q, k, cos, sin, interpret=_pallas.interpret_mode())
+    return _rope_xla(q, k, cos, sin, rotate_half)
 
+
+def _rope_xla(q, k, cos, sin, rotate_half):
     def rot(x):
         if rotate_half:
             x1, x2 = jnp.split(x, 2, axis=-1)

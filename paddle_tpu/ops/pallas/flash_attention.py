@@ -312,6 +312,92 @@ def _flash_bwd_rule(scale, causal, block_q, block_k, interpret, res, g):
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
+# ------------------------------------------- platform-deferred entry point
+def _dense_fwd(q, k, v, scale, causal):
+    """XLA forward producing residuals in the SAME kernel layout as _fwd
+    ((qr, kr, vr, out, lse) with [bh, s, d] / [bh, sq, 1] fp32 lse), so a
+    lax.platform_dependent can pick pallas-vs-XLA per lowering target."""
+    b, sq, h, d = q.shape
+    sc = 1.0 / math.sqrt(d) if scale is None else scale
+    sk = k.shape[1]
+    bh = b * h
+    qr = q.transpose(0, 2, 1, 3).reshape(bh, sq, d)
+    kr = k.transpose(0, 2, 1, 3).reshape(bh, sk, d)
+    vr = v.transpose(0, 2, 1, 3).reshape(bh, sk, d)
+    s = jnp.einsum("bqd,bkd->bqk", qr.astype(jnp.float32),
+                   kr.astype(jnp.float32)) * sc
+    if causal:
+        s = jnp.where(jnp.tril(jnp.ones((sq, sk), bool))[None], s, -1e30)
+    lse = jax.nn.logsumexp(s, -1, keepdims=True)  # [bh, sq, 1]
+    p = jnp.exp(s - lse)
+    out = jnp.einsum("bqk,bkd->bqd", p, vr.astype(jnp.float32)).astype(q.dtype)
+    o = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    return o, (qr, kr, vr, out, lse)
+
+
+def _dense_bwd(scale, causal, res, g, dlse=None):
+    """XLA backward from the kernel-layout residuals (same math as the
+    pallas kernels: ds = p * (dp - delta [+ dlse fold])."""
+    qr, kr, vr, outr, lse = res
+    bh, sq, d = qr.shape
+    sc = 1.0 / math.sqrt(d) if scale is None else scale
+    sk = kr.shape[1]
+    do = g.transpose(0, 2, 1, 3).reshape(bh, sq, d).astype(jnp.float32)
+    s = jnp.einsum("bqd,bkd->bqk", qr.astype(jnp.float32),
+                   kr.astype(jnp.float32)) * sc
+    if causal:
+        s = jnp.where(jnp.tril(jnp.ones((sq, sk), bool))[None], s, -1e30)
+    p = jnp.exp(s - lse)
+    dv = jnp.einsum("bqk,bqd->bkd", p, do)
+    dp = jnp.einsum("bqd,bkd->bqk", do, vr.astype(jnp.float32))
+    delta = jnp.sum(do * outr.astype(jnp.float32), -1, keepdims=True)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
+    ds = p * (dp - delta)
+    dq = jnp.einsum("bqk,bkd->bqd", ds, kr.astype(jnp.float32)) * sc
+    dk = jnp.einsum("bqk,bqd->bkd", ds, qr.astype(jnp.float32)) * sc
+    b = g.shape[0]
+    h = g.shape[2]
+    un = lambda x, s_, dt: x.astype(dt).reshape(b, h, s_, d).transpose(0, 2, 1, 3)
+    return un(dq, sq, qr.dtype), un(dk, sk, kr.dtype), un(dv, sk, vr.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_platform(q, k, v, scale=None, causal=False,
+                             block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """flash_attention whose pallas-vs-XLA choice happens at LOWERING time
+    (lax.platform_dependent): a program exported for 'tpu' from any host
+    embeds the Mosaic kernel, while the same trace stays runnable on CPU.
+    The platform cond sits INSIDE the custom-vjp fwd/bwd, so nothing ever
+    differentiates through it (jax cannot JVP a pallas_call inside a cond
+    branch)."""
+    o, _ = _platform_fwd(q, k, v, scale, causal, block_q, block_k)
+    return o
+
+
+def _platform_fwd(q, k, v, scale, causal, block_q, block_k):
+    return jax.lax.platform_dependent(
+        q, k, v,
+        tpu=lambda q, k, v: _fwd(q, k, v, scale, causal, block_q, block_k,
+                                 False),
+        default=lambda q, k, v: _dense_fwd(q, k, v, scale, causal))
+
+
+def _platform_fwd_rule(q, k, v, scale, causal, block_q, block_k):
+    return _platform_fwd(q, k, v, scale, causal, block_q, block_k)
+
+
+def _platform_bwd_rule(scale, causal, block_q, block_k, res, g):
+    return jax.lax.platform_dependent(
+        *res, g,
+        tpu=lambda *a: _bwd(scale, causal, block_q, block_k, False,
+                            a[:5], a[5]),
+        default=lambda *a: _dense_bwd(scale, causal, a[:5], a[5]))
+
+
+flash_attention_platform.defvjp(_platform_fwd_rule, _platform_bwd_rule)
+
+
 # ----------------------------------------------- varlen (segmented) flash
 # Reference: phi flash_attn_unpadded / flash_attn_varlen
 # (paddle/phi/kernels/gpu/flash_attn_kernel.cu varlen entries) — packed
@@ -669,3 +755,15 @@ def flash_attention_tuned(q, k, v, scale=None, causal=False, interpret=False,
     if q.shape[1] % block_q or k.shape[1] % block_k:
         raise ValueError("block does not divide sequence")  # tuner skips
     return flash_attention(q, k, v, scale, causal, block_q, block_k, interpret)
+
+
+@_autotune(_BLOCK_CANDIDATES,
+           key_extra=lambda q, k, v, scale=None,
+           causal=False: bool(causal))
+def flash_attention_platform_tuned(q, k, v, scale=None, causal=False,
+                                   *, block_q, block_k):
+    """flash_attention_platform (lowering-time pallas/XLA choice) with the
+    same autotuned block-size selection as flash_attention_tuned."""
+    if q.shape[1] % block_q or k.shape[1] % block_k:
+        raise ValueError("block does not divide sequence")  # tuner skips
+    return flash_attention_platform(q, k, v, scale, causal, block_q, block_k)

@@ -59,19 +59,44 @@ def _apply(x, cos, sin, sign, interpret):
     )(x, cos, sin)
 
 
+def _apply_xla(x, cos, sin, sign):
+    """XLA composition of the same rotate_half math (platform fallback)."""
+    d = x.shape[-1]
+    x1 = x[..., : d // 2]
+    x2 = x[..., d // 2:]
+    rot = jnp.concatenate([-x2, x1], axis=-1)
+    c = cos[None, :, None, :].astype(jnp.float32)
+    s = sin[None, :, None, :].astype(jnp.float32)
+    xf = x.astype(jnp.float32)
+    return (xf * c + sign * rot.astype(jnp.float32) * s).astype(x.dtype)
+
+
+def _apply_platform(x, cos, sin, sign, interpret):
+    """Pallas kernel on TPU, XLA composition elsewhere — chosen at
+    LOWERING time (lax.platform_dependent), sitting INSIDE the custom-vjp
+    rules so it is never itself differentiated (jax cannot JVP a
+    pallas_call inside a cond branch)."""
+    if interpret:
+        return _apply(x, cos, sin, sign, True)
+    return jax.lax.platform_dependent(
+        x, cos, sin,
+        tpu=lambda x, c, s: _apply(x, c, s, sign, False),
+        default=lambda x, c, s: _apply_xla(x, c, s, sign))
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def _rope_one(x, cos, sin, interpret=False):
-    return _apply(x, cos, sin, 1.0, interpret)
+    return _apply_platform(x, cos, sin, 1.0, interpret)
 
 
 def _rope_one_fwd(x, cos, sin, interpret):
-    return _apply(x, cos, sin, 1.0, interpret), (cos, sin)
+    return _apply_platform(x, cos, sin, 1.0, interpret), (cos, sin)
 
 
 def _rope_one_bwd(interpret, res, g):
     cos, sin = res
     # transpose of the rotation: rotate the other way
-    return _apply(g, cos, sin, -1.0, interpret), None, None
+    return _apply_platform(g, cos, sin, -1.0, interpret), None, None
 
 
 _rope_one.defvjp(_rope_one_fwd, _rope_one_bwd)

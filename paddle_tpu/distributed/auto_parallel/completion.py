@@ -1,0 +1,498 @@
+"""Completer: einsum-level sharding propagation over the traced program.
+
+Reference: python/paddle/distributed/auto_parallel/static/completion.py:108
+(Completer.complete_forward_annotation walking ops and applying
+fluid/distributed/auto_parallel/spmd_rules/ — matmul_spmd_rule.cc,
+embedding_spmd_rule.cc, elementwise, layer_norm...), followed by
+reshard.py:978 inserting the collectives the annotations imply.
+
+TPU-native shape: the program is a JAXPR, the rules run over jax
+primitives, and the "Resharder" is GSPMD — once parameters and batch are
+annotated consistently, XLA inserts exactly the collectives the dist
+attrs imply. What this module does (and the name/shape heuristics in
+engine.plan_parameter_specs do NOT) is derive every parameter's placement
+from its USE SITES:
+
+  * batch inputs are seeded P('dp', ...) and specs flow forward through
+    every equation (elementwise merge, reshape split/merge tracking,
+    transpose/reduce/gather rules, recursion into pjit/custom calls);
+  * a parameter's spec is CHOSEN at its first compute use by the matmul /
+    embedding rules: an activation whose contracted dim already carries
+    'mp' forces row-parallel (one psum, resolves the layout); otherwise
+    the out-dim is sharded column-parallel for free — the Megatron
+    alternation emerges from cost minimization, not from name matching;
+  * biases/norm scales resolve at their elementwise merge against the
+    activation layout (a column-parallel linear's bias comes out
+    P('mp'), a layernorm weight on replicated features P()).
+
+Outputs per-parameter PartitionSpecs plus an estimated collective-bytes
+cost used by the planner as a tie-break.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+Spec = Tuple[Optional[str], ...]
+
+
+class _Free:
+    """A value derived from a not-yet-placed parameter through shape-only
+    ops; dim_map[var_dim] = param_dim (or None for broadcast dims)."""
+
+    def __init__(self, pid: int, dim_map: Tuple[Optional[int], ...]):
+        self.pid = pid
+        self.dim_map = dim_map
+
+
+class Completer:
+    def __init__(self, mesh, mp_axis: str = "mp", dp_axis: str = "dp"):
+        self.mesh = mesh
+        self.axis_size = dict(zip(mesh.axis_names,
+                                  np.asarray(mesh.devices).shape))
+        self.mp = mp_axis if self.axis_size.get(mp_axis, 1) > 1 else None
+        self.dp = dp_axis if self.axis_size.get(dp_axis, 1) > 1 else None
+        self.param_specs: Dict[int, Spec] = {}
+        self.comm_bytes = 0.0
+
+    # ----------------------------------------------------------- helpers
+    def _div(self, dim_size: int, axis: Optional[str]) -> bool:
+        return axis is not None and dim_size % self.axis_size[axis] == 0
+
+    def _resolve(self, free: _Free, var_shape, want: Spec) -> Spec:
+        """Fix a free parameter's spec so its var maps onto `want`."""
+        nd = max((d for d in free.dim_map if d is not None), default=-1) + 1
+        spec = list(self.param_specs.get(free.pid, (None,) * nd))
+        spec += [None] * (nd - len(spec))
+        for vdim, pdim in enumerate(free.dim_map):
+            if pdim is not None and vdim < len(want) and want[vdim]:
+                spec[pdim] = want[vdim]
+        chosen = tuple(spec)
+        prev = self.param_specs.get(free.pid)
+        if prev is not None and prev != chosen:
+            # conflicting uses (e.g. tied weights used both ways):
+            # keep the intersection
+            chosen = tuple(a if a == b else None
+                           for a, b in zip(prev, chosen))
+        self.param_specs[free.pid] = chosen
+        return tuple(chosen[p] if p is not None else None
+                     for p in free.dim_map)
+
+    @staticmethod
+    def _merge(specs: Sequence[Spec]) -> Spec:
+        out = []
+        for dims in zip(*specs):
+            named = [d for d in dims if d]
+            out.append(named[0] if named and all(d == named[0]
+                                                for d in named) else None)
+        return tuple(out)
+
+    # ------------------------------------------------------------- entry
+    def run(self, closed_jaxpr, n_params: int,
+            batch_specs: List[Spec]) -> Tuple[Dict[int, Spec], float]:
+        """Propagate through `closed_jaxpr` whose first n_params invars are
+        parameters (free) and remaining invars are batch inputs with the
+        given seeds. Returns ({param_index: spec}, comm_bytes)."""
+        jaxpr = closed_jaxpr.jaxpr
+        env: Dict[Any, Any] = {}
+        for i, v in enumerate(jaxpr.invars):
+            if i < n_params:
+                env[v] = _Free(i, tuple(range(len(v.aval.shape))))
+            else:
+                seed = batch_specs[i - n_params]
+                nd = len(v.aval.shape)
+                seed = tuple(seed[:nd]) + (None,) * (nd - len(seed))
+                env[v] = seed
+        for v in jaxpr.constvars:
+            env[v] = (None,) * len(v.aval.shape)
+        self._walk(jaxpr, env)
+        # unresolved params (never used in a placing op) stay unplaced
+        return dict(self.param_specs), self.comm_bytes
+
+    # ------------------------------------------------------ interpreter
+    def _read(self, env, atom):
+        if hasattr(atom, "val"):  # Literal
+            return (None,) * np.ndim(atom.val)
+        return env.get(atom, (None,) * len(atom.aval.shape))
+
+    def _spec_of(self, env, atom) -> Spec:
+        """Spec for an input; free params resolve to their current spec
+        (unknown dims None) WITHOUT fixing them."""
+        got = self._read(env, atom)
+        if isinstance(got, _Free):
+            spec = self.param_specs.get(got.pid)
+            return tuple((spec[p] if spec and p is not None and
+                          p < len(spec) else None) for p in got.dim_map)
+        return got
+
+    def _walk(self, jaxpr, env):
+        for eqn in jaxpr.eqns:
+            self._eqn(eqn, env)
+
+    def _eqn(self, eqn, env):  # noqa: C901 - one dispatch table
+        prim = eqn.primitive.name
+        invals = [self._read(env, a) for a in eqn.invars]
+        shapes = [tuple(getattr(a.aval, "shape", ())) if hasattr(a, "aval")
+                  else np.shape(a.val) for a in eqn.invars]
+        out_shapes = [tuple(v.aval.shape) for v in eqn.outvars]
+
+        def setout(specs):
+            for v, s in zip(eqn.outvars, specs):
+                env[v] = tuple(s)
+
+        # ---- recursion into sub-jaxprs (pjit / remat / custom_*) -------
+        sub = None
+        for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+            if key in eqn.params and hasattr(eqn.params[key], "jaxpr"):
+                sub = eqn.params[key].jaxpr
+                break
+            if key in eqn.params and hasattr(eqn.params[key], "eqns"):
+                sub = eqn.params[key]
+                break
+        if sub is not None:
+            subenv: Dict[Any, Any] = {}
+            for sv, val in zip(sub.invars, invals):
+                subenv[sv] = val
+            for sv in sub.constvars:
+                subenv[sv] = (None,) * len(sv.aval.shape)
+            self._walk(sub, subenv)
+            outs = []
+            for sv in sub.outvars:
+                got = subenv.get(sv)
+                if isinstance(got, _Free):
+                    got = self._spec_of(subenv, sv)
+                outs.append(got if got is not None
+                            else (None,) * len(sv.aval.shape))
+            setout(outs)
+            return
+
+        # ---- dot_general: the matmul spmd rule -------------------------
+        if prim == "dot_general":
+            ((lc, rc), (lb, rb)) = eqn.params["dimension_numbers"]
+            lhs, rhs = invals[0], invals[1]
+            lshape, rshape = shapes[0], shapes[1]
+            if isinstance(rhs, _Free) and not isinstance(lhs, _Free):
+                rhs = self._place_matmul_param(
+                    env, eqn.invars[1], rhs, rshape, rc, rb,
+                    act_spec=lhs, act_shape=lshape, act_contract=lc,
+                    out_size=math.prod(out_shapes[0]) or 1)
+            elif isinstance(lhs, _Free) and not isinstance(rhs, _Free):
+                lhs = self._place_matmul_param(
+                    env, eqn.invars[0], lhs, lshape, lc, lb,
+                    act_spec=rhs, act_shape=rshape, act_contract=rc,
+                    out_size=math.prod(out_shapes[0]) or 1)
+            lhs = lhs if not isinstance(lhs, _Free) else \
+                self._spec_of(env, eqn.invars[0])
+            rhs = rhs if not isinstance(rhs, _Free) else \
+                self._spec_of(env, eqn.invars[1])
+            batch = [self._merge([(lhs[i],), (rhs[j],)])[0]
+                     for i, j in zip(lb, rb)]
+            lfree = [lhs[i] for i in range(len(lshape))
+                     if i not in lc and i not in lb]
+            rfree = [rhs[j] for j in range(len(rshape))
+                     if j not in rc and j not in rb]
+            # contracted dim sharded on either side -> GSPMD psums
+            if any(lhs[i] for i in lc) or any(rhs[j] for j in rc):
+                self.comm_bytes += math.prod(out_shapes[0]) * 4
+            used = set(batch)
+            out = batch + [a if a not in used and not used.add(a) else None
+                           for a in lfree] + \
+                [a if a not in used and not used.add(a) else None
+                 for a in rfree]
+            setout([tuple(out)])
+            return
+
+        # ---- gather: the embedding spmd rule ---------------------------
+        if prim == "gather":
+            op, idx = invals[0], invals[1]
+            dnums = eqn.params["dimension_numbers"]
+            if (isinstance(op, _Free) and len(shapes[0]) == 2
+                    and tuple(dnums.collapsed_slice_dims) == (0,)
+                    and self._div(shapes[0][0], self.mp)):
+                # vocab-parallel embedding: shard the gathered dim; GSPMD
+                # lowers to masked-gather + psum of the partial rows
+                op = self._resolve(_Free(op.pid, op.dim_map),
+                                   shapes[0], (self.mp, None))
+                self.comm_bytes += math.prod(out_shapes[0]) * 4
+            elif isinstance(op, _Free):
+                op = self._spec_of(env, eqn.invars[0])
+            idx_spec = idx if not isinstance(idx, _Free) else \
+                self._spec_of(env, eqn.invars[1])
+            out_nd = len(out_shapes[0])
+            offset = list(dnums.offset_dims)
+            out = [None] * out_nd
+            bi = 0
+            for d in range(out_nd):
+                if d not in offset and bi < len(idx_spec):
+                    out[d] = idx_spec[bi]
+                    bi += 1
+            setout([tuple(out)])
+            return
+
+        # ---- shape ops keeping free lineage ----------------------------
+        if prim == "broadcast_in_dim":
+            bdims = eqn.params["broadcast_dimensions"]
+            out_nd = len(out_shapes[0])
+            if isinstance(invals[0], _Free):
+                dim_map: List[Optional[int]] = [None] * out_nd
+                for in_d, out_d in enumerate(bdims):
+                    dim_map[out_d] = invals[0].dim_map[in_d]
+                env[eqn.outvars[0]] = _Free(invals[0].pid, tuple(dim_map))
+                return
+            out = [None] * out_nd
+            for in_d, out_d in enumerate(bdims):
+                if shapes[0][in_d] == out_shapes[0][out_d]:
+                    out[out_d] = invals[0][in_d]
+            setout([tuple(out)])
+            return
+
+        if prim == "transpose":
+            perm = eqn.params["permutation"]
+            if isinstance(invals[0], _Free):
+                env[eqn.outvars[0]] = _Free(
+                    invals[0].pid,
+                    tuple(invals[0].dim_map[p] for p in perm))
+                return
+            setout([tuple(invals[0][p] for p in perm)])
+            return
+
+        if prim == "reshape":
+            self._reshape(eqn, env, invals[0], shapes[0], out_shapes[0])
+            return
+
+        if prim in ("squeeze", "expand_dims"):
+            in_shape, out_shape = shapes[0], out_shapes[0]
+            spec = invals[0] if not isinstance(invals[0], _Free) else \
+                self._spec_of(env, eqn.invars[0])
+            out, i = [], 0
+            for s in out_shape:
+                while i < len(in_shape) and in_shape[i] == 1 and s != 1:
+                    i += 1
+                if i < len(in_shape) and in_shape[i] == s:
+                    out.append(spec[i])
+                    i += 1
+                else:
+                    out.append(None)
+            setout([tuple(out)])
+            return
+
+        if prim in ("convert_element_type", "stop_gradient", "copy"):
+            if isinstance(invals[0], _Free):
+                env[eqn.outvars[0]] = invals[0]
+                return
+            setout([invals[0]])
+            return
+
+        if prim in ("reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+                    "argmax", "argmin", "reduce_and", "reduce_or"):
+            axes = set(eqn.params.get("axes", ()))
+            spec = invals[0] if not isinstance(invals[0], _Free) else \
+                self._spec_of(env, eqn.invars[0])
+            if any(spec[a] for a in axes if a < len(spec)):
+                self.comm_bytes += math.prod(out_shapes[0] or (1,)) * 4
+            setout([tuple(s for d, s in enumerate(spec) if d not in axes)])
+            return
+
+        if prim == "split":
+            spec = invals[0] if not isinstance(invals[0], _Free) else \
+                self._spec_of(env, eqn.invars[0])
+            axis = eqn.params.get("axis", 0)
+            outs = []
+            for oshape in out_shapes:
+                s = list(spec)
+                if s[axis] and not self._div(oshape[axis], s[axis]):
+                    s[axis] = None
+                outs.append(tuple(s))
+            setout(outs)
+            return
+
+        if prim in ("concatenate",):
+            dim = eqn.params["dimension"]
+            specs = [v if not isinstance(v, _Free)
+                     else self._spec_of(env, a)
+                     for v, a in zip(invals, eqn.invars)]
+            merged = list(self._merge(specs))
+            merged[dim] = None
+            setout([tuple(merged)])
+            return
+
+        if prim in ("iota", "rng_bit_generator", "random_seed",
+                    "random_wrap", "random_bits"):
+            setout([(None,) * len(s) for s in out_shapes])
+            return
+
+        # ---- default: elementwise merge / shape-match passthrough ------
+        known = []
+        for v, a, shp in zip(invals, eqn.invars, shapes):
+            if isinstance(v, _Free):
+                continue
+            known.append((v, shp))
+        resolved_in = []
+        for v, a, shp in zip(invals, eqn.invars, shapes):
+            if isinstance(v, _Free):
+                # free param merging elementwise against a known operand of
+                # the same shape: the bias/scale rule — inherit its layout
+                want = next((kv for kv, ks in known if ks == shp), None)
+                if want is not None:
+                    resolved_in.append(self._resolve(v, shp, want))
+                else:
+                    resolved_in.append(self._spec_of(env, a))
+            else:
+                resolved_in.append(v)
+        same = [s for s, shp in zip(resolved_in, shapes)
+                if shp == out_shapes[0]]
+        if same and all(len(s) == len(out_shapes[0]) for s in same):
+            setout([self._merge(same)] * len(eqn.outvars))
+        else:
+            setout([(None,) * len(s) for s in out_shapes])
+
+    # ------------------------------------------------------ matmul rule
+    def _place_matmul_param(self, env, atom, free: _Free, wshape,
+                            w_contract, w_batch, act_spec, act_shape,
+                            act_contract, out_size) -> Spec:
+        """Choose a free parameter's placement at a dot_general use.
+
+        Reference: matmul_spmd_rule.cc — the rule set collapses to:
+          * activation's contracted dim already sharded on 'mp'
+              -> ROW parallel (shard the param's contracted dim; GSPMD
+                 inserts one psum over 'mp'), resolving the layout;
+          * otherwise -> COLUMN parallel (shard the param's last free
+                 dim), communication-free, leaving the activation
+                 feature-sharded for the next matmul's row rule.
+        """
+        if self.mp is None:
+            return self._resolve(free, wshape, (None,) * len(wshape))
+        act_mp = any(act_spec[d] == self.mp for d in act_contract
+                     if d < len(act_spec))
+        want: List[Optional[str]] = [None] * len(wshape)
+        if act_mp:
+            cd = w_contract[0] if w_contract else None
+            if cd is not None and self._div(wshape[cd], self.mp):
+                want[cd] = self.mp
+                self.comm_bytes += out_size * 4  # the row-parallel psum
+        else:
+            frees = [d for d in range(len(wshape))
+                     if d not in w_contract and d not in w_batch]
+            for d in reversed(frees):
+                if self._div(wshape[d], self.mp):
+                    want[d] = self.mp
+                    break
+        # resolve against the param's own dims (identity mapping: the
+        # _Free here is the raw invar or a shape-preserving view)
+        pid_map = free.dim_map
+        inv = _Free(free.pid, pid_map)
+        return self._resolve(inv, wshape, tuple(want))
+
+    def _reshape(self, eqn, env, inval, in_shape, out_shape):
+        """Split/merge dim tracking: a sharded dim keeps its axis when it
+        maps to (or is the MAJOR factor of) an output dim."""
+        spec = inval if not isinstance(inval, _Free) else \
+            self._spec_of(env, eqn.invars[0])
+        out: List[Optional[str]] = [None] * len(out_shape)
+        i = j = 0
+        while i < len(in_shape) and j < len(out_shape):
+            if in_shape[i] == out_shape[j]:
+                out[j] = spec[i]
+                i += 1
+                j += 1
+            elif in_shape[i] != 0 and out_shape[j] % max(in_shape[i], 1) == 0 \
+                    and in_shape[i] < out_shape[j]:
+                # merge: in dims i.. combine into out j; major in-dim's
+                # axis survives if divisibility holds
+                acc = in_shape[i]
+                major = spec[i]
+                i += 1
+                while i < len(in_shape) and acc < out_shape[j]:
+                    acc *= in_shape[i]
+                    i += 1
+                if major and self._div(out_shape[j], major):
+                    out[j] = major
+                j += 1
+            elif out_shape[j] != 0 and in_shape[i] % max(out_shape[j], 1) == 0 \
+                    and out_shape[j] < in_shape[i]:
+                # split: in dim i splits into out dims j..; axis goes to
+                # the MAJOR (first) output factor
+                acc = out_shape[j]
+                if spec[i] and self._div(out_shape[j], spec[i]):
+                    out[j] = spec[i]
+                j += 1
+                while j < len(out_shape) and acc < in_shape[i]:
+                    acc *= out_shape[j]
+                    j += 1
+                i += 1
+            else:
+                i += 1
+                j += 1
+        if isinstance(inval, _Free):
+            env[eqn.outvars[0]] = _Free(
+                inval.pid, tuple(None for _ in out_shape))
+            return
+        env[eqn.outvars[0]] = tuple(out)
+
+
+def trace_loss_jaxpr(model, sample_ids, sample_labels, loss_of):
+    """Abstract-trace `loss_of` once. The jaxpr is MESH-INDEPENDENT, so a
+    planner evaluating many candidate meshes traces once and reruns only
+    the propagation. Returns (closed_jaxpr, param_names, param_shapes,
+    n_batch)."""
+    from ...core.tensor import Tensor
+
+    params = list(model.named_parameters())
+    pvals = [p._value for _, p in params]
+
+    def fwd(pv, ids, lbl):
+        saved = [p._value for _, p in params]
+        try:
+            for (_, p), v in zip(params, pv):
+                p._value = v
+            return loss_of(Tensor(ids),
+                           Tensor(lbl) if lbl is not None else None)._value
+        finally:
+            for (_, p), v in zip(params, saved):
+                p._value = v
+
+    ids = np.asarray(sample_ids)
+    lbl = None if sample_labels is None else np.asarray(sample_labels)
+    if lbl is None:
+        jx = jax.make_jaxpr(lambda pv, i: fwd(pv, i, None))(pvals, ids)
+        n_batch = 1
+    else:
+        jx = jax.make_jaxpr(fwd)(pvals, ids, lbl)
+        n_batch = 2
+    names = [nm for nm, _ in params]
+    shapes = [tuple(p.shape) for _, p in params]
+    return jx, names, shapes, n_batch
+
+
+def complete_from_jaxpr(jx, param_names, param_shapes, n_batch,
+                        mesh) -> Tuple[Dict[str, P], float]:
+    """Run the Completer over a pre-traced jaxpr for one candidate mesh."""
+    comp = Completer(mesh)
+    dp = comp.dp
+    batch_seed: List[Spec] = [((dp,) if dp else (None,))] * n_batch
+    idx_specs, cost = comp.run(jx, len(param_names), batch_seed)
+    out: Dict[str, P] = {}
+    for i, (name, shape) in enumerate(zip(param_names, param_shapes)):
+        spec = idx_specs.get(i)
+        if spec is None:
+            out[name] = P()
+        else:
+            spec = tuple(spec[:len(shape)]) + \
+                (None,) * (len(shape) - len(spec))
+            out[name] = P(*spec) if any(spec) else P()
+    return out, cost
+
+
+def complete_parameter_specs(model, mesh, sample_ids, sample_labels,
+                             loss_of) -> Tuple[Dict[str, P], float]:
+    """Trace `loss_of` abstractly and derive every parameter's placement
+    from its use sites (see Completer). Returns (name->PartitionSpec,
+    estimated collective bytes). Raises on trace failure — the caller
+    falls back to the name/shape rules."""
+    jx, names, shapes, n_batch = trace_loss_jaxpr(
+        model, sample_ids, sample_labels, loss_of)
+    return complete_from_jaxpr(jx, names, shapes, n_batch, mesh)

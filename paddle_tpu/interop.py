@@ -1,0 +1,274 @@
+"""Reference-ecosystem checkpoint interop: load published Paddle
+`*.pdparams` state dicts into this framework's model zoo.
+
+Reference format: `paddle.save(model.state_dict(), 'm.pdparams')` pickles
+a {structured_name: ndarray} dict (python/paddle/framework/io.py save —
+tensors are converted to numpy before pickling). This framework's layers
+already follow the reference's parameter conventions (Linear [in, out],
+Conv OIHW, BatchNorm `_mean`/`_variance` buffers in the state dict), so
+vision checkpoints map near-1:1; NLP checkpoints from the PaddleNLP
+ecosystem need structural renames plus a q/k/v -> fused-qkv weave (this
+zoo fuses attention projections; the per-head column layout is
+[q_h | k_h | v_h] per head — see models/bert.py BertSelfAttention).
+
+Name aliasing follows the compat tables the reference keeps in
+paddle/phi/api/yaml/op_compat.yaml (e.g. batch_norm Mean/Variance ->
+mean/variance, fluid-era `.w_0`/`.b_0` parameter suffixes).
+"""
+from __future__ import annotations
+
+import io
+import pickle
+import re
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = [
+    "load_pdparams", "save_pdparams", "convert_paddle_state_dict",
+    "load_paddle_checkpoint",
+]
+
+
+# ------------------------------------------------------------- pickle IO
+class _RestrictedUnpickler(pickle.Unpickler):
+    """Unpickle only what a pdparams state dict legitimately contains."""
+
+    _ALLOWED = {
+        ("numpy", "ndarray"), ("numpy", "dtype"),
+        ("numpy.core.multiarray", "_reconstruct"),
+        ("numpy.core.multiarray", "scalar"),
+        ("numpy._core.multiarray", "_reconstruct"),
+        ("numpy._core.multiarray", "scalar"),
+        ("collections", "OrderedDict"),
+        ("_codecs", "encode"),  # numpy pickles bytes via _codecs.encode
+    }
+
+    def find_class(self, module, name):
+        # strict allowlist only: a module prefix check would admit exec
+        # gadgets like numpy.testing._private.utils.runstring
+        if (module, name) in self._ALLOWED:
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"pdparams: refusing to unpickle {module}.{name}")
+
+
+def load_pdparams(path: str) -> Dict[str, np.ndarray]:
+    """Read a reference-format `.pdparams` file into {name: ndarray}."""
+    with open(path, "rb") as f:
+        obj = _RestrictedUnpickler(f).load()
+    if not isinstance(obj, dict):
+        raise ValueError(f"pdparams: expected a state dict, got {type(obj)}")
+    return {str(k): np.asarray(v) for k, v in obj.items()}
+
+
+def save_pdparams(state_dict, path: str) -> None:
+    """Write a reference-compatible `.pdparams` (numpy-valued pickle)."""
+    out = {}
+    for k, v in state_dict.items():
+        v = getattr(v, "_value", v)
+        out[str(k)] = np.asarray(v)
+    with open(path, "wb") as f:
+        pickle.dump(out, f, protocol=2)
+
+
+# ------------------------------------------------------ name conversion
+# fluid-era parameter suffixes (op_compat.yaml-era compat: linear/conv
+# parameters were published as `<op>_<i>.w_0` / `.b_0`)
+_FLUID_SUFFIXES = [(re.compile(r"\.w_0$"), ".weight"),
+                   (re.compile(r"\.b_0$"), ".bias"),
+                   (re.compile(r"\.w_1$"), ".weight"),
+                   (re.compile(r"\.b_1$"), ".bias")]
+
+# batch_norm compat (op_compat.yaml: batch_norm {Scale: scale, Bias:
+# bias, Mean: mean, Variance: variance}); published vision state dicts
+# use `_mean`/`_variance`, older exports `.mean`/`.variance`
+_BN_ALIASES = [(re.compile(r"\.mean$"), "._mean"),
+               (re.compile(r"\.variance$"), "._variance"),
+               (re.compile(r"\.moving_mean$"), "._mean"),
+               (re.compile(r"\.moving_variance$"), "._variance")]
+
+
+def _apply_aliases(name: str) -> str:
+    for pat, rep in _FLUID_SUFFIXES + _BN_ALIASES:
+        name = pat.sub(rep, name)
+    return name
+
+
+def _weave_qkv(wq, wk, wv, num_heads: int, axis: int):
+    """Concatenate separate q/k/v projections into the fused per-head
+    layout [q_h | k_h | v_h] used by this zoo's attention blocks."""
+    H = wq.shape[axis]
+    hd = H // num_heads
+    parts = []
+    for arr in (wq, wk, wv):
+        shape = list(arr.shape)
+        shape[axis:axis + 1] = [num_heads, hd]
+        parts.append(arr.reshape(shape))
+    woven = np.stack(parts, axis=axis + 1)  # [..., heads, 3, hd, ...]
+    shape = list(wq.shape)
+    shape[axis] = 3 * H
+    return woven.reshape(shape)
+
+
+def _unweave_qkv(w, num_heads: int, axis: int):
+    """Inverse of _weave_qkv (used to EXPORT back to q/k/v checkpoints)."""
+    H3 = w.shape[axis]
+    H = H3 // 3
+    hd = H // num_heads
+    shape = list(w.shape)
+    shape[axis:axis + 1] = [num_heads, 3, hd]
+    woven = w.reshape(shape)
+    outs = []
+    for i in range(3):
+        part = np.take(woven, i, axis=axis + 1)
+        shape = list(w.shape)
+        shape[axis] = H
+        outs.append(part.reshape(shape))
+    return outs
+
+
+def _convert_bert(sd: Dict[str, np.ndarray],
+                  num_heads: Optional[int] = None) -> Dict[str, np.ndarray]:
+    """PaddleNLP bert naming -> this zoo's BertModel naming.
+
+    PaddleNLP (transformers.bert.modeling.BertModel over
+    nn.TransformerEncoder): bert.embeddings.*,
+    bert.encoder.layers.{i}.self_attn.{q,k,v}_proj / out_proj,
+    .linear1/.linear2, .norm1/.norm2, bert.pooler.dense.
+    """
+    sd = {re.sub(r"^bert\.", "", k): v for k, v in sd.items()}
+    out: Dict[str, np.ndarray] = {}
+    # gather q/k/v triplets per layer for the weave
+    qkv: Dict[str, Dict[str, np.ndarray]] = {}
+    renames = [
+        (re.compile(r"^encoder\.layers\.(\d+)\.self_attn\.out_proj\."),
+         r"encoder.\1.attention.out."),
+        (re.compile(r"^encoder\.layers\.(\d+)\.linear1\."),
+         r"encoder.\1.fc_in."),
+        (re.compile(r"^encoder\.layers\.(\d+)\.linear2\."),
+         r"encoder.\1.fc_out."),
+        (re.compile(r"^encoder\.layers\.(\d+)\.norm1\."),
+         r"encoder.\1.attn_norm."),
+        (re.compile(r"^encoder\.layers\.(\d+)\.norm2\."),
+         r"encoder.\1.ffn_norm."),
+        (re.compile(r"^pooler\.dense\."), "pooler."),
+    ]
+    for k, v in sd.items():
+        m = re.match(r"^encoder\.layers\.(\d+)\.self_attn\."
+                     r"([qkv])_proj\.(weight|bias)$", k)
+        if m:
+            qkv.setdefault(f"{m.group(1)}.{m.group(3)}", {})[m.group(2)] = v
+            continue
+        nk = k
+        for pat, rep in renames:
+            nk = pat.sub(rep, nk)
+        out[nk] = v
+    for key, triple in qkv.items():
+        layer, kind = key.split(".")
+        if set(triple) != {"q", "k", "v"}:
+            raise ValueError(f"bert convert: incomplete q/k/v for layer "
+                             f"{layer} ({sorted(triple)})")
+        wq = triple["q"]
+        heads = num_heads
+        if heads is None:
+            raise ValueError("bert convert: num_heads required to weave "
+                             "q/k/v into the fused layout")
+        axis = 1 if kind == "weight" else 0
+        out[f"encoder.{layer}.attention.qkv.{kind}"] = _weave_qkv(
+            triple["q"], triple["k"], triple["v"], heads, axis)
+    return out
+
+
+def _export_bert(sd: Dict[str, np.ndarray],
+                 num_heads: int) -> Dict[str, np.ndarray]:
+    """This zoo's BertModel naming -> PaddleNLP naming (inverse)."""
+    out: Dict[str, np.ndarray] = {}
+    renames = [
+        (re.compile(r"^encoder\.(\d+)\.attention\.out\."),
+         r"encoder.layers.\1.self_attn.out_proj."),
+        (re.compile(r"^encoder\.(\d+)\.fc_in\."),
+         r"encoder.layers.\1.linear1."),
+        (re.compile(r"^encoder\.(\d+)\.fc_out\."),
+         r"encoder.layers.\1.linear2."),
+        (re.compile(r"^encoder\.(\d+)\.attn_norm\."),
+         r"encoder.layers.\1.norm1."),
+        (re.compile(r"^encoder\.(\d+)\.ffn_norm\."),
+         r"encoder.layers.\1.norm2."),
+        (re.compile(r"^pooler\."), "pooler.dense."),
+    ]
+    for k, v in sd.items():
+        m = re.match(r"^encoder\.(\d+)\.attention\.qkv\.(weight|bias)$", k)
+        if m:
+            axis = 1 if m.group(2) == "weight" else 0
+            q, kk, vv = _unweave_qkv(np.asarray(v), num_heads, axis)
+            for nm, arr in (("q", q), ("k", kk), ("v", vv)):
+                out[f"bert.encoder.layers.{m.group(1)}.self_attn."
+                    f"{nm}_proj.{m.group(2)}"] = arr
+            continue
+        nk = k
+        for pat, rep in renames:
+            nk = pat.sub(rep, nk)
+        out["bert." + nk] = np.asarray(getattr(v, "_value", v))
+    return out
+
+
+def convert_paddle_state_dict(sd: Dict[str, np.ndarray], model=None,
+                              family: Optional[str] = None,
+                              num_heads: Optional[int] = None
+                              ) -> Dict[str, np.ndarray]:
+    """Map a reference-ecosystem state dict onto this zoo's names.
+
+    family: 'bert' (PaddleNLP naming, q/k/v weave), or None for the
+    near-identity vision mapping (alias fixups only). Auto-detected from
+    key fingerprints when None and a bert-style dict is given."""
+    if family is None:
+        if any(".self_attn.q_proj." in k for k in sd):
+            family = "bert"
+    if family == "bert":
+        if num_heads is None and model is not None:
+            num_heads = getattr(getattr(model, "config", None),
+                                "num_heads", None)
+        return _convert_bert(sd, num_heads=num_heads)
+    return {_apply_aliases(k): v for k, v in sd.items()}
+
+
+def load_paddle_checkpoint(model, path: str, family: Optional[str] = None,
+                           strict: bool = True) -> List[str]:
+    """Load a `.pdparams` checkpoint into `model`. Returns the list of
+    checkpoint keys that did not match any model state (empty when
+    strict, or raises)."""
+    sd = load_pdparams(path)
+    conv = convert_paddle_state_dict(sd, model=model, family=family)
+    own = model.state_dict()
+    missing = [k for k in own if k not in conv]
+    unexpected = [k for k in conv if k not in own]
+    if strict and (missing or unexpected):
+        raise ValueError(
+            f"load_paddle_checkpoint: missing={missing[:8]} "
+            f"unexpected={unexpected[:8]} "
+            f"(of {len(missing)}/{len(unexpected)})")
+    for k, v in conv.items():
+        if k in own:
+            cur = own[k]
+            if tuple(np.shape(v)) != tuple(cur.shape):
+                raise ValueError(
+                    f"load_paddle_checkpoint: shape mismatch for {k}: "
+                    f"checkpoint {np.shape(v)} vs model {tuple(cur.shape)}")
+    model.set_state_dict({k: v for k, v in conv.items() if k in own})
+    return unexpected
+
+
+def export_paddle_state_dict(model, family: Optional[str] = None,
+                             num_heads: Optional[int] = None
+                             ) -> Dict[str, np.ndarray]:
+    """Export `model`'s state dict under reference-ecosystem names (the
+    inverse mapping; useful for round-trip tests and for publishing
+    checkpoints consumable by reference tooling)."""
+    sd = {k: np.asarray(getattr(v, "_value", v))
+          for k, v in model.state_dict().items()}
+    if family == "bert":
+        heads = num_heads or getattr(getattr(model, "config", None),
+                                     "num_heads", None)
+        return _export_bert(sd, heads)
+    return sd

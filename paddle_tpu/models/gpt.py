@@ -251,3 +251,121 @@ class GPTForCausalLM(nn.Layer, GenerationMixin):
             )
             return loss
         return logits
+
+
+# --------------------------------------------------- pipeline decomposition
+class _GPTPipeEmbed(nn.Layer):
+    """Stage-0 pre layer: token + positional embedding + dropout, and the
+    final LayerNorm that the (tied) head applies — kept here so the
+    pipeline's middle stages are HOMOGENEOUS GPTBlocks (the schedule
+    engine requires structurally identical stages; embedding/head run
+    fused into the first/last stages via SharedLayerDesc)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.wte = nn.Embedding(config.vocab_size, config.hidden_size)
+        self.wpe = nn.Embedding(config.max_position_embeddings,
+                                config.hidden_size)
+        self.drop = nn.Dropout(config.hidden_dropout_prob)
+        if config.tie_word_embeddings:
+            # the tied head applies the final norm from this shared layer;
+            # untied configs keep ln_f in their own head stage instead
+            self.ln_f = nn.LayerNorm(config.hidden_size)
+
+    @property
+    def weight(self):
+        return self.wte.weight  # the shared (tied) embedding weight
+
+    def forward(self, ids):
+        s = ids.shape[1]
+        p = api.arange(0, s, 1, dtype="int32")
+        return self.drop(self.wte(ids) + self.wpe(p))
+
+
+class _GPTPipeHead(nn.Layer):
+    """Untied head: final norm + projection (shared_post, own weights)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln_f = nn.LayerNorm(config.hidden_size)
+        self.proj = ColumnParallelLinear(config.hidden_size,
+                                         config.vocab_size,
+                                         has_bias=False, gather_output=True)
+
+    @property
+    def weight(self):
+        return self.proj.weight
+
+    def forward(self, h):
+        return self.proj(self.ln_f(h))
+
+
+def _gpt_tied_head_fwd(layer, h):
+    return api.matmul(layer.ln_f(h), layer.wte.weight, transpose_y=True)
+
+
+def _gpt_untied_head_fwd(layer, h):
+    return layer(h)
+
+
+def _gpt_pipeline_loss(out, label):
+    v = out.shape[-1]
+    return F.cross_entropy(api.reshape(out, [-1, v]),
+                           api.reshape(label, [-1]))
+
+
+def _gpt_pipeline_descs(self):
+    """LayerDesc decomposition of this model for pipeline engines
+    (reference: PipeLayer desc lists in python/paddle/distributed/fleet/
+    meta_parallel/parallel_layers/pp_layers.py; the fleet GPT benchmarks
+    build [embedding] + [TransformerLayer]*L + [norm+head] descs).
+
+    Returns (descs, loss_fn, copy_weights) where copy_weights(pipeline_
+    layer) copies THIS model's weights into the built pipeline. Rotary
+    configs are rejected (rope tables are shared state the desc layers
+    don't carry)."""
+    from ..distributed.fleet.pipeline_parallel import (
+        LayerDesc, SharedLayerDesc)
+
+    cfg = self.config
+    if cfg.use_rotary:
+        raise ValueError("pipeline_descs: rotary GPT configs are not "
+                         "pipeline-decomposable (rope is shared state)")
+    descs = [SharedLayerDesc("embed", _GPTPipeEmbed, None, "weight", cfg)]
+    descs += [LayerDesc(GPTBlock, cfg) for _ in range(cfg.num_layers)]
+    if cfg.tie_word_embeddings:
+        descs.append(SharedLayerDesc("embed", _GPTPipeEmbed,
+                                     _gpt_tied_head_fwd, "weight", cfg))
+    else:
+        descs.append(SharedLayerDesc("head", _GPTPipeHead,
+                                     _gpt_untied_head_fwd, "weight", cfg))
+
+    model = self
+
+    def copy_weights(pl, reverse=False):
+        """model -> pipeline (default) or pipeline -> model (reverse,
+        used to sync trained weights back after a pp fit)."""
+        pre = pl.shared_pre
+        pairs = [(model.gpt.wte.weight, pre.wte.weight),
+                 (model.gpt.wpe.weight, pre.wpe.weight)]
+        if cfg.tie_word_embeddings:
+            pairs += [(model.gpt.ln_f.weight, pre.ln_f.weight),
+                      (model.gpt.ln_f.bias, pre.ln_f.bias)]
+        for src_blk, dst_blk in zip(model.gpt.blocks, pl.run_function):
+            pairs += list(zip(src_blk.parameters(), dst_blk.parameters()))
+        if not cfg.tie_word_embeddings:
+            head = pl.shared_post[0]
+            pairs += [(model.gpt.ln_f.weight, head.ln_f.weight),
+                      (model.gpt.ln_f.bias, head.ln_f.bias),
+                      (model.lm_head.weight, head.proj.weight)]
+        for m_p, p_p in pairs:
+            assert tuple(m_p.shape) == tuple(p_p.shape)
+            if reverse:
+                m_p._value = p_p._value
+            else:
+                p_p._value = m_p._value
+
+    return descs, _gpt_pipeline_loss, copy_weights
+
+
+GPTForCausalLM.pipeline_descs = _gpt_pipeline_descs

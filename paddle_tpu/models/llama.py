@@ -226,3 +226,119 @@ class LlamaForCausalLM(nn.Layer, GenerationMixin):
             shift_labels = api.reshape(labels[:, 1:], [-1])
             return F.cross_entropy(shift_logits, shift_labels)
         return logits
+
+
+# --------------------------------------------------- pipeline decomposition
+class _LlamaPipeBlock(nn.Layer):
+    """LlamaDecoderLayer with its own rope tables so the stage is
+    self-contained (cos/sin recomputed per stage — position-only)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.block = LlamaDecoderLayer(config)
+        head_dim = config.hidden_size // config.num_heads
+        self._rope = _rope_tables(head_dim, config.max_position_embeddings,
+                                  config.rope_theta)
+
+    def forward(self, h):
+        s = h.shape[1]
+        cos = Tensor(self._rope[0]._value[:s])
+        sin = Tensor(self._rope[1]._value[:s])
+        return self.block(h, (cos, sin))
+
+
+class _LlamaPipeEmbed(nn.Layer):
+    """Stage-0 pre: token embedding; also holds the final RMSNorm the
+    (tied) head applies, keeping middle stages homogeneous."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.embed = VocabParallelEmbedding(config.vocab_size,
+                                            config.hidden_size)
+        if config.tie_word_embeddings:
+            # final norm applied by the tied head; untied configs keep it
+            # in their own head stage
+            self.norm = nn.RMSNorm(config.hidden_size,
+                                   epsilon=config.rms_norm_eps)
+
+    @property
+    def weight(self):
+        return self.embed.weight
+
+    def forward(self, ids):
+        return self.embed(ids)
+
+
+class _LlamaPipeHead(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.norm = nn.RMSNorm(config.hidden_size,
+                               epsilon=config.rms_norm_eps)
+        self.proj = ColumnParallelLinear(config.hidden_size,
+                                         config.vocab_size, has_bias=False)
+
+    @property
+    def weight(self):
+        return self.proj.weight
+
+    def forward(self, h):
+        return self.proj(self.norm(h))
+
+
+def _llama_tied_head_fwd(layer, h):
+    return api.matmul(layer.norm(h), api.t(layer.embed.weight))
+
+
+def _llama_untied_head_fwd(layer, h):
+    return layer(h)
+
+
+def _llama_pipeline_loss(out, label):
+    v = out.shape[-1]
+    shift_logits = api.reshape(out[:, :-1, :], [-1, v])
+    shift_labels = api.reshape(label[:, 1:], [-1])
+    return F.cross_entropy(shift_logits, shift_labels)
+
+
+def _llama_pipeline_descs(self):
+    """LayerDesc decomposition (see GPTForCausalLM.pipeline_descs).
+    Returns (descs, loss_fn, copy_weights)."""
+    from ..distributed.fleet.pipeline_parallel import (
+        LayerDesc, SharedLayerDesc)
+
+    cfg = self.config
+    descs = [SharedLayerDesc("embed", _LlamaPipeEmbed, None, "weight", cfg)]
+    descs += [LayerDesc(_LlamaPipeBlock, cfg)
+              for _ in range(cfg.num_layers)]
+    if cfg.tie_word_embeddings:
+        descs.append(SharedLayerDesc("embed", _LlamaPipeEmbed,
+                                     _llama_tied_head_fwd, "weight", cfg))
+    else:
+        descs.append(SharedLayerDesc("head", _LlamaPipeHead,
+                                     _llama_untied_head_fwd, "weight", cfg))
+
+    model = self
+
+    def copy_weights(pl, reverse=False):
+        """model -> pipeline (default) or pipeline -> model (reverse)."""
+        pre = pl.shared_pre
+        pairs = [(model.model.embed_tokens.weight, pre.embed.weight)]
+        if cfg.tie_word_embeddings:
+            pairs.append((model.model.norm.weight, pre.norm.weight))
+        for src_l, dst in zip(model.model.layers, pl.run_function):
+            pairs += list(zip(src_l.parameters(), dst.block.parameters()))
+        if not cfg.tie_word_embeddings:
+            head = pl.shared_post[0]
+            pairs += [(model.model.norm.weight, head.norm.weight),
+                      (model.lm_head.weight, head.proj.weight)]
+        for m_p, p_p in pairs:
+            assert tuple(m_p.shape) == tuple(p_p.shape)
+            if reverse:
+                m_p._value = p_p._value
+            else:
+                p_p._value = m_p._value
+
+    return descs, _llama_pipeline_loss, copy_weights
+
+
+LlamaForCausalLM.pipeline_descs = _llama_pipeline_descs

@@ -86,7 +86,12 @@ class TestEngineFit:
         assert len(hist["loss"]) == 2
         assert np.isfinite(hist["loss"]).all()
         cfgd = eng.plan["mesh_config"]
-        assert cfgd is not None and cfgd["dp"] * cfgd["mp"] == len(jax.devices())
+        assert cfgd is not None
+        total = 1
+        for v in cfgd.values():
+            total *= v
+        # the planner may pick any point of the full dp/mp/pp/sep topology
+        assert total == len(jax.devices()), cfgd
 
     def test_engine_evaluate(self):
         cfg = GPTConfig.tiny()

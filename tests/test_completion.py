@@ -1,0 +1,178 @@
+"""Completer tests (VERDICT r4 item 3): einsum-level sharding propagation
+derives the classic Megatron placements from USE SITES, with no name
+heuristics; the Engine executes planner-chosen pp and sep degrees with
+loss parity.
+
+Reference: completion.py Completer + spmd_rules
+(fluid/distributed/auto_parallel/spmd_rules/matmul_spmd_rule.cc etc.).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.auto_parallel import Engine
+from paddle_tpu.distributed.auto_parallel.completion import (
+    complete_parameter_specs)
+from paddle_tpu.models import GPTConfig, GPTForCausalLM
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+
+def _gpt(heads=8, hidden=64, layers=2, **kw):
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=hidden, num_layers=layers,
+                    num_heads=heads, max_position_embeddings=64,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                    **kw)
+    return cfg, GPTForCausalLM(cfg)
+
+
+class TestCompleterSpecs:
+    def test_gpt_megatron_pairing_derived(self):
+        cfg, m = _gpt()
+        mesh = dist.build_mesh(dp=2, mp=4)
+        ids = np.zeros((4, 16), np.int32)
+        specs, cost = complete_parameter_specs(
+            m, mesh, ids, None,
+            lambda i, l: m(i, labels=i if l is None else l))
+        s = {k: tuple(v) for k, v in specs.items()}
+        # vocab-parallel embedding (embedding rule, from the gather)
+        assert s["gpt.wte.weight"] == ("mp", None)
+        # column-parallel fan-out (matmul rule: act replicated)
+        assert s["gpt.blocks.0.attn.qkv_proj.weight"] == (None, "mp")
+        assert s["gpt.blocks.0.mlp.fc_in.weight"] == (None, "mp")
+        # row-parallel fan-in (matmul rule: act feature dim carries mp)
+        assert s["gpt.blocks.0.attn.out_proj.weight"] == ("mp", None)
+        assert s["gpt.blocks.0.mlp.fc_out.weight"] == ("mp", None)
+        # norms replicate
+        assert s["gpt.blocks.0.ln_1.weight"] == ()
+        assert s["gpt.ln_f.weight"] == ()
+        assert cost > 0  # the row psums were accounted
+
+    def test_unshardable_heads_stay_consistent(self):
+        # heads < mp: propagation discovers the attention reshape cannot
+        # carry 'mp', so the derived plan stays internally consistent
+        # (no axis survives an indivisible split)
+        cfg, m = _gpt(heads=2, hidden=32)
+        mesh = dist.build_mesh(dp=2, mp=4)
+        ids = np.zeros((4, 16), np.int32)
+        specs, _ = complete_parameter_specs(
+            m, mesh, ids, None, lambda i, l: m(i, labels=i))
+        # qkv still column-shards (3H=96 % 4 == 0); out_proj must NOT be
+        # row-parallel since the activation lost 'mp' in the head split
+        assert tuple(specs["gpt.blocks.0.attn.out_proj.weight"]) != \
+            ("mp", None)
+
+    def test_llama_specs(self):
+        paddle.seed(0)
+        cfg = LlamaConfig(vocab_size=128, hidden_size=64, num_layers=1,
+                          num_heads=8, num_key_value_heads=8,
+                          intermediate_size=128,
+                          max_position_embeddings=64)
+        m = LlamaForCausalLM(cfg)
+        mesh = dist.build_mesh(dp=2, mp=4)
+        ids = np.zeros((4, 16), np.int32)
+        specs, _ = complete_parameter_specs(
+            m, mesh, ids, None, lambda i, l: m(i, labels=i))
+        s = {k: tuple(v) for k, v in specs.items()}
+        assert s["model.embed_tokens.weight"] == ("mp", None)
+        # down_proj is the fan-in of the gated MLP -> row parallel
+        down = [k for k in s if "down_proj" in k][0]
+        assert s[down] == ("mp", None)
+
+
+class TestEnginePipeline:
+    def test_engine_pp_mesh_loss_parity(self):
+        # explicit mesh with a pp axis: Engine auto-builds the pipeline
+        # from pipeline_descs, copies weights, and the first train_batch
+        # loss equals the model's own full-batch loss
+        cfg, m = _gpt(layers=4)
+        opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+        mesh = dist.build_mesh(dp=2, pp=2, mp=2)
+        eng = Engine(m, optimizer=opt, mesh=mesh)
+        ids = np.random.RandomState(0).randint(
+            0, cfg.vocab_size, (8, 16)).astype(np.int32)
+        ref = float(m(paddle.to_tensor(ids),
+                      labels=paddle.to_tensor(ids)).item())
+        hist = eng.fit([(paddle.to_tensor(ids),)], epochs=1)
+        assert eng.plan["method"] == "pipeline"
+        np.testing.assert_allclose(hist["loss"][0], ref, rtol=1e-4)
+
+    def test_engine_sep_mesh_ring_parity(self, monkeypatch):
+        cfg, m = _gpt(layers=2, use_rotary=True)
+        assert cfg.sequence_parallel is None
+        ids = np.random.RandomState(1).randint(
+            0, cfg.vocab_size, (4, 32)).astype(np.int32)
+        ref = float(m(paddle.to_tensor(ids),
+                      labels=paddle.to_tensor(ids)).item())
+        opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+        mesh = dist.build_mesh(dp=4, sep=2)
+        eng = Engine(m, optimizer=opt, mesh=mesh)
+
+        # prove ring attention actually EXECUTES (the config flag alone
+        # is not enough — layers snapshot it at construction)
+        from paddle_tpu.distributed import context_parallel as cp
+
+        calls = []
+        real_ring = cp.ring_attention
+
+        def spy(*a, **k):
+            calls.append(1)
+            return real_ring(*a, **k)
+
+        monkeypatch.setattr(cp, "ring_attention", spy)
+        hist = eng.fit([(paddle.to_tensor(ids),)], epochs=1)
+        assert cfg.sequence_parallel == "ring"  # engine flipped the mode
+        assert m.gpt.blocks[0].attn.sequence_parallel == "ring"
+        assert calls, "ring_attention never ran under the sep mesh"
+        np.testing.assert_allclose(hist["loss"][0], ref, rtol=1e-3)
+
+    def test_llama_engine_pp_smoke(self):
+        paddle.seed(0)
+        cfg = LlamaConfig(vocab_size=128, hidden_size=64, num_layers=4,
+                          num_heads=4, num_key_value_heads=4,
+                          intermediate_size=128,
+                          max_position_embeddings=64)
+        m = LlamaForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+        mesh = dist.build_mesh(dp=2, pp=2, mp=2)
+        eng = Engine(m, optimizer=opt, mesh=mesh)
+        ids = np.random.RandomState(2).randint(
+            0, cfg.vocab_size, (8, 16)).astype(np.int32)
+        ref = float(m(paddle.to_tensor(ids),
+                      labels=paddle.to_tensor(ids)).item())
+        hist = eng.fit([(paddle.to_tensor(ids),)], epochs=1)
+        np.testing.assert_allclose(hist["loss"][0], ref, rtol=1e-4)
+
+
+class TestEnginePipelineSync:
+    def test_fit_syncs_weights_back_to_model(self):
+        cfg, m = _gpt(layers=4)
+        opt = paddle.optimizer.AdamW(1e-2, parameters=m.parameters())
+        mesh = dist.build_mesh(dp=2, pp=2, mp=2)
+        eng = Engine(m, optimizer=opt, mesh=mesh)
+        ids = np.random.RandomState(3).randint(
+            0, cfg.vocab_size, (8, 16)).astype(np.int32)
+        before = np.asarray(m.gpt.blocks[0].mlp.fc_in.weight._value).copy()
+        l0 = None
+        hist = eng.fit([(paddle.to_tensor(ids),)] * 3, epochs=1)
+        after = np.asarray(m.gpt.blocks[0].mlp.fc_in.weight._value)
+        assert not np.allclose(before, after), "weights not synced back"
+        # training actually reduced the loss on the repeated batch
+        assert hist["loss"][-1] < hist["loss"][0]
+
+    def test_pp_optimizer_clone_keeps_hyperparams(self):
+        cfg, m = _gpt(layers=4)
+        opt = paddle.optimizer.AdamW(3e-4, beta1=0.95, beta2=0.98,
+                                     weight_decay=0.1,
+                                     parameters=m.parameters())
+        mesh = dist.build_mesh(dp=2, pp=2, mp=2)
+        eng = Engine(m, optimizer=opt, mesh=mesh)
+        ids = np.random.RandomState(4).randint(
+            0, cfg.vocab_size, (8, 16)).astype(np.int32)
+        eng.prepare((paddle.to_tensor(ids),))
+        assert eng._pp_opt._beta1 == 0.95
+        assert eng._pp_opt._beta2 == 0.98
+        assert eng._pp_opt._decoupled_wd == 0.1
+        assert eng._pp_opt is not opt
+        assert eng._pp_opt._state == {}

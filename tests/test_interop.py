@@ -1,0 +1,113 @@
+"""Reference-ecosystem checkpoint interop (VERDICT r5 item 4): published
+Paddle `.pdparams` state dicts load into the zoo with output parity.
+
+Fixtures are synthesized round trips (zero egress): a state dict written
+under REFERENCE naming (vision structured names incl. BN _mean/_variance;
+PaddleNLP bert naming with separate q/k/v projections) is loaded through
+the converter into a FRESH model, which must reproduce the original
+model's outputs exactly.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import interop
+from paddle_tpu.models.bert import BertConfig, BertModel
+from paddle_tpu.vision.models import resnet18
+
+
+def test_resnet_pdparams_round_trip(tmp_path):
+    paddle.seed(0)
+    m = resnet18(num_classes=10)
+    m.eval()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 3, 32, 32).astype(np.float32))
+    ref = m(x).numpy()
+
+    path = os.path.join(tmp_path, "resnet18.pdparams")
+    interop.save_pdparams(m.state_dict(), path)
+
+    paddle.seed(123)  # different init: parity must come from the load
+    m2 = resnet18(num_classes=10)
+    m2.eval()
+    assert not np.allclose(m2(x).numpy(), ref)
+    unexpected = interop.load_paddle_checkpoint(m2, path)
+    assert unexpected == []
+    np.testing.assert_allclose(m2(x).numpy(), ref, rtol=1e-5, atol=1e-6)
+
+
+def test_bn_stat_aliases():
+    sd = {"bn1.mean": np.zeros(3), "bn1.moving_variance": np.ones(3),
+          "fc_0.w_0": np.zeros((2, 2)), "fc_0.b_0": np.zeros(2)}
+    conv = interop.convert_paddle_state_dict(sd)
+    assert set(conv) == {"bn1._mean", "bn1._variance",
+                         "fc_0.weight", "fc_0.bias"}
+
+
+def test_bert_paddlenlp_round_trip(tmp_path):
+    cfg = BertConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                     num_heads=4, intermediate_size=64,
+                     max_position_embeddings=32,
+                     hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    paddle.seed(0)
+    m = BertModel(cfg)
+    m.eval()
+    ids = paddle.to_tensor(np.random.RandomState(1).randint(
+        0, 128, (2, 16)).astype(np.int32))
+    ref = m(ids)[0].numpy()
+
+    # export under PaddleNLP naming: bert.* prefix, SEPARATE q/k/v projs
+    nlp_sd = interop.export_paddle_state_dict(m, family="bert")
+    assert any(".self_attn.q_proj.weight" in k for k in nlp_sd)
+    assert all(k.startswith("bert.") for k in nlp_sd)
+    path = os.path.join(tmp_path, "bert.pdparams")
+    interop.save_pdparams(nlp_sd, path)
+
+    paddle.seed(99)
+    m2 = BertModel(cfg)
+    m2.eval()
+    assert not np.allclose(m2(ids)[0].numpy(), ref)
+    # family auto-detected from the q_proj fingerprint
+    interop.load_paddle_checkpoint(m2, path)
+    np.testing.assert_allclose(m2(ids)[0].numpy(), ref, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_qkv_weave_is_exact_inverse():
+    rng = np.random.RandomState(0)
+    H, heads = 24, 4
+    wq, wk, wv = (rng.randn(H, H).astype(np.float32) for _ in range(3))
+    woven = interop._weave_qkv(wq, wk, wv, heads, axis=1)
+    assert woven.shape == (H, 3 * H)
+    q2, k2, v2 = interop._unweave_qkv(woven, heads, axis=1)
+    np.testing.assert_array_equal(q2, wq)
+    np.testing.assert_array_equal(k2, wk)
+    np.testing.assert_array_equal(v2, wv)
+
+
+def test_restricted_unpickler_rejects_code(tmp_path):
+    import pickle
+
+    class Evil:
+        def __reduce__(self):
+            return (os.system, ("echo pwned",))
+
+    path = os.path.join(tmp_path, "evil.pdparams")
+    with open(path, "wb") as f:
+        pickle.dump({"a": Evil()}, f)
+    with pytest.raises(pickle.UnpicklingError):
+        interop.load_pdparams(path)
+
+
+def test_strict_shape_mismatch(tmp_path):
+    paddle.seed(0)
+    m = resnet18(num_classes=10)
+    sd = {k: np.asarray(v._value if hasattr(v, "_value") else v)
+          for k, v in m.state_dict().items()}
+    sd["fc.weight"] = np.zeros((3, 3), np.float32)
+    path = os.path.join(tmp_path, "bad.pdparams")
+    interop.save_pdparams(sd, path)
+    with pytest.raises(ValueError, match="shape mismatch"):
+        interop.load_paddle_checkpoint(m, path)

@@ -33,6 +33,8 @@ print('probe ok', float(x[0,0]))" >> "$LOG" 2>&1
     echo "[$(date -u +%T)] moebench rc=$?" >> "$LOG"
     timeout 2400 python tools/decodebench.py --preset large >> "$LOG" 2>&1
     echo "[$(date -u +%T)] decodebench rc=$?" >> "$LOG"
+    timeout 1200 env SPARSEBENCH_TPU=1 python tools/sparsebench.py >> "$LOG" 2>&1
+    echo "[$(date -u +%T)] sparsebench rc=$?" >> "$LOG"
     echo "=== harvest done $(date -u +%FT%TZ)" >> "$LOG"
     exit 0
   fi

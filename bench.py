@@ -114,13 +114,44 @@ def run_child(preset: str) -> int:
         model, opt = amp.decorate(model, opt, level="O2", dtype="bfloat16")
         amp_level = "O2"
 
-    def loss_fn(ids):
-        with amp.auto_cast(level=amp_level, dtype="bfloat16"):
-            return model(ids, labels=ids)
+    # BENCH_PACKED=1: feed packed variable-length documents through the
+    # varlen path (native pack_varlen -> segments -> segmented/varlen
+    # flash attention) instead of a fixed rectangular batch
+    packed = os.environ.get("BENCH_PACKED") == "1" and not cfg.use_rotary
+    if packed:
+        from paddle_tpu.io.packing import pack_examples
 
-    step = TrainStep(model, loss_fn, opt)
-    ids = paddle.to_tensor(
-        np.random.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+        rng = np.random.RandomState(0)
+        docs = []
+        total = 0
+        while total < batch * seq:
+            n = int(rng.randint(seq // 8, seq))
+            docs.append(rng.randint(0, cfg.vocab_size, n).astype(np.int32))
+            total += n
+        ids_np, seg_np, labels_np = pack_examples(docs, seq)
+        ids_np, seg_np, labels_np = (a[:batch] for a in
+                                     (ids_np, seg_np, labels_np))
+        log(f"[{preset}] packed varlen batch: {len(docs)} docs -> "
+            f"{ids_np.shape[0]} rows x {seq}")
+
+        def loss_fn(ids, seg, lab):
+            with amp.auto_cast(level=amp_level, dtype="bfloat16"):
+                return model(ids, labels=lab, segments=seg)
+
+        step = TrainStep(model, loss_fn, opt)
+        _seg = paddle.to_tensor(seg_np)
+        _lab = paddle.to_tensor(labels_np)
+        _raw_step = step
+        step = lambda ids: _raw_step(ids, _seg, _lab)  # noqa: E731
+        ids = paddle.to_tensor(ids_np)
+    else:
+        def loss_fn(ids):
+            with amp.auto_cast(level=amp_level, dtype="bfloat16"):
+                return model(ids, labels=ids)
+
+        step = TrainStep(model, loss_fn, opt)
+        ids = paddle.to_tensor(
+            np.random.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
 
     t0 = time.time()
     loss = step(ids)
@@ -166,6 +197,7 @@ def run_child(preset: str) -> int:
         "backend": backend,
         "preset": preset,
         "flash_attention": bool(_flags.get_flag("use_flash_attention")),
+        "packed_varlen": packed,
         "final_loss": round(float(loss.item()), 4),
     }
     if on_accel:

@@ -1,0 +1,159 @@
+"""Heter-PS analog: a device-HBM-cached embedding table over a host-RAM
+(or PS-backed) full table.
+
+Reference: paddle/fluid/framework/fleet/heter_ps/ — feature_value.h /
+hashtable / HeterComm keep HOT feature rows in GPU HBM with the full
+table in host memory or SSD, moving rows across tiers per batch. The
+TPU-native collapse of that machinery:
+
+  * the full table lives in HOST memory (numpy; a ShardedPSWorker can be
+    plugged in as the backing store for multi-node capacity);
+  * a fixed-capacity DEVICE cache (one jnp array [capacity, dim]) holds
+    the hot rows; the slot map + LRU order are host-side (python dict —
+    the id set per batch is host data anyway, exactly like the
+    reference's host-side hashtable build per pass);
+  * `lookup(ids)` faults missing rows in (one host->device transfer of
+    the miss rows, one scatter into the cache), evicting least-recently
+    used slots with write-back of dirty rows, then serves the batch as
+    ONE device gather — the training step stays fully compiled, keyed by
+    cache-slot indices instead of raw ids;
+  * `update(ids, grads)` applies a device scatter-add style SGD update to
+    the cached rows only (rows were faulted in by the preceding lookup)
+    and marks them dirty; `flush()` writes every dirty row back.
+
+Capacity defaults to a fraction of free HBM via the device memory
+surface (paddle_tpu.device.memory_stats).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["HBMCachedEmbedding"]
+
+
+class HBMCachedEmbedding:
+    def __init__(self, num_rows: int, dim: int, capacity: Optional[int] = None,
+                 host_table: Optional[np.ndarray] = None, lr: float = 0.1,
+                 dtype=np.float32, hbm_fraction: float = 0.25):
+        self.num_rows = int(num_rows)
+        self.dim = int(dim)
+        self.lr = float(lr)
+        if host_table is not None:
+            host_table = np.asarray(host_table, dtype)
+            if host_table.shape != (num_rows, dim):
+                raise ValueError(f"host_table shape {host_table.shape} != "
+                                 f"({num_rows}, {dim})")
+            self.host = host_table
+        else:
+            rng = np.random.default_rng(0)
+            self.host = (rng.standard_normal((num_rows, dim)) * 0.01
+                         ).astype(dtype)
+        if capacity is None:
+            capacity = self._default_capacity(dim, np.dtype(dtype).itemsize,
+                                              hbm_fraction)
+        self.capacity = max(1, min(int(capacity), self.num_rows))
+        # device cache: [capacity, dim]
+        self.cache = jnp.zeros((self.capacity, self.dim), dtype)
+        # host-side metadata: id -> slot, LRU order, dirty flags
+        self._slot_of: "OrderedDict[int, int]" = OrderedDict()
+        self._dirty: Dict[int, bool] = {}
+        self._free = list(range(self.capacity - 1, -1, -1))
+        self.stats = {"hits": 0, "misses": 0, "evictions": 0,
+                      "writebacks": 0}
+
+    def _default_capacity(self, dim, itemsize, fraction) -> int:
+        """Size the cache from the device memory surface (reference: the
+        heter-ps resource allocator sizing HBM pools per device)."""
+        try:
+            from .. import device as _device
+
+            stats = _device.memory_stats()
+            free = max(stats.get("bytes_limit", 0)
+                       - stats.get("bytes_in_use", 0), 0)
+        except Exception:
+            free = 0
+        if not free:
+            free = 1 << 30  # fallback: size against 1 GiB
+        rows = int(free * fraction) // max(dim * itemsize, 1)
+        return max(1, rows)
+
+    # ------------------------------------------------------------ faults
+    def _touch(self, fid: int):
+        self._slot_of.move_to_end(fid)
+
+    def _evict_one(self) -> int:
+        fid, slot = self._slot_of.popitem(last=False)  # least recent
+        if self._dirty.pop(fid, False):
+            self.host[fid] = np.asarray(self.cache[slot])
+            self.stats["writebacks"] += 1
+        self.stats["evictions"] += 1
+        return slot
+
+    def _fault_in(self, ids: np.ndarray) -> np.ndarray:
+        """Ensure every id is cached; return the slot index per id."""
+        uniq = np.unique(ids)
+        if len(uniq) > self.capacity:
+            raise ValueError(
+                f"batch touches {len(uniq)} unique rows > cache capacity "
+                f"{self.capacity}; raise capacity or shrink the batch")
+        miss = [int(f) for f in uniq if f not in self._slot_of]
+        for f in (int(f) for f in uniq):
+            if f in self._slot_of:
+                self._touch(f)
+                self.stats["hits"] += 1
+        if miss:
+            self.stats["misses"] += len(miss)
+            slots = []
+            for f in miss:
+                slot = self._free.pop() if self._free else self._evict_one()
+                self._slot_of[f] = slot
+                slots.append(slot)
+            # ONE host->device transfer + ONE scatter for all misses
+            rows = jnp.asarray(self.host[np.asarray(miss)])
+            self.cache = self.cache.at[jnp.asarray(slots)].set(rows)
+        return np.asarray([self._slot_of[int(f)] for f in ids],
+                          np.int32)
+
+    # ------------------------------------------------------------ public
+    def lookup(self, ids) -> jax.Array:
+        """Embed `ids` ([...]-shaped int array) -> [... , dim] from the
+        device cache (faulting misses in first)."""
+        ids = np.asarray(ids)
+        slots = self._fault_in(ids.ravel()).reshape(ids.shape)
+        return self.cache[jnp.asarray(slots)]
+
+    def update(self, ids, grads) -> None:
+        """SGD update on the cached rows (rows are present: training
+        always looks up before it updates). Duplicate ids accumulate."""
+        ids = np.asarray(ids).ravel()
+        grads = jnp.asarray(grads).reshape(len(ids), self.dim)
+        slots = self._fault_in(ids)
+        # merge duplicate slots before the scatter (SelectedRows rule)
+        uniq, inv = np.unique(slots, return_inverse=True)
+        merged = jnp.zeros((len(uniq), self.dim), grads.dtype)
+        merged = merged.at[jnp.asarray(inv)].add(grads)
+        self.cache = self.cache.at[jnp.asarray(uniq)].add(
+            -self.lr * merged)
+        for f in np.unique(ids):
+            self._dirty[int(f)] = True
+
+    def flush(self) -> int:
+        """Write every dirty cached row back to the host table."""
+        dirty = [f for f, d in self._dirty.items() if d]
+        if dirty:
+            slots = np.asarray([self._slot_of[f] for f in dirty])
+            self.host[np.asarray(dirty)] = np.asarray(
+                self.cache[jnp.asarray(slots)])
+            self.stats["writebacks"] += len(dirty)
+        self._dirty.clear()
+        return len(dirty)
+
+    def as_array(self) -> np.ndarray:
+        """The full table with all cached updates applied (flushes)."""
+        self.flush()
+        return self.host

@@ -19,8 +19,10 @@ processes.
 """
 from __future__ import annotations
 
+import json
+import os
 import threading
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -138,6 +140,91 @@ class ParameterServer:
             return {"shape": tuple(t.shape), "optimizer": acc.kind,
                     "lr": acc.lr, "l2_norm": float(np.linalg.norm(t))}
 
+    # ----------------------------------------------- snapshot / recovery
+    @classmethod
+    def save_snapshot(cls, path: str) -> List[str]:
+        """Persist every table + its accessor state to a fresh VERSIONED
+        subdirectory, then atomically repoint `CURRENT` — so a crash at
+        ANY point mid-save leaves the previous complete snapshot as the
+        one load_snapshot reads (snapshot-level atomicity, not just
+        per-table). Reference: the brpc PS server's table snapshot paths
+        (paddle/fluid/distributed/ps/table/ *_table Save/Load)."""
+        os.makedirs(path, exist_ok=True)
+        versions = [int(d[1:]) for d in os.listdir(path)
+                    if d.startswith("v") and d[1:].isdigit()]
+        vdir = os.path.join(path, f"v{max(versions, default=-1) + 1}")
+        os.makedirs(vdir, exist_ok=True)
+        names = []
+        with cls._meta_lock:
+            table_names = list(cls._tables)
+        for name in table_names:
+            with cls._lock(name):
+                t = cls._tables[name]
+                acc = cls._accessors[name]
+                state = {"table": t, "kind": np.asarray(acc.kind),
+                         "lr": np.asarray(acc.lr),
+                         "decay": np.asarray(acc.decay)}
+                if acc.kind == "adagrad":
+                    state["g2"] = acc.g2
+                elif acc.kind == "adam":
+                    state.update(m1=acc.m1, m2=acc.m2,
+                                 b1p=acc.b1p, b2p=acc.b2p)
+                with open(os.path.join(vdir, f"{name}.npz"), "wb") as f:
+                    np.savez(f, **state)
+                names.append(name)
+        with open(os.path.join(vdir, "meta.json"), "w") as f:
+            json.dump({"tables": names}, f)
+        cur_tmp = os.path.join(path, ".CURRENT.tmp")
+        with open(cur_tmp, "w") as f:
+            f.write(os.path.basename(vdir))
+        os.replace(cur_tmp, os.path.join(path, "CURRENT"))
+        # keep only the latest two complete versions
+        for v in sorted(versions)[:-1]:
+            old = os.path.join(path, f"v{v}")
+            try:
+                for fn in os.listdir(old):
+                    os.unlink(os.path.join(old, fn))
+                os.rmdir(old)
+            except OSError:
+                pass
+        return names
+
+    @classmethod
+    def load_snapshot(cls, path: str) -> List[str]:
+        """Restore tables + accessor state from the snapshot directory's
+        CURRENT version (server restart recovery)."""
+        with open(os.path.join(path, "CURRENT")) as f:
+            vdir = os.path.join(path, f.read().strip())
+        with open(os.path.join(vdir, "meta.json")) as f:
+            names = json.load(f)["tables"]
+        for name in names:
+            with np.load(os.path.join(vdir, f"{name}.npz"),
+                         allow_pickle=False) as z:
+                table = z["table"]
+                kind = str(z["kind"])
+                acc = _Accessor(kind, float(z["lr"]), table.shape,
+                                float(z["decay"]))
+                if kind == "adagrad":
+                    acc.g2 = z["g2"]
+                elif kind == "adam":
+                    acc.m1, acc.m2 = z["m1"], z["m2"]
+                    acc.b1p, acc.b2p = z["b1p"], z["b2p"]
+            # swap under BOTH locks: a concurrent push must not land on
+            # the orphaned pre-restore array
+            with cls._lock(name):
+                with cls._meta_lock:
+                    cls._tables[name] = table
+                    cls._accessors[name] = acc
+        return names
+
+    @classmethod
+    def reset(cls) -> None:
+        """Drop all server state (crash simulation / test isolation)."""
+        with cls._meta_lock:
+            cls._tables.clear()
+            cls._accessors.clear()
+            cls._locks.clear()
+
 
 class PSWorker:
     """Worker-side handle: pull/push against the server over rpc."""
@@ -181,3 +268,131 @@ class PSWorker:
 
         rpc.rpc_sync(self.server, ParameterServer.push_sparse,
                      args=(name, np.asarray(ids), np.asarray(grads)))
+
+
+class ShardedPSWorker:
+    """Worker handle over a table SHARDED across multiple server processes
+    (reference: the PS service's table partitioning across server nodes —
+    paddle/fluid/distributed/ps/service/ brpc_ps_client routing by
+    shard_num). Row r of a table lives on server `r % n_servers` at local
+    row `r // n_servers` (modulo layout: sparse id routing and dense
+    reassembly use the same rule, so one table serves both paths).
+
+    save/load fan the snapshot out to every shard server; a restarted
+    server restores ITS shard from its own snapshot directory.
+    """
+
+    def __init__(self, servers: List[str]):
+        if not servers:
+            raise ValueError("ShardedPSWorker needs at least one server")
+        self.servers = list(servers)
+        self._shapes: Dict[str, tuple] = {}
+
+    def _n(self) -> int:
+        return len(self.servers)
+
+    def _shape_of(self, name: str) -> tuple:
+        """Global table shape; discovered from the servers' shard stats
+        when this handle didn't create the table (fresh worker, trainer
+        restart)."""
+        if name not in self._shapes:
+            from . import rpc
+
+            rows = 0
+            width: tuple = ()
+            for srv in self.servers:
+                st = rpc.rpc_sync(srv, ParameterServer.table_stats,
+                                  args=(name,))
+                rows += int(st["shape"][0])
+                width = tuple(st["shape"][1:])
+            self._shapes[name] = (rows,) + width
+        return self._shapes[name]
+
+    def create_table(self, name, shape, lr=0.1, init=None,
+                     optimizer="sgd", decay=0.0):
+        from . import rpc
+
+        shape = tuple(shape)
+        self._shapes[name] = shape
+        if init is None:
+            rng = np.random.default_rng(abs(hash(name)) % (1 << 31))
+            init = (rng.standard_normal(shape) * 0.01).astype(np.float32)
+        init = np.asarray(init, np.float32)
+        for i, srv in enumerate(self.servers):
+            rows = np.arange(i, shape[0], self._n())
+            rpc.rpc_sync(srv, ParameterServer.create_table,
+                         args=(name, (len(rows),) + shape[1:], lr,
+                               init[rows], optimizer, decay))
+        return shape
+
+    def _route(self, ids):
+        ids = np.asarray(ids, np.int64)
+        srv_of = ids % self._n()
+        local = ids // self._n()
+        return srv_of, local
+
+    def pull_sparse(self, name, ids):
+        from . import rpc
+
+        ids = np.asarray(ids, np.int64)
+        srv_of, local = self._route(ids)
+        width = self._shape_of(name)[1:]
+        out = np.zeros((len(ids),) + width, np.float32)
+        for i, srv in enumerate(self.servers):
+            mask = srv_of == i
+            if not mask.any():
+                continue
+            out[mask] = rpc.rpc_sync(srv, ParameterServer.pull_sparse,
+                                     args=(name, local[mask]))
+        return out
+
+    def push_sparse(self, name, ids, grads):
+        from . import rpc
+
+        ids = np.asarray(ids, np.int64)
+        grads = np.asarray(grads, np.float32)
+        srv_of, local = self._route(ids)
+        for i, srv in enumerate(self.servers):
+            mask = srv_of == i
+            if not mask.any():
+                continue
+            rpc.rpc_sync(srv, ParameterServer.push_sparse,
+                         args=(name, local[mask], grads[mask]))
+
+    def pull_dense(self, name):
+        from . import rpc
+
+        shape = self._shape_of(name)
+        out = np.zeros(shape, np.float32)
+        for i, srv in enumerate(self.servers):
+            rows = np.arange(i, shape[0], self._n())
+            out[rows] = rpc.rpc_sync(srv, ParameterServer.pull_dense,
+                                     args=(name,))
+        return out
+
+    def push_dense(self, name, grad):
+        from . import rpc
+
+        grad = np.asarray(grad, np.float32)
+        for i, srv in enumerate(self.servers):
+            rows = np.arange(i, grad.shape[0], self._n())
+            rpc.rpc_sync(srv, ParameterServer.push_dense,
+                         args=(name, grad[rows]))
+
+    # --------------------------------------------- snapshot orchestration
+    def _shard_dir(self, base: str, srv: str) -> str:
+        return os.path.join(base, srv)
+
+    def save_snapshot(self, base_dir: str) -> Dict[str, List[str]]:
+        from . import rpc
+
+        return {srv: rpc.rpc_sync(srv, ParameterServer.save_snapshot,
+                                  args=(self._shard_dir(base_dir, srv),))
+                for srv in self.servers}
+
+    def restore_server(self, srv: str, base_dir: str) -> List[str]:
+        """Reload one (restarted) server's shard from its snapshot."""
+        from . import rpc
+
+        return rpc.rpc_sync(srv, ParameterServer.load_snapshot,
+                            args=(self._shard_dir(base_dir, srv),))

@@ -1,0 +1,101 @@
+"""Packed variable-length batch production for the GPT pretrain path.
+
+Reference: the C++ data pipeline's varlen batching (data_feed.cc slot
+parsing into batches) feeding FlashAttnUnpaddedKernel
+(paddle/phi/kernels/gpu/flash_attn_kernel.cu varlen entries). TPU-native
+shape: documents stream into FIXED [rows, capacity] int32 buffers (static
+shapes for jit) through the native pt_pack_varlen hot loop; per-token
+segment ids drive the segmented flash kernel, and padding (segment -1)
+gets ignore-labels so the loss matches padded batching exactly.
+"""
+from __future__ import annotations
+
+from typing import Iterable, Iterator, List, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["pack_examples", "PackedLMBatches", "IGNORE_LABEL"]
+
+IGNORE_LABEL = -100
+
+
+def _pack_numpy(docs: Sequence[np.ndarray], capacity: int,
+                pad_id: int,
+                split_docs: bool = True) -> Tuple[np.ndarray, np.ndarray]:
+    """Pure-python fallback mirroring pt_pack_varlen exactly."""
+    rows_ids: List[List[int]] = [[]]
+    rows_seg: List[List[int]] = [[]]
+    seg = 0
+    for d in docs:
+        d = np.asarray(d, np.int32).ravel()
+        off = 0
+        if (not split_docs and rows_ids[-1]
+                and len(d) > capacity - len(rows_ids[-1])):
+            rows_ids.append([])
+            rows_seg.append([])
+            seg = 0
+        while off < len(d):
+            if len(rows_ids[-1]) == capacity:
+                rows_ids.append([])
+                rows_seg.append([])
+                seg = 0
+            take = min(capacity - len(rows_ids[-1]), len(d) - off)
+            rows_ids[-1].extend(d[off:off + take].tolist())
+            rows_seg[-1].extend([seg] * take)
+            off += take
+            if off >= len(d):
+                seg += 1
+    ids = np.full((len(rows_ids), capacity), pad_id, np.int32)
+    segm = np.full((len(rows_ids), capacity), -1, np.int32)
+    for r, (ri, rs) in enumerate(zip(rows_ids, rows_seg)):
+        ids[r, :len(ri)] = ri
+        segm[r, :len(rs)] = rs
+    return ids, segm
+
+
+def pack_examples(docs: Sequence, capacity: int, pad_id: int = 0,
+                  split_docs: bool = True
+                  ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Pack token documents into fixed rows. Returns (ids, segments,
+    labels), each [rows, capacity] int32; labels are the ids with
+    IGNORE_LABEL at padding so `cross_entropy(..., ignore_index=-100)`
+    skips them. split_docs=True cuts documents at row boundaries
+    (densest); False keeps documents whole per row (exact per-doc
+    semantics, some tail padding)."""
+    try:
+        from .. import native
+
+        ids, seg = native.pack_varlen(docs, capacity, pad_id=pad_id,
+                                      split_docs=split_docs)
+    except Exception:
+        ids, seg = _pack_numpy(docs, capacity, pad_id, split_docs)
+    labels = np.where(seg >= 0, ids, IGNORE_LABEL).astype(np.int64)
+    return ids, seg, labels
+
+
+class PackedLMBatches:
+    """Iterate (ids, segments, labels) batches of `batch_rows` packed rows
+    from a stream of token documents — the drop-in pretrain feed for
+    `GPTForCausalLM(ids, labels=labels, segments=segments)`."""
+
+    def __init__(self, docs: Iterable, capacity: int, batch_rows: int,
+                 pad_id: int = 0, drop_last: bool = True,
+                 split_docs: bool = True):
+        self.docs = docs
+        self.capacity = int(capacity)
+        self.batch_rows = int(batch_rows)
+        self.pad_id = pad_id
+        self.drop_last = drop_last
+        self.split_docs = split_docs
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray,
+                                         np.ndarray]]:
+        ids, seg, labels = pack_examples(list(self.docs), self.capacity,
+                                         self.pad_id,
+                                         split_docs=self.split_docs)
+        n = ids.shape[0]
+        stop = (n // self.batch_rows) * self.batch_rows if self.drop_last \
+            else n
+        for r in range(0, stop, self.batch_rows):
+            sl = slice(r, min(r + self.batch_rows, n))
+            yield ids[sl], seg[sl], labels[sl]

@@ -87,7 +87,7 @@ class CausalSelfAttention(nn.Layer):
                 "parallelism (the ring/Ulysses kernels are deterministic); "
                 "set attention_dropout_prob=0")
 
-    def forward(self, x, rope=None, cache=None, pos=None):
+    def forward(self, x, rope=None, cache=None, pos=None, segments=None):
         b, s, h = x.shape
         qkv = self.qkv_proj(x)
         qkv = api.reshape(qkv, [b, s, self.num_heads, 3 * self.head_dim])
@@ -106,7 +106,15 @@ class CausalSelfAttention(nn.Layer):
                 q, k, v, cache[0], cache[1], pos)
             out = api.reshape(out, [b, s, h])
             return self.resid_dropout(self.out_proj(out)), (new_k, new_v)
-        if self.sequence_parallel:
+        if segments is not None:
+            if self.sequence_parallel:
+                raise NotImplementedError(
+                    "packed (segments=) batches are not supported under "
+                    "sequence_parallel; gather the sequence first")
+            # packed-document path: attention restricted to each document
+            # (native pack_varlen batches; varlen flash kernel on TPU)
+            out = api.segmented_attention(q, k, v, segments, causal=True)
+        elif self.sequence_parallel:
             # long-context path: sequence sharded over the 'sep' mesh axis,
             # ring/Ulysses attention as one registered op (context_parallel)
             out = api.sequence_parallel_attention(
@@ -141,14 +149,14 @@ class GPTBlock(nn.Layer):
         self.ln_2 = nn.LayerNorm(config.hidden_size)
         self.mlp = GPTMLP(config)
 
-    def forward(self, x, rope=None, cache=None, pos=None):
+    def forward(self, x, rope=None, cache=None, pos=None, segments=None):
         if cache is not None:
             a, new_cache = self.attn(self.ln_1(x), rope=rope, cache=cache,
                                      pos=pos)
             x = x + a
             x = x + self.mlp(self.ln_2(x))
             return x, new_cache
-        x = x + self.attn(self.ln_1(x), rope=rope)
+        x = x + self.attn(self.ln_1(x), rope=rope, segments=segments)
         x = x + self.mlp(self.ln_2(x))
         return x
 
@@ -177,11 +185,15 @@ class GPTModel(nn.Layer):
             return Tensor(jnp.cos(emb)), Tensor(jnp.sin(emb))
         return None
 
-    def forward(self, input_ids, caches=None, pos=None):
+    def forward(self, input_ids, caches=None, pos=None, segments=None):
         b, s = input_ids.shape
         h = self.wte(input_ids)
         rope = None
         if caches is not None:
+            if segments is not None:
+                raise NotImplementedError(
+                    "packed (segments=) batches are not supported with "
+                    "KV-cache decoding")
             import jax.numpy as jnp
             from jax import lax
 
@@ -202,7 +214,25 @@ class GPTModel(nn.Layer):
                 h, nc = block(h, rope=rope, cache=cache, pos=Tensor(pos_v))
                 new_caches.append(nc)
             return self.ln_f(h), new_caches
-        if self.config.use_rotary:
+        if segments is not None:
+            if self.config.use_rotary:
+                raise NotImplementedError(
+                    "packed (segments=) batches require learned positions; "
+                    "rotary packed attention is not supported yet")
+            # positions RESTART at each packed document so a packed row
+            # embeds exactly like the same documents padded separately
+            import jax.numpy as jnp
+            from jax import lax
+
+            seg_v = (segments._value if isinstance(segments, Tensor)
+                     else jnp.asarray(segments)).astype(jnp.int32)
+            ar = jnp.arange(s, dtype=jnp.int32)[None, :]
+            new_doc = jnp.concatenate(
+                [jnp.ones((b, 1), bool), seg_v[:, 1:] != seg_v[:, :-1]],
+                axis=1)
+            starts = lax.cummax(jnp.where(new_doc, ar, 0), axis=1)
+            h = h + self.wpe(Tensor(ar - starts))
+        elif self.config.use_rotary:
             rope = self._rope(s)
         else:
             p = api.arange(0, s, 1, dtype="int32")
@@ -212,10 +242,10 @@ class GPTModel(nn.Layer):
             if self.config.recompute and self.training:
                 from ..distributed.fleet.recompute import recompute
 
-                h = recompute(block, h, rope=rope,
+                h = recompute(block, h, rope=rope, segments=segments,
                               policy=self.config.recompute_policy)
             else:
-                h = block(h, rope=rope)
+                h = block(h, rope=rope, segments=segments)
         return self.ln_f(h)
 
 
@@ -238,11 +268,19 @@ class GPTForCausalLM(nn.Layer, GenerationMixin):
             return api.matmul(h, self.gpt.wte.weight, transpose_y=True)
         return self.lm_head(h)
 
-    def forward(self, input_ids, labels=None, caches=None, pos=None):
+    def forward(self, input_ids, labels=None, caches=None, pos=None,
+                segments=None):
+        """segments: optional [b, s] packed-document ids (padding -1) —
+        the varlen pretrain path (native pack_varlen + segmented
+        attention); labels at padding should be -100 (ignored)."""
         if caches is not None:
+            if segments is not None:
+                raise NotImplementedError(
+                    "packed (segments=) batches are not supported with "
+                    "KV-cache decoding; generate per document")
             h, new_caches = self.gpt(input_ids, caches=caches, pos=pos)
             return self._head(h), new_caches
-        h = self.gpt(input_ids)
+        h = self.gpt(input_ids, segments=segments)
         logits = self._head(h)
         if labels is not None:
             loss = F.cross_entropy(

@@ -111,6 +111,11 @@ def _bind(lib: ctypes.CDLL) -> None:
     ]
     lib.pt_feed_stack.restype = c.c_uint64
     lib.pt_feed_copy.argtypes = [c.c_void_p, c.c_void_p, c.c_uint64]
+    lib.pt_pack_varlen.argtypes = [
+        c.c_void_p, c.c_void_p, c.c_int64, c.c_int64, c.c_int32,
+        c.c_void_p, c.c_void_p, c.c_int64, c.c_int32,
+    ]
+    lib.pt_pack_varlen.restype = c.c_int64
     # arena
     lib.pt_arena_create.argtypes = [c.c_uint64]
     lib.pt_arena_create.restype = c.c_void_p
@@ -473,3 +478,34 @@ def feed_copy_out(buf, offset, shape, dtype):
     lib.pt_feed_copy(ctypes.c_void_p(base + offset),
                      out.ctypes.data_as(ctypes.c_void_p), out.nbytes)
     return out
+
+
+def pack_varlen(docs, capacity: int, pad_id: int = 0,
+                split_docs: bool = True):
+    """Stream variable-length int32 token docs into packed fixed rows
+    (native hot loop; see feed.cc pt_pack_varlen). Returns
+    (ids [rows, capacity] int32, segments [rows, capacity] int32) where
+    padding has segment -1 and documents cut at row boundaries continue
+    as new segments."""
+    import numpy as np
+
+    lib = get_lib()
+    docs = [np.ascontiguousarray(d, np.int32).ravel() for d in docs]
+    lengths = np.asarray([len(d) for d in docs], np.int64)
+    tokens = (np.concatenate(docs) if docs
+              else np.zeros(0, np.int32)).astype(np.int32)
+    total = int(lengths.sum())
+    max_rows = max(1, (total + capacity - 1) // capacity + 1
+                   + (0 if split_docs else len(docs)))
+    ids = np.full((max_rows, capacity), pad_id, np.int32)
+    seg = np.full((max_rows, capacity), -1, np.int32)
+    rows = int(lib.pt_pack_varlen(
+        tokens.ctypes.data_as(ctypes.c_void_p),
+        lengths.ctypes.data_as(ctypes.c_void_p),
+        len(docs), capacity, pad_id,
+        ids.ctypes.data_as(ctypes.c_void_p),
+        seg.ctypes.data_as(ctypes.c_void_p), max_rows,
+        1 if split_docs else 0))
+    if rows < 0:
+        raise ValueError("pack_varlen: row buffer too small (internal)")
+    return ids[:rows], seg[:rows]

@@ -76,4 +76,72 @@ void pt_feed_copy(const void* src, void* dst, uint64_t nbytes) {
                 nbytes);
 }
 
+// Stream variable-length token documents into fixed-capacity packed rows
+// (reference analog: the data_feed.cc slot-parsing/batching hot loop; the
+// varlen-flash consumer is FlashAttnUnpaddedKernel).
+//
+// tokens: all docs concatenated; lengths[n_docs] in tokens. Rows are cut
+// at `capacity`; a document crossing a row boundary continues as a NEW
+// segment in the next row (attention reset at the cut, the packed-
+// pretraining convention). Per-row segment ids start at 0 and increment
+// at every document (or cut) boundary; tail padding gets segment -1 and
+// `pad_id` tokens. Returns rows used, or -1 if max_rows is too small.
+// split_docs != 0: a document crossing a row boundary is cut (densest
+// packing, attention reset at the cut). split_docs == 0: a document that
+// does not fit the remaining row starts a NEW row (whole-document
+// packing — the tail of the previous row becomes padding; documents
+// longer than `capacity` start at a fresh row and are cut at capacity
+// boundaries only).
+int64_t pt_pack_varlen(const int32_t* tokens, const int64_t* lengths,
+                       int64_t n_docs, int64_t capacity, int32_t pad_id,
+                       int32_t* out_ids, int32_t* out_seg,
+                       int64_t max_rows, int32_t split_docs) {
+  int64_t row = 0, col = 0;
+  int32_t seg = 0;
+  const int32_t* p = tokens;
+  for (int64_t d = 0; d < n_docs; ++d) {
+    int64_t remaining = lengths[d];
+    if (!split_docs && col > 0 && remaining > capacity - col) {
+      // whole-doc mode: pad out this row and start fresh
+      for (int64_t i = col; i < capacity; ++i) {
+        out_ids[row * capacity + i] = pad_id;
+        out_seg[row * capacity + i] = -1;
+      }
+      ++row;
+      col = 0;
+      seg = 0;
+    }
+    while (remaining > 0) {
+      if (col == capacity) {
+        ++row;
+        col = 0;
+        seg = 0;
+      }
+      if (row >= max_rows) return -1;
+      int64_t take = capacity - col;
+      if (remaining < take) take = remaining;
+      std::memcpy(out_ids + row * capacity + col, p,
+                  (size_t)take * sizeof(int32_t));
+      for (int64_t i = 0; i < take; ++i) out_seg[row * capacity + col + i] = seg;
+      p += take;
+      col += take;
+      remaining -= take;
+      if (remaining > 0) {
+        // document cut at the row boundary: next chunk is a new segment
+        continue;
+      }
+      ++seg;
+    }
+  }
+  // pad the tail of the last row
+  if (col > 0 || row == 0) {
+    for (int64_t i = col; i < capacity; ++i) {
+      out_ids[row * capacity + i] = pad_id;
+      out_seg[row * capacity + i] = -1;
+    }
+    ++row;
+  }
+  return row;
+}
+
 }  // extern "C"

@@ -1026,6 +1026,39 @@ def flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
     return out[0]
 
 
+def segmented_attention(q, k, v, segment_ids, causal=True, scale=None):
+    """Batched packed-sequence attention: q/k/v [b, s, h, d] with
+    segment_ids [b, s] (same id = same document; padding uses -1, which
+    only matches itself). The batch-granular sibling of
+    flash_attn_unpadded (reference FlashAttnUnpaddedKernel,
+    paddle/phi/kernels/gpu/flash_attn_kernel.cu) for the packed GPT
+    pretrain path: tokens attend only within their document, causally."""
+    b, s, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    seg = segment_ids.astype(jnp.int32)
+
+    from ...core import flags as _flags
+    from .. import pallas as _pallas
+
+    if (
+        _flags.get_flag("use_flash_attention")
+        and _pallas.pallas_enabled()
+        and s % 128 == 0
+        and d <= 256
+    ):
+        from ..pallas.flash_attention import flash_attention_segmented
+
+        return flash_attention_segmented(
+            q, k, v, seg, scale, causal,
+            interpret=_pallas.interpret_mode())
+    mask = seg[:, :, None] == seg[:, None, :]
+    if causal:
+        mask = mask & jnp.tril(jnp.ones((s, s), bool))[None]
+    return scaled_dot_product_attention(
+        q, k, v, attn_mask=mask[:, None], is_causal=False, scale=scale)
+
+
 def pool2d(x, kernel_size, stride=None, padding=0, pooling_type="max",
            ceil_mode=False, exclusive=True, adaptive=False,
            data_format="NCHW", global_pooling=False):

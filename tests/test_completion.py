@@ -110,8 +110,13 @@ class TestEnginePipeline:
         eng = Engine(m, optimizer=opt, mesh=mesh)
 
         # prove ring attention actually EXECUTES (the config flag alone
-        # is not enough — layers snapshot it at construction)
+        # is not enough — layers snapshot it at construction). The spy
+        # only fires at TRACE time, so drop any compiled executables a
+        # previous test may have cached for these shapes first.
         from paddle_tpu.distributed import context_parallel as cp
+        from paddle_tpu.ops import registry as _registry
+
+        _registry._EXEC_CACHE.clear()
 
         calls = []
         real_ring = cp.ring_attention

@@ -76,6 +76,11 @@ def spd(n):
 # op -> lambda returning (args, kwargs). Arrays are wrapped to Tensor by the
 # runner; everything else passes through.
 SPECS = {
+    # ---- attention over packed segments (varlen pretrain path)
+    "segmented_attention": lambda: (
+        [f32(2, 8, 2, 4), f32(2, 8, 2, 4), f32(2, 8, 2, 4),
+         np.repeat(np.array([[0, 0, 0, 1, 1, 2, 2, -1]], np.int32), 2, 0)],
+        {"causal": True}),
     # ---- math: unary float
     "log2": lambda: ([pos(3, 4)], {}),
     "log10": lambda: ([pos(3, 4)], {}),

@@ -1,0 +1,149 @@
+"""Packed-varlen pretrain path (VERDICT r5 item 7): native packer ->
+segments -> segmented attention -> GPT loss, with loss parity vs padded
+per-document batching.
+
+Reference: data_feed.cc varlen batching + FlashAttnUnpaddedKernel
+(paddle/phi/kernels/gpu/flash_attn_kernel.cu).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io.packing import IGNORE_LABEL, PackedLMBatches, pack_examples
+from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+
+def _docs(rng, n=6, lo=5, hi=30, vocab=128):
+    return [rng.randint(0, vocab, rng.randint(lo, hi)).astype(np.int32)
+            for _ in range(n)]
+
+
+class TestPacker:
+    def test_native_matches_numpy_fallback(self):
+        from paddle_tpu.io.packing import _pack_numpy
+        from paddle_tpu import native
+
+        rng = np.random.RandomState(0)
+        docs = _docs(rng)
+        for split in (True, False):
+            ids_n, seg_n = native.pack_varlen(docs, 16, pad_id=0,
+                                              split_docs=split)
+            ids_p, seg_p = _pack_numpy(docs, 16, 0, split)
+            np.testing.assert_array_equal(ids_n, ids_p)
+            np.testing.assert_array_equal(seg_n, seg_p)
+
+    def test_every_token_lands_once(self):
+        rng = np.random.RandomState(1)
+        docs = _docs(rng)
+        ids, seg, labels = pack_examples(docs, 16)
+        total = sum(len(d) for d in docs)
+        assert (seg >= 0).sum() == total
+        got = ids[seg >= 0]
+        np.testing.assert_array_equal(got, np.concatenate(docs))
+        assert (labels[seg < 0] == IGNORE_LABEL).all()
+
+    def test_batch_iterator(self):
+        rng = np.random.RandomState(2)
+        batches = list(PackedLMBatches(_docs(rng, n=10), capacity=16,
+                                       batch_rows=2, drop_last=False))
+        assert batches
+        for ids, seg, labels in batches:
+            assert ids.shape[1] == 16 and ids.shape == seg.shape
+
+
+class TestLossParity:
+    def _model(self, vocab=128):
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=vocab, hidden_size=32, num_layers=2,
+                        num_heads=2, max_position_embeddings=64,
+                        hidden_dropout_prob=0.0,
+                        attention_dropout_prob=0.0)
+        m = GPTForCausalLM(cfg)
+        m.eval()
+        return m
+
+    def test_packed_loss_matches_padded(self):
+        rng = np.random.RandomState(3)
+        docs = _docs(rng, n=5, lo=4, hi=14)
+        cap = 16
+        m = self._model()
+
+        # packed (whole-doc mode): identical (context, target) pairs
+        # as padded batching — exact parity; split_docs=True would cut
+        # docs at row boundaries (different, denser semantics)
+        ids, seg, labels = pack_examples(docs, cap, split_docs=False)
+        packed_loss = float(m(paddle.to_tensor(ids),
+                              labels=paddle.to_tensor(labels),
+                              segments=paddle.to_tensor(seg)).item())
+
+        # padded: one doc per row, pads ignored; same (context, target)
+        # pairs per token -> the per-token mean CE must match
+        pids = np.zeros((len(docs), cap), np.int32)
+        plabels = np.full((len(docs), cap), IGNORE_LABEL, np.int64)
+        pseg = np.full((len(docs), cap), -1, np.int32)
+        for i, d in enumerate(docs):
+            pids[i, :len(d)] = d
+            plabels[i, :len(d)] = d
+            pseg[i, :len(d)] = 0
+        padded_loss = float(m(paddle.to_tensor(pids),
+                              labels=paddle.to_tensor(plabels),
+                              segments=paddle.to_tensor(pseg)).item())
+        np.testing.assert_allclose(packed_loss, padded_loss, rtol=1e-5)
+
+    def test_segment_isolation(self):
+        # a token's logits must not change when a DIFFERENT document in
+        # the same packed row changes (attention isolation)
+        m = self._model()
+        rng = np.random.RandomState(4)
+        d1 = rng.randint(0, 128, 6).astype(np.int32)
+        d2a = rng.randint(0, 128, 6).astype(np.int32)
+        d2b = rng.randint(0, 128, 6).astype(np.int32)
+        cap = 16
+        out = {}
+        for tag, d2 in (("a", d2a), ("b", d2b)):
+            ids, seg, _ = pack_examples([d1, d2], cap)
+            logits = m(paddle.to_tensor(ids),
+                       segments=paddle.to_tensor(seg)).numpy()
+            out[tag] = logits[0, :6]  # d1's logits
+        np.testing.assert_allclose(out["a"], out["b"], atol=1e-5)
+
+    def test_packed_flash_kernel_parity(self):
+        # the interpret-mode varlen flash kernel agrees with the masked
+        # dense fallback through the full model
+        m = self._model()
+        rng = np.random.RandomState(5)
+        docs = _docs(rng, n=4, lo=20, hi=60)
+        ids, seg, labels = pack_examples(docs, 128)
+        dense = float(m(paddle.to_tensor(ids),
+                        labels=paddle.to_tensor(labels),
+                        segments=paddle.to_tensor(seg)).item())
+        paddle.set_flags({"use_flash_attention": True,
+                          "pallas_interpret": True})
+        try:
+            flash = float(m(paddle.to_tensor(ids),
+                            labels=paddle.to_tensor(labels),
+                            segments=paddle.to_tensor(seg)).item())
+        finally:
+            paddle.set_flags({"use_flash_attention": False,
+                              "pallas_interpret": False})
+        np.testing.assert_allclose(flash, dense, rtol=2e-4)
+
+    def test_train_step_consumes_packed_batches(self):
+        from paddle_tpu import optimizer
+        from paddle_tpu.jit.trainer import TrainStep
+
+        m = self._model()
+        m.train()
+        opt = optimizer.AdamW(1e-2, parameters=m.parameters())
+        step = TrainStep(
+            m, lambda ids, seg, lab: m(ids, labels=lab, segments=seg), opt)
+        rng = np.random.RandomState(6)
+        losses = []
+        batches = list(PackedLMBatches(_docs(rng, n=12, lo=8, hi=30),
+                                       capacity=32, batch_rows=2))
+        for _ in range(4):
+            for ids, seg, labels in batches:
+                losses.append(float(step(
+                    paddle.to_tensor(ids), paddle.to_tensor(seg),
+                    paddle.to_tensor(labels)).item()))
+        assert losses[-1] < losses[0]

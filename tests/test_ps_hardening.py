@@ -1,0 +1,133 @@
+"""PS hardening (VERDICT r5 item 6): snapshot/restore, table sharding
+across >=2 server processes, and server-failure recovery mid-training.
+
+Reference: paddle/fluid/distributed/ps/service/brpc_ps_server.cc with
+table Save/Load snapshot paths in ps/table/ and client-side shard
+routing; the failure drill mirrors the recsys operational story —
+snapshot, lose a server, restart it, restore its shard, keep training.
+"""
+import os
+import socket
+
+import numpy as np
+import pytest
+
+
+def _free_endpoint():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return f"127.0.0.1:{s.getsockname()[1]}"
+
+
+def test_snapshot_round_trip_in_process(tmp_path):
+    from paddle_tpu.distributed.ps import ParameterServer
+
+    ParameterServer.reset()
+    try:
+        ParameterServer.create_table("t", (6, 3), lr=0.5, optimizer="adam",
+                                     init=np.ones((6, 3), np.float32))
+        ParameterServer.push_sparse("t", np.array([1, 2]),
+                                    np.ones((2, 3), np.float32))
+        before = ParameterServer.pull_dense("t")
+        ParameterServer.save_snapshot(str(tmp_path))
+
+        # crash: all state lost
+        ParameterServer.reset()
+        with pytest.raises(KeyError):
+            ParameterServer.pull_dense("t")
+
+        ParameterServer.load_snapshot(str(tmp_path))
+        np.testing.assert_array_equal(ParameterServer.pull_dense("t"),
+                                      before)
+        # adam accessor state survived: the SAME push after restore must
+        # produce the SAME table as it would have without the crash
+        ParameterServer.push_sparse("t", np.array([1]),
+                                    np.ones((1, 3), np.float32))
+        after_restore = ParameterServer.pull_dense("t")
+
+        ParameterServer.reset()
+        ParameterServer.create_table("t", (6, 3), lr=0.5, optimizer="adam",
+                                     init=np.ones((6, 3), np.float32))
+        ParameterServer.push_sparse("t", np.array([1, 2]),
+                                    np.ones((2, 3), np.float32))
+        ParameterServer.push_sparse("t", np.array([1]),
+                                    np.ones((1, 3), np.float32))
+        uninterrupted = ParameterServer.pull_dense("t")
+        np.testing.assert_allclose(after_restore, uninterrupted, atol=1e-6)
+    finally:
+        ParameterServer.reset()
+
+
+def _sharded_ps_role(master_ep, snap_dir):
+    """3-process world: ranks 0,1 = shard servers, rank 2 = trainer.
+
+    The trainer trains a sharded table, snapshots, then rank 0's server
+    'crashes' (loses ALL its state); the trainer restores that shard from
+    the snapshot and continues — final state must equal an uninterrupted
+    run."""
+    import os
+
+    import numpy as np
+
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.distributed.ps import ParameterServer, ShardedPSWorker
+
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    name = f"ps{rank}" if rank < 2 else "trainer"
+    rpc.init_rpc(name, rank=rank, world_size=3, master_endpoint=master_ep)
+    try:
+        if rank < 2:
+            return "server"
+        w = ShardedPSWorker(["ps0", "ps1"])
+        shape = w.create_table("emb", (10, 4), lr=0.5,
+                               init=np.ones((10, 4), np.float32))
+        assert tuple(shape) == (10, 4)
+
+        # --- step 1: sparse push touching rows on BOTH shards ----------
+        ids = np.array([0, 1, 4, 7])        # servers: 0,1,0,1
+        w.push_sparse("emb", ids, np.ones((4, 4), np.float32))
+        rows = w.pull_sparse("emb", ids)
+        if not np.allclose(rows, 0.5):
+            return f"step1 mismatch {rows}"
+        # untouched row stays 1.0
+        if not np.allclose(w.pull_sparse("emb", np.array([3])), 1.0):
+            return "untouched row changed"
+
+        # --- snapshot, then kill server ps0's state --------------------
+        w.save_snapshot(snap_dir)
+        rpc.rpc_sync("ps0", ParameterServer.reset, args=())
+        try:
+            w.pull_sparse("emb", np.array([0]))  # shard gone
+            return "expected failure after server crash"
+        except Exception:
+            pass
+
+        # --- restart: restore ps0's shard, continue training -----------
+        w.restore_server("ps0", snap_dir)
+        w.push_sparse("emb", np.array([0, 1]), np.ones((2, 4), np.float32))
+        final = w.pull_sparse("emb", np.array([0, 1, 4, 3]))
+        # rows 0,1: two steps of sgd(0.5): 1 - 0.5 - 0.5 = 0.0
+        # row 4: one step -> 0.5 ; row 3: untouched -> 1.0
+        want = np.array([0.0, 0.0, 0.5, 1.0])
+        if not np.allclose(final[:, 0], want, atol=1e-6):
+            return f"post-restore mismatch {final[:, 0]} vs {want}"
+
+        # dense path through the shard layout
+        w.push_dense("emb", np.full((10, 4), 0.1, np.float32))
+        dense = w.pull_dense("emb")
+        if not np.allclose(dense[3, 0], 1.0 - 0.05, atol=1e-6):
+            return f"dense mismatch {dense[3, 0]}"
+        return "ok"
+    finally:
+        rpc.shutdown()
+
+
+def test_sharded_ps_server_failure_recovery(tmp_path):
+    import paddle_tpu.distributed as dist
+
+    results = dist.spawn(_sharded_ps_role,
+                         args=(_free_endpoint(), str(tmp_path)),
+                         nprocs=3, timeout=240)
+    assert results[0] == "server"
+    assert results[1] == "server"
+    assert results[2] == "ok", results[2]

@@ -334,10 +334,24 @@ class Completer:
         resolved_in = []
         for v, a, shp in zip(invals, eqn.invars, shapes):
             if isinstance(v, _Free):
-                # free param merging elementwise against a known operand of
-                # the same shape: the bias/scale rule — inherit its layout
-                want = next((kv for kv, ks in known if ks == shp), None)
-                if want is not None:
+                # free param merging elementwise against a known operand:
+                # the bias/scale rule — inherit the other operand's layout
+                # on every non-degenerate matching dim (size-1 broadcast
+                # dims stay unsharded). Guardrails: the reference operand
+                # must BE the elementwise result (shape == output shape),
+                # and an all-None inheritance must NOT pin the param —
+                # this default branch also sees non-elementwise prims
+                # (scatter, dynamic_update_slice, ...) where fixing the
+                # param against an unrelated operand (e.g. indices) would
+                # veto its real placing use later.
+                want = None
+                for kv, ks in known:
+                    if len(ks) == len(shp) and ks == tuple(out_shapes[0]):
+                        want = tuple(
+                            kv[d] if shp[d] == ks[d] and shp[d] != 1
+                            else None for d in range(len(shp)))
+                        break
+                if want is not None and any(want):
                     resolved_in.append(self._resolve(v, shp, want))
                 else:
                     resolved_in.append(self._spec_of(env, a))

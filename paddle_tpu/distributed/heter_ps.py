@@ -6,8 +6,9 @@ hashtable / HeterComm keep HOT feature rows in GPU HBM with the full
 table in host memory or SSD, moving rows across tiers per batch. The
 TPU-native collapse of that machinery:
 
-  * the full table lives in HOST memory (numpy; a ShardedPSWorker can be
-    plugged in as the backing store for multi-node capacity);
+  * the full table lives in a BACKING tier — host-RAM numpy
+    (HostTableBacking) or a parameter-server table (PSTableBacking over a
+    PSWorker/ShardedPSWorker, multi-node capacity);
   * a fixed-capacity DEVICE cache (one jnp array [capacity, dim]) holds
     the hot rows; the slot map + LRU order are host-side (python dict —
     the id set per batch is host data anyway, exactly like the
@@ -33,26 +34,62 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["HBMCachedEmbedding"]
+__all__ = ["HBMCachedEmbedding", "HostTableBacking", "PSTableBacking"]
+
+
+class HostTableBacking:
+    """Default backing tier: a host-RAM numpy table."""
+
+    def __init__(self, table: np.ndarray):
+        self.table = table
+
+    def pull_rows(self, ids) -> np.ndarray:
+        return self.table[np.asarray(ids, np.int64)]
+
+    def push_rows(self, ids, values) -> None:
+        self.table[np.asarray(ids, np.int64)] = values
+
+
+class PSTableBacking:
+    """Backing tier over a parameter-server table: a PSWorker or
+    ShardedPSWorker handle plus the table name — the full table lives
+    server-side (multi-node capacity), the device cache stays local.
+    Write-back uses the raw set_rows path (no optimizer rule: the cache
+    already applied its update on device)."""
+
+    def __init__(self, worker, name: str):
+        self.worker = worker
+        self.name = name
+
+    def pull_rows(self, ids) -> np.ndarray:
+        return np.asarray(self.worker.pull_sparse(self.name, ids))
+
+    def push_rows(self, ids, values) -> None:
+        self.worker.set_rows(self.name, ids, values)
 
 
 class HBMCachedEmbedding:
     def __init__(self, num_rows: int, dim: int, capacity: Optional[int] = None,
                  host_table: Optional[np.ndarray] = None, lr: float = 0.1,
-                 dtype=np.float32, hbm_fraction: float = 0.25):
+                 dtype=np.float32, hbm_fraction: float = 0.25,
+                 backing=None):
         self.num_rows = int(num_rows)
         self.dim = int(dim)
         self.lr = float(lr)
-        if host_table is not None:
+        if backing is not None:
+            if host_table is not None:
+                raise ValueError("pass host_table OR backing, not both")
+            self.backing = backing
+        elif host_table is not None:
             host_table = np.asarray(host_table, dtype)
             if host_table.shape != (num_rows, dim):
                 raise ValueError(f"host_table shape {host_table.shape} != "
                                  f"({num_rows}, {dim})")
-            self.host = host_table
+            self.backing = HostTableBacking(host_table)
         else:
             rng = np.random.default_rng(0)
-            self.host = (rng.standard_normal((num_rows, dim)) * 0.01
-                         ).astype(dtype)
+            self.backing = HostTableBacking(
+                (rng.standard_normal((num_rows, dim)) * 0.01).astype(dtype))
         if capacity is None:
             capacity = self._default_capacity(dim, np.dtype(dtype).itemsize,
                                               hbm_fraction)
@@ -86,11 +123,11 @@ class HBMCachedEmbedding:
     def _touch(self, fid: int):
         self._slot_of.move_to_end(fid)
 
-    def _evict_one(self) -> int:
+    def _evict_one(self, deferred_wb) -> int:
         fid, slot = self._slot_of.popitem(last=False)  # least recent
         if self._dirty.pop(fid, False):
-            self.host[fid] = np.asarray(self.cache[slot])
-            self.stats["writebacks"] += 1
+            deferred_wb.append((fid, slot))  # batched after the loop: one
+            self.stats["writebacks"] += 1   # push per fault-in, not per row
         self.stats["evictions"] += 1
         return slot
 
@@ -109,12 +146,20 @@ class HBMCachedEmbedding:
         if miss:
             self.stats["misses"] += len(miss)
             slots = []
+            deferred_wb: list = []
             for f in miss:
-                slot = self._free.pop() if self._free else self._evict_one()
+                slot = self._free.pop() if self._free \
+                    else self._evict_one(deferred_wb)
                 self._slot_of[f] = slot
                 slots.append(slot)
-            # ONE host->device transfer + ONE scatter for all misses
-            rows = jnp.asarray(self.host[np.asarray(miss)])
+            if deferred_wb:
+                # ONE batched write-back for all dirty evictions
+                wb_ids = np.asarray([f for f, _ in deferred_wb])
+                wb_slots = jnp.asarray([s for _, s in deferred_wb])
+                self.backing.push_rows(wb_ids,
+                                       np.asarray(self.cache[wb_slots]))
+            # ONE backing fetch + ONE scatter for all misses
+            rows = jnp.asarray(self.backing.pull_rows(np.asarray(miss)))
             self.cache = self.cache.at[jnp.asarray(slots)].set(rows)
         return np.asarray([self._slot_of[int(f)] for f in ids],
                           np.int32)
@@ -147,13 +192,18 @@ class HBMCachedEmbedding:
         dirty = [f for f, d in self._dirty.items() if d]
         if dirty:
             slots = np.asarray([self._slot_of[f] for f in dirty])
-            self.host[np.asarray(dirty)] = np.asarray(
-                self.cache[jnp.asarray(slots)])
+            self.backing.push_rows(
+                np.asarray(dirty),
+                np.asarray(self.cache[jnp.asarray(slots)]))
             self.stats["writebacks"] += len(dirty)
         self._dirty.clear()
         return len(dirty)
 
     def as_array(self) -> np.ndarray:
-        """The full table with all cached updates applied (flushes)."""
+        """The full table with all cached updates applied (flushes).
+        Host-table backing only — a PS backing has no local full copy."""
         self.flush()
-        return self.host
+        if not isinstance(self.backing, HostTableBacking):
+            raise TypeError("as_array() requires a HostTableBacking; "
+                            "read PS-backed tables through the worker")
+        return self.backing.table

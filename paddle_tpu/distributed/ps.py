@@ -132,6 +132,15 @@ class ParameterServer:
             cls._accessors[name].apply_rows(cls._tables[name], uniq, merged)
 
     @classmethod
+    def set_rows(cls, name: str, ids, values) -> None:
+        """Raw row assignment (no optimizer rule) — the write-back path
+        for tiered caches (heter_ps) and restore tooling."""
+        ids = np.asarray(ids, np.int64)
+        values = np.asarray(values, np.float32)
+        with cls._lock(name):
+            cls._tables[name][ids] = values
+
+    @classmethod
     def table_stats(cls, name: str) -> Dict[str, float]:
         """Accessor/stat surface (reference table->Pull/GetTableStat)."""
         with cls._lock(name):
@@ -263,6 +272,12 @@ class PSWorker:
         return rpc.rpc_sync(self.server, ParameterServer.pull_sparse,
                             args=(name, np.asarray(ids)))
 
+    def set_rows(self, name, ids, values):
+        from . import rpc
+
+        rpc.rpc_sync(self.server, ParameterServer.set_rows,
+                     args=(name, np.asarray(ids), np.asarray(values)))
+
     def push_sparse(self, name, ids, grads):
         from . import rpc
 
@@ -358,6 +373,19 @@ class ShardedPSWorker:
                 continue
             rpc.rpc_sync(srv, ParameterServer.push_sparse,
                          args=(name, local[mask], grads[mask]))
+
+    def set_rows(self, name, ids, values):
+        from . import rpc
+
+        ids = np.asarray(ids, np.int64)
+        values = np.asarray(values, np.float32)
+        srv_of, local = self._route(ids)
+        for i, srv in enumerate(self.servers):
+            mask = srv_of == i
+            if not mask.any():
+                continue
+            rpc.rpc_sync(srv, ParameterServer.set_rows,
+                         args=(name, local[mask], values[mask]))
 
     def pull_dense(self, name):
         from . import rpc

@@ -44,6 +44,11 @@ class TestCompleterSpecs:
         # row-parallel fan-in (matmul rule: act feature dim carries mp)
         assert s["gpt.blocks.0.attn.out_proj.weight"] == ("mp", None)
         assert s["gpt.blocks.0.mlp.fc_out.weight"] == ("mp", None)
+        # column-parallel biases follow their activation layout; row-
+        # parallel biases apply after the psum and replicate
+        assert s["gpt.blocks.0.attn.qkv_proj.bias"] == ("mp",)
+        assert s["gpt.blocks.0.mlp.fc_in.bias"] == ("mp",)
+        assert s["gpt.blocks.0.attn.out_proj.bias"] == ()
         # norms replicate
         assert s["gpt.blocks.0.ln_1.weight"] == ()
         assert s["gpt.ln_f.weight"] == ()

@@ -34,7 +34,7 @@ def test_lru_eviction_and_writeback():
     emb.lookup(np.array([10, 11, 12, 13]))
     assert emb.stats["evictions"] >= 4
     assert emb.stats["writebacks"] >= 1
-    np.testing.assert_allclose(emb.host[0], host[0] - 1.0, atol=1e-6)
+    np.testing.assert_allclose(emb.backing.table[0], host[0] - 1.0, atol=1e-6)
     # refaulting row 0 serves the written-back value
     np.testing.assert_allclose(np.asarray(emb.lookup(np.array([0])))[0],
                                host[0] - 1.0, atol=1e-6)
@@ -70,3 +70,37 @@ def test_capacity_overflow_raises():
 def test_default_capacity_from_memory_surface():
     emb = HBMCachedEmbedding(1 << 20, 64)  # no capacity given
     assert 1 <= emb.capacity <= 1 << 20
+
+
+def test_ps_backed_cache_in_process():
+    """The cache over a PS table (in-process ParameterServer here; the
+    worker handles expose the identical pull_sparse/set_rows surface over
+    rpc — transport covered by tests/test_ps_hardening.py)."""
+    from paddle_tpu.distributed.heter_ps import PSTableBacking
+    from paddle_tpu.distributed.ps import ParameterServer
+
+    ParameterServer.reset()
+    try:
+        host = _table(64, 8)
+        ParameterServer.create_table("emb", (64, 8), init=host.copy())
+
+        class _Local:  # bind the classmethod surface like a worker handle
+            pull_sparse = staticmethod(ParameterServer.pull_sparse)
+            set_rows = staticmethod(ParameterServer.set_rows)
+
+        emb = HBMCachedEmbedding(64, 8, capacity=8,
+                                 backing=PSTableBacking(_Local(), "emb"),
+                                 lr=0.5)
+        dense = host.copy()
+        rng = np.random.RandomState(2)
+        for _ in range(6):
+            ids = rng.randint(0, 64, 5)
+            g = rng.randn(5, 8).astype(np.float32)
+            emb.lookup(ids)
+            emb.update(ids, g)
+            np.add.at(dense, ids, -0.5 * g)
+        emb.flush()
+        np.testing.assert_allclose(ParameterServer.pull_dense("emb"),
+                                   dense, atol=1e-5)
+    finally:
+        ParameterServer.reset()

@@ -191,3 +191,17 @@ class GenerationMixin:
             out.append(tok)
         return Tensor(jnp.concatenate(
             [ids] + [o[:, None] for o in out], axis=1))
+
+
+def packed_positions(seg_v, s):
+    """Per-document positions for a packed row batch: positions restart
+    at every segment boundary (shared by GPT/LLaMA packed paths)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    b = seg_v.shape[0]
+    ar = jnp.arange(s, dtype=jnp.int32)[None, :]
+    new_doc = jnp.concatenate(
+        [jnp.ones((b, 1), bool), seg_v[:, 1:] != seg_v[:, :-1]], axis=1)
+    starts = lax.cummax(jnp.where(new_doc, ar, 0), axis=1)
+    return ar - starts

@@ -215,23 +215,25 @@ class GPTModel(nn.Layer):
                 new_caches.append(nc)
             return self.ln_f(h), new_caches
         if segments is not None:
-            if self.config.use_rotary:
-                raise NotImplementedError(
-                    "packed (segments=) batches require learned positions; "
-                    "rotary packed attention is not supported yet")
             # positions RESTART at each packed document so a packed row
             # embeds exactly like the same documents padded separately
             import jax.numpy as jnp
-            from jax import lax
+
+            from .generation import packed_positions
 
             seg_v = (segments._value if isinstance(segments, Tensor)
                      else jnp.asarray(segments)).astype(jnp.int32)
-            ar = jnp.arange(s, dtype=jnp.int32)[None, :]
-            new_doc = jnp.concatenate(
-                [jnp.ones((b, 1), bool), seg_v[:, 1:] != seg_v[:, :-1]],
-                axis=1)
-            starts = lax.cummax(jnp.where(new_doc, ar, 0), axis=1)
-            h = h + self.wpe(Tensor(ar - starts))
+            pos2d = packed_positions(seg_v, s)  # [b, s] per-doc positions
+            if self.config.use_rotary:
+                cos_t, sin_t = self._rope(s)
+                # per-token rope gather -> [b, s, 1, d] broadcast layout.
+                # NOTE: batch-varying cos/sin bypasses the fused Pallas
+                # rope kernel (it expects a [s, d] table); a kernel-side
+                # position gather is the chip-hot-path follow-up
+                rope = (Tensor(cos_t._value[pos2d][:, :, None, :]),
+                        Tensor(sin_t._value[pos2d][:, :, None, :]))
+            else:
+                h = h + self.wpe(Tensor(pos2d))
         elif self.config.use_rotary:
             rope = self._rope(s)
         else:

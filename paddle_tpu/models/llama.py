@@ -89,7 +89,7 @@ class LlamaAttention(nn.Layer):
         self.o_proj = RowParallelLinear(c.num_heads * self.head_dim, c.hidden_size,
                                         has_bias=False, input_is_parallel=True)
 
-    def forward(self, x, rope, cache=None, pos=None):
+    def forward(self, x, rope, cache=None, pos=None, segments=None):
         b, s, h = x.shape
         q = api.reshape(self.q_proj(x), [b, s, self.num_heads, self.head_dim])
         k = api.reshape(self.k_proj(x), [b, s, self.num_kv_heads, self.head_dim])
@@ -106,7 +106,12 @@ class LlamaAttention(nn.Layer):
             rep = self.num_heads // self.num_kv_heads
             k = api.repeat_interleave(k, rep, axis=2)
             v = api.repeat_interleave(v, rep, axis=2)
-        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        if segments is not None:
+            # packed-document path (varlen pretrain): attention restricted
+            # to each document, causally
+            out = api.segmented_attention(q, k, v, segments, causal=True)
+        else:
+            out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
         out = api.reshape(out, [b, s, self.num_heads * self.head_dim])
         return self.o_proj(out)
 
@@ -137,14 +142,15 @@ class LlamaDecoderLayer(nn.Layer):
                                                    epsilon=config.rms_norm_eps)
         self.mlp = LlamaMLP(config)
 
-    def forward(self, x, rope, cache=None, pos=None):
+    def forward(self, x, rope, cache=None, pos=None, segments=None):
         if cache is not None:
             a, new_cache = self.self_attn(self.input_layernorm(x), rope,
                                           cache=cache, pos=pos)
             x = x + a
             x = x + self.mlp(self.post_attention_layernorm(x))
             return x, new_cache
-        x = x + self.self_attn(self.input_layernorm(x), rope)
+        x = x + self.self_attn(self.input_layernorm(x), rope,
+                               segments=segments)
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x
 
@@ -161,9 +167,13 @@ class LlamaModel(nn.Layer):
         self._rope = _rope_tables(head_dim, config.max_position_embeddings,
                                   config.rope_theta)
 
-    def forward(self, input_ids, caches=None, pos=None):
+    def forward(self, input_ids, caches=None, pos=None, segments=None):
         s = input_ids.shape[1]
         if caches is not None:
+            if segments is not None:
+                raise NotImplementedError(
+                    "packed (segments=) batches are not supported with "
+                    "KV-cache decoding")
             from jax import lax
 
             pos_v = pos._value if isinstance(pos, Tensor) else jnp.asarray(pos)
@@ -179,17 +189,28 @@ class LlamaModel(nn.Layer):
                 h, nc = layer(h, (cos, sin), cache=cache, pos=Tensor(pos_v))
                 new_caches.append(nc)
             return self.norm(h), new_caches
-        cos = Tensor(self._rope[0]._value[:s])
-        sin = Tensor(self._rope[1]._value[:s])
+        if segments is not None:
+            # per-document positions (restart at each packed doc) drive a
+            # per-token rope gather -> [b, s, 1, d] broadcast layout
+            from .generation import packed_positions
+
+            seg_v = (segments._value if isinstance(segments, Tensor)
+                     else jnp.asarray(segments)).astype(jnp.int32)
+            pos2d = packed_positions(seg_v, s)
+            cos = Tensor(self._rope[0]._value[pos2d][:, :, None, :])
+            sin = Tensor(self._rope[1]._value[pos2d][:, :, None, :])
+        else:
+            cos = Tensor(self._rope[0]._value[:s])
+            sin = Tensor(self._rope[1]._value[:s])
         h = self.embed_tokens(input_ids)
         for layer in self.layers:
             if self.config.recompute and self.training:
                 from ..distributed.fleet.recompute import recompute
 
-                h = recompute(layer, h, (cos, sin),
+                h = recompute(layer, h, (cos, sin), segments=segments,
                               policy=self.config.recompute_policy)
             else:
-                h = layer(h, (cos, sin))
+                h = layer(h, (cos, sin), segments=segments)
         return self.norm(h)
 
 
@@ -214,16 +235,32 @@ class LlamaForCausalLM(nn.Layer, GenerationMixin):
             return api.matmul(h, api.t(self.model.embed_tokens.weight))
         return self.lm_head(h)
 
-    def forward(self, input_ids, labels=None, caches=None, pos=None):
+    def forward(self, input_ids, labels=None, caches=None, pos=None,
+                segments=None):
+        """segments: optional [b, s] packed-document ids (padding -1);
+        the shifted loss masks pairs that would cross a document
+        boundary."""
         if caches is not None:
+            if segments is not None:
+                raise NotImplementedError(
+                    "packed (segments=) batches are not supported with "
+                    "KV-cache decoding")
             h, new_caches = self.model(input_ids, caches=caches, pos=pos)
             return self._head(h), new_caches
-        h = self.model(input_ids)
+        h = self.model(input_ids, segments=segments)
         logits = self._head(h)
         if labels is not None:
             b, s, v = logits.shape
             shift_logits = api.reshape(logits[:, :-1, :], [-1, v])
-            shift_labels = api.reshape(labels[:, 1:], [-1])
+            lab = labels._value if isinstance(labels, Tensor) else \
+                jnp.asarray(labels)
+            shift_lab = lab[:, 1:]
+            if segments is not None:
+                seg_v = (segments._value if isinstance(segments, Tensor)
+                         else jnp.asarray(segments))
+                same_doc = seg_v[:, 1:] == seg_v[:, :-1]
+                shift_lab = jnp.where(same_doc, shift_lab, -100)
+            shift_labels = api.reshape(Tensor(shift_lab), [-1])
             return F.cross_entropy(shift_logits, shift_labels)
         return logits
 

@@ -93,7 +93,11 @@ class CausalSelfAttention(nn.Layer):
         qkv = api.reshape(qkv, [b, s, self.num_heads, 3 * self.head_dim])
         q, k, v = api.split(qkv, 3, axis=-1)
         if rope is not None:
-            q, k = api.rotary_position_embedding(q, k, rope[0], rope[1])
+            if len(rope) == 3:  # packed: (cos_table, sin_table, pos2d)
+                q, k = api.rotary_position_embedding_packed(
+                    q, k, rope[0], rope[1], rope[2])
+            else:
+                q, k = api.rotary_position_embedding(q, k, rope[0], rope[1])
         if cache is not None:
             if self.sequence_parallel:
                 raise NotImplementedError(
@@ -225,13 +229,10 @@ class GPTModel(nn.Layer):
                      else jnp.asarray(segments)).astype(jnp.int32)
             pos2d = packed_positions(seg_v, s)  # [b, s] per-doc positions
             if self.config.use_rotary:
+                # packed rope rides tables + per-token positions; the TPU
+                # kernel gathers rows in-kernel (one-hot MXU lookup)
                 cos_t, sin_t = self._rope(s)
-                # per-token rope gather -> [b, s, 1, d] broadcast layout.
-                # NOTE: batch-varying cos/sin bypasses the fused Pallas
-                # rope kernel (it expects a [s, d] table); a kernel-side
-                # position gather is the chip-hot-path follow-up
-                rope = (Tensor(cos_t._value[pos2d][:, :, None, :]),
-                        Tensor(sin_t._value[pos2d][:, :, None, :]))
+                rope = (cos_t, sin_t, Tensor(pos2d))
             else:
                 h = h + self.wpe(Tensor(pos2d))
         elif self.config.use_rotary:

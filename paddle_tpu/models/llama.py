@@ -94,7 +94,11 @@ class LlamaAttention(nn.Layer):
         q = api.reshape(self.q_proj(x), [b, s, self.num_heads, self.head_dim])
         k = api.reshape(self.k_proj(x), [b, s, self.num_kv_heads, self.head_dim])
         v = api.reshape(self.v_proj(x), [b, s, self.num_kv_heads, self.head_dim])
-        q, k = api.rotary_position_embedding(q, k, rope[0], rope[1])
+        if len(rope) == 3:  # packed: (cos_table, sin_table, pos2d)
+            q, k = api.rotary_position_embedding_packed(
+                q, k, rope[0], rope[1], rope[2])
+        else:
+            q, k = api.rotary_position_embedding(q, k, rope[0], rope[1])
         if cache is not None:
             # GQA caches keep the UNREPEATED kv heads (HBM = kv_heads/d of
             # MHA); the cached op broadcasts per q-head group at compute time
@@ -197,20 +201,22 @@ class LlamaModel(nn.Layer):
             seg_v = (segments._value if isinstance(segments, Tensor)
                      else jnp.asarray(segments)).astype(jnp.int32)
             pos2d = packed_positions(seg_v, s)
-            cos = Tensor(self._rope[0]._value[pos2d][:, :, None, :])
-            sin = Tensor(self._rope[1]._value[pos2d][:, :, None, :])
+            # slice tables to s (positions are < s): smaller in-kernel
+            # lookup and it keeps long-context configs on the kernel path
+            rope = (Tensor(self._rope[0]._value[:s]),
+                    Tensor(self._rope[1]._value[:s]), Tensor(pos2d))
         else:
-            cos = Tensor(self._rope[0]._value[:s])
-            sin = Tensor(self._rope[1]._value[:s])
+            rope = (Tensor(self._rope[0]._value[:s]),
+                    Tensor(self._rope[1]._value[:s]))
         h = self.embed_tokens(input_ids)
         for layer in self.layers:
             if self.config.recompute and self.training:
                 from ..distributed.fleet.recompute import recompute
 
-                h = recompute(layer, h, (cos, sin), segments=segments,
+                h = recompute(layer, h, rope, segments=segments,
                               policy=self.config.recompute_policy)
             else:
-                h = layer(h, (cos, sin), segments=segments)
+                h = layer(h, rope, segments=segments)
         return self.norm(h)
 
 

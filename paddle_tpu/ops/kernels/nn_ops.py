@@ -1026,6 +1026,25 @@ def flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
     return out[0]
 
 
+def rotary_position_embedding_packed(q, k, cos, sin, pos):
+    """Rope with PER-TOKEN positions (packed-document pretraining):
+    q/k [b, s, h, d], cos/sin TABLES [P, d], pos [b, s] int32. The TPU
+    lowering gathers the table rows in-kernel (one-hot MXU lookup inside
+    ops/pallas/rope._rope_packed_kernel) so the gathered [b, s, d] cos/sin
+    never materialize in HBM; other platforms take the gather+rotate XLA
+    composition. The VJP reuses the forward with sign=-1, valid for REAL
+    rope tables (duplicated half structure, cos/sin of the same angles) —
+    not for arbitrary tables."""
+    from ..pallas.rope import fused_rope_packed
+    from .. import pallas as _pallas
+
+    cv = cos if not hasattr(cos, "_value") else cos._value
+    sv = sin if not hasattr(sin, "_value") else sin._value
+    pv = pos if not hasattr(pos, "_value") else pos._value
+    return fused_rope_packed(q, k, cv, sv, pv.astype(jnp.int32),
+                             interpret=_pallas.interpret_mode())
+
+
 def segmented_attention(q, k, v, segment_ids, causal=True, scale=None):
     """Batched packed-sequence attention: q/k/v [b, s, h, d] with
     segment_ids [b, s] (same id = same document; padding uses -1, which

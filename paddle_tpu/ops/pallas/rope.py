@@ -118,3 +118,106 @@ def fused_rope(q, k, cos, sin, interpret=False):
             f"fused_rope: cos seq {cos.shape[0]} != q seq {q.shape[1]}"
         )
     return _rope_one(q, cos, sin, interpret), _rope_one(k, cos, sin, interpret)
+
+
+# ------------------------------------------------ packed (per-token) rope
+def _rope_packed_kernel(x_ref, pos_ref, cos_ref, sin_ref, o_ref, *, sign):
+    """Rope with PER-TOKEN positions (packed-document pretraining): the
+    cos/sin rows are gathered in-kernel via a one-hot MXU matmul — the
+    canonical TPU table lookup (mosaic has no general vector gather) —
+    so the [b, s, d] gathered tables never round-trip HBM."""
+    x = x_ref[...].astype(jnp.float32)       # [bs, h, d]
+    pos = pos_ref[...][0]                    # [8, bs] replicated -> [bs]
+    cos_t = cos_ref[...]                     # [P, d] fp32
+    sin_t = sin_ref[...]
+    onehot = (pos[:, None] == jax.lax.broadcasted_iota(
+        jnp.int32, (1, cos_t.shape[0]), 1)).astype(jnp.float32)
+    cos = (onehot @ cos_t)[:, None, :]       # [bs, 1, d]
+    sin = (onehot @ sin_t)[:, None, :]
+    d = x.shape[-1]
+    x1 = x[..., : d // 2]
+    x2 = x[..., d // 2:]
+    rot = jnp.concatenate([-x2, x1], axis=-1)
+    o_ref[...] = (x * cos + sign * rot * sin).astype(o_ref.dtype)
+
+
+# table bytes allowed resident in VMEM for the in-kernel lookup
+_PACKED_TABLE_VMEM_BUDGET = 4 << 20
+
+
+def _packed_supported(x, cos_tab):
+    s = x.shape[1]
+    P = cos_tab.shape[0]
+    bs = _seq_block(s, x.shape[2], x.shape[3], x.dtype.itemsize)
+    table_bytes = 2 * P * cos_tab.shape[1] * 4
+    onehot_bytes = bs * P * 4  # the in-kernel [bs, P] fp32 lookup matrix
+    return (s % bs == 0
+            and table_bytes + onehot_bytes <= _PACKED_TABLE_VMEM_BUDGET)
+
+
+def _apply_packed(x, pos2d, cos_tab, sin_tab, sign, interpret):
+    b, s, h, d = x.shape
+    bs = _seq_block(s, h, d, x.dtype.itemsize)
+    pos8 = jnp.repeat(pos2d.astype(jnp.int32)[:, None, :], 8, axis=1)
+    return pl.pallas_call(
+        functools.partial(_rope_packed_kernel, sign=sign),
+        grid=(b, s // bs),
+        in_specs=[
+            pl.BlockSpec((None, bs, h, d), lambda i, j: (i, j, 0, 0)),
+            pl.BlockSpec((None, 8, bs), lambda i, j: (i, 0, j)),
+            pl.BlockSpec(cos_tab.shape, lambda i, j: (0, 0)),
+            pl.BlockSpec(sin_tab.shape, lambda i, j: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, bs, h, d), lambda i, j: (i, j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=interpret,
+    )(x, pos8, cos_tab.astype(jnp.float32), sin_tab.astype(jnp.float32))
+
+
+def _xla_packed(x, pos2d, cos_tab, sin_tab, sign):
+    cos = jnp.take(cos_tab, pos2d, axis=0)[:, :, None, :].astype(jnp.float32)
+    sin = jnp.take(sin_tab, pos2d, axis=0)[:, :, None, :].astype(jnp.float32)
+    d = x.shape[-1]
+    x1 = x[..., : d // 2]
+    x2 = x[..., d // 2:]
+    rot = jnp.concatenate([-x2, x1], axis=-1)
+    xf = x.astype(jnp.float32)
+    return (xf * cos + sign * rot.astype(jnp.float32) * sin).astype(x.dtype)
+
+
+def _apply_packed_platform(x, pos2d, cos_tab, sin_tab, sign, interpret):
+    if interpret:
+        return _apply_packed(x, pos2d, cos_tab, sin_tab, sign, True)
+    if not _packed_supported(x, cos_tab):
+        return _xla_packed(x, pos2d, cos_tab, sin_tab, sign)
+    return jax.lax.platform_dependent(
+        x, pos2d, cos_tab, sin_tab,
+        tpu=lambda x, p, c, s: _apply_packed(x, p, c, s, sign, False),
+        default=lambda x, p, c, s: _xla_packed(x, p, c, s, sign))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _rope_one_packed(x, pos2d, cos_tab, sin_tab, interpret=False):
+    return _apply_packed_platform(x, pos2d, cos_tab, sin_tab, 1.0, interpret)
+
+
+def _rope_one_packed_fwd(x, pos2d, cos_tab, sin_tab, interpret):
+    return (_apply_packed_platform(x, pos2d, cos_tab, sin_tab, 1.0,
+                                   interpret),
+            (pos2d, cos_tab, sin_tab))
+
+
+def _rope_one_packed_bwd(interpret, res, g):
+    pos2d, cos_tab, sin_tab = res
+    return (_apply_packed_platform(g, pos2d, cos_tab, sin_tab, -1.0,
+                                   interpret), None, None, None)
+
+
+_rope_one_packed.defvjp(_rope_one_packed_fwd, _rope_one_packed_bwd)
+
+
+def fused_rope_packed(q, k, cos_tab, sin_tab, pos2d, interpret=False):
+    """q, k: [b, s, h, d]; cos/sin tables: [P, d]; pos2d: [b, s] int32
+    per-token positions (packed documents restart at 0)."""
+    return (_rope_one_packed(q, pos2d, cos_tab, sin_tab, interpret),
+            _rope_one_packed(k, pos2d, cos_tab, sin_tab, interpret))

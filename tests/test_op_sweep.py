@@ -77,6 +77,9 @@ def spd(n):
 # runner; everything else passes through.
 SPECS = {
     # ---- attention over packed segments (varlen pretrain path)
+    "rotary_position_embedding_packed": lambda: (
+        [f32(2, 8, 2, 4), f32(2, 8, 2, 4), f32(16, 4), f32(16, 4),
+         np.tile(np.arange(8, dtype=np.int32), (2, 1))], {}),
     "segmented_attention": lambda: (
         [f32(2, 8, 2, 4), f32(2, 8, 2, 4), f32(2, 8, 2, 4),
          np.repeat(np.array([[0, 0, 0, 1, 1, 2, 2, -1]], np.int32), 2, 0)],

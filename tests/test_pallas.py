@@ -275,3 +275,43 @@ def test_fused_adamw_wiring(monkeypatch):
 
     for a, b in zip(ref, fused):
         np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5)
+
+
+def test_fused_rope_packed():
+    """Packed rope: in-kernel one-hot MXU table lookup vs the XLA gather
+    composition, fwd + bwd (interpret mode)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas.rope import _xla_packed, fused_rope_packed
+
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(2, 256, 4, 16), jnp.float32)
+    k = jnp.asarray(rng.randn(2, 256, 4, 16), jnp.float32)
+    # REAL rope tables (halves duplicated): the linear-VJP identity
+    # sign=-1 == transpose holds only for this production structure
+    t = np.arange(64)[:, None]
+    inv = 1.0 / (10000 ** (np.arange(8) / 8.0))
+    ang = t * inv[None]
+    tab_c = jnp.asarray(np.concatenate([np.cos(ang)] * 2, -1), jnp.float32)
+    tab_s = jnp.asarray(np.concatenate([np.sin(ang)] * 2, -1), jnp.float32)
+    pos = jnp.asarray(rng.randint(0, 64, (2, 256)), jnp.int32)
+
+    qo, ko = fused_rope_packed(q, k, tab_c, tab_s, pos, interpret=True)
+    np.testing.assert_allclose(np.asarray(qo),
+                               np.asarray(_xla_packed(q, pos, tab_c, tab_s,
+                                                      1.0)), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ko),
+                               np.asarray(_xla_packed(k, pos, tab_c, tab_s,
+                                                      1.0)), atol=1e-5)
+
+    def loss_k(q):
+        qo, _ = fused_rope_packed(q, k, tab_c, tab_s, pos, interpret=True)
+        return jnp.sum(qo * qo)
+
+    def loss_r(q):
+        return jnp.sum(_xla_packed(q, pos, tab_c, tab_s, 1.0) ** 2)
+
+    gk = jax.grad(loss_k)(q)
+    gr = jax.grad(loss_r)(q)
+    np.testing.assert_allclose(np.asarray(gk), np.asarray(gr), atol=1e-4)

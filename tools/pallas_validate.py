@@ -147,6 +147,13 @@ def main():
     cosa = jax.ShapeDtypeStruct((2048, 128), jnp.float32)
     lower_tpu("rope_4x2048x16x128_bf16",
               lambda q, k, c, s: fused_rope(q, k, c, s), qr, qr, cosa, cosa)
+    posa = jax.ShapeDtypeStruct((4, 2048), jnp.int32)
+    taba = jax.ShapeDtypeStruct((2048, 128), jnp.float32)
+    from paddle_tpu.ops.pallas.rope import fused_rope_packed
+
+    lower_tpu("rope_packed_4x2048x16x128_bf16",
+              lambda q, k, c, s, p_: fused_rope_packed(q, k, c, s, p_),
+              qr, qr, taba, taba, posa)
     pa = jax.ShapeDtypeStruct((4096 * 4096,), jnp.float32)
     lower_tpu("fused_adamw_16M_flat_f32",
               lambda p, g, m, v: fused_adamw_update(p, g, m, v, lr=1e-3,

@@ -204,6 +204,14 @@ def get_all_worker_infos():
     return sorted(_agent.workers.values(), key=lambda w: w.rank)
 
 
+def barrier(name: str = "rpc_user_barrier", world_size=None) -> None:
+    """Block until every rpc worker reaches this (named) barrier —
+    rides the rendezvous store's generation-counted barrier."""
+    if _agent is None:
+        raise RuntimeError("rpc not initialized; call init_rpc first")
+    _agent.store.barrier(name, world_size=world_size or _agent.world_size)
+
+
 def shutdown():
     global _agent
     if _agent is not None:

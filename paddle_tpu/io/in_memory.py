@@ -1,0 +1,227 @@
+"""InMemoryDataset: slot-record files loaded to RAM with local/global
+shuffle — the recsys data path.
+
+Reference: python/paddle/distributed/fleet/dataset/dataset.py
+InMemoryDataset over paddle/fluid/framework/data_set.cc /
+data_feed.cc (~30k LoC: multi-slot text parsing, memory channels,
+trainer-global shuffle over RPC). TPU-native collapse:
+
+  * the multi-slot text format is parsed by ONE native call
+    (feed.cc pt_parse_slot_lines — the ParseOneInstance hot loop);
+  * records live as numpy arenas (values + per-slot counts), not
+    per-record objects — load_into_memory is two allocations per file;
+  * local_shuffle permutes an index array; global_shuffle redistributes
+    records across ranks by record-hash over the framework RPC layer
+    (the reference's trainer-global shuffle semantics: afterwards every
+    record lives on exactly one rank, keyed by hash, so epoch batches
+    across the fleet see a global permutation);
+  * batches come out slot-major: dense slots stacked [b, n]; sparse
+    (variable-count) slots as (values, cu_offsets) — the same
+    cu_seqlens convention the varlen flash path consumes.
+
+Line format (MultiSlotDataGenerator protocol): per record line, for each
+declared slot in order: `<count> v1 ... vcount`.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["InMemoryDataset"]
+
+# global-shuffle inboxes keyed by dataset name (rpc peers deliver here)
+_SHUFFLE_INBOX: Dict[str, List[Tuple[np.ndarray, np.ndarray]]] = {}
+
+
+def _shuffle_receive(name: str, vals, counts) -> bool:
+    _SHUFFLE_INBOX.setdefault(name, []).append(
+        (np.asarray(vals), np.asarray(counts)))
+    return True
+
+
+class InMemoryDataset:
+    """`init(batch_size=..., slots=[...]) -> set_filelist ->
+    load_into_memory -> [local|global]_shuffle -> iterate batches`."""
+
+    def __init__(self, name: str = "dataset0"):
+        self.name = name
+        self.batch_size = 1
+        self.slots: List[Tuple[str, str]] = []  # (name, 'dense'|'sparse')
+        self._files: List[str] = []
+        self._vals = np.zeros(0, np.float64)
+        self._counts = np.zeros((0, 0), np.int32)
+        self._order: Optional[np.ndarray] = None
+        self._shuffled_size: Optional[int] = None
+
+    # ------------------------------------------------------------- setup
+    def init(self, batch_size: int = 1,
+             slots: Sequence[Tuple[str, str]] = ()):
+        """slots: [(slot_name, kind)] with kind 'dense' (fixed count per
+        record) or 'sparse' (variable count, batched as values+offsets)."""
+        self.batch_size = int(batch_size)
+        self.slots = list(slots)
+        return self
+
+    def set_filelist(self, files: Sequence[str]) -> None:
+        self._files = list(files)
+
+    # ------------------------------------------------------------ loading
+    def load_into_memory(self) -> None:
+        from .. import native
+
+        vals_parts, count_parts = [], []
+        for path in self._files:
+            with open(path, "rb") as f:
+                data = f.read()
+            try:
+                vals, counts = native.parse_slot_lines(data,
+                                                       len(self.slots))
+            except RuntimeError:  # native toolchain unavailable
+                vals, counts = self._parse_python(data)
+            vals_parts.append(vals)
+            count_parts.append(counts)
+        if vals_parts:
+            self._vals = np.concatenate(vals_parts)
+            self._counts = np.concatenate(count_parts, axis=0)
+        self._order = np.arange(self._counts.shape[0])
+        self._shuffled_size = None
+
+    def _parse_python(self, data: bytes):
+        vals: List[float] = []
+        counts: List[List[int]] = []
+        for line in data.decode().splitlines():
+            toks = line.split()
+            if not toks:
+                continue
+            row = []
+            i = 0
+            for _ in self.slots:
+                n = int(toks[i])
+                i += 1
+                row.append(n)
+                vals.extend(float(t) for t in toks[i:i + n])
+                i += n
+            counts.append(row)
+        return (np.asarray(vals, np.float64),
+                np.asarray(counts, np.int32).reshape(len(counts),
+                                                     len(self.slots)))
+
+    def release_memory(self) -> None:
+        self._vals = np.zeros(0, np.float64)
+        self._counts = np.zeros((0, len(self.slots)), np.int32)
+        self._order = None
+        self._shuffled_size = None
+
+    def get_memory_data_size(self) -> int:
+        return int(self._counts.shape[0])
+
+    def get_shuffle_data_size(self) -> int:
+        return int(self._shuffled_size if self._shuffled_size is not None
+                   else self._counts.shape[0])
+
+    # ----------------------------------------------------------- shuffles
+    def local_shuffle(self, seed: Optional[int] = None) -> None:
+        rng = np.random.default_rng(seed)
+        self._order = rng.permutation(self._counts.shape[0])
+
+    def _record_bounds(self) -> np.ndarray:
+        """Start offset of each record in the value arena."""
+        per_rec = self._counts.sum(axis=1)
+        return np.concatenate([[0], np.cumsum(per_rec)])
+
+    def _records_subset(self, idx: np.ndarray):
+        bounds = self._record_bounds()
+        vals = np.concatenate(
+            [self._vals[bounds[i]:bounds[i + 1]] for i in idx]) \
+            if len(idx) else np.zeros(0, np.float64)
+        return vals, self._counts[idx]
+
+    def global_shuffle(self, seed: Optional[int] = None,
+                       timeout: float = 120.0) -> None:
+        """Redistribute records across the RPC world by record hash, then
+        shuffle locally (reference InMemoryDataset.global_shuffle over the
+        trainer fleet). Single-process (no rpc) degrades to
+        local_shuffle."""
+        from ..distributed import rpc
+
+        infos = []
+        try:
+            infos = rpc.get_all_worker_infos()
+        except Exception:
+            pass
+        if len(infos) <= 1:
+            self.local_shuffle(seed)
+            return
+        me = rpc.get_worker_info()
+        n = len(infos)
+        # hash each record's bytes -> owner rank (seed-salted so epochs
+        # redistribute differently)
+        bounds = self._record_bounds()
+        owners = np.empty(self._counts.shape[0], np.int64)
+        salt = str(seed).encode()
+        for i in range(self._counts.shape[0]):
+            h = hashlib.blake2b(
+                self._vals[bounds[i]:bounds[i + 1]].tobytes() + salt,
+                digest_size=8).digest()
+            owners[i] = int.from_bytes(h, "little") % n
+        for rank in range(n):
+            idx = np.nonzero(owners == rank)[0]
+            if not len(idx):
+                continue
+            vals, counts = self._records_subset(idx)
+            if infos[rank].name == me.name:
+                _shuffle_receive(self.name, vals, counts)
+            else:
+                rpc.rpc_sync(infos[rank].name, _shuffle_receive,
+                             args=(self.name, vals, counts),
+                             timeout=timeout)
+        # everyone must have DELIVERED before anyone reads its inbox
+        rpc.barrier(f"inmem_shuffle/{self.name}", world_size=n)
+        parts = _SHUFFLE_INBOX.pop(self.name, [])
+        if parts:
+            self._vals = np.concatenate([p[0] for p in parts])
+            self._counts = np.concatenate([p[1] for p in parts], axis=0)
+        else:
+            self._vals = np.zeros(0, np.float64)
+            self._counts = np.zeros((0, len(self.slots)), np.int32)
+        self._shuffled_size = self._counts.shape[0]
+        self.local_shuffle(seed)
+
+    # ------------------------------------------------------------ batches
+    def __iter__(self) -> Iterator[Dict[str, object]]:
+        order = self._order if self._order is not None \
+            else np.arange(self._counts.shape[0])
+        # flat per-(record, slot) start offsets, computed ONCE: the start
+        # of slot s of record i is flat[i * n_slots + s]
+        n_slots = max(len(self.slots), 1)
+        flat = np.concatenate(
+            [[0], np.cumsum(self._counts.ravel())]).astype(np.int64)
+        B = self.batch_size
+        for b0 in range(0, len(order) - B + 1, B):
+            idx = order[b0:b0 + B]
+            out: Dict[str, object] = {}
+            for s, (sname, kind) in enumerate(self.slots):
+                pieces = []
+                cnts = self._counts[idx, s]
+                for i in idx:
+                    start = flat[i * n_slots + s]
+                    pieces.append(
+                        self._vals[start:start + self._counts[i, s]])
+                if kind == "dense":
+                    if len(set(cnts.tolist())) > 1:
+                        raise ValueError(
+                            f"dense slot {sname!r} has varying counts "
+                            f"{sorted(set(cnts.tolist()))}")
+                    out[sname] = np.stack(pieces).astype(np.float32)
+                else:
+                    values = (np.concatenate(pieces)
+                              if pieces else np.zeros(0, np.float64))
+                    cu = np.concatenate(
+                        [[0], np.cumsum(cnts)]).astype(np.int32)
+                    out[sname] = (values.astype(np.int64), cu)
+            yield out
+
+    def __len__(self) -> int:
+        return self._counts.shape[0] // self.batch_size

@@ -116,6 +116,11 @@ def _bind(lib: ctypes.CDLL) -> None:
         c.c_void_p, c.c_void_p, c.c_int64, c.c_int32,
     ]
     lib.pt_pack_varlen.restype = c.c_int64
+    lib.pt_parse_slot_lines.argtypes = [
+        c.c_char_p, c.c_int64, c.c_int64, c.c_void_p, c.c_int64,
+        c.c_void_p, c.c_int64,
+    ]
+    lib.pt_parse_slot_lines.restype = c.c_int64
     # arena
     lib.pt_arena_create.argtypes = [c.c_uint64]
     lib.pt_arena_create.restype = c.c_void_p
@@ -509,3 +514,28 @@ def pack_varlen(docs, capacity: int, pad_id: int = 0,
     if rows < 0:
         raise ValueError("pack_varlen: row buffer too small (internal)")
     return ids[:rows], seg[:rows]
+
+
+def parse_slot_lines(data: bytes, n_slots: int):
+    """Parse multi-slot text records natively (see feed.cc). Returns
+    (values f64 [n_vals], counts i32 [n_records, n_slots])."""
+    import numpy as np
+
+    lib = get_lib()
+    # a value needs >= 2 bytes of text; counts need >= 2 per slot field
+    vals_cap = max(16, len(data) // 2 + 1)
+    # each record line carries n_slots count tokens of >= 2 bytes, so
+    # n_records <= len//(2*n_slots)+1; cap = that times n_slots
+    counts_cap = max(16 * n_slots, len(data) // 2 + n_slots)
+    vals = np.empty(vals_cap, np.float64)
+    counts = np.empty(counts_cap, np.int32)
+    n = int(lib.pt_parse_slot_lines(
+        data, len(data), n_slots,
+        vals.ctypes.data_as(ctypes.c_void_p), vals_cap,
+        counts.ctypes.data_as(ctypes.c_void_p), counts_cap))
+    if n == -1:
+        raise ValueError("parse_slot_lines: capacity exceeded (internal)")
+    if n == -2:
+        raise ValueError("parse_slot_lines: malformed multi-slot record")
+    counts = counts[:n * n_slots].reshape(n, n_slots).copy()
+    return vals[:int(counts.sum())].copy(), counts  # drop the big arenas

@@ -9,6 +9,7 @@
 // pt_feed_stack collates equal-shape samples into one contiguous batch
 // buffer — the two memcpy walls of the input pipeline.
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <thread>
 #include <vector>
@@ -142,6 +143,58 @@ int64_t pt_pack_varlen(const int32_t* tokens, const int64_t* lengths,
     ++row;
   }
   return row;
+}
+
+}  // extern "C"
+
+extern "C" {
+
+// Parse multi-slot text records (reference data_feed.cc
+// MultiSlotDataFeed::ParseOneInstance hot loop): each line holds, per
+// declared slot in order, "<count> v1 ... vcount". Values parse as
+// doubles (callers cast dense float slots / integer id slots).
+//
+// Outputs: out_vals (all values, record-major), out_counts (n_records *
+// n_slots per-slot counts). Returns the record count, or -1 if a
+// capacity is exceeded, -2 on malformed input.
+int64_t pt_parse_slot_lines(const char* buf, int64_t len, int64_t n_slots,
+                            double* out_vals, int64_t vals_cap,
+                            int32_t* out_counts, int64_t counts_cap) {
+  int64_t i = 0, n_vals = 0, n_records = 0;
+  while (i < len) {
+    // skip blank lines
+    while (i < len && (buf[i] == '\n' || buf[i] == '\r')) ++i;
+    if (i >= len) break;
+    if ((n_records + 1) * n_slots > counts_cap) return -1;
+    for (int64_t s = 0; s < n_slots; ++s) {
+      // parse count
+      while (i < len && (buf[i] == ' ' || buf[i] == '\t')) ++i;
+      if (i >= len || buf[i] == '\n' || buf[i] == '\r') return -2;
+      int64_t cnt = 0;
+      bool any = false;
+      while (i < len && buf[i] >= '0' && buf[i] <= '9') {
+        cnt = cnt * 10 + (buf[i] - '0');
+        ++i;
+        any = true;
+      }
+      if (!any) return -2;
+      out_counts[n_records * n_slots + s] = (int32_t)cnt;
+      for (int64_t v = 0; v < cnt; ++v) {
+        while (i < len && (buf[i] == ' ' || buf[i] == '\t')) ++i;
+        if (i >= len || buf[i] == '\n' || buf[i] == '\r') return -2;
+        char* end = nullptr;
+        double val = strtod(buf + i, &end);
+        if (end == buf + i) return -2;
+        if (n_vals >= vals_cap) return -1;
+        out_vals[n_vals++] = val;
+        i = end - buf;
+      }
+    }
+    // to end of line
+    while (i < len && buf[i] != '\n') ++i;
+    ++n_records;
+  }
+  return n_records;
 }
 
 }  // extern "C"

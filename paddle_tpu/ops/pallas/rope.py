@@ -129,6 +129,10 @@ def _rope_packed_kernel(x_ref, pos_ref, cos_ref, sin_ref, o_ref, *, sign):
     x = x_ref[...].astype(jnp.float32)       # [bs, h, d]
     pos = pos_ref[...][0]                    # [8, bs] replicated -> [bs]
     cos_t = cos_ref[...]                     # [P, d] fp32
+    # clamp: out-of-range positions take the last row on EVERY platform
+    # (matches jnp.take's default clip; an unclamped one-hot would
+    # silently zero the rotation instead)
+    pos = jnp.clip(pos, 0, cos_t.shape[0] - 1)
     sin_t = sin_ref[...]
     onehot = (pos[:, None] == jax.lax.broadcasted_iota(
         jnp.int32, (1, cos_t.shape[0]), 1)).astype(jnp.float32)
@@ -218,6 +222,7 @@ _rope_one_packed.defvjp(_rope_one_packed_fwd, _rope_one_packed_bwd)
 
 def fused_rope_packed(q, k, cos_tab, sin_tab, pos2d, interpret=False):
     """q, k: [b, s, h, d]; cos/sin tables: [P, d]; pos2d: [b, s] int32
-    per-token positions (packed documents restart at 0)."""
+    per-token positions (packed documents restart at 0). Out-of-range
+    positions clamp to the last table row on every platform."""
     return (_rope_one_packed(q, pos2d, cos_tab, sin_tab, interpret),
             _rope_one_packed(k, pos2d, cos_tab, sin_tab, interpret))

@@ -1,0 +1,137 @@
+"""InMemoryDataset tests (VERDICT missing #3 data-pipeline depth):
+native multi-slot parsing, load/shuffle/batch, and trainer-global
+shuffle over real processes.
+
+Reference: fleet/dataset/dataset.py InMemoryDataset over data_set.cc /
+data_feed.cc.
+"""
+import os
+import socket
+
+import numpy as np
+
+from paddle_tpu.io.in_memory import InMemoryDataset
+
+
+def _write_slot_file(path, rows, rng):
+    """rows of (label, dense[4], sparse ids varlen) in multi-slot text."""
+    lines = []
+    for label, dense, ids in rows:
+        toks = [f"1 {label}"]
+        toks.append("4 " + " ".join(f"{v:.3f}" for v in dense))
+        toks.append(f"{len(ids)} " + " ".join(str(i) for i in ids))
+        lines.append(" ".join(toks))
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+def _rows(rng, n):
+    return [(int(rng.integers(0, 2)),
+             rng.standard_normal(4),
+             rng.integers(0, 1000, rng.integers(1, 6)).tolist())
+            for _ in range(n)]
+
+
+def test_load_parse_batches(tmp_path):
+    rng = np.random.default_rng(0)
+    rows = _rows(rng, 10)
+    path = os.path.join(tmp_path, "part-0.txt")
+    _write_slot_file(path, rows, rng)
+
+    ds = InMemoryDataset().init(batch_size=2, slots=[
+        ("label", "dense"), ("feat", "dense"), ("ids", "sparse")])
+    ds.set_filelist([path])
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 10
+    batches = list(ds)
+    assert len(batches) == 5
+    b0 = batches[0]
+    assert b0["label"].shape == (2, 1)
+    assert b0["feat"].shape == (2, 4)
+    np.testing.assert_allclose(b0["feat"][0], rows[0][1], atol=1e-3)
+    values, cu = b0["ids"]
+    assert cu[-1] == len(values)
+    np.testing.assert_array_equal(values[:cu[1]], rows[0][2])
+
+
+def test_local_shuffle_permutes(tmp_path):
+    rng = np.random.default_rng(1)
+    rows = _rows(rng, 20)
+    path = os.path.join(tmp_path, "p.txt")
+    _write_slot_file(path, rows, rng)
+    ds = InMemoryDataset().init(batch_size=1, slots=[
+        ("label", "dense"), ("feat", "dense"), ("ids", "sparse")])
+    ds.set_filelist([path])
+    ds.load_into_memory()
+    before = [b["feat"][0].copy() for b in ds]
+    ds.local_shuffle(seed=7)
+    after = [b["feat"][0].copy() for b in ds]
+    assert not all(np.allclose(a, b) for a, b in zip(before, after))
+    # same multiset of records
+    key = lambda arr: tuple(np.round(arr, 3))
+    assert sorted(map(key, before)) == sorted(map(key, after))
+
+
+def test_python_parser_matches_native(tmp_path):
+    rng = np.random.default_rng(2)
+    rows = _rows(rng, 8)
+    path = os.path.join(tmp_path, "p.txt")
+    _write_slot_file(path, rows, rng)
+    ds = InMemoryDataset().init(batch_size=1, slots=[
+        ("label", "dense"), ("feat", "dense"), ("ids", "sparse")])
+    with open(path, "rb") as f:
+        data = f.read()
+    from paddle_tpu import native
+
+    v_n, c_n = native.parse_slot_lines(data, 3)
+    v_p, c_p = ds._parse_python(data)
+    np.testing.assert_allclose(v_n, v_p, atol=1e-9)
+    np.testing.assert_array_equal(c_n, c_p)
+
+
+def _global_shuffle_role(master_ep, data_dir):
+    import os
+
+    import numpy as np
+
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.io.in_memory import InMemoryDataset
+
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    rpc.init_rpc(f"trainer{rank}", rank=rank, world_size=2,
+                 master_endpoint=master_ep)
+    try:
+        ds = InMemoryDataset(name="gshuf").init(batch_size=1, slots=[
+            ("label", "dense"), ("feat", "dense"), ("ids", "sparse")])
+        ds.set_filelist([os.path.join(data_dir, f"part-{rank}.txt")])
+        ds.load_into_memory()
+        ds.global_shuffle(seed=3)
+        feats = sorted(tuple(np.round(b["feat"][0], 3)) for b in ds)
+        return (ds.get_shuffle_data_size(), feats)
+    finally:
+        rpc.shutdown()
+
+
+def test_global_shuffle_over_processes(tmp_path):
+    import paddle_tpu.distributed as dist
+
+    rng = np.random.default_rng(4)
+    all_rows = []
+    for rank in range(2):
+        rows = _rows(rng, 12)
+        all_rows += rows
+        _write_slot_file(os.path.join(tmp_path, f"part-{rank}.txt"),
+                         rows, rng)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    results = dist.spawn(_global_shuffle_role,
+                         args=(f"127.0.0.1:{port}", str(tmp_path)),
+                         nprocs=2, timeout=240)
+    sizes = [r[0] for r in results]
+    assert sum(sizes) == 24              # every record on exactly one rank
+    assert min(sizes) >= 1               # hash split touched both ranks
+    merged = sorted(results[0][1] + results[1][1])
+    want = sorted(tuple(np.round(np.asarray(r[1], np.float32), 3))
+                  for r in all_rows)
+    assert merged == want                # global multiset preserved

@@ -252,6 +252,35 @@ def not_(a):
     return not bool(a)
 
 
+def convert_logical_and(lhs, rhs_fn):
+    """`a and b` (reference convert_operators.convert_logical_and):
+    python values keep exact short-circuit semantics (rhs_fn is only
+    called when needed); a tensor lhs evaluates both sides and lowers to
+    logical_and."""
+    if not _is_dynamic(lhs):
+        return lhs and rhs_fn()
+    rhs = rhs_fn()
+    if not _is_dynamic(rhs):
+        rhs = bool(rhs)
+    return Tensor(jnp.logical_and(jnp.asarray(_to_val(lhs)),
+                                  jnp.asarray(_to_val(rhs))))
+
+
+def convert_logical_or(lhs, rhs_fn):
+    """`a or b` — short-circuit for python values, logical_or for
+    tensors (reference convert_logical_or)."""
+    if not _is_dynamic(lhs):
+        return lhs or rhs_fn()
+    rhs = rhs_fn()
+    if not _is_dynamic(rhs):
+        rhs = bool(rhs)
+    return Tensor(jnp.logical_or(jnp.asarray(_to_val(lhs)),
+                                 jnp.asarray(_to_val(rhs))))
+
+
+
+
+
 # --------------------------------------------------------------- AST pass
 def _assigned_names(stmts) -> set:
     names = set()
@@ -623,6 +652,39 @@ class ControlFlowTransformer(ast.NodeTransformer):
         self._n += 1
         return f"__d2s_{base}{self._n}"
 
+    # -- logical operators (reference logical_transformer.py) -------------
+    def visit_BoolOp(self, node: ast.BoolOp):
+        self.generic_visit(node)
+        # `a and b and c` -> __d2s_and(__d2s_and(a, lambda: b), lambda: c):
+        # python operands keep exact short-circuit + value semantics (the
+        # rhs lambda only runs when needed); tensor operands lower to
+        # logical_and/or instead of failing on Tensor.__bool__
+        fn = "__d2s_and" if isinstance(node.op, ast.And) else "__d2s_or"
+        # a walrus/yield in a non-first operand would bind inside the
+        # generated lambda's scope (or turn it into a generator): leave
+        # such BoolOps untransformed, the same loud-fallback contract as
+        # in-place stores
+        for v in node.values[1:]:
+            if any(isinstance(n, (ast.NamedExpr, ast.Yield, ast.YieldFrom))
+                   for n in ast.walk(v)):
+                return node
+        out = node.values[0]
+        for v in node.values[1:]:
+            lam = ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                                   kwonlyargs=[], kw_defaults=[],
+                                   kwarg=None, defaults=[]),
+                body=v)
+            out = ast.Call(func=_name(fn), args=[out, lam], keywords=[])
+        return out
+
+    def visit_UnaryOp(self, node: ast.UnaryOp):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.Call(func=_name("__d2s_lnot"), args=[node.operand],
+                            keywords=[])
+        return node
+
     # -- if ---------------------------------------------------------------
     def visit_If(self, node: ast.If):
         self.generic_visit(node)
@@ -963,6 +1025,9 @@ def _runtime_globals(func, uses_global: bool = False):
     g["__d2s_not"] = not_
     g["__d2s_ret_unset"] = RET_UNSET
     g["__d2s_ret_final"] = ret_final
+    g["__d2s_and"] = convert_logical_and
+    g["__d2s_or"] = convert_logical_or
+    g["__d2s_lnot"] = not_  # `not x` shares the guard helper
     return g
 
 

@@ -480,3 +480,75 @@ class TestWhileInplaceGuard:
         g = jit.to_static(f)
         with pytest.raises(Exception):
             g(_t([1.0, 2.0]), _t([0.0]))
+
+
+class TestLogicalOperators:
+    """Logical and/or/not lowering (reference logical_transformer.py +
+    convert_operators convert_logical_*): python operands keep exact
+    short-circuit semantics; tensor operands lower to logical ops."""
+
+    def test_tensor_and_in_if(self):
+        @jit.to_static
+        def f(x):
+            if (x.sum() > 0) and (x.sum() < 10):
+                y = x * 2
+            else:
+                y = x - 1
+            return y
+
+        assert np.allclose(f(_t([2.0])).numpy(), [4])
+        assert np.allclose(f(_t([20.0])).numpy(), [19])
+        assert np.allclose(f(_t([-1.0])).numpy(), [-2])
+
+    def test_tensor_or_and_not(self):
+        @jit.to_static
+        def f(x):
+            if (x.sum() < -5) or not (x.sum() < 5):
+                y = x * 10
+            else:
+                y = x + 1
+            return y
+
+        assert np.allclose(f(_t([-7.0])).numpy(), [-70])
+        assert np.allclose(f(_t([7.0])).numpy(), [70])
+        assert np.allclose(f(_t([1.0])).numpy(), [2])
+
+    def test_python_short_circuit_preserved(self):
+        calls = []
+
+        def expensive():
+            calls.append(1)
+            return True
+
+        @jit.to_static
+        def f(x, flag):
+            if flag and expensive():
+                return x + 1
+            return x - 1
+
+        assert np.allclose(f(_t([0.0]), False).numpy(), [-1])
+        assert calls == []  # rhs never evaluated: short-circuit intact
+        assert np.allclose(f(_t([0.0]), True).numpy(), [1])
+        assert calls == [1]
+
+    def test_python_value_semantics_preserved(self):
+        @jit.to_static
+        def f(x, a, b):
+            c = a or b       # python `or` returns the VALUE, not a bool
+            return x + c
+
+        assert np.allclose(f(_t([0.0]), 0, 5).numpy(), [5])
+        assert np.allclose(f(_t([0.0]), 3, 5).numpy(), [3])
+
+    def test_mixed_tensor_and_in_while(self):
+        @jit.to_static
+        def f(x):
+            i = _t(0.0)
+            s = x * 0
+            while (i.sum() < 10) and (s.sum() < 6):
+                s = s + x
+                i = i + 1
+            return s
+
+        # x=[1,2]: s.sum() grows 3/iter -> stops after 2 iters
+        assert np.allclose(f(_t([1.0, 2.0])).numpy(), [2, 4])

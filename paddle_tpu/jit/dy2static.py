@@ -278,9 +278,6 @@ def convert_logical_or(lhs, rhs_fn):
                                  jnp.asarray(_to_val(rhs))))
 
 
-
-
-
 # --------------------------------------------------------------- AST pass
 def _assigned_names(stmts) -> set:
     names = set()
@@ -665,7 +662,8 @@ class ControlFlowTransformer(ast.NodeTransformer):
         # such BoolOps untransformed, the same loud-fallback contract as
         # in-place stores
         for v in node.values[1:]:
-            if any(isinstance(n, (ast.NamedExpr, ast.Yield, ast.YieldFrom))
+            if any(isinstance(n, (ast.NamedExpr, ast.Yield, ast.YieldFrom,
+                                  ast.Await))
                    for n in ast.walk(v)):
                 return node
         out = node.values[0]
@@ -681,7 +679,7 @@ class ControlFlowTransformer(ast.NodeTransformer):
     def visit_UnaryOp(self, node: ast.UnaryOp):
         self.generic_visit(node)
         if isinstance(node.op, ast.Not):
-            return ast.Call(func=_name("__d2s_lnot"), args=[node.operand],
+            return ast.Call(func=_name("__d2s_not"), args=[node.operand],
                             keywords=[])
         return node
 
@@ -1027,7 +1025,6 @@ def _runtime_globals(func, uses_global: bool = False):
     g["__d2s_ret_final"] = ret_final
     g["__d2s_and"] = convert_logical_and
     g["__d2s_or"] = convert_logical_or
-    g["__d2s_lnot"] = not_  # `not x` shares the guard helper
     return g
 
 

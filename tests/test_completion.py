@@ -122,6 +122,10 @@ class TestEnginePipeline:
         from paddle_tpu.ops import registry as _registry
 
         _registry._EXEC_CACHE.clear()
+        # the sp-attention builder lru-caches a jitted closure over the
+        # real ring_attention; a prior test with the same mesh/flags would
+        # serve it compiled and the spy would never re-trace
+        cp._sp_attention_fn.cache_clear()
 
         calls = []
         real_ring = cp.ring_attention
@@ -131,7 +135,11 @@ class TestEnginePipeline:
             return real_ring(*a, **k)
 
         monkeypatch.setattr(cp, "ring_attention", spy)
-        hist = eng.fit([(paddle.to_tensor(ids),)], epochs=1)
+        try:
+            hist = eng.fit([(paddle.to_tensor(ids),)], epochs=1)
+        finally:
+            # never leave a spy-closing jitted entry in the global cache
+            cp._sp_attention_fn.cache_clear()
         assert cfg.sequence_parallel == "ring"  # engine flipped the mode
         assert m.gpt.blocks[0].attn.sequence_parallel == "ring"
         assert calls, "ring_attention never ran under the sep mesh"

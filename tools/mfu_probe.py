@@ -24,7 +24,7 @@ OUT_PATH = os.environ.get("MFU_PROBE_OUT",
 
 
 def measure(name, hidden=1024, layers=24, heads=16, batch=8, seq=1024,
-            steps=5, flash=True, o2=False, recompute=False):
+            steps=5, flash=True, o2=False, recompute=False, packed=False):
     import jax
 
     import paddle_tpu as paddle
@@ -47,13 +47,37 @@ def measure(name, hidden=1024, layers=24, heads=16, batch=8, seq=1024,
         model, opt = amp.decorate(model, opt, level="O2", dtype="bfloat16")
         level = "O2"
 
-    def loss_fn(ids):
-        with amp.auto_cast(level=level, dtype="bfloat16"):
-            return model(ids, labels=ids)
+    if packed:
+        # varlen path: packed documents, segmented flash attention
+        from paddle_tpu.io.packing import pack_examples
 
-    step = TrainStep(model, loss_fn, opt)
-    ids = paddle.to_tensor(
-        np.random.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+        rng = np.random.RandomState(0)
+        docs, total = [], 0
+        while total < batch * seq:
+            n = int(rng.randint(seq // 8, seq))
+            docs.append(rng.randint(0, cfg.vocab_size, n).astype(np.int32))
+            total += n
+        ids_np, seg_np, lab_np = (a[:batch] for a in
+                                  pack_examples(docs, seq))
+
+        def loss_fn(ids, seg, lab):
+            with amp.auto_cast(level=level, dtype="bfloat16"):
+                return model(ids, labels=lab, segments=seg)
+
+        _step = TrainStep(model, loss_fn, opt)
+        _seg = paddle.to_tensor(seg_np)
+        _lab = paddle.to_tensor(lab_np)
+        step = lambda ids: _step(ids, _seg, _lab)  # noqa: E731
+        ids = paddle.to_tensor(ids_np)
+    else:
+        def loss_fn(ids):
+            with amp.auto_cast(level=level, dtype="bfloat16"):
+                return model(ids, labels=ids)
+
+        step = TrainStep(model, loss_fn, opt)
+        ids = paddle.to_tensor(
+            np.random.randint(0, cfg.vocab_size,
+                              (batch, seq)).astype(np.int32))
     t0 = time.time()
     loss = step(ids)
     float(loss.item())
@@ -78,7 +102,8 @@ def measure(name, hidden=1024, layers=24, heads=16, batch=8, seq=1024,
             "config": name, "backend": jax.default_backend(),
             "params_millions": round(n_params / 1e6, 1),
             "batch": batch, "seq": seq, "flash": flash, "o2": o2,
-            "recompute": recompute, "compile_s": round(compile_s, 1),
+            "recompute": recompute, "packed": packed,
+            "compile_s": round(compile_s, 1),
             "step_ms": round(dt * 1000, 2), "tokens_per_sec": round(tps, 1),
             "mfu": round(mfu, 4), "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
         }) + "\n")
@@ -99,6 +124,8 @@ CONFIGS = {
     "o2b16flashoff": dict(o2=True, batch=16, flash=False),
     "o2b64r": dict(o2=True, batch=64, recompute=True),
     "o2s2048b16r": dict(o2=True, batch=16, seq=2048, recompute=True),
+    "o2b16packed": dict(o2=True, batch=16, packed=True),
+    "o2s2048b8packed": dict(o2=True, batch=8, seq=2048, packed=True),
 }
 
 

@@ -25,7 +25,7 @@ print('probe ok', float(x[0,0]))" >> "$LOG" 2>&1
   echo "[$(date -u +%T)] probe attempt $i rc=$rc" >> "$LOG"
   if [ $rc -eq 0 ]; then
     echo "[$(date -u +%T)] chip alive -> harvesting" >> "$LOG"
-    timeout 7200 python tools/mfu_probe.py baseline o2 o2b16 o2b32 o2b32r flashoff >> "$LOG" 2>&1
+    timeout 7200 python tools/mfu_probe.py baseline o2 o2b16 o2b32 o2b32r flashoff o2b16packed >> "$LOG" 2>&1
     echo "[$(date -u +%T)] mfu_probe rc=$?" >> "$LOG"
     timeout 3600 python tools/opbench.py --out OPBENCH_r05.json >> "$LOG" 2>&1
     echo "[$(date -u +%T)] opbench rc=$?" >> "$LOG"

@@ -87,3 +87,10 @@ from .context_parallel import (  # noqa: F401
 )
 from .parallel import DataParallel  # noqa: F401
 from .sharded import shard_map, shard_tensor_to, sharded_fn  # noqa: F401
+from ..io.in_memory import InMemoryDataset  # noqa: F401,E402
+from .heter_ps import HBMCachedEmbedding  # noqa: F401,E402
+from .ps import (  # noqa: F401,E402
+    ParameterServer,
+    PSWorker,
+    ShardedPSWorker,
+)

@@ -26,3 +26,9 @@ from .sampler import (  # noqa: F401
     SubsetRandomSampler,
     WeightedRandomSampler,
 )
+from .in_memory import InMemoryDataset  # noqa: F401,E402
+from .packing import (  # noqa: F401,E402
+    IGNORE_LABEL,
+    PackedLMBatches,
+    pack_examples,
+)

@@ -1,0 +1,94 @@
+#!/bin/bash
+# Watchdog v2: the round-5 tunnel alternates between healthy and a wedged
+# remote-compile service (tpu_compile_helper 500s / indefinite hangs), so a
+# fire-once harvest chain (v1) stalls for hours of stacked timeouts.  v2
+# interleaves health probes WITH the harvest: each work item is attempted
+# only right after a fresh probe succeeds, and a failure sends us back to
+# the cool-down loop with the remaining items intact.
+#
+# Work items, in value order (highest first):
+#   mfu:<preset>   one mfu_probe ablation (each persists to MFU_PROBE.jsonl)
+#   opbench / moebench / decodebench / sparsebench
+cd /root/repo || exit 1
+LOG=tools/tpu_watchdog2.log
+STATE=tools/.watchdog2_items
+if [ ! -f "$STATE" ]; then
+  cat > "$STATE" <<'EOF'
+mfu:o2
+mfu:o2b32
+mfu:o2b16
+mfu:o2b32r
+mfu:o2b16packed
+mfu:flashoff
+opbench
+moebench
+decodebench
+sparsebench
+EOF
+fi
+# single-instance guard: a second launch must not race the first on the
+# shared state file (double pops silently drop queue items)
+PIDFILE=tools/.watchdog2_pid
+if [ -f "$PIDFILE" ] && kill -0 "$(cat "$PIDFILE")" 2>/dev/null; then
+  echo "watchdog2 already running (pid $(cat "$PIDFILE")); exiting" >> "$LOG"; exit 0
+fi
+echo $$ > "$PIDFILE"
+: > tools/.watchdog2_retries  # per-run retry counts: stale counts from a prior run must not shrink this run's attempt budget
+# v2 supersedes v1; both running means double chip occupancy. Kill the v1
+# supervisor AND any in-flight harvest child it spawned.
+pkill -f 'bash tools/tpu_watchdog.sh' 2>/dev/null
+sleep 1
+pkill -f 'tools/(mfu_probe|opbench|moebench|decodebench|sparsebench)' 2>/dev/null
+echo "=== watchdog2 start $(date -u +%FT%TZ)" >> "$LOG"
+
+probe() {
+  timeout 240 python -c "
+import jax, jax.numpy as jnp
+assert jax.default_backend() not in ('cpu',), jax.default_backend()
+x = jax.jit(lambda a,b: (a@b).sum())(jnp.ones((256,256), jnp.bfloat16), jnp.ones((256,256), jnp.bfloat16))
+print('probe ok', float(x))" >> "$LOG" 2>&1
+}
+
+run_item() {  # $1 = item name; rc!=0 -> keep the item queued
+  case "$1" in
+    mfu:*)      timeout 1800 python tools/mfu_probe.py "${1#mfu:}" ;;
+    opbench)    timeout 3600 python tools/opbench.py --out OPBENCH_r05.json ;;
+    moebench)   timeout 2400 python tools/moebench.py --out MOEBENCH_r05.json ;;
+    decodebench) timeout 2400 python tools/decodebench.py --preset large ;;
+    sparsebench) timeout 1200 env SPARSEBENCH_TPU=1 python tools/sparsebench.py ;;
+    *) echo "unknown item $1" >&2; return 1 ;;
+  esac
+}
+
+for i in $(seq 1 200); do
+  if ! [ -s "$STATE" ]; then echo "=== all items done $(date -u +%FT%TZ)" >> "$LOG"; exit 0; fi
+  if pgrep -f "mfu_probe|opbench|moebench|tpu_smoke|decodebench|sparsebench" > /dev/null; then
+    echo "[$(date -u +%T)] chip busy (another tool), waiting" >> "$LOG"; sleep 600; continue
+  fi
+  probe; rc=$?
+  echo "[$(date -u +%T)] probe $i rc=$rc ($(head -1 "$STATE") next, $(wc -l < "$STATE") left)" >> "$LOG"
+  if [ $rc -ne 0 ]; then sleep 540; continue; fi
+  item=$(head -1 "$STATE")
+  run_item "$item" >> "$LOG" 2>&1
+  irc=$?
+  echo "[$(date -u +%T)] item $item rc=$irc" >> "$LOG"
+  # mfu_probe exits 0 even when a preset FAILED (it persists per-row);
+  # verify the row actually landed before retiring an mfu item
+  if [ $irc -eq 0 ] && { [[ "$item" != mfu:* ]] || tail -20 MFU_PROBE.jsonl 2>/dev/null | grep -q "\"config\": \"${item#mfu:}\", \"backend\": \"tpu\""; }; then
+    tail -n +2 "$STATE" > "$STATE.tmp" && mv "$STATE.tmp" "$STATE"
+    continue
+  fi
+  # failed (nonzero rc, timeout, or no evidence row): rotate to the END of
+  # the queue with a capped attempt budget so one sick item can't starve
+  # the rest of the harvest
+  echo "$item" >> tools/.watchdog2_retries
+  tail -n +2 "$STATE" > "$STATE.tmp" && mv "$STATE.tmp" "$STATE"
+  if [ "$(grep -c "^$item$" tools/.watchdog2_retries)" -lt 4 ]; then
+    echo "[$(date -u +%T)] $item failed; requeueing at tail" >> "$LOG"
+    echo "$item" >> "$STATE"
+  else
+    echo "[$(date -u +%T)] $item failed 4x; dropping" >> "$LOG"
+  fi
+  sleep 300  # cool down, re-probe before the next item
+done
+echo "=== watchdog2 gave up $(date -u +%FT%TZ)" >> "$LOG"

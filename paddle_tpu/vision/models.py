@@ -765,3 +765,179 @@ class GoogLeNet(nn.Layer):
 
 def googlenet(pretrained=False, **kwargs):
     return GoogLeNet(**kwargs)
+
+
+def resnext50_32x4d(pretrained=False, **kwargs):
+    """Reference: vision/models/resnet.py:533 (ResNeXt = grouped bottleneck)."""
+    return ResNet(BottleneckBlock, 50, width=4, groups=32, **kwargs)
+
+
+def resnext50_64x4d(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 50, width=4, groups=64, **kwargs)
+
+
+def resnext101_32x4d(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 101, width=4, groups=32, **kwargs)
+
+
+def resnext101_64x4d(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 101, width=4, groups=64, **kwargs)
+
+
+def resnext152_32x4d(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 152, width=4, groups=32, **kwargs)
+
+
+def resnext152_64x4d(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 152, width=4, groups=64, **kwargs)
+
+
+def wide_resnet50_2(pretrained=False, **kwargs):
+    """Reference: vision/models/resnet.py:751 (2x-wide bottleneck interior)."""
+    return ResNet(BottleneckBlock, 50, width=128, **kwargs)
+
+
+def wide_resnet101_2(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 101, width=128, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# InceptionV3 (reference: vision/models/inceptionv3.py:488). Published
+# topology (Szegedy et al. 2015); original condensed layer-API build.
+
+class _ConvBN(nn.Sequential):
+    def __init__(self, in_c, out_c, k, stride=1, padding=0):
+        super().__init__(
+            nn.Conv2D(in_c, out_c, k, stride=stride, padding=padding,
+                      bias_attr=False),
+            nn.BatchNorm2D(out_c), nn.ReLU())
+
+
+class _IncA(nn.Layer):
+    def __init__(self, in_c, pool_c):
+        super().__init__()
+        self.b1 = _ConvBN(in_c, 64, 1)
+        self.b5 = nn.Sequential(_ConvBN(in_c, 48, 1), _ConvBN(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(_ConvBN(in_c, 64, 1), _ConvBN(64, 96, 3, padding=1),
+                                _ConvBN(96, 96, 3, padding=1))
+        self.pool = nn.Sequential(nn.AvgPool2D(3, 1, 1), _ConvBN(in_c, pool_c, 1))
+
+    def forward(self, x):
+        import paddle_tpu as _p
+
+        return _p.concat([self.b1(x), self.b5(x), self.b3(x), self.pool(x)], axis=1)
+
+
+class _IncB(nn.Layer):
+    """Grid reduction 35->17."""
+
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = _ConvBN(in_c, 384, 3, stride=2)
+        self.b3d = nn.Sequential(_ConvBN(in_c, 64, 1), _ConvBN(64, 96, 3, padding=1),
+                                 _ConvBN(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, 2)
+
+    def forward(self, x):
+        import paddle_tpu as _p
+
+        return _p.concat([self.b3(x), self.b3d(x), self.pool(x)], axis=1)
+
+
+class _IncC(nn.Layer):
+    def __init__(self, in_c, c7):
+        super().__init__()
+        self.b1 = _ConvBN(in_c, 192, 1)
+        self.b7 = nn.Sequential(
+            _ConvBN(in_c, c7, 1), _ConvBN(c7, c7, (1, 7), padding=(0, 3)),
+            _ConvBN(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7d = nn.Sequential(
+            _ConvBN(in_c, c7, 1), _ConvBN(c7, c7, (7, 1), padding=(3, 0)),
+            _ConvBN(c7, c7, (1, 7), padding=(0, 3)),
+            _ConvBN(c7, c7, (7, 1), padding=(3, 0)),
+            _ConvBN(c7, 192, (1, 7), padding=(0, 3)))
+        self.pool = nn.Sequential(nn.AvgPool2D(3, 1, 1), _ConvBN(in_c, 192, 1))
+
+    def forward(self, x):
+        import paddle_tpu as _p
+
+        return _p.concat([self.b1(x), self.b7(x), self.b7d(x), self.pool(x)], axis=1)
+
+
+class _IncD(nn.Layer):
+    """Grid reduction 17->8."""
+
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = nn.Sequential(_ConvBN(in_c, 192, 1), _ConvBN(192, 320, 3, stride=2))
+        self.b7 = nn.Sequential(
+            _ConvBN(in_c, 192, 1), _ConvBN(192, 192, (1, 7), padding=(0, 3)),
+            _ConvBN(192, 192, (7, 1), padding=(3, 0)), _ConvBN(192, 192, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, 2)
+
+    def forward(self, x):
+        import paddle_tpu as _p
+
+        return _p.concat([self.b3(x), self.b7(x), self.pool(x)], axis=1)
+
+
+class _IncE(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b1 = _ConvBN(in_c, 320, 1)
+        self.b3_stem = _ConvBN(in_c, 384, 1)
+        self.b3_a = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.b3d_stem = nn.Sequential(_ConvBN(in_c, 448, 1),
+                                      _ConvBN(448, 384, 3, padding=1))
+        self.b3d_a = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3d_b = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.pool = nn.Sequential(nn.AvgPool2D(3, 1, 1), _ConvBN(in_c, 192, 1))
+
+    def forward(self, x):
+        import paddle_tpu as _p
+
+        s = self.b3_stem(x)
+        d = self.b3d_stem(x)
+        return _p.concat([
+            self.b1(x),
+            _p.concat([self.b3_a(s), self.b3_b(s)], axis=1),
+            _p.concat([self.b3d_a(d), self.b3d_b(d)], axis=1),
+            self.pool(x)], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    """Reference: vision/models/inceptionv3.py:488 (same stage schedule:
+    stem -> 3xA(pool 32/64/64) -> B -> C(128/160/160/192) -> D -> 2xE)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = nn.Sequential(
+            _ConvBN(3, 32, 3, stride=2), _ConvBN(32, 32, 3),
+            _ConvBN(32, 64, 3, padding=1), nn.MaxPool2D(3, 2),
+            _ConvBN(64, 80, 1), _ConvBN(80, 192, 3), nn.MaxPool2D(3, 2))
+        self.blocks = nn.Sequential(
+            _IncA(192, 32), _IncA(256, 64), _IncA(288, 64),
+            _IncB(288),
+            _IncC(768, 128), _IncC(768, 160), _IncC(768, 160), _IncC(768, 192),
+            _IncD(768),
+            _IncE(1280), _IncE(2048))
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.5)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(x.flatten(1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    return InceptionV3(**kwargs)

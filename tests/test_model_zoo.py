@@ -80,3 +80,25 @@ def test_hapi_fit_squeezenet():
                   loss=paddle.nn.CrossEntropyLoss())
     hist = model.fit(ds, batch_size=8, epochs=1, verbose=0)
     assert np.isfinite(hist["loss"][0])
+
+
+def test_resnext_wide_resnet_shapes():
+    """Reference resnet.py:533/751: grouped bottleneck + 2x-wide variants."""
+    x = paddle.to_tensor(np.random.randn(1, 3, 64, 64).astype(np.float32))
+    m = M.resnext50_32x4d(num_classes=6)
+    m.eval()
+    assert tuple(m(x).shape) == (1, 6)
+    # grouped conv2 width: 4 * 32 groups = 128 at stage-1 width 64
+    assert m.layer1[0].conv2.weight.shape[0] == 128
+    w = M.wide_resnet50_2(num_classes=6)
+    w.eval()
+    assert tuple(w(x).shape) == (1, 6)
+    assert w.layer1[0].conv2.weight.shape[0] == 128  # 64 * (128/64)
+
+
+def test_inception_v3_shape():
+    """Reference inceptionv3.py:488: stage widths 192->288->768->1280->2048."""
+    m = M.inception_v3(num_classes=5)
+    m.eval()
+    x = paddle.to_tensor(np.random.randn(1, 3, 299, 299).astype(np.float32))
+    assert tuple(m(x).shape) == (1, 5)

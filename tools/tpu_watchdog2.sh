@@ -52,6 +52,7 @@ print('probe ok', float(x))" >> "$LOG" 2>&1
 run_item() {  # $1 = item name; rc!=0 -> keep the item queued
   case "$1" in
     mfu:*)      timeout 1800 python tools/mfu_probe.py "${1#mfu:}" ;;
+    profile)    timeout 1800 python tools/profile_step.py baseline && test -f PROFILE_r05.json ;;
     opbench)    timeout 3600 python tools/opbench.py --out OPBENCH_r05.json ;;
     moebench)   timeout 2400 python tools/moebench.py --out MOEBENCH_r05.json ;;
     decodebench) timeout 2400 python tools/decodebench.py --preset large ;;
@@ -62,7 +63,10 @@ run_item() {  # $1 = item name; rc!=0 -> keep the item queued
 
 for i in $(seq 1 200); do
   if ! [ -s "$STATE" ]; then echo "=== all items done $(date -u +%FT%TZ)" >> "$LOG"; exit 0; fi
-  if pgrep -f "mfu_probe|opbench|moebench|tpu_smoke|decodebench|sparsebench" > /dev/null; then
+  # match actual tool invocations only — a shell whose COMMAND TEXT mentions
+  # a tool name (e.g. the operator editing this queue via heredoc) must not
+  # read as a chip holder
+  if pgrep -f "python tools/(mfu_probe|opbench|moebench|tpu_smoke|decodebench|sparsebench|profile_step)" > /dev/null; then
     echo "[$(date -u +%T)] chip busy (another tool), waiting" >> "$LOG"; sleep 600; continue
   fi
   probe; rc=$?

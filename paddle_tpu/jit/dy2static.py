@@ -98,6 +98,27 @@ def _to_val(x):
     return x._value if isinstance(x, Tensor) else x
 
 
+def _tree_val(x):
+    """Unwrap Tensor leaves inside an arbitrary container structure —
+    fixed-STRUCTURE containers (a [state, aux] pair, a dict of stats) are
+    legal loop carries; only GROWING containers are not (lax.while_loop
+    carries arbitrary pytrees, but the structure must be invariant)."""
+    return jax.tree_util.tree_map(_to_val, x,
+                                  is_leaf=lambda l: isinstance(l, Tensor))
+
+
+def _tree_tensor(x):
+    """Rewrap every array leaf of a carry slot as a Tensor, preserving the
+    container structure the user's code sees."""
+    return jax.tree_util.tree_map(Tensor, x)
+
+
+def _tree_asarray(x):
+    return jax.tree_util.tree_map(
+        lambda l: l if isinstance(l, (jax.Array, jax.core.Tracer))
+        else jnp.asarray(l), x)
+
+
 def convert_ifelse(pred, true_fn, false_fn, names: Tuple[str, ...]):
     """Runtime dispatch for a rewritten `if`. Returns the tuple of merged
     outputs for `names`."""
@@ -138,8 +159,13 @@ def convert_ifelse(pred, true_fn, false_fn, names: Tuple[str, ...]):
 
 
 def convert_while(cond_fn, body_fn, init: Tuple[Any, ...],
-                  names: Tuple[str, ...]):
-    """Runtime dispatch for a rewritten `while`."""
+                  names: Tuple[str, ...], mutated: Tuple[str, ...] = ()):
+    """Runtime dispatch for a rewritten `while`. `mutated` names received
+    in-place container mutations (`.append` etc.) in the body — legal on
+    the Python path, impossible to lower (XLA carries need static shapes),
+    so the tensor path rejects them with guidance instead of leaking
+    tracers (reference list_transformer.py converts these to dynamic
+    LoDTensorArray writes, a host-interpreter capability)."""
     first = cond_fn(*init)
     if not _is_dynamic(first):
         vs = tuple(init)
@@ -150,11 +176,31 @@ def convert_while(cond_fn, body_fn, init: Tuple[Any, ...],
                 # break/return flag set under a tensor `if` turned into a
                 # traced value): the iterations run so far are unrolled
                 # into the trace; the remainder lowers to while_loop
+                _check_mutated_containers(vs, names, mutated)
                 return _tensor_while(cond_fn, body_fn, vs, names)
             if not c:
                 return vs
             vs = tuple(body_fn(*vs))
+    _check_mutated_containers(init, names, mutated)
     return _tensor_while(cond_fn, body_fn, init, names)
+
+
+def _check_mutated_containers(init, names, mutated):
+    for name in mutated:
+        try:
+            v = init[names.index(name)]
+        except ValueError:
+            continue
+        if isinstance(v, (list, dict, set, bytearray)):
+            raise TypeError(
+                f"to_static: {name!r} is a Python {type(v).__name__} "
+                "mutated (e.g. .append) inside a tensor-dependent loop; "
+                "XLA loop carries need static shapes, so a growing "
+                "container cannot be lowered. Either keep the trip count "
+                "a Python value (the loop unrolls and list ops keep exact "
+                "semantics), or preallocate a Tensor of the maximum length "
+                "and write slots functionally (out = paddle.scatter(out, "
+                "i, v) / out[i] = v outside the loop).")
 
 
 def _tensor_while(cond_fn, body_fn, init, names):
@@ -170,7 +216,7 @@ def _tensor_while(cond_fn, body_fn, init, names):
             out = body_fn(*init)
             return tuple(
                 None if (o is RET_UNSET or isinstance(o, _Undefined))
-                else _to_val(o) for o in out)
+                else _tree_val(o) for o in out)
 
         try:
             probe = jax.eval_shape(_probe_thunk)
@@ -179,7 +225,7 @@ def _tensor_while(cond_fn, body_fn, init, names):
             # body evaluation in eager)
             probe = tuple(
                 None if (o is RET_UNSET or isinstance(o, _Undefined))
-                else _to_val(o) for o in body_fn(*init))
+                else _tree_val(o) for o in body_fn(*init))
         for i, v in enumerate(init):
             if v is not RET_UNSET:
                 continue
@@ -198,7 +244,7 @@ def _tensor_while(cond_fn, body_fn, init, names):
     def expand(vals):
         full: List[Any] = [None] * len(init)
         for j, i in enumerate(carried):
-            full[i] = Tensor(vals[j])
+            full[i] = _tree_tensor(vals[j])
         for i in temps:
             full[i] = init[i]  # the sentinel; assigned in body before use
         for i in passthrough:
@@ -211,15 +257,13 @@ def _tensor_while(cond_fn, body_fn, init, names):
 
     def b(*vals):
         out = body_fn(*expand(list(vals)))
-        return [_to_val(out[i]) for i in carried]
+        return [_tree_val(out[i]) for i in carried]
 
-    init_vals = [_to_val(init[i]) for i in carried]
-    init_vals = [v if isinstance(v, jax.Array) or isinstance(v, jax.core.Tracer)
-                 else jnp.asarray(v) for v in init_vals]
+    init_vals = [_tree_asarray(_tree_val(init[i])) for i in carried]
     final = wl(c, b, init_vals)
     out: List[Any] = [None] * len(init)
     for j, i in enumerate(carried):
-        out[i] = Tensor(final[j])
+        out[i] = _tree_tensor(final[j])
     for i in temps:
         out[i] = _Undefined(names[i])
     for i in passthrough:
@@ -383,6 +427,40 @@ def _has_inplace_store(stmts) -> bool:
 
         def visit_AnnAssign(self, n):
             self._check(n.target)
+            self.generic_visit(n)
+
+        def visit_FunctionDef(self, n):
+            pass  # inner scopes run only when called
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, n):
+            pass
+
+    for s in stmts:
+        V().visit(s)
+    return found
+
+
+_MUTATOR_METHODS = frozenset(
+    ("append", "extend", "insert", "pop", "remove", "clear", "add",
+     "discard", "update", "setdefault", "popitem"))
+
+
+def _mutated_container_names(stmts) -> set:
+    """Names that receive an in-place container-mutating method call
+    (`ys.append(v)`, `d.update(...)`) anywhere in `stmts`. These are
+    mutations the transformer cannot express as assignments; the lowered
+    while threads them through the carry so the runtime can either keep
+    Python semantics (python trip count) or reject with guidance."""
+    found: set = set()
+
+    class V(ast.NodeVisitor):
+        def visit_Call(self, n):
+            f = n.func
+            if (isinstance(f, ast.Attribute) and f.attr in _MUTATOR_METHODS
+                    and isinstance(f.value, ast.Name)):
+                found.add(f.value.id)
             self.generic_visit(n)
 
         def visit_FunctionDef(self, n):
@@ -692,6 +770,11 @@ class ControlFlowTransformer(ast.NodeTransformer):
             # in-place stores can't be pred-gated by the where-merge; leave
             # the `if` untransformed so a tensor predicate fails loudly
             return node
+        if _mutated_container_names(node.body) \
+                or _mutated_container_names(node.orelse):
+            # same hazard as stores: a `.append`/`.update` in a branch runs
+            # in BOTH branch thunks under a tensor predicate's where-merge
+            return node
         outs = sorted(n for n in (_assigned_names(node.body)
                                   | _assigned_names(node.orelse))
                       if not n.startswith("__d2s_"))
@@ -818,7 +901,10 @@ class ControlFlowTransformer(ast.NodeTransformer):
             # (or applies once at trace time); keep Python semantics so a
             # tensor condition fails loudly instead
             return node
-        outs = sorted(n for n in _assigned_names(node.body)
+        mutated = sorted(n for n in _mutated_container_names(node.body)
+                         if not n.startswith("__d2s_"))
+        outs = sorted(n for n in
+                      (_assigned_names(node.body) | set(mutated))
                       if not n.startswith("__d2s_"))
         if not outs:
             return node
@@ -851,7 +937,10 @@ class ControlFlowTransformer(ast.NodeTransformer):
                 args=[_name(cname), _name(bname), init,
                       ast.Tuple(elts=[ast.Constant(o) for o in outs],
                                 ctx=ast.Load())],
-                keywords=[]))
+                keywords=[] if not mutated else [ast.keyword(
+                    arg="mutated",
+                    value=ast.Tuple(elts=[ast.Constant(m) for m in mutated],
+                                    ctx=ast.Load()))]))
         return pre + [cond_def, body_def, call]
 
     # -- for i in range(...) ----------------------------------------------

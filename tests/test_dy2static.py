@@ -552,3 +552,94 @@ class TestLogicalOperators:
 
         # x=[1,2]: s.sum() grows 3/iter -> stops after 2 iters
         assert np.allclose(f(_t([1.0, 2.0])).numpy(), [2, 4])
+
+
+class TestContainerMutation:
+    """Reference list_transformer.py semantics, TPU contract: python trip
+    counts keep exact list semantics; tensor-dependent loops/branches that
+    grow a container are rejected with guidance (XLA carries are static)."""
+
+    def test_list_append_python_loop_exact(self):
+        @jit.to_static
+        def f(x, n):
+            ys = []
+            for i in range(n):     # python trip count: unrolls
+                ys.append(x * i)
+            return paddle.stack(ys)
+
+        out = f(_t([1.0, 2.0]), 3)
+        assert np.allclose(out.numpy(), [[0, 0], [1, 2], [2, 4]])
+
+    def test_list_append_tensor_while_raises_actionable(self):
+        @jit.to_static
+        def f(x, n):
+            ys = []
+            i = _t(0.0)
+            while i < n:           # tensor-dependent
+                ys.append(x * i)
+                i = i + 1
+            return ys
+
+        with pytest.raises(TypeError, match="append.*tensor-dependent|"
+                                            "tensor-dependent loop"):
+            f(_t([1.0]), _t(3.0))
+
+    def test_list_append_in_tensor_if_fails_loudly(self):
+        @jit.to_static
+        def f(x):
+            ys = [x]
+            if x.sum() > 0:        # tensor predicate + append: untransformed
+                ys.append(x * 2)
+            return len(ys)
+
+        with pytest.raises(Exception):  # tracer bool error, not silence
+            f(_t([1.0]))
+
+    def test_list_append_in_python_if_preserved(self):
+        @jit.to_static
+        def f(x, flag):
+            ys = [x]
+            if flag:               # python predicate: exact semantics
+                ys.append(x * 2)
+            return paddle.stack(ys)
+
+        assert f(_t([1.0]), True).shape[0] == 2
+        assert f(_t([1.0]), False).shape[0] == 1
+
+    def test_dict_update_tensor_while_raises(self):
+        @jit.to_static
+        def f(x, n):
+            d = {}
+            i = _t(0.0)
+            while i < n:
+                d.update(a=x)
+                i = i + 1
+            return d
+
+        with pytest.raises(TypeError, match="dict"):
+            f(_t([1.0]), _t(2.0))
+
+    def test_rebound_list_in_tensor_while_still_works(self):
+        # a REASSIGNED (not mutated) fixed-shape list stays lowerable --
+        # the pre-existing contract must not regress
+        @jit.to_static
+        def f(x, n):
+            pair = [x.sum() * 0, x.sum() * 0 + 1]
+            i = _t(0.0)
+            while i < n:
+                pair = [pair[1], pair[0]]   # swap, no growth
+                i = i + 1
+            return pair[0]
+
+        assert float(f(_t([1.0]), _t(3.0)).numpy()) == 1.0
+
+    def test_dict_state_reassigned_in_tensor_while(self):
+        # fixed-STRUCTURE dict rebuilt each iteration: a legal pytree carry
+        @jit.to_static
+        def f(x, n):
+            st = {"s": x * 0, "i": _t(0.0)}
+            while st["i"] < n:
+                st = {"s": st["s"] + x, "i": st["i"] + 1}
+            return st["s"]
+
+        assert np.allclose(f(_t([1.0, 2.0]), _t(3.0)).numpy(), [3, 6])

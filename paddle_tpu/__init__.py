@@ -108,6 +108,31 @@ from .framework.containers import (  # noqa: F401, E402
     create_array,
 )
 from .hapi.model import Model, summary  # noqa: F401, E402
+from .api_extra import *  # noqa: F401, F403, E402 (reference __all__ parity)
+
+# top-level inplace twins (paddle.tanh_(x) etc. — reference exposes the
+# method AND a function for each inplace op)
+import sys as _sys
+
+
+def _install_inplace_functions():
+    this = _sys.modules[__name__]
+    for _n in dir(Tensor):
+        if _n.endswith("_") and not _n.startswith("_") \
+                and not hasattr(this, _n):
+            def _mk(meth):
+                def fn(x, *a, **k):
+                    return getattr(x, meth)(*a, **k)
+
+                fn.__name__ = meth
+                fn.__doc__ = f"In-place variant: Tensor.{meth}."
+                return fn
+
+            setattr(this, _n, _mk(_n))
+
+
+_install_inplace_functions()
+del _sys
 
 version = "0.1.0"
 __version__ = version

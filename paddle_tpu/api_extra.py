@@ -1,0 +1,308 @@
+"""Top-level API parity fill-ins: the reference `paddle.__all__` names not
+covered by the YAML op registry or existing submodule re-exports.
+
+Reference: python/paddle/__init__.py __all__ (314 names). Most entries here
+are thin compositions over registered ops (so autograd/jit dispatch comes
+for free); a few are host utilities (iinfo/finfo/set_printoptions) or
+documented CUDA-compat aliases with TPU semantics.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .core.tensor import Tensor
+from .core import dtype as _dtype_mod
+from .core import to_tensor
+from . import ops
+from .ops import api as _api
+
+__all__ = [
+    "iinfo", "finfo", "dtype", "rank", "is_tensor", "is_complex",
+    "is_integer", "is_floating_point", "diagflat", "randint_like",
+    "floor_mod", "broadcast_shape", "tensordot", "polar", "scatter_nd",
+    "tolist", "clone", "set_printoptions", "check_shape", "batch",
+    "flops", "ParamAttr", "create_parameter", "LazyGuard", "DataParallel",
+    "get_cuda_rng_state", "set_cuda_rng_state", "CUDAPinnedPlace",
+    "disable_signal_handler",
+]
+
+
+# -- dtype introspection ----------------------------------------------------
+
+dtype = np.dtype  # paddle.dtype: the type of dtype objects (accepts 'float32')
+
+
+class _FinfoResult:
+    """paddle.finfo result (reference python/paddle/framework/dtype.py):
+    min/max/eps/tiny/smallest_normal/resolution/bits/dtype."""
+
+    def __init__(self, np_finfo):
+        self.min = float(np_finfo.min)
+        self.max = float(np_finfo.max)
+        self.eps = float(np_finfo.eps)
+        self.tiny = float(np_finfo.tiny)
+        self.smallest_normal = float(np_finfo.smallest_normal)
+        self.resolution = float(np_finfo.resolution)
+        self.bits = int(np_finfo.bits)
+        self.dtype = str(np.dtype(np_finfo.dtype))
+
+
+class _IinfoResult:
+    def __init__(self, np_iinfo):
+        self.min = int(np_iinfo.min)
+        self.max = int(np_iinfo.max)
+        self.bits = int(np_iinfo.bits)
+        self.dtype = str(np.dtype(np_iinfo.dtype))
+
+
+def finfo(dt):
+    try:
+        return _FinfoResult(np.finfo(np.dtype(dt)))
+    except ValueError:
+        # bfloat16/float8 live in ml_dtypes, which ships its own finfo
+        import ml_dtypes
+
+        return _FinfoResult(ml_dtypes.finfo(np.dtype(dt)))
+
+
+def iinfo(dt):
+    return _IinfoResult(np.iinfo(np.dtype(dt)))
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)
+
+
+def _np_dtype(x):
+    return np.dtype(str(x.dtype)) if isinstance(x, Tensor) else np.dtype(x)
+
+
+def is_complex(x) -> bool:
+    return np.issubdtype(_np_dtype(x), np.complexfloating)
+
+
+def is_integer(x) -> bool:
+    return np.issubdtype(_np_dtype(x), np.integer)
+
+
+def is_floating_point(x) -> bool:
+    return np.issubdtype(_np_dtype(x), np.floating)
+
+
+def rank(x) -> Tensor:
+    """0-D int32 tensor holding ndim (reference paddle.rank)."""
+    return to_tensor(np.asarray(len(x.shape), np.int32))
+
+
+# -- tensor ops composed from registered ops --------------------------------
+
+def diagflat(x, offset: int = 0):
+    flat = _api.flatten(x) if len(x.shape) > 1 else x
+    n = int(flat.shape[0])
+    size = n + abs(offset)
+    out = _api.zeros([size, size], dtype=str(flat.dtype))
+    rows = np.arange(n) + max(-offset, 0)
+    cols = np.arange(n) + max(offset, 0)
+    idx = to_tensor(np.stack([rows, cols], 1).astype(np.int64))
+    return _api.scatter_nd_add(out, idx, flat)
+
+
+def randint_like(x, low=0, high=None, dtype=None):
+    return _api.randint(low, high, shape=list(x.shape),
+                        dtype=dtype or str(x.dtype))
+
+
+def floor_mod(x, y):
+    return _api.remainder(x, y)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def tensordot(x, y, axes=2):
+    """Contraction over `axes` (int | [ax_x, ax_y] | ([..], [..])),
+    composed from transpose/reshape/matmul so autograd flows through the
+    registered ops (reference python/paddle/tensor/linalg.py tensordot)."""
+    nx, ny = len(x.shape), len(y.shape)
+    if isinstance(axes, int):
+        ax_x = list(range(nx - axes, nx))
+        ax_y = list(range(axes))
+    else:
+        ax_x, ax_y = axes
+        ax_x = [ax_x] if isinstance(ax_x, int) else list(ax_x)
+        ax_y = [ax_y] if isinstance(ax_y, int) else list(ax_y)
+        # reference semantics: a missing/shorter spec broadcasts the last
+        # given axes; normalize negatives
+        ax_x = [a % nx for a in ax_x]
+        ax_y = [a % ny for a in ax_y]
+    free_x = [a for a in range(nx) if a not in ax_x]
+    free_y = [a for a in range(ny) if a not in ax_y]
+    k = int(np.prod([x.shape[a] for a in ax_x])) if ax_x else 1
+    m = int(np.prod([x.shape[a] for a in free_x])) if free_x else 1
+    n = int(np.prod([y.shape[a] for a in free_y])) if free_y else 1
+    xt = _api.transpose(x, free_x + ax_x)
+    yt = _api.transpose(y, ax_y + free_y)
+    out = _api.matmul(_api.reshape(xt, [m, k]), _api.reshape(yt, [k, n]))
+    out_shape = [int(x.shape[a]) for a in free_x] + \
+        [int(y.shape[a]) for a in free_y]
+    return _api.reshape(out, out_shape or [1])[0] if not out_shape else \
+        _api.reshape(out, out_shape)
+
+
+def polar(abs, angle):  # noqa: A002  (reference keyword name)
+    """complex from magnitude+phase: abs*cos(angle) + i*abs*sin(angle)."""
+    real = _api.multiply(abs, _api.cos(angle))
+    imag = _api.multiply(abs, _api.sin(angle))
+    return _api.complex(real, imag)
+
+
+def scatter_nd(index, updates, shape):
+    zeros = _api.zeros(list(shape), dtype=str(updates.dtype))
+    return _api.scatter_nd_add(zeros, index, updates)
+
+
+def tolist(x):
+    return x.tolist()
+
+
+def clone(x):
+    return x.clone()
+
+
+def check_shape(x, expected):
+    """Assert-like shape check (reference static check utility)."""
+    got = tuple(int(s) for s in x.shape)
+    exp = tuple(expected)
+    ok = len(got) == len(exp) and all(
+        e in (-1, None) or g == e for g, e in zip(got, exp))
+    if not ok:
+        raise ValueError(f"check_shape: expected {exp}, got {got}")
+    return x
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Tensor repr prints via numpy; route the knobs there (reference
+    paddle.set_printoptions)."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Legacy reader decorator (reference python/paddle/reader/decorator.py
+    batch): group a sample generator into lists of batch_size."""
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Forward-pass FLOPs via XLA cost analysis on the traced network
+    (reference python/paddle/hapi/dynamic_flops.py counts per-layer hooks;
+    the compiler's own cost model is the TPU-native source of truth)."""
+    from .cost_model import CostModel
+
+    x = _api.zeros(list(input_size), dtype="float32")
+    was_training = getattr(net, "training", False)
+    if hasattr(net, "eval"):
+        net.eval()
+    try:
+        cm = CostModel()
+        stats = cm.static_cost(lambda t: net(t), x)
+        total = int(stats.get("flops", 0))
+    finally:
+        if was_training and hasattr(net, "train"):
+            net.train()
+    if print_detail:
+        print(f"Total FLOPs: {total}")
+    return total
+
+
+# -- framework utilities ----------------------------------------------------
+
+from .nn import ParamAttr  # noqa: E402  (re-export at top level)
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Standalone learnable parameter (reference
+    python/paddle/tensor/creation.py create_parameter)."""
+    import math
+
+    if default_initializer is not None:
+        data = default_initializer(shape, dtype)
+        val = data._value if isinstance(data, Tensor) else np.asarray(data)
+        t = Tensor(val)
+    elif is_bias:
+        t = Tensor(np.zeros(shape, np.dtype(dtype)))
+    else:
+        fan_in = shape[0] if shape else 1
+        bound = math.sqrt(6.0 / max(fan_in, 1))
+        t = Tensor(np.random.uniform(-bound, bound,
+                                     shape).astype(np.dtype(dtype)))
+    t.stop_gradient = False
+    if name:
+        t.name = name
+    return t
+
+
+class LazyGuard:
+    """Reference paddle.LazyGuard defers parameter materialization during
+    Layer construction. Parameters here are host-initialized numpy buffers
+    whose device upload already happens lazily at first compiled use, so
+    construction under the guard is cheap; the guard is the API-compat
+    scope marker."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+# -- device/compat aliases --------------------------------------------------
+
+from .distributed import DataParallel  # noqa: E402  (top-level alias)
+from .core.random import get_rng_state as _get_rng, set_rng_state as _set_rng
+from .core import CPUPlace as _CPUPlace
+
+
+def get_cuda_rng_state():
+    """CUDA-compat alias: the accelerator generator state (reference keeps
+    per-device CUDA generators; TPU has one process-level generator)."""
+    return _get_rng()
+
+
+def set_cuda_rng_state(state):
+    _set_rng(state)
+
+
+class CUDAPinnedPlace(_CPUPlace):
+    """Compat alias: pinned host memory is a CUDA transfer concept; on TPU
+    host staging buffers are managed by PJRT, so this is host placement."""
+
+
+def disable_signal_handler():
+    """Reference unhooks its native-crash signal handlers
+    (paddle/fluid/platform/init.cc DisableSignalHandler); this runtime
+    installs none, so there is nothing to unhook."""
+    return None

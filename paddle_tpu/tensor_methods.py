@@ -73,8 +73,17 @@ def install():
         "add", "subtract", "multiply", "divide", "scale", "clip", "exp",
         "sqrt", "rsqrt", "reciprocal", "floor", "ceil", "round", "abs",
         "tanh", "relu", "sigmoid", "neg", "cast",
+        # reference inplace YAML breadth (ops.yaml entries with an `_`
+        # twin): trig/exp families and shape/scatter rewrites
+        "cos", "sin", "tan", "acos", "asin", "atan", "cosh", "sinh",
+        "atanh", "asinh", "acosh", "expm1", "erf", "erfinv", "square",
+        "pow", "log", "log2", "log10", "log1p", "trunc", "frac",
+        "remainder", "floor_divide", "lerp", "reshape", "squeeze",
+        "unsqueeze", "flatten", "scatter", "index_add", "index_put",
+        "index_fill", "addmm", "put_along_axis", "clip_by_norm",
     ]:
-        setattr(Tensor, name + "_", _make_inplace(name))
+        if hasattr(api, name):
+            setattr(Tensor, name + "_", _make_inplace(name))
 
     def zero_(self):
         self._value = api.zeros_like(self)._value

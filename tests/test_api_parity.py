@@ -1,0 +1,147 @@
+"""Top-level API parity vs the reference `paddle.__all__` (314 names) and
+behavior checks for the fill-in implementations (api_extra.py).
+
+Reference: python/paddle/__init__.py __all__."""
+import ast
+import pathlib
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+_REF_INIT = pathlib.Path("/root/reference/python/paddle/__init__.py")
+
+
+@pytest.mark.skipif(not _REF_INIT.exists(), reason="reference not present")
+def test_top_level_all_parity():
+    tree = ast.parse(_REF_INIT.read_text())
+    ref_all = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__":
+                    ref_all = [ast.literal_eval(e) for e in node.value.elts]
+    assert ref_all, "reference __all__ not found"
+    missing = [n for n in ref_all if not hasattr(paddle, n)]
+    assert missing == [], f"missing top-level names: {missing}"
+
+
+def test_finfo_iinfo():
+    assert paddle.finfo("float32").bits == 32
+    assert paddle.finfo(paddle.bfloat16).max > 3e38
+    assert paddle.iinfo("int8").max == 127
+    assert paddle.iinfo(paddle.int32).min == -(2 ** 31)
+
+
+def test_type_predicates_and_rank():
+    x = paddle.to_tensor(np.zeros((2, 3), np.float32))
+    assert paddle.is_tensor(x) and not paddle.is_tensor(np.zeros(2))
+    assert paddle.is_floating_point(x) and not paddle.is_integer(x)
+    i = paddle.to_tensor(np.zeros(2, np.int64))
+    assert paddle.is_integer(i)
+    assert int(paddle.rank(x).numpy()) == 2
+
+
+def test_tensordot_matches_numpy():
+    rng = np.random.RandomState(0)
+    a = rng.randn(2, 3, 4).astype(np.float32)
+    b = rng.randn(4, 3, 5).astype(np.float32)
+    out = paddle.tensordot(paddle.to_tensor(a), paddle.to_tensor(b),
+                           axes=([2, 1], [0, 1]))
+    assert np.allclose(out.numpy(), np.tensordot(a, b, axes=([2, 1], [0, 1])),
+                       atol=1e-5)
+    # int form + gradient flows through registered ops
+    xa = paddle.to_tensor(a)
+    xa.stop_gradient = False
+    s = paddle.tensordot(xa, paddle.to_tensor(b), axes=1)
+    s.sum().backward()
+    assert xa.grad is not None and xa.grad.shape == list(a.shape)
+
+
+def test_diagflat_polar_scatter_nd():
+    d = paddle.diagflat(paddle.to_tensor(np.array([1., 2.], np.float32)), -1)
+    assert np.allclose(d.numpy(), np.diagflat([1., 2.], -1))
+    p = paddle.polar(paddle.to_tensor(np.array([2.0], np.float32)),
+                     paddle.to_tensor(np.array([np.pi / 2], np.float32)))
+    assert np.allclose(p.numpy(), [2j], atol=1e-6)
+    idx = paddle.to_tensor(np.array([[1], [3]], np.int64))
+    upd = paddle.to_tensor(np.array([9., 10.], np.float32))
+    s = paddle.scatter_nd(idx, upd, [5])
+    assert np.allclose(s.numpy(), [0, 9, 0, 10, 0])
+
+
+def test_inplace_function_twins():
+    z = paddle.to_tensor(np.array([0.0], np.float32))
+    out = paddle.cos_(z)
+    assert out is z and np.allclose(z.numpy(), [1.0])
+    w = paddle.to_tensor(np.zeros((2, 3), np.float32))
+    paddle.reshape_(w, [3, 2])
+    assert tuple(w.shape) == (3, 2)
+    u = paddle.to_tensor(np.array([1.0, 4.0], np.float32))
+    paddle.sqrt_(u)
+    assert np.allclose(u.numpy(), [1.0, 2.0])
+
+
+def test_broadcast_shape_and_floor_mod():
+    assert paddle.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
+    r = paddle.floor_mod(paddle.to_tensor(np.array([7], np.int32)),
+                         paddle.to_tensor(np.array([3], np.int32)))
+    assert int(r.numpy()) == 1
+
+
+def test_randint_like_and_clone_tolist():
+    x = paddle.to_tensor(np.zeros((3, 4), np.int64))
+    r = paddle.randint_like(x, 0, 10)
+    assert tuple(r.shape) == (3, 4)
+    assert (r.numpy() >= 0).all() and (r.numpy() < 10).all()
+    c = paddle.clone(x)
+    assert paddle.tolist(c) == x.numpy().tolist()
+
+
+def test_batch_decorator():
+    def reader():
+        yield from range(7)
+
+    batches = list(paddle.batch(reader, 3)())
+    assert batches == [[0, 1, 2], [3, 4, 5], [6]]
+    batches = list(paddle.batch(reader, 3, drop_last=True)())
+    assert batches == [[0, 1, 2], [3, 4, 5]]
+
+
+def test_create_parameter_and_param_attr():
+    p = paddle.create_parameter([4, 3], "float32")
+    assert not p.stop_gradient and tuple(p.shape) == (4, 3)
+    b = paddle.create_parameter([3], "float32", is_bias=True)
+    assert np.allclose(b.numpy(), 0)
+    assert paddle.ParamAttr is not None
+
+
+def test_flops_counts_matmul():
+    net = paddle.nn.Linear(64, 32)
+    n = paddle.flops(net, [8, 64])
+    # 2*M*N*K = 2*8*64*32 = 32768 (+ bias); XLA may fold, so just sanity
+    assert n >= 2 * 8 * 64 * 32
+
+
+def test_cuda_compat_aliases():
+    st = paddle.get_cuda_rng_state()
+    paddle.set_cuda_rng_state(st)
+    assert isinstance(paddle.CUDAPinnedPlace(), paddle.CPUPlace)
+    paddle.disable_signal_handler()
+
+
+def test_check_shape_and_printoptions():
+    x = paddle.to_tensor(np.zeros((2, 5), np.float32))
+    paddle.check_shape(x, (2, -1))
+    with pytest.raises(ValueError):
+        paddle.check_shape(x, (3, 5))
+    paddle.set_printoptions(precision=4)
+    np.testing.assert_equal(np.get_printoptions()["precision"], 4)
+    paddle.set_printoptions(precision=8)
+
+
+def test_lazy_guard_scope():
+    with paddle.LazyGuard():
+        m = paddle.nn.Linear(4, 4)
+    assert tuple(m.weight.shape) == (4, 4)

@@ -1,0 +1,183 @@
+"""Seq2seq decoding: BeamSearchDecoder + dynamic_decode.
+
+Reference: python/paddle/nn/decode.py — Decoder protocol
+(initialize/step/finalize), BeamSearchDecoder over any RNNCell-like
+callable, and the dynamic_decode driver. Dygraph semantics here: a host
+step loop (the reference's dygraph path is the same; its static path
+builds a while_op); each step's tensor math is compiled by XLA as usual,
+and the final backtrace is the registered gather_tree op
+(phi/kernels/gather_tree_kernel)."""
+from __future__ import annotations
+
+import collections
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops import api
+
+
+class Decoder:
+    """Protocol: initialize() -> (inputs, states, finished);
+    step(time, inputs, states) -> (outputs, states, inputs, finished);
+    finalize(outputs, states, seq_lengths) -> (outputs, states)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        return outputs, final_states
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam search over a cell: log-prob accumulation, per-step top-k over
+    (beam x vocab), parent-pointer bookkeeping, end-token freezing.
+
+    cell(inputs, states) must return (logits_or_hidden, next_states); pass
+    output_fn to map cell output to vocab logits and embedding_fn to map
+    token ids to the next step's inputs (reference BeamSearchDecoder
+    signature)."""
+
+    OutputWrapper = collections.namedtuple(
+        "OutputWrapper", ("scores", "predicted_ids", "parent_ids"))
+    StateWrapper = collections.namedtuple(
+        "StateWrapper", ("cell_states", "log_probs", "finished", "lengths"))
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- beam tensor helpers (reference tile_beam_merge_with_batch) --------
+    def _merge(self, x):
+        """[B, K, ...] -> [B*K, ...]"""
+        v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        return Tensor(v.reshape((-1,) + v.shape[2:]))
+
+    def _split(self, x):
+        """[B*K, ...] -> [B, K, ...]"""
+        v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        return Tensor(v.reshape((-1, self.beam_size) + v.shape[1:]))
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """Repeat a batch tensor for each beam: [B, ...] -> [B*K, ...]."""
+        v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        tiled = jnp.repeat(v[:, None], beam_size, axis=1)
+        return Tensor(tiled.reshape((-1,) + v.shape[1:]))
+
+    def initialize(self, initial_cell_states):
+        states = jnp.asarray(
+            initial_cell_states._value
+            if isinstance(initial_cell_states, Tensor)
+            else initial_cell_states)
+        batch = states.shape[0]
+        k = self.beam_size
+        cell_states = self.tile_beam_merge_with_batch(
+            Tensor(states), k)
+        # beam 0 live, others dead (-inf) so step 1 expands a single beam
+        log_probs = jnp.tile(
+            jnp.array([0.0] + [-1e9] * (k - 1), jnp.float32), (batch, 1))
+        finished = jnp.zeros((batch, k), bool)
+        lengths = jnp.zeros((batch, k), jnp.int64)
+        ids = Tensor(jnp.full((batch * k,), self.start_token, jnp.int64))
+        inputs = self.embedding_fn(ids) if self.embedding_fn else ids
+        return inputs, self.StateWrapper(cell_states, log_probs, finished,
+                                         lengths), Tensor(finished)
+
+    def step(self, time, inputs, states, **kwargs):
+        cell_out, next_cell_states = self.cell(inputs, states.cell_states,
+                                               **kwargs)
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)
+        logits = cell_out._value if isinstance(cell_out, Tensor) \
+            else jnp.asarray(cell_out)
+        k = self.beam_size
+        vocab = logits.shape[-1]
+        batch = logits.shape[0] // k
+        step_lp = jax.nn.log_softmax(logits, axis=-1).reshape(
+            (batch, k, vocab))
+        # finished beams only extend with end_token at zero cost
+        frozen = jnp.full((vocab,), -1e9).at[self.end_token].set(0.0)
+        step_lp = jnp.where(states.finished[..., None], frozen, step_lp)
+        total = states.log_probs[..., None] + step_lp
+        flat = total.reshape(batch, k * vocab)
+        top_lp, top_idx = jax.lax.top_k(flat, k)
+        parent = (top_idx // vocab).astype(jnp.int64)
+        token = (top_idx % vocab).astype(jnp.int64)
+
+        bi = jnp.arange(batch)[:, None]
+        finished = states.finished[bi, parent] | (token == self.end_token)
+        lengths = states.lengths[bi, parent] + (~finished).astype(jnp.int64)
+
+        # reorder cell states by parent beam
+        cells = next_cell_states._value if isinstance(next_cell_states,
+                                                      Tensor) \
+            else jnp.asarray(next_cell_states)
+        cells = cells.reshape((batch, k) + cells.shape[1:])
+        cells = cells[bi, parent].reshape((batch * k,) + cells.shape[2:])
+
+        out = self.OutputWrapper(Tensor(top_lp), Tensor(token),
+                                 Tensor(parent))
+        nstate = self.StateWrapper(Tensor(cells), top_lp, finished, lengths)
+        ids = Tensor(token.reshape(-1))
+        nxt = self.embedding_fn(ids) if self.embedding_fn else ids
+        return out, nstate, nxt, Tensor(finished)
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        """Backtrace parent pointers into contiguous sequences via the
+        gather_tree op: ids/parents stacked [T, B, K]."""
+        ids = api.stack([o.predicted_ids for o in outputs], 0)
+        parents = api.stack([o.parent_ids for o in outputs], 0)
+        final = api.gather_tree(ids, parents)
+        return final, final_states
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Run decoder.initialize, step until every sequence is finished or
+    max_step_num, then finalize (reference nn/decode.py dynamic_decode)."""
+    inputs, states, finished = decoder.initialize(inits)
+    outputs = []
+    step = 0
+    fin = np.asarray(finished._value if isinstance(finished, Tensor)
+                     else finished)
+    while not fin.all():
+        if max_step_num is not None and step >= max_step_num:
+            break
+        out, states, inputs, finished = decoder.step(step, inputs, states,
+                                                     **kwargs)
+        outputs.append(out)
+        fin = np.asarray(finished._value if isinstance(finished, Tensor)
+                         else finished)
+        step += 1
+    lengths = getattr(states, "lengths", None)
+    final_outputs, final_states = decoder.finalize(outputs, states, lengths)
+    if not output_time_major and isinstance(final_outputs, Tensor):
+        perm = [1, 2, 0] if final_outputs.ndim == 3 else None
+        if perm:
+            final_outputs = api.transpose(final_outputs, perm)
+    if return_length:
+        return final_outputs, final_states, Tensor(jnp.asarray(
+            lengths if lengths is not None else 0))
+    return final_outputs, final_states
+
+
+import jax  # noqa: E402  (top_k in step)

@@ -153,3 +153,104 @@ def sequence_mask(lengths, maxlen=None, dtype="int64"):
         maxlen = int(lengths.max().item())
     rng = _api.arange(0, maxlen, 1, dtype="int64")
     return _api.cast(_api.less_than(rng, _api.unsqueeze(lengths, -1)), dtype)
+
+
+# -- round-5 API parity (reference nn/functional/__init__.py __all__) -------
+
+from ..ops.api import (  # noqa: F401, E402
+    bilinear,
+    class_center_sample,
+    diag_embed,
+    gather_tree,
+    max_unpool3d,
+    temporal_shift,
+)
+from ..ops.api import margin_cross_entropy as _margin_ce_op  # noqa: E402
+from ..ops.api import rnnt_loss as _rnnt_op  # noqa: E402
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return _api.mean(loss)
+    if reduction == "sum":
+        return _api.sum(loss)
+    return loss
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    return _reduce(_rnnt_op(input, label, input_lengths, label_lengths,
+                            blank, fastemit_lambda), reduction)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    out = _margin_ce_op(logits, label, margin1, margin2, margin3, scale,
+                        return_softmax=return_softmax)
+    if return_softmax:
+        loss, sm = out
+        return _reduce(loss, reduction), sm
+    return _reduce(out, reduction)
+
+
+def relu_(x):
+    return x.relu_()
+
+
+def elu_(x, alpha=1.0):
+    out = _api.elu(x, alpha)
+    x._value = out._value
+    x._grad_node = out._grad_node
+    if not out.stop_gradient:
+        x.stop_gradient = False
+    return x
+
+
+def tanh_(x):
+    return x.tanh_()
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None):
+    """Block-sparse attention with a per-head CSR connectivity pattern
+    (reference phi/kernels/sparse/gpu/sparse_attention via cusparse; here
+    the CSR pattern gates a masked dense softmax — exact semantics, with
+    the density caveat documented: for long-sequence sparse patterns use
+    paddle_tpu.sparse attention or flash_attn_unpadded, which tile).
+
+    query/key/value: [B, H, T, D]; offset: [B, H, T+1]; columns: [B, H, nnz].
+    """
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor as _T
+
+    off = sparse_csr_offset._value if hasattr(sparse_csr_offset, "_value") \
+        else jnp.asarray(sparse_csr_offset)
+    cols = sparse_csr_columns._value if hasattr(sparse_csr_columns, "_value") \
+        else jnp.asarray(sparse_csr_columns)
+    b, h, t, d = query.shape
+    nnz = cols.shape[-1]
+    # CSR pattern -> boolean mask (integer-only; grads flow through q/k/v
+    # below via registered ops). Row of each slot: searchsorted on offsets.
+    slot = jnp.arange(nnz)
+    rows = jax.vmap(jax.vmap(
+        lambda o: jnp.searchsorted(o, slot, side="right") - 1))(off)
+    mask = jnp.zeros((b, h, t, t), bool)
+    bi = jnp.arange(b)[:, None, None]
+    hi = jnp.arange(h)[None, :, None]
+    valid = slot[None, None, :] < off[..., -1:]
+    mask = mask.at[bi, hi, jnp.clip(rows, 0, t - 1),
+                   jnp.clip(cols, 0, t - 1)].max(valid)
+    neg = _T(jnp.where(mask, 0.0, -1e30).astype(jnp.float32))
+    scores = _api.scale(
+        _api.matmul(query, key, transpose_y=True), 1.0 / (d ** 0.5))
+    scores = _api.add(scores, neg)
+    if attn_mask is not None:
+        scores = _api.add(scores, attn_mask)
+    p = softmax(scores, axis=-1)
+    return _api.matmul(p, value)
+
+
+import jax  # noqa: E402  (used by sparse_attention row recovery)

@@ -509,3 +509,113 @@ class Unfold(Layer):
 
     def forward(self, x):
         return F.unfold(x, *self.args)
+
+
+# -- round-5 API-parity layers (reference python/paddle/nn/layer/) ----------
+
+Softsign = _act_layer("Softsign", "softsign")
+RReLU = _act_layer("RReLU", "rrelu")
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel axis of NCHW input (reference
+    nn/layer/activation.py Softmax2D)."""
+
+    def forward(self, x):
+        if x.ndim not in (3, 4):
+            raise ValueError(f"Softmax2D expects 3D/4D input, got {x.ndim}D")
+        return F.softmax(x, axis=-3)
+
+
+class UpsamplingNearest2D(Layer):
+    """Reference nn/layer/common.py UpsamplingNearest2D."""
+
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW"):
+        super().__init__()
+        self.size, self.scale_factor, self.data_format = \
+            size, scale_factor, data_format
+
+    def forward(self, x):
+        return F.interpolate(x, self.size, self.scale_factor, "nearest",
+                             False, self.data_format)
+
+
+class UpsamplingBilinear2D(Layer):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW"):
+        super().__init__()
+        self.size, self.scale_factor, self.data_format = \
+            size, scale_factor, data_format
+
+    def forward(self, x):
+        return F.interpolate(x, self.size, self.scale_factor, "bilinear",
+                             True, self.data_format)
+
+
+class Pad1D(Layer):
+    """Reference nn/layer/common.py Pad1D over NCL input (an int padding
+    means the same pad on both ends, as in the reference)."""
+
+    def __init__(self, padding, mode="constant", value=0.0, data_format="NCL"):
+        super().__init__()
+        self.padding = [padding] * 2 if isinstance(padding, int) else padding
+        self.mode, self.value, self.data_format = mode, value, data_format
+
+    def forward(self, x):
+        return F.pad(x, self.padding, self.mode, self.value, self.data_format)
+
+
+class Pad3D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCDHW"):
+        super().__init__()
+        self.padding = [padding] * 6 if isinstance(padding, int) else padding
+        self.mode, self.value, self.data_format = mode, value, data_format
+
+    def forward(self, x):
+        return F.pad(x, self.padding, self.mode, self.value, self.data_format)
+
+
+class CosineSimilarity(Layer):
+    def __init__(self, axis=1, eps=1e-8):
+        super().__init__()
+        self.axis, self.eps = axis, eps
+
+    def forward(self, x1, x2):
+        return F.cosine_similarity(x1, x2, axis=self.axis, eps=self.eps)
+
+
+class Bilinear(Layer):
+    """out = x1 @ W[o] @ x2 + b (reference nn/layer/common.py Bilinear)."""
+
+    def __init__(self, in1_features, in2_features, out_features,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [out_features, in1_features, in2_features])
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_features], is_bias=True)
+
+    def forward(self, x1, x2):
+        return F.bilinear(x1, x2, self.weight, self.bias)
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW"):
+        super().__init__()
+        self.args = (kernel_size, stride, padding)
+
+    def forward(self, x, indices, output_size=None):
+        k, s, p = self.args
+        return F.max_unpool3d(x, indices, k, s, p, output_size)
+
+
+class Unflatten(Layer):
+    """Reference nn/layer/common.py Unflatten: expand one axis to `shape`."""
+
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis, self.shape = axis, shape
+
+    def forward(self, x):
+        return api.unflatten(x, self.axis, self.shape)

@@ -226,3 +226,19 @@ class HSigmoidLoss(Layer):
     def forward(self, input, label):
         return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
                                self.bias)
+
+
+class RNNTLoss(Layer):
+    """RNN-Transducer loss (reference nn/layer/loss.py RNNTLoss over the
+    warprnnt kernel; here the log-domain lattice DP is the registered
+    rnnt_loss op)."""
+
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank, self.reduction = blank, reduction
+        self.fastemit_lambda = fastemit_lambda
+
+    def forward(self, logits, labels, logit_lengths, label_lengths):
+        return F.rnnt_loss(logits, labels, logit_lengths, label_lengths,
+                           self.blank, self.fastemit_lambda, self.reduction)

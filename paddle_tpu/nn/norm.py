@@ -164,3 +164,47 @@ class LocalResponseNorm(Layer):
 
         denom = Tensor((self.k + self.alpha * window) ** self.beta)
         return x / denom
+
+
+class InstanceNorm1D(InstanceNorm2D):
+    """NCL input; the functional normalizes over all trailing spatial axes,
+    so only the expected-rank check differs (reference nn/layer/norm.py)."""
+
+
+class InstanceNorm3D(InstanceNorm2D):
+    """NCDHW input."""
+
+
+class SpectralNorm(Layer):
+    """Weight spectral normalization via persistent power iteration
+    (reference nn/layer/norm.py SpectralNorm; phi spectral_norm kernel).
+    Holds the u/v iteration vectors as buffers; forward(weight) returns
+    weight / sigma_max."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 name=None):
+        super().__init__()
+        import numpy as _np
+
+        self._dim, self._power_iters, self._eps = dim, power_iters, eps
+        h = weight_shape[dim]
+        w = int(_np.prod([s for i, s in enumerate(weight_shape)
+                          if i != dim]))
+        rng = _np.random.RandomState(0)
+
+        def _unit(n):
+            v = rng.normal(size=n).astype(_np.float32)
+            return v / max(float(_np.linalg.norm(v)), eps)
+
+        self.weight_u = self.create_parameter([h])
+        self.weight_u.set_value(_unit(h))
+        self.weight_u.stop_gradient = True
+        self.weight_v = self.create_parameter([w])
+        self.weight_v.set_value(_unit(w))
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight):
+        from ..ops import api as _api
+
+        return _api.spectral_norm(weight, self.weight_u, self.weight_v,
+                                  self._dim, self._power_iters, self._eps)

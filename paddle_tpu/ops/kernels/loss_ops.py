@@ -315,7 +315,13 @@ def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
     of a sequential U loop.
     """
     if fastemit_lambda:
-        raise NotImplementedError("rnnt_loss: fastemit_lambda not supported")
+        # FastEmit (Yu et al. 2021, as in warprnnt/torchaudio): the LOSS is
+        # the standard transducer loss; the GRADIENT's emit component is
+        # scaled by (1+lambda). Needs the analytic alpha-beta gradient, so
+        # it routes through the custom-vjp path.
+        return _rnnt_loss_fastemit(logits, labels, logit_lengths,
+                                   label_lengths, blank,
+                                   float(fastemit_lambda))
     lp = jax.nn.log_softmax(logits, axis=-1)
     B, T, U1, V = lp.shape
     U = U1 - 1
@@ -363,6 +369,125 @@ def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
         jnp.take_along_axis(blank_lp, (tl - 1)[:, None, None], axis=1)[:, 0, :],
         ul[:, None], axis=1)[:, 0]
     return -(a_final + final_blank)
+
+
+def _rnnt_alpha_beta(logits, labels, logit_lengths, label_lengths, blank):
+    """Full lattice quantities for the analytic transducer gradient:
+    returns (loss [B], alphas, betas, blank_lp, emit_lp, logP).
+
+    beta(t,u) = log prob of completing the alignment from node (t,u);
+    terminal: beta contribution 0 past the final blank at (tl-1, ul).
+    Same associative-scan u-solver as the alpha pass, run in reverse."""
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    B, T, U1, V = lp.shape
+    U = U1 - 1
+    blank_lp = lp[..., blank]
+    lab = labels.astype(jnp.int32)
+    emit_lp = jnp.take_along_axis(
+        lp[:, :, :U, :], lab[:, None, :, None], axis=-1)[..., 0]
+    NEG = -1e30
+    tl = logit_lengths.astype(jnp.int32)
+    ul = label_lengths.astype(jnp.int32)
+    uu = jnp.arange(U1)
+    # emission beyond the label length is illegal
+    emit_pad = jnp.concatenate([emit_lp, jnp.full((B, T, 1), NEG)], -1)
+    emit_pad = jnp.where(uu[None, None, :] < ul[:, None, None],
+                         emit_pad, NEG)                        # [B, T, U+1]
+
+    def solve_row(base, c):
+        cs = jnp.concatenate([jnp.full(c.shape[:-1] + (1,), NEG), c[..., :-1]],
+                             axis=-1)
+
+        def comb(l, r):
+            cl, bl = l
+            cr, br = r
+            return cl + cr, jnp.logaddexp(br, cr + bl)
+
+        _, y = jax.lax.associative_scan(comb, (cs, base), axis=-1)
+        return y
+
+    def astep(alpha_prev, t):
+        init0 = jnp.concatenate(
+            [jnp.zeros((B, 1)), jnp.full((B, U), NEG)], -1)
+        base = jnp.where(t == 0, init0,
+                         alpha_prev + blank_lp[:, jnp.maximum(t - 1, 0), :])
+        alpha = solve_row(base, emit_pad[:, t, :])
+        return alpha, alpha
+
+    _, alphas = jax.lax.scan(astep, jnp.full((B, U1), NEG), jnp.arange(T))
+    alphas = jnp.moveaxis(alphas, 0, 1)                        # [B, T, U+1]
+
+    def bstep(beta_next, t):
+        # T-direction continuation: masked outside t+1 < tl; the final
+        # blank at (tl-1, ul) terminates with continuation 0
+        cont = jnp.where((t + 1 < tl)[:, None], beta_next, NEG)
+        cont = jnp.where(((t == tl - 1)[:, None])
+                         & (uu[None, :] == ul[:, None]), 0.0, cont)
+        base = blank_lp[:, t, :] + cont
+        # u-direction runs high->low: solve on the reversed axis. The
+        # solver couples y[i] to y[i-1] with coefficient c[i-1]; for
+        # beta(u) = logaddexp(base, beta(u+1) + emit(t, u)) the coefficient
+        # is TARGET-indexed, so shift the reversed emission row left.
+        er = emit_pad[:, t, ::-1]
+        c = jnp.concatenate([er[:, 1:], jnp.full((B, 1), NEG)], -1)
+        beta = solve_row(base[..., ::-1], c)[..., ::-1]
+        return beta, beta
+
+    _, betas = jax.lax.scan(bstep, jnp.full((B, U1), NEG),
+                            jnp.arange(T - 1, -1, -1))
+    betas = jnp.moveaxis(betas, 0, 1)[:, ::-1, :]              # [B, T, U+1]
+    logP = betas[:, 0, 0]
+    return -logP, alphas, betas, blank_lp, emit_pad, lp, logP
+
+
+def _rnnt_loss_fastemit(logits, labels, logit_lengths, label_lengths,
+                        blank, lam):
+    @jax.custom_vjp
+    def core(z):
+        return _rnnt_alpha_beta(z, labels, logit_lengths, label_lengths,
+                                blank)[0]
+
+    def fwd(z):
+        loss, alphas, betas, blank_lp, emit_pad, lp, logP = _rnnt_alpha_beta(
+            z, labels, logit_lengths, label_lengths, blank)
+        return loss, (z, alphas, betas, blank_lp, emit_pad, lp, logP)
+
+    def bwd(res, g):
+        z, alphas, betas, blank_lp, emit_pad, lp, logP = res
+        B, T, U1, V = lp.shape
+        tl = logit_lengths.astype(jnp.int32)
+        ul = label_lengths.astype(jnp.int32)
+        uu = jnp.arange(U1)
+        NEG = -1e30
+        # blank continuation mirrors the beta T-step (0 at the terminal)
+        cont = jnp.where((jnp.arange(T)[None, :, None] + 1 < tl[:, None, None]),
+                         jnp.concatenate([betas[:, 1:, :],
+                                          jnp.full((B, 1, U1), NEG)], 1),
+                         NEG)
+        cont = jnp.where((jnp.arange(T)[None, :, None] == (tl - 1)[:, None, None])
+                         & (uu[None, None, :] == ul[:, None, None]), 0.0, cont)
+        gamma_blank = jnp.exp(alphas + blank_lp + cont - logP[:, None, None])
+        beta_up = jnp.concatenate([betas[:, :, 1:],
+                                   jnp.full((B, T, 1), NEG)], -1)
+        gamma_emit = (1.0 + lam) * jnp.exp(
+            alphas + emit_pad + beta_up - logP[:, None, None])
+        occupancy = gamma_blank + gamma_emit                   # [B, T, U+1]
+        grad_lp = jnp.zeros_like(lp)
+        grad_lp = grad_lp.at[..., blank].add(-gamma_blank)
+        lab = labels.astype(jnp.int32)
+        lab_pad = jnp.concatenate(
+            [lab, jnp.zeros((B, 1), jnp.int32)], -1)           # [B, U+1]
+        bi = jnp.arange(B)[:, None, None]
+        ti = jnp.arange(T)[None, :, None]
+        grad_lp = grad_lp.at[
+            bi, ti, uu[None, None, :],
+            jnp.broadcast_to(lab_pad[:, None, :], (B, T, U1))].add(-gamma_emit)
+        # d loss / d z through log_softmax: dz = dlp - softmax * sum(dlp)
+        dz = grad_lp - jnp.exp(lp) * jnp.sum(grad_lp, -1, keepdims=True)
+        return (dz * g[:, None, None, None],)
+
+    core.defvjp(fwd, bwd)
+    return core(logits)
 
 
 def class_center_sample(label, num_classes, num_samples, seed=None):

@@ -458,12 +458,6 @@ def adaptive_max_pool2d(x, output_size, data_format="NCHW"):
 def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False, data_format="NCHW"):
     """Reference F.interpolate: 3-D (linear, NCW), 4-D (bilinear/bicubic,
     NCHW) and 5-D (trilinear, NCDHW) resampling, channel-first or -last."""
-    if align_corners and mode != "nearest":
-        # jax.image.resize samples on the half-pixel grid only; silently
-        # returning the wrong grid would fail reference parity invisibly
-        raise NotImplementedError(
-            "align_corners=True is not supported (XLA resize uses "
-            "half-pixel sampling); use align_corners=False")
     nsp = x.ndim - 2
     channel_last = data_format in ("NWC", "NHWC", "NDHWC")
     if channel_last:
@@ -481,6 +475,36 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=F
     method = {"nearest": "nearest", "linear": "linear", "bilinear": "bilinear",
               "trilinear": "trilinear", "bicubic": "bicubic",
               "cubic": "bicubic", "area": "linear"}[mode]
+    if align_corners and mode != "nearest":
+        # jax.image.resize only samples the half-pixel grid, so build the
+        # corner-aligned grid explicitly: out coord i maps to
+        # i*(in-1)/(out-1), then separable linear interpolation via one
+        # gather+lerp per spatial axis (reference bilinear_interp_kernel
+        # align_corners branch).
+        if mode in ("bicubic", "cubic"):
+            raise NotImplementedError(
+                "align_corners=True bicubic is not supported; use "
+                "bilinear or align_corners=False")
+        out = x
+        for ax_i, new_len in enumerate(size):
+            axis = (1 + ax_i) if channel_last else (2 + ax_i)
+            old_len = out.shape[axis]
+            if new_len == old_len:
+                continue
+            if new_len == 1 or old_len == 1:
+                coords = jnp.zeros((new_len,), x.dtype)
+            else:
+                coords = jnp.arange(new_len, dtype=jnp.float32) \
+                    * ((old_len - 1) / (new_len - 1))
+            lo = jnp.clip(jnp.floor(coords).astype(jnp.int32), 0, old_len - 1)
+            hi = jnp.clip(lo + 1, 0, old_len - 1)
+            w_hi = (coords - lo.astype(coords.dtype)).astype(x.dtype)
+            shape = [1] * out.ndim
+            shape[axis] = new_len
+            w_hi = w_hi.reshape(shape)
+            out = jnp.take(out, lo, axis=axis) * (1 - w_hi) \
+                + jnp.take(out, hi, axis=axis) * w_hi
+        return out
     target = (n, *size, c) if channel_last else (n, c, *size)
     return jax.image.resize(x, target, method=method)
 

@@ -276,6 +276,66 @@ class RMSProp(Optimizer):
         return (p.astype(jnp.float32) - v).astype(p.dtype), new_state
 
 
+class Adamax(Optimizer):
+    """Adam with an infinity-norm second moment (reference
+    python/paddle/optimizer/adamax.py: inf_norm = max(beta2*inf_norm, |g|),
+    step = lr/(1-beta1^t) * m / (inf_norm + eps))."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _init_state(self, p_value):
+        return {
+            "moment": jnp.zeros_like(p_value, dtype=jnp.float32),
+            "inf_norm": jnp.zeros_like(p_value, dtype=jnp.float32),
+            "beta1_pow": jnp.ones((), jnp.float32),
+        }
+
+    def _update(self, p, g, state, lr):
+        g32 = g.astype(jnp.float32)
+        b1p = state["beta1_pow"] * self._beta1
+        m = self._beta1 * state["moment"] + (1 - self._beta1) * g32
+        u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g32))
+        new_p = p.astype(jnp.float32) - (lr / (1 - b1p)) * m / (u + self._eps)
+        return new_p.astype(p.dtype), {
+            "moment": m, "inf_norm": u, "beta1_pow": b1p}
+
+
+class Adadelta(Optimizer):
+    """Accumulated-delta scaling (reference python/paddle/optimizer/
+    adadelta.py: E[g^2] and E[dx^2] running averages, step =
+    sqrt((E[dx^2]+eps)/(E[g^2]+eps)) * g, scaled by learning_rate)."""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._rho, self._eps = rho, epsilon
+
+    def _init_state(self, p_value):
+        return {
+            "avg_squared_grad": jnp.zeros_like(p_value, dtype=jnp.float32),
+            "avg_squared_update": jnp.zeros_like(p_value, dtype=jnp.float32),
+        }
+
+    def _update(self, p, g, state, lr):
+        g32 = g.astype(jnp.float32)
+        sg = self._rho * state["avg_squared_grad"] \
+            + (1 - self._rho) * jnp.square(g32)
+        delta = jnp.sqrt((state["avg_squared_update"] + self._eps)
+                         / (sg + self._eps)) * g32
+        su = self._rho * state["avg_squared_update"] \
+            + (1 - self._rho) * jnp.square(delta)
+        new_p = p.astype(jnp.float32) - lr * delta
+        return new_p.astype(p.dtype), {
+            "avg_squared_grad": sg, "avg_squared_update": su}
+
+
 class Lamb(Optimizer):
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
                  beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,

@@ -248,21 +248,19 @@ def create_parameter(shape, dtype="float32", name=None, attr=None,
     python/paddle/tensor/creation.py create_parameter)."""
     import math
 
+    from .nn.layer import Parameter
+
     if default_initializer is not None:
         data = default_initializer(shape, dtype)
         val = data._value if isinstance(data, Tensor) else np.asarray(data)
-        t = Tensor(val)
     elif is_bias:
-        t = Tensor(np.zeros(shape, np.dtype(dtype)))
+        val = np.zeros(shape, np.dtype(dtype))
     else:
         fan_in = shape[0] if shape else 1
         bound = math.sqrt(6.0 / max(fan_in, 1))
-        t = Tensor(np.random.uniform(-bound, bound,
-                                     shape).astype(np.dtype(dtype)))
-    t.stop_gradient = False
-    if name:
-        t.name = name
-    return t
+        val = np.random.uniform(-bound, bound,
+                                shape).astype(np.dtype(dtype))
+    return Parameter(val, name=name)
 
 
 class LazyGuard:

@@ -94,3 +94,25 @@ from .ps import (  # noqa: F401,E402
     PSWorker,
     ShardedPSWorker,
 )
+from . import launch  # noqa: F401,E402  (reference exposes the module)
+from . import checkpoint as io  # noqa: F401,E402  (distributed.io: dist save/load utilities)
+from ..io.in_memory import QueueDataset  # noqa: F401,E402
+from .collective import alltoall, gather, split  # noqa: F401,E402
+from .objects import (  # noqa: F401,E402
+    ParallelMode,
+    all_gather_object,
+    broadcast_object_list,
+    destroy_process_group,
+    get_backend,
+    gloo_barrier,
+    gloo_init_parallel_env,
+    gloo_release,
+    is_available,
+    scatter_object_list,
+    wait,
+)
+from .ps import (  # noqa: F401,E402
+    CountFilterEntry,
+    ProbabilityEntry,
+    ShowClickEntry,
+)

@@ -260,6 +260,19 @@ def all_to_all(out_tensor_list, in_tensor_list, group: Optional[Group] = None, s
     return out_tensor_list
 
 
+alltoall = all_to_all  # reference exposes both spellings
+
+
+def gather(tensor: Tensor, gather_list: Optional[list] = None, dst=0,
+           group: Optional[Group] = None, sync_op=True):
+    """Reference communication/gather: dst receives the per-rank list. In
+    single-controller SPMD the gathered list is materialized on every rank
+    (an all-gather — XLA has no rooted gather on ICI); dst semantics are
+    preserved at the API level."""
+    return all_gather(gather_list if gather_list is not None else [],
+                      tensor, group, sync_op)
+
+
 def alltoall_single(tensor: Tensor, group: Optional[Group] = None, split_axis=0, concat_axis=0):
     """Single-tensor all-to-all (the EP/Ulysses building block)."""
     bound = _bound_axis(group)
@@ -417,3 +430,40 @@ def batch_isend_irecv(p2p_op_list):
             for k, i in enumerate(ids):
                 recvs[i].tensor._value = out[k]
     return [r.tensor for r in recvs]
+
+
+# -- megatron-style split helper (reference python/paddle/distributed/
+# collective.py split: partitions a linear/embedding computation across the
+# model-parallel group, creating the sharded weight on first use) -----------
+
+_split_layer_cache: dict = {}
+
+
+def split(x, size, operation="linear", axis=0, num_partitions=None,
+          gather_out=True, weight_attr=None, bias_attr=None, name=None):
+    """Distributed fc/embedding over the model-parallel axis. `size` is the
+    FULL (in, out) shape (or (vocab, embed) for embedding); the sharded
+    layer is created once per call-site `name` and cached, mirroring the
+    reference's parameter creation inside split()."""
+    from .fleet.mp_layers import (ColumnParallelLinear, RowParallelLinear,
+                                  VocabParallelEmbedding)
+
+    key = name or f"dist_split_{operation}_{axis}_{tuple(size)}"
+    layer = _split_layer_cache.get(key)
+    if layer is None:
+        if operation == "embedding":
+            layer = VocabParallelEmbedding(int(size[0]), int(size[1]))
+        elif operation == "linear" and axis == 0:
+            # weight rows (input dim) partitioned -> row-parallel
+            layer = RowParallelLinear(int(size[0]), int(size[1]),
+                                      input_is_parallel=False,
+                                      has_bias=bias_attr is not False)
+        elif operation == "linear" and axis == 1:
+            layer = ColumnParallelLinear(int(size[0]), int(size[1]),
+                                         gather_output=gather_out,
+                                         has_bias=bias_attr is not False)
+        else:
+            raise ValueError(
+                f"split: unsupported operation={operation!r} axis={axis}")
+        _split_layer_cache[key] = layer
+    return layer(x)

@@ -1,0 +1,175 @@
+"""Host-side distributed utilities: object collectives over the TCPStore,
+gloo-compat barriers, and process-group introspection.
+
+Reference: python/paddle/distributed/communication/ all_gather_object /
+broadcast_object_list / scatter_object_list serialize with pickle and ride
+the GLOO/NCCL byte collectives; the TPU-native transport for host objects
+is the native TCPStore (the same rendezvous the launcher and elastic use —
+object payloads are control-plane, not ICI traffic)."""
+from __future__ import annotations
+
+import enum
+import os
+import pickle
+import threading
+from typing import List, Optional
+
+from .env import get_rank, get_world_size
+
+
+class ParallelMode(enum.IntEnum):
+    """Reference python/paddle/distributed/parallel.py ParallelMode."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+_lock = threading.Lock()
+_store = None
+_round = 0
+
+
+def _get_store():
+    """Lazy world store: rank 0 hosts on PADDLE_OBJECT_STORE_PORT (or the
+    master port + 17); every rank connects. None for single-process runs."""
+    global _store
+    if get_world_size() <= 1:
+        return None
+    with _lock:
+        if _store is None:
+            from ..native import TCPStore
+
+            master = os.environ.get("PADDLE_MASTER") \
+                or os.environ.get("COORDINATOR_ADDRESS") or "127.0.0.1:0"
+            host, _, port_s = master.partition(":")
+            port = int(os.environ.get("PADDLE_OBJECT_STORE_PORT",
+                                      int(port_s or 0) + 17))
+            _store = TCPStore(host, port, is_master=get_rank() == 0,
+                              world_size=get_world_size(), timeout_s=120.0)
+        return _store
+
+
+def _next_round() -> int:
+    global _round
+    _round += 1
+    return _round
+
+
+def all_gather_object(object_list: List, obj, group=None):
+    """Gather picklable objects from every rank into object_list (in rank
+    order) on every rank."""
+    store = _get_store()
+    if store is None:
+        object_list.clear()
+        object_list.append(obj)
+        return
+    r = _next_round()
+    rank, world = get_rank(), get_world_size()
+    store.set(f"ogo/{r}/{rank}", pickle.dumps(obj))
+    object_list.clear()
+    for i in range(world):
+        object_list.append(pickle.loads(store.get(f"ogo/{r}/{i}")))
+
+
+def broadcast_object_list(object_list: List, src: int = 0, group=None):
+    """In-place broadcast of a list of picklable objects from src."""
+    store = _get_store()
+    if store is None:
+        return
+    r = _next_round()
+    if get_rank() == src:
+        store.set(f"obc/{r}", pickle.dumps(list(object_list)))
+    else:
+        object_list[:] = pickle.loads(store.get(f"obc/{r}"))
+
+
+def scatter_object_list(out_object_list: List, in_object_list=None,
+                        src: int = 0, group=None):
+    """Each rank receives in_object_list[rank] from src."""
+    store = _get_store()
+    if store is None:
+        out_object_list.clear()
+        out_object_list.append((in_object_list or [None])[0])
+        return
+    r = _next_round()
+    rank, world = get_rank(), get_world_size()
+    if rank == src:
+        objs = list(in_object_list or [])
+        if len(objs) != world:
+            raise ValueError(
+                f"scatter_object_list: need {world} objects, got {len(objs)}")
+        for i, o in enumerate(objs):
+            store.set(f"osc/{r}/{i}", pickle.dumps(o))
+    out_object_list.clear()
+    out_object_list.append(pickle.loads(store.get(f"osc/{r}/{rank}")))
+
+
+# -- gloo compat (reference python/paddle/distributed/parallel_with_gloo.py:
+# CPU-side barrier machinery; the TCPStore plays gloo's role here) ----------
+
+def gloo_init_parallel_env(rank_id: int, rank_num: int,
+                           server_endpoint: str):
+    global _store
+    if rank_num <= 1:
+        return
+    from ..native import TCPStore
+
+    host, _, port = server_endpoint.partition(":")
+    with _lock:
+        _store = TCPStore(host, int(port), is_master=rank_id == 0,
+                          world_size=rank_num, timeout_s=120.0)
+    os.environ.setdefault("PADDLE_TRAINER_ID", str(rank_id))
+    os.environ.setdefault("PADDLE_TRAINERS_NUM", str(rank_num))
+
+
+def gloo_barrier():
+    store = _get_store()
+    if store is not None:
+        store.barrier()
+
+
+def gloo_release():
+    global _store
+    with _lock:
+        _store = None
+
+
+# -- introspection ----------------------------------------------------------
+
+def is_available() -> bool:
+    """Distributed execution is available whenever jax is importable — the
+    mesh/collective layer needs no extra runtime (reference checks for a
+    compiled-with-distributed build)."""
+    return True
+
+
+def get_backend(group=None) -> str:
+    """Reference returns 'NCCL'/'GLOO'; the in-program transport here is
+    XLA collectives over ICI/DCN."""
+    return "XLA"
+
+
+def destroy_process_group(group=None):
+    """Tear down host-side group state (reference
+    communication/group.py destroy_process_group). In-program mesh axes
+    need no teardown; this clears the object store and group registry."""
+    from . import collective as C
+
+    gloo_release()
+    if group is None:
+        C._groups.clear()
+    else:
+        C._groups.pop(getattr(group, "id", None), None)
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """Block until the tensor's device computation is complete (reference
+    communication/wait: stream sync; PJRT equivalent is a ready-fetch)."""
+    v = tensor._value if hasattr(tensor, "_value") else tensor
+    try:
+        v.block_until_ready()
+    except AttributeError:
+        pass
+    return tensor

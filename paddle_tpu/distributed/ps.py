@@ -70,6 +70,33 @@ class _Accessor:
         return table
 
 
+class CountFilterEntry:
+    """Feature admission: a sparse id only starts training after it has
+    been pushed `count` times (reference fleet/entry_attr CountFilterEntry
+    — cold features never materialize in the table)."""
+
+    def __init__(self, count: int):
+        self.count = int(count)
+
+
+class ProbabilityEntry:
+    """Feature admission: each new id is admitted with probability p
+    (sticky once admitted) — reference fleet/entry_attr ProbabilityEntry."""
+
+    def __init__(self, probability: float):
+        self.probability = float(probability)
+
+
+class ShowClickEntry:
+    """Designates the show/click slots whose values feed the table's
+    CTR statistics (reference fleet/entry_attr ShowClickEntry; the
+    accessor reads them for score-based eviction)."""
+
+    def __init__(self, show_name: str, click_name: str):
+        self.show_name = show_name
+        self.click_name = click_name
+
+
 class ParameterServer:
     """Runs inside the server process; the rpc layer invokes its methods.
 
@@ -82,13 +109,18 @@ class ParameterServer:
     _tables: Dict[str, np.ndarray] = {}
     _accessors: Dict[str, _Accessor] = {}
     _locks: Dict[str, threading.Lock] = {}
+    _entries: Dict[str, object] = {}
+    _push_counts: Dict[str, np.ndarray] = {}
+    _admitted: Dict[str, np.ndarray] = {}
     _meta_lock = threading.Lock()
 
     @classmethod
     def create_table(cls, name: str, shape, lr: float = 0.1, init=None,
-                     optimizer: str = "sgd", decay: float = 0.0):
+                     optimizer: str = "sgd", decay: float = 0.0,
+                     entry=None):
         """Reference the_one_ps table config: each table carries its own
-        accessor (optimizer rule + state) and decay."""
+        accessor (optimizer rule + state), decay, and optionally a feature
+        admission entry."""
         if init is None:
             rng = np.random.default_rng(abs(hash(name)) % (1 << 31))
             init = (rng.standard_normal(shape) * 0.01).astype(np.float32)
@@ -97,7 +129,34 @@ class ParameterServer:
             cls._accessors[name] = _Accessor(
                 optimizer, lr, cls._tables[name].shape, decay)
             cls._locks.setdefault(name, threading.Lock())
+            if entry is not None:
+                cls._entries[name] = entry
+                n = cls._tables[name].shape[0]
+                cls._push_counts[name] = np.zeros(n, np.int64)
+                cls._admitted[name] = np.zeros(n, bool)
         return tuple(cls._tables[name].shape)
+
+    @classmethod
+    def _admit(cls, name: str, uniq: np.ndarray) -> np.ndarray:
+        """Apply the table's admission entry to unique pushed ids; returns
+        the boolean keep-mask. Must run under the table lock."""
+        entry = cls._entries.get(name)
+        if entry is None:
+            return np.ones(len(uniq), bool)
+        counts = cls._push_counts[name]
+        counts[uniq] += 1
+        admitted = cls._admitted[name]
+        if isinstance(entry, CountFilterEntry):
+            admitted[uniq] |= counts[uniq] >= entry.count
+        elif isinstance(entry, ProbabilityEntry):
+            fresh = ~admitted[uniq] & (counts[uniq] == 1)
+            rng = np.random.default_rng(
+                abs(hash((name, int(counts.sum())))) % (1 << 31))
+            admitted[uniq] |= fresh & (rng.random(len(uniq))
+                                       < entry.probability)
+        else:  # ShowClickEntry: statistics-only, no admission gating
+            admitted[uniq] = True
+        return admitted[uniq]
 
     @classmethod
     def _lock(cls, name: str) -> threading.Lock:
@@ -129,7 +188,12 @@ class ParameterServer:
         merged = np.zeros((len(uniq),) + grads.shape[1:], np.float32)
         np.add.at(merged, inv, grads)
         with cls._lock(name):
-            cls._accessors[name].apply_rows(cls._tables[name], uniq, merged)
+            keep = cls._admit(name, uniq)
+            if not keep.all():
+                uniq, merged = uniq[keep], merged[keep]
+            if len(uniq):
+                cls._accessors[name].apply_rows(cls._tables[name], uniq,
+                                                merged)
 
     @classmethod
     def set_rows(cls, name: str, ids, values) -> None:
@@ -233,6 +297,9 @@ class ParameterServer:
             cls._tables.clear()
             cls._accessors.clear()
             cls._locks.clear()
+            cls._entries.clear()
+            cls._push_counts.clear()
+            cls._admitted.clear()
 
 
 class PSWorker:

@@ -225,3 +225,40 @@ class InMemoryDataset:
 
     def __len__(self) -> int:
         return self._counts.shape[0] // self.batch_size
+
+
+class QueueDataset(InMemoryDataset):
+    """Streaming dataset (reference framework/data_set.cc DatasetImpl
+    QueueDataset mode): records flow file->parse->batch without the
+    in-memory arena, so there is no global_shuffle and memory stays O(one
+    file). The slot/batch surface matches InMemoryDataset."""
+
+    def load_into_memory(self):
+        raise RuntimeError(
+            "QueueDataset streams from files; use iterate() directly "
+            "(load_into_memory/global_shuffle are InMemoryDataset features)")
+
+    def global_shuffle(self, *a, **k):
+        raise RuntimeError("QueueDataset cannot global_shuffle (streaming); "
+                           "use InMemoryDataset")
+
+    def local_shuffle(self, *a, **k):
+        raise RuntimeError("QueueDataset cannot shuffle (streaming); "
+                           "use InMemoryDataset")
+
+    def __iter__(self):
+        """Yield batches file by file, parsing each file as it is reached."""
+        from .. import native
+
+        for path in self._files:
+            with open(path, "rb") as f:
+                data = f.read()
+            try:
+                vals, counts = native.parse_slot_lines(data, len(self.slots))
+            except RuntimeError:
+                vals, counts = self._parse_python(data)
+            sub = InMemoryDataset(self.name + "#chunk")
+            sub.init(batch_size=self.batch_size, slots=self.slots)
+            sub._vals, sub._counts = vals, counts
+            sub._order = np.arange(counts.shape[0])
+            yield from sub

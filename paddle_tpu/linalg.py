@@ -1,0 +1,58 @@
+"""paddle.linalg namespace (reference python/paddle/linalg.py: 25 re-exports
+from tensor.linalg). All but two ARE registered ops; `inv` is the registry's
+`inverse`, and pca_lowrank composes center + svd here."""
+from __future__ import annotations
+
+from .ops.api import (  # noqa: F401
+    cholesky,
+    cholesky_solve,
+    cond,
+    corrcoef,
+    cov,
+    det,
+    eig,
+    eigh,
+    eigvals,
+    eigvalsh,
+    lstsq,
+    lu,
+    lu_unpack,
+    matrix_power,
+    matrix_rank,
+    multi_dot,
+    norm,
+    pinv,
+    qr,
+    slogdet,
+    solve,
+    svd,
+    triangular_solve,
+)
+from .ops.api import inverse as inv  # noqa: F401
+
+__all__ = [
+    "cholesky", "norm", "cond", "cov", "corrcoef", "inv", "eig", "eigvals",
+    "multi_dot", "matrix_rank", "svd", "qr", "pca_lowrank", "lu",
+    "lu_unpack", "matrix_power", "det", "slogdet", "eigh", "eigvalsh",
+    "pinv", "solve", "cholesky_solve", "triangular_solve", "lstsq",
+]
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Principal components via truncated SVD (reference
+    python/paddle/tensor/linalg.py pca_lowrank uses the randomized
+    Halko-Martinsson-Tropp sketch for very wide matrices; at framework
+    scale the exact thin SVD of the centered matrix is the TPU-friendly
+    form — one jittable svd instead of niter QR passes)."""
+    from .ops import api as _api
+
+    m, n = int(x.shape[-2]), int(x.shape[-1])
+    if q is None:
+        q = min(6, m, n)
+    if center:
+        mean = _api.mean(x, axis=-2, keepdim=True)
+        x = _api.subtract(x, mean)
+    u, s, v = svd(x, full_matrices=False)
+    # svd returns V^H rows; pca_lowrank returns V columns
+    vt = _api.transpose(v, list(range(v.ndim - 2)) + [v.ndim - 1, v.ndim - 2])
+    return u[..., :, :q], s[..., :q], vt[..., :, :q]

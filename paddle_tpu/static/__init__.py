@@ -182,6 +182,7 @@ class Executor:
 
     def run(self, program=None, feed=None, fetch_list=None, return_numpy=True):
         program = program if program is not None else _default_main
+        program = getattr(program, "_program", program)  # CompiledProgram
         feed = feed or {}
         fetch_list = fetch_list or []
         if not program._ops:
@@ -280,10 +281,20 @@ class _StaticNN:
         from ..ops import api
 
         in_features = int(np.prod(x.shape[num_flatten_dims:]))
-        w = Parameter(I.XavierUniform()([in_features, size], "float32"))
-        b = Parameter(I.Constant(0.0)([size], "float32"))
         flat = api.flatten(x, start_axis=num_flatten_dims) \
             if len(x.shape) > num_flatten_dims + 1 else x
+        from .extras import WeightNormParamAttr
+
+        if isinstance(weight_attr, WeightNormParamAttr):
+            # w = g * v / ||v|| along `dim` (reference weight_norm_hook)
+            v = Parameter(I.XavierUniform()([in_features, size], "float32"))
+            dim = weight_attr.dim if weight_attr.dim is not None else 1
+            g = Parameter(api.norm(v, p=2, axis=1 - dim, keepdim=True))
+            w = api.multiply(g, api.divide(
+                v, api.norm(v, p=2, axis=1 - dim, keepdim=True)))
+        else:
+            w = Parameter(I.XavierUniform()([in_features, size], "float32"))
+        b = Parameter(I.Constant(0.0)([size], "float32"))
         out = api.matmul(flat, w) + b
         if activation:
             out = getattr(api, activation)(out)
@@ -456,3 +467,43 @@ _StaticNN.cond = staticmethod(cond)
 _StaticNN.while_loop = staticmethod(while_loop)
 _StaticNN.case = staticmethod(case)
 _StaticNN.switch_case = staticmethod(switch_case)
+
+
+from .extras import (  # noqa: F401, E402
+    Variable,
+    accuracy,
+    auc,
+    create_global_var,
+    create_parameter,
+    ctr_metric_bundle,
+    device_guard,
+    exponential_decay,
+    set_ipu_shard,
+    xpu_places,
+    BuildStrategy,
+    CompiledProgram,
+    ExecutionStrategy,
+    ExponentialMovingAverage,
+    IpuCompiledProgram,
+    IpuStrategy,
+    Print,
+    Scope,
+    WeightNormParamAttr,
+    append_backward,
+    cpu_places,
+    cuda_places,
+    deserialize_persistables,
+    deserialize_program,
+    global_scope,
+    gradients,
+    ipu_shard_guard,
+    load_from_file,
+    load_program_state,
+    normalize_program,
+    py_func,
+    save_to_file,
+    scope_guard,
+    serialize_persistables,
+    serialize_program,
+    set_program_state,
+)

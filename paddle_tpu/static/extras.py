@@ -1,0 +1,618 @@
+"""static API long tail (reference python/paddle/static/__init__.py __all__):
+backward recording, scopes, program serialization/state, strategy shells,
+EMA, py_func/Print, and place helpers.
+
+Design notes vs the reference:
+  - append_backward/gradients RECORD a grad pseudo-op on the tape whose
+    replay differentiates the prefix program with jax.grad — the XLA-native
+    form of the reference's symbolic grad-op insertion
+    (python/paddle/base/backward.py append_backward).
+  - py_func rides jax.pure_callback (host callback), the Print op rides
+    jax.debug.print — both stay jittable inside Executor.
+  - IPU entries exist and raise, exactly like a reference build compiled
+    without IPU support.
+"""
+from __future__ import annotations
+
+import pickle
+from types import SimpleNamespace
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from . import Program, _OpRecord, default_main_program
+
+
+# -- backward ---------------------------------------------------------------
+
+def _prefix_inputs(program: Program, n_ops: int):
+    """Every external Tensor input of the first n_ops records (placeholders,
+    params, constants) — the seed set a prefix replay needs."""
+    produced, inputs, seen = set(), [], set()
+    for rec in program._ops[:n_ops]:
+        for leaf in rec.leaves:
+            if isinstance(leaf, Tensor) and id(leaf) not in produced \
+                    and id(leaf) not in seen:
+                seen.add(id(leaf))
+                inputs.append(leaf)
+        for t in rec.out_tensors:
+            produced.add(id(t))
+    return inputs
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None,
+              name=None):
+    """d(sum(targets))/d(inputs) as new program variables (reference
+    static/gradients); fetchable through Executor.run."""
+    program = default_main_program()
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    n_ops = program.num_ops()
+    ext = _prefix_inputs(program, n_ops)
+    ext_ids = [id(t) for t in ext]
+    wrt_ids = [id(t) for t in inputs]
+    target_ids = [id(t) for t in targets]
+
+    def grad_fn(*vals):
+        base_env = dict(zip(ext_ids, vals[:len(ext_ids)]))
+        wrt_vals = list(vals[len(ext_ids):])
+
+        def loss_of(wv):
+            env = dict(base_env)
+            env.update(zip(wrt_ids, wv))
+            # prefix replay, inlined to avoid mutating the program
+            for rec in program._ops[:n_ops]:
+                rvals = [env.get(id(l), l._value) if isinstance(l, Tensor)
+                         else l for l in rec.leaves]
+                a, k = jax.tree_util.tree_unflatten(rec.treedef, rvals)
+                out = rec.opdef.fn(*a, **k)
+                outs = out if isinstance(out, (tuple, list)) else [out]
+                for t, v in zip(rec.out_tensors, outs):
+                    env[id(t)] = v
+            total = sum(jnp.sum(env[i]) for i in target_ids)
+            return total
+
+        return tuple(jax.grad(loss_of)(wrt_vals))
+
+    grad_outs = [Tensor(jnp.zeros_like(t._value)) for t in inputs]
+    leaves = ext + list(inputs)
+    _, treedef = jax.tree_util.tree_flatten(
+        ((None,) * len(leaves), {}), is_leaf=lambda x: x is None)
+    program._ops.append(_OpRecord(
+        SimpleNamespace(fn=grad_fn, name="grad"), leaves, treedef,
+        grad_outs))
+    return grad_outs
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Append grad computation for `loss` wrt every trainable parameter;
+    returns [(param, grad_var)] (reference base/backward.py)."""
+    program = default_main_program()
+    params = parameter_list or program._params()
+    grads = gradients([loss], list(params))
+    return list(zip(params, grads))
+
+
+# -- scopes -----------------------------------------------------------------
+
+class _VarWrapper:
+    def __init__(self, name, store):
+        self.name = name
+        self._store = store
+
+    def get_tensor(self):
+        return self._store[self.name]
+
+    def set(self, value, place=None):
+        self._store[self.name] = np.asarray(value)
+
+
+class Scope:
+    """Name -> value store (reference framework/scope.h Scope)."""
+
+    def __init__(self):
+        self._vars: Dict[str, object] = {}
+
+    def var(self, name):
+        self._vars.setdefault(name, None)
+        return _VarWrapper(name, self._vars)
+
+    def find_var(self, name):
+        return _VarWrapper(name, self._vars) if name in self._vars else None
+
+    def local_scope(self):
+        return Scope()
+
+
+_global_scope = Scope()
+_scope_stack: List[Scope] = []
+
+
+def global_scope() -> Scope:
+    return _scope_stack[-1] if _scope_stack else _global_scope
+
+
+class scope_guard:
+    def __init__(self, scope: Scope):
+        self.scope = scope
+
+    def __enter__(self):
+        _scope_stack.append(self.scope)
+        return self
+
+    def __exit__(self, *exc):
+        _scope_stack.pop()
+        return False
+
+
+# -- program serialization ---------------------------------------------------
+
+class _TRef:
+    """Picklable stand-in for a Tensor leaf inside a serialized op tree;
+    carries the tensor's index (shared across ops -> dataflow edges) and,
+    for external inputs, its captured value."""
+
+    def __init__(self, idx: int, value=None):
+        self.idx = idx
+        self.value = value
+
+
+def serialize_program(feed_vars=None, fetch_vars=None, program=None) -> bytes:
+    program = program or default_main_program()
+    ops = []
+    tensor_index: Dict[int, int] = {}
+
+    def tid(t):
+        return tensor_index.setdefault(id(t), len(tensor_index))
+
+    produced: set = set()
+    for rec in program._ops:
+        name = getattr(rec.opdef, "name", "?")
+        if name in ("grad", "py_func", "print"):
+            raise ValueError(
+                f"serialize_program: {name!r} pseudo-ops hold host state "
+                "and are not serializable; serialize the forward program")
+        a, k = jax.tree_util.tree_unflatten(
+            rec.treedef, list(range(len(rec.leaves))))
+
+        def enc(x):
+            if isinstance(x, int) and 0 <= x < len(rec.leaves):
+                leaf = rec.leaves[x]
+                if isinstance(leaf, Tensor):
+                    i = tid(leaf)
+                    val = None if i in produced else np.asarray(leaf._value)
+                    return _TRef(i, val)
+                return leaf
+            return x
+
+        tree = jax.tree_util.tree_map(enc, (a, k))
+        outs = []
+        for t in rec.out_tensors:
+            i = tid(t)
+            produced.add(i)
+            outs.append(i)
+        ops.append({"op": name, "tree": tree, "outs": outs})
+    feeds = {n: tensor_index.get(id(t)) for n, t in program._feeds.items()}
+    return pickle.dumps({"ops": ops, "feeds": feeds, "version": 1})
+
+
+def deserialize_program(data: bytes) -> Program:
+    from ..ops import registry
+
+    desc = pickle.loads(data)
+    prog = Program()
+    tensors: Dict[int, Tensor] = {}
+
+    def tref(marker: _TRef) -> Tensor:
+        if marker.idx not in tensors:
+            init = marker.value if marker.value is not None else 0.0
+            tensors[marker.idx] = Tensor(jnp.asarray(init))
+        return tensors[marker.idx]
+
+    for op in desc["ops"]:
+        opdef = registry.get_op(op["op"])
+        is_ref = lambda x: isinstance(x, _TRef)  # noqa: E731
+        decoded = jax.tree_util.tree_map(
+            lambda x: tref(x) if is_ref(x) else x, op["tree"],
+            is_leaf=is_ref)
+        leaves, treedef = jax.tree_util.tree_flatten(
+            decoded, is_leaf=lambda x: isinstance(x, Tensor))
+        outs = [tensors.setdefault(i, Tensor(jnp.zeros(())))
+                for i in op["outs"]]
+        prog._ops.append(_OpRecord(opdef, leaves, treedef, outs))
+    for n, i in desc["feeds"].items():
+        if i is not None and i in tensors:
+            prog._feeds[n] = tensors[i]
+            prog._feeds[n]._is_placeholder = True
+    return prog
+
+
+def serialize_persistables(feed_vars=None, fetch_vars=None,
+                           program=None) -> bytes:
+    program = program or default_main_program()
+    return pickle.dumps({i: np.asarray(p._value)
+                         for i, p in enumerate(program._params())})
+
+
+def deserialize_persistables(program: Program, data: bytes, executor=None):
+    state = pickle.loads(data)
+    for i, p in enumerate(program._params()):
+        if i in state:
+            p._value = jnp.asarray(state[i])
+
+
+def save_to_file(path: str, content: bytes):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def normalize_program(program: Program, feed_vars=None, fetch_vars=None):
+    """Inference-ready clone (reference prunes to the feed->fetch slice and
+    drops train attrs; replay already computes only recorded ops)."""
+    return program.clone(for_test=True)
+
+
+def load_program_state(model_path: str, var_list=None) -> Dict[str, np.ndarray]:
+    from ..framework.io import load as _load
+
+    state = _load(model_path)
+    return {k: np.asarray(v._value if isinstance(v, Tensor) else v)
+            for k, v in state.items()}
+
+
+def set_program_state(program: Program, state: Dict[str, np.ndarray]):
+    for i, p in enumerate(program._params()):
+        key = f"param_{i}"
+        if key in state:
+            p._value = jnp.asarray(state[key])
+
+
+# -- strategies / compiled program ------------------------------------------
+
+class BuildStrategy:
+    """Graph-build knobs (reference pybind BuildStrategy). XLA owns fusion
+    and scheduling on TPU, so these are recorded preferences; the fields
+    the executor honours are documented on use."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_elewise_add_act_ops = True
+        self.fuse_bn_act_ops = True
+        self.memory_optimize = True
+        self.reduce_strategy = 0
+        self.gradient_scale_strategy = 0
+        self.build_cinn_pass = False
+        self.sync_batch_norm = False
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+        self.use_experimental_executor = False
+
+
+class CompiledProgram:
+    """Program + strategies (reference CompiledProgram). Executor.run
+    unwraps it; with_data_parallel is the legacy multi-device spelling —
+    on TPU, device parallelism comes from the mesh, so it records the
+    request and returns self."""
+
+    def __init__(self, program, build_strategy: Optional[BuildStrategy] = None):
+        self._program = getattr(program, "_program", program)
+        self.build_strategy = build_strategy or BuildStrategy()
+        self.exec_strategy = ExecutionStrategy()
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, places=None):
+        if build_strategy is not None:
+            self.build_strategy = build_strategy
+        if exec_strategy is not None:
+            self.exec_strategy = exec_strategy
+        return self
+
+
+# -- EMA ---------------------------------------------------------------------
+
+class ExponentialMovingAverage:
+    """Shadow-parameter EMA with apply/restore swap (reference
+    static/ema.py ExponentialMovingAverage)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._shadow: Dict[int, object] = {}
+        self._backup: Dict[int, object] = {}
+        self._step = 0
+
+    def _params(self):
+        return default_main_program()._params()
+
+    def update(self):
+        self._step += 1
+        for p in self._params():
+            s = self._shadow.get(id(p))
+            v = jnp.asarray(p._value, jnp.float32)
+            if s is None:
+                s = v
+            s = self._decay * s + (1.0 - self._decay) * v
+            self._shadow[id(p)] = s
+
+    def apply(self, executor=None, need_restore=True):
+        ema = self
+
+        class _Ctx:
+            def __enter__(self):
+                for p in ema._params():
+                    if id(p) in ema._shadow:
+                        ema._backup[id(p)] = p._value
+                        # bias-corrected shadow, reference ema formula
+                        corr = 1.0 - ema._decay ** max(ema._step, 1)
+                        p._value = jnp.asarray(
+                            ema._shadow[id(p)] / corr, p._value.dtype)
+                return self
+
+            def __exit__(self, *exc):
+                if need_restore:
+                    ema.restore()
+                return False
+
+        return _Ctx()
+
+    def restore(self, executor=None):
+        for p in self._params():
+            if id(p) in self._backup:
+                p._value = self._backup.pop(id(p))
+
+
+# -- host-callback ops -------------------------------------------------------
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-python op inside a compiled program via jax.pure_callback
+    (reference py_func_op; backward_func becomes the custom VJP)."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    shapes = [jax.ShapeDtypeStruct(tuple(o.shape), o._value.dtype)
+              for o in outs]
+
+    def host(*vals):
+        res = func(*[np.asarray(v) for v in vals])
+        res = res if isinstance(res, (list, tuple)) else [res]
+        return tuple(np.asarray(r, s.dtype).reshape(s.shape)
+                     for r, s in zip(res, shapes))
+
+    @jax.custom_vjp
+    def call(*vals):
+        r = jax.pure_callback(host, tuple(shapes), *vals)
+        return r if len(r) > 1 else r[0]
+
+    def fwd(*vals):
+        return call(*vals), vals
+
+    def bwd(vals, g):
+        if backward_func is None:
+            return tuple(jnp.zeros_like(v) for v in vals)
+        gs = g if isinstance(g, (list, tuple)) else [g]
+        bshapes = [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in vals]
+
+        def bhost(*a):
+            n = len(vals)
+            res = backward_func(*[np.asarray(q) for q in a])
+            res = res if isinstance(res, (list, tuple)) else [res]
+            return tuple(np.asarray(r, s.dtype).reshape(s.shape)
+                         for r, s in zip(res, bshapes))
+
+        return jax.pure_callback(bhost, tuple(bshapes), *vals, *gs)
+
+    call.defvjp(fwd, bwd)
+    vals = [t._value if isinstance(t, Tensor) else t for t in xs]
+    result = call(*vals)
+    results = result if isinstance(result, (tuple, list)) else [result]
+    for o, v in zip(outs, results):
+        o._value = v
+    # record for Executor replay
+    prog = default_main_program()
+    leaves = list(xs)
+    _, treedef = jax.tree_util.tree_flatten(
+        (tuple(range(len(leaves))), {}))
+    prog._ops.append(_OpRecord(
+        SimpleNamespace(fn=lambda *v: call(*v), name="py_func"),
+        leaves, treedef, list(outs)))
+    return out
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Identity op that prints at execution (reference Print op ->
+    jax.debug.print, which fires from compiled code too)."""
+    msg = message or getattr(input, "name", "var")
+
+    def fn(v):
+        jax.debug.print(msg + " = {v}", v=v)
+        return v
+
+    out = Tensor(fn(input._value))
+    prog = default_main_program()
+    _, treedef = jax.tree_util.tree_flatten(((0,), {}))
+    prog._ops.append(_OpRecord(SimpleNamespace(fn=fn, name="print"),
+                               [input], treedef, [out]))
+    return out
+
+
+# -- places ------------------------------------------------------------------
+
+def cpu_places(device_count=None):
+    from ..core.place import CPUPlace
+
+    n = device_count or 1
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """Accelerator places (CUDA-compat name): the TPU devices visible to
+    this process."""
+    from ..core.place import TPUPlace
+
+    ids = device_ids if device_ids is not None \
+        else range(len(jax.devices()))
+    return [TPUPlace(i) for i in ids]
+
+
+# -- param attrs -------------------------------------------------------------
+
+from ..nn import ParamAttr as _ParamAttr  # noqa: E402
+
+
+class WeightNormParamAttr(_ParamAttr):
+    """Weight-normalized parameter config (reference
+    static/nn/common.py WeightNormParamAttr): layers that honour it
+    (static.nn.fc) reparameterize w = g * v / ||v|| along `dim`."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        super().__init__(name=name, initializer=initializer,
+                         learning_rate=learning_rate, regularizer=regularizer,
+                         trainable=trainable, need_clip=need_clip)
+        self.dim = dim
+
+
+# -- IPU (absent hardware, faithful reference behavior: a build without IPU
+# support raises on use) -----------------------------------------------------
+
+def _no_ipu(*a, **k):
+    raise RuntimeError(
+        "IPU is not a target of this TPU-native build (reference behavior "
+        "when Paddle is compiled without IPU support); see README descopes")
+
+
+class IpuStrategy:
+    def __init__(self, *a, **k):
+        _no_ipu()
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        _no_ipu()
+
+
+def ipu_shard_guard(*a, **k):
+    _no_ipu()
+
+
+# -- remaining static long tail ---------------------------------------------
+
+Variable = Tensor  # reference static.Variable is the graph tensor handle
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    t = Tensor(jnp.full(tuple(shape), value,
+                        dtype=np.dtype(dtype)), name=name)
+    t.stop_gradient = True
+    return t
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    from ..api_extra import create_parameter as _cp
+
+    return _cp(shape, dtype, name, attr, is_bias, default_initializer)
+
+
+def xpu_places(device_ids=None):
+    """Accelerator places (XPU-compat name)."""
+    return cuda_places(device_ids)
+
+
+class device_guard:
+    """Pin ops created in this scope to a device (reference
+    static/device_guard). 'cpu' maps to the host platform; anything else
+    stays on the accelerator (XLA owns op placement within a device)."""
+
+    def __init__(self, device=None):
+        self.device = device
+        self._ctx = None
+
+    def __enter__(self):
+        if self.device == "cpu":
+            self._ctx = jax.default_device(jax.devices("cpu")[0])
+            self._ctx.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        if self._ctx is not None:
+            self._ctx.__exit__(*exc)
+        return False
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """Batch top-k accuracy (reference static/nn/metric.py accuracy)."""
+    from ..ops import api
+
+    topk = api.topk(input, k=k, axis=-1)[1]
+    lab = api.reshape(label, [-1, 1])
+    hit = api.cast(api.equal(topk, lab), "float32")
+    return api.mean(api.sum(hit, axis=-1))
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, ins_tag_weight=None):
+    """Batch AUC + stat states (reference static/nn/metric.py auc returns
+    (auc_out, batch_auc_out, [batch_states], [states])); computed with the
+    metric module's threshold-bucket formulation."""
+    from ..metric import Auc as _Auc
+
+    m = _Auc(num_thresholds=num_thresholds)
+    pred = np.asarray(input._value if isinstance(input, Tensor) else input)
+    lab = np.asarray(label._value if isinstance(label, Tensor) else label)
+    if pred.ndim == 2 and pred.shape[1] == 2:
+        pass  # already [neg, pos] probabilities
+    else:
+        p = pred.reshape(-1, 1)
+        pred = np.concatenate([1 - p, p], axis=1)
+    m.update(pred, lab.reshape(-1, 1))
+    val = Tensor(jnp.asarray(m.accumulate(), jnp.float32))
+    states = [Tensor(jnp.asarray(s)) for s in (m._stat_pos, m._stat_neg)]
+    return val, val, states, states
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """CTR metric set (reference static/nn/metric.py ctr_metric_bundle:
+    auc + squared error + prediction/label means)."""
+    from ..ops import api
+
+    pred = input if isinstance(input, Tensor) else Tensor(jnp.asarray(input))
+    lab = api.cast(label, "float32")
+    sqrerr = api.mean(api.square(api.subtract(pred, lab)))
+    abserr = api.mean(api.abs(api.subtract(pred, lab)))
+    prob = api.mean(pred)
+    q = api.mean(lab)
+    auc_out, *_ = auc(pred, label)
+    return auc_out, sqrerr, abserr, prob, q
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """Legacy lr-decay builder -> the scheduler object (reference moved
+    this to optimizer.lr; static kept the name)."""
+    from ..optimizer.lr import ExponentialDecay
+
+    sched = ExponentialDecay(learning_rate=learning_rate, gamma=decay_rate)
+    sched._decay_steps = decay_steps
+    sched._staircase = staircase
+    return sched
+
+
+def set_ipu_shard(*a, **k):
+    _no_ipu()

@@ -92,7 +92,11 @@ from . import profiler  # noqa: F401, E402
 from . import geometric  # noqa: F401, E402
 from . import quantization  # noqa: F401, E402
 from . import fft  # noqa: F401, E402
+from . import callbacks  # noqa: F401, E402
+from . import hub  # noqa: F401, E402
 from . import linalg  # noqa: F401, E402
+from . import regularizer  # noqa: F401, E402
+from . import sysconfig  # noqa: F401, E402
 from . import signal  # noqa: F401, E402
 from . import audio  # noqa: F401, E402
 from . import text  # noqa: F401, E402

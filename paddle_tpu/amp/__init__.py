@@ -211,3 +211,20 @@ class GradScaler:
         self._scale = jnp.asarray(float(state["scale"]), jnp.float32)
         self._good_steps = jnp.asarray(state.get("good_steps", 0), jnp.int32)
         self._bad_steps = jnp.asarray(state.get("bad_steps", 0), jnp.int32)
+
+
+def is_float16_supported(device=None):
+    """fp16 compute support (reference amp/__init__.py): TPU MXUs compute
+    in bf16; fp16 storage works but matmul lowering upcasts, so the
+    reference's 'supported' contract (native fast path) is False on TPU
+    and True only for GPU places."""
+    import jax
+
+    return jax.default_backend() == "gpu"
+
+
+def is_bfloat16_supported(device=None):
+    """bf16 is the TPU-native compute dtype."""
+    import jax
+
+    return jax.default_backend() in ("tpu", "cpu", "gpu")

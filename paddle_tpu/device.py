@@ -138,3 +138,152 @@ def live_buffer_report(device=None, top_k: int = 10) -> List[Dict]:
             continue
     rows.sort(key=lambda r: -r["nbytes"])
     return rows[:top_k]
+
+
+# -- round-5 compat surface (reference python/paddle/device/__init__.py) ----
+
+from .core.place import CPUPlace as _CPUPlace  # noqa: E402
+from .core.place import TPUPlace as _TPUPlace  # noqa: E402
+
+
+class XPUPlace(_TPUPlace):
+    """Kunlun-compat alias: the accelerator place."""
+
+
+class IPUPlace(_CPUPlace):
+    """Graphcore-compat alias; IPU is not a target here (README descopes)."""
+
+
+def set_device(device):
+    from .core import set_device as _sd
+
+    return _sd(device)
+
+
+def get_cudnn_version():
+    """None: no cuDNN in a TPU/XLA stack (reference returns None when not
+    compiled with CUDA)."""
+    return None
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_ipu() -> bool:
+    return False
+
+
+def is_compiled_with_cinn() -> bool:
+    """The XLA compiler plays CINN's role; the CINN flag itself is False."""
+    return False
+
+
+def is_compiled_with_custom_device(device_type: str) -> bool:
+    """TPU is this build's custom device (reference custom-device runtime)."""
+    return device_type in ("tpu", "TPU")
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()} | {"cpu"})
+
+
+def get_all_custom_device_type():
+    return [t for t in get_all_device_type() if t not in ("cpu", "gpu")]
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return [d for d in get_available_device()
+            if not d.startswith(("cpu", "gpu"))]
+
+
+class Stream:
+    """Execution-stream handle (reference device/__init__.py Stream). PJRT
+    orders work per device queue; the handle carries the device and
+    synchronize() drains it — the capability the reference exposes that is
+    meaningful on TPU."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = _dev(device)
+
+    def synchronize(self):
+        synchronize(self.device)
+
+    def wait_event(self, event):
+        event.synchronize()
+
+    def wait_stream(self, stream):
+        stream.synchronize()
+
+    def record_event(self, event=None):
+        ev = event or Event()
+        ev.record(self)
+        return ev
+
+
+class Event:
+    """Cross-stream marker (reference Event): records the device queue
+    state; synchronize() = drain the recording device."""
+
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        self._stream = None
+        import time as _time
+
+        self._t = None
+        self._timing = enable_timing
+        self._time = _time
+
+    def record(self, stream=None):
+        self._stream = stream or current_stream()
+        if self._timing:
+            self._t = self._time.time()
+
+    def query(self) -> bool:
+        return True  # PJRT queues drain in order; no async query surface
+
+    def synchronize(self):
+        if self._stream is not None:
+            self._stream.synchronize()
+
+
+_current_streams: Dict[int, Stream] = {}
+
+
+def current_stream(device=None) -> Stream:
+    d = _dev(device)
+    return _current_streams.setdefault(d.id, Stream(d))
+
+
+def set_stream(stream: Stream):
+    _current_streams[stream.device.id] = stream
+    return stream
+
+
+class stream_guard:
+    """Context manager scoping the current stream (reference
+    device/__init__.py stream_guard)."""
+
+    def __init__(self, stream: Stream):
+        self.stream = stream
+
+    def __enter__(self):
+        self._saved = _current_streams.get(self.stream.device.id)
+        set_stream(self.stream)
+        return self
+
+    def __exit__(self, *exc):
+        if self._saved is not None:
+            _current_streams[self.stream.device.id] = self._saved
+        return False

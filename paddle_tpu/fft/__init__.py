@@ -14,11 +14,15 @@ from ..ops.api import (  # noqa: F401
     fftn,
     fftshift,
     hfft,
+    hfft2,
+    hfftn,
     ifft,
     ifft2,
     ifftn,
     ifftshift,
     ihfft,
+    ihfft2,
+    ihfftn,
     irfft,
     irfft2,
     irfftn,
@@ -38,7 +42,7 @@ def rfftfreq(n, d=1.0, dtype=None, name=None):
 
 __all__ = [
     "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
-    "fft2", "ifft2", "rfft2", "irfft2",
-    "fftn", "ifftn", "rfftn", "irfftn",
+    "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+    "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
     "fftshift", "ifftshift", "fftfreq", "rfftfreq",
 ]

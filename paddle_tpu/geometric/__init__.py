@@ -127,3 +127,72 @@ __all__ = [
     "sample_neighbors",
     "reindex_graph",
 ]
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weighted neighbor sampling: neighbors drawn without replacement with
+    probability proportional to edge_weight (reference
+    sampling/neighbors.py weighted_sample_neighbors; GPU kernel uses
+    A-Res reservoir keys — same distribution here via the Efraimidis-
+    Spirakis exponential-key trick, vectorized per node)."""
+    rown = np.asarray(row.numpy() if isinstance(row, Tensor) else row)
+    colp = np.asarray(colptr.numpy() if isinstance(colptr, Tensor) else colptr)
+    w = np.asarray(edge_weight.numpy() if isinstance(edge_weight, Tensor)
+                   else edge_weight).astype(np.float64).reshape(-1)
+    nodes = np.asarray(input_nodes.numpy()
+                       if isinstance(input_nodes, Tensor) else input_nodes)
+    eid = np.asarray(eids.numpy() if isinstance(eids, Tensor) else eids) \
+        if eids is not None else None
+    out_nb, out_cnt, out_eids = [], [], []
+    rng = np.random.default_rng()
+    for nd in nodes.reshape(-1):
+        beg, end = int(colp[nd]), int(colp[nd + 1])
+        nbrs = rown[beg:end]
+        ids = np.arange(beg, end)
+        if sample_size >= 0 and len(nbrs) > sample_size:
+            keys = rng.exponential(size=len(nbrs)) / np.maximum(
+                w[beg:end], 1e-30)
+            pick = np.argpartition(keys, sample_size)[:sample_size]
+            nbrs, ids = nbrs[pick], ids[pick]
+        out_nb.append(nbrs)
+        out_cnt.append(len(nbrs))
+        if eid is not None:
+            out_eids.append(eid[ids])
+    neighbors = Tensor(np.concatenate(out_nb) if out_nb
+                       else np.array([], rown.dtype))
+    counts = Tensor(np.asarray(out_cnt, np.int32))
+    if return_eids:
+        if eid is None:
+            raise ValueError("return_eids=True needs eids")
+        return neighbors, counts, Tensor(np.concatenate(out_eids))
+    return neighbors, counts
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """reindex_graph over per-edge-type neighbor lists: one shared id space
+    seeded by x, neighbors of every type compacted against it (reference
+    reindex.py reindex_heter_graph)."""
+    xs = np.asarray(x.numpy() if isinstance(x, Tensor) else x).reshape(-1)
+    mapping = {int(v): i for i, v in enumerate(xs)}
+    out_nodes = list(xs)
+    rs, rd = [], []
+    for nb, cnt in zip(neighbors, count):
+        nbn = np.asarray(nb.numpy() if isinstance(nb, Tensor)
+                         else nb).reshape(-1)
+        cn = np.asarray(cnt.numpy() if isinstance(cnt, Tensor)
+                        else cnt).reshape(-1)
+        src = np.empty(len(nbn), np.int64)
+        for i, v in enumerate(nbn):
+            iv = int(v)
+            if iv not in mapping:
+                mapping[iv] = len(out_nodes)
+                out_nodes.append(iv)
+            src[i] = mapping[iv]
+        dst = np.repeat(np.arange(len(cn)), cn)
+        rs.append(src)
+        rd.append(dst)
+    return ([Tensor(s) for s in rs], [Tensor(d) for d in rd],
+            Tensor(np.asarray(out_nodes, np.int64)))

@@ -228,3 +228,89 @@ def config_callbacks(callbacks=None, model=None, log_freq=10, verbose=2,
 
 __all__ = ["Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
            "LRScheduler", "EarlyStopping", "VisualDL", "config_callbacks"]
+
+
+class ReduceLROnPlateau(Callback):
+    """Scale LR down when a monitored metric plateaus (reference
+    callbacks.py ReduceLROnPlateau)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0.0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self.min_delta = abs(min_delta)
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.best = None
+        self.wait = 0
+        self.cooldown_counter = 0
+
+    def _improved(self, value):
+        if self.best is None:
+            return True
+        if self.mode == "min":
+            return value < self.best - self.min_delta
+        return value > self.best + self.min_delta
+
+    def on_epoch_end(self, epoch, logs=None):
+        value = (logs or {}).get(self.monitor)
+        if value is None:
+            return
+        if isinstance(value, (list, np.ndarray)):
+            value = float(np.asarray(value).reshape(-1)[0])
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self._improved(value):
+            self.best = value
+            self.wait = 0
+            return
+        self.wait += 1
+        if self.wait > self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is not None:
+                old = opt.get_lr()
+                new = max(old * self.factor, self.min_lr)
+                if new < old:
+                    opt.set_lr(new)
+                    if self.verbose:
+                        print(f"Epoch {epoch + 1}: reducing lr "
+                              f"{old:.2e} -> {new:.2e}")
+            self.cooldown_counter = self.cooldown
+            self.wait = 0
+
+
+class WandbCallback(Callback):
+    """Weights & Biases logging (reference callbacks.py WandbCallback).
+    Imports wandb lazily and raises without it, matching the reference's
+    hard dependency; pass a stub module via `wandb=` for testing."""
+
+    def __init__(self, project=None, run_name=None, wandb=None, **kwargs):
+        super().__init__()
+        if wandb is None:
+            try:
+                import wandb  # type: ignore
+            except ImportError as e:
+                raise ImportError(
+                    "WandbCallback requires the wandb package "
+                    "(reference behavior)") from e
+        self._wandb = wandb
+        self._kwargs = dict(kwargs, project=project, name=run_name)
+        self._run = None
+
+    def on_train_begin(self, logs=None):
+        self._run = self._wandb.init(**self._kwargs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self._run is not None:
+            self._wandb.log(dict(logs or {}, epoch=epoch))
+
+    def on_train_end(self, logs=None):
+        if self._run is not None and hasattr(self._wandb, "finish"):
+            self._wandb.finish()

@@ -1,0 +1,52 @@
+"""paddle.hub (reference python/paddle/hapi/hub.py): hubconf.py model
+loading. This environment has no egress, so the 'github' source is
+unavailable by policy; 'local' directories and importable modules work
+fully — load/list/help against any repo_dir with a hubconf.py."""
+from __future__ import annotations
+
+import importlib
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_HUB_CONF = "hubconf.py"
+
+
+def _import_hubconf(repo_dir: str, source: str):
+    if source == "github":
+        raise RuntimeError(
+            "paddle.hub github source needs network egress, which this "
+            "environment forbids; clone the repo and use source='local'")
+    if os.path.isdir(repo_dir):
+        path = os.path.join(repo_dir, _HUB_CONF)
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"no {_HUB_CONF} in {repo_dir}")
+        spec = importlib.util.spec_from_file_location("hubconf", path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules["hubconf"] = mod
+        spec.loader.exec_module(mod)
+        return mod
+    return importlib.import_module(repo_dir)
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    """Entrypoint names exported by the repo's hubconf (callables not
+    starting with '_')."""
+    mod = _import_hubconf(repo_dir, source)
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    mod = _import_hubconf(repo_dir, source)
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    mod = _import_hubconf(repo_dir, source)
+    entry = getattr(mod, model, None)
+    if entry is None or not callable(entry):
+        raise RuntimeError(f"no callable entrypoint {model!r} in {repo_dir}")
+    return entry(**kwargs)

@@ -21,3 +21,73 @@ from .optimizer import (  # noqa: F401, E402
 from .. import multiprocessing  # noqa: F401, E402 (reference: paddle.incubate.multiprocessing)
 
 from ..core import autotune  # noqa: F401, E402 (paddle.incubate.autotune parity)
+
+# -- reference incubate.__all__ surface (graph ops live in geometric; the
+# incubate names are the legacy spellings) ----------------------------------
+from ..geometric import (  # noqa: E402, F401
+    segment_max,
+    segment_mean,
+    segment_min,
+    segment_sum,
+)
+from ..geometric import send_u_recv as graph_send_recv  # noqa: E402, F401
+from ..geometric import reindex_graph as graph_reindex  # noqa: E402, F401
+from ..geometric import (  # noqa: E402, F401
+    sample_neighbors as graph_sample_neighbors,
+)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop sampling: chain sample_neighbors per hop, reindexing the
+    frontier (reference incubate/graph_khop_sampler)."""
+    import numpy as _np
+
+    from ..core.tensor import Tensor as _T
+    from ..geometric import reindex_graph, sample_neighbors
+
+    frontier = input_nodes
+    all_nb, all_cnt = [], []
+    for k in sample_sizes:
+        nb, cnt = sample_neighbors(row, colptr, frontier, sample_size=k)
+        all_nb.append(nb)
+        all_cnt.append(cnt)
+        frontier = _T(_np.unique(nb.numpy()))
+    nbs = _T(_np.concatenate([n.numpy() for n in all_nb]))
+    cnts = _T(_np.concatenate([c.numpy() for c in all_cnt]))
+    src, dst, nodes = reindex_graph(input_nodes, nbs, cnts)
+    return src, dst, nodes, cnts
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) as one fused expression (reference fused op
+    softmax_mask_fuse — XLA fuses the add into the softmax on TPU)."""
+    from ..ops import api
+
+    return api.softmax(api.add(x, mask), axis=-1)
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """Causal-masked softmax without materializing the mask input
+    (reference softmax_mask_fuse_upper_triangle: scores [B,H,T,T])."""
+    import jax.numpy as _jnp
+
+    from ..core.tensor import Tensor as _T
+    from ..ops import api
+
+    t = x.shape[-1]
+    causal = _jnp.triu(_jnp.full((t, t), -1e30, _jnp.float32), k=1)
+    return api.softmax(api.add(x, _T(causal)), axis=-1)
+
+
+def identity_loss(x, reduction="none"):
+    """Mark a tensor as the loss with optional reduction (reference
+    incubate identity_loss op, the IPU loss-marker; here the reduction is
+    the whole semantic)."""
+    from ..ops import api
+
+    if reduction in (0, "sum"):
+        return api.sum(x)
+    if reduction in (1, "mean"):
+        return api.mean(x)
+    return x

@@ -91,6 +91,8 @@ class StaticFunction:
     """Result of @to_static: traces on first call per input signature, then
     replays the compiled XLA program."""
 
+    _globally_enabled = True  # paddle.jit.enable_to_static switch
+
     def __init__(self, fn, input_spec=None, layers=()):
         self._fn = fn
         self._input_spec = input_spec
@@ -145,6 +147,8 @@ class StaticFunction:
                 (treedef, tuple(dyn_idx), tuple(static_leaves)))
 
     def __call__(self, *args, **kwargs):
+        if not StaticFunction._globally_enabled:
+            return self._fn(*args, **kwargs)  # dygraph passthrough
         if self._jitted is None:
             self._build()
         dyn_vals, static_key = self._split_args(args, kwargs)
@@ -261,12 +265,7 @@ def load(path, **config):
     with open(path + ".pdmodel", "rb") as f:
         exported = jexport.deserialize(f.read())
 
-    def run(*args):
-        vals = tuple(a._value if isinstance(a, Tensor) else jnp.asarray(a) for a in args)
-        out = exported.call(vals)
-        return jax.tree_util.tree_map(lambda x: Tensor(x), out)
-
-    return run
+    return TranslatedLayer(exported)
 
 
 def check_artifact_compat(path):
@@ -296,3 +295,56 @@ def check_artifact_compat(path):
 def not_to_static(fn):
     fn._not_to_static = True
     return fn
+
+
+# -- round-5 API parity (reference python/paddle/jit/__init__.py __all__) ---
+
+_ignored_modules: List[Any] = []
+_code_level = 0
+_verbosity = 0
+
+
+def ignore_module(modules):
+    """Modules whose functions to_static leaves untransformed (reference
+    jit/api.py ignore_module): their functions fall back to tracing."""
+    from . import dy2static as _d2s
+
+    mods = modules if isinstance(modules, (list, tuple)) else [modules]
+    _ignored_modules.extend(mods)
+    _d2s.IGNORED_MODULES = tuple(_ignored_modules)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Dump transformed code at/below `level` (reference
+    jit/dy2static/logging_utils.py); dy2static checks this knob."""
+    global _code_level
+    _code_level = level
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    global _verbosity
+    _verbosity = level
+
+
+def enable_to_static(enable: bool = True):
+    """Global to_static switch (reference enable_to_static): disabled ->
+    StaticFunction runs the original dygraph callable."""
+    StaticFunction._globally_enabled = bool(enable)
+
+
+class TranslatedLayer(Layer):
+    """A loaded inference program as a Layer (reference
+    jit/translated_layer.py TranslatedLayer: the jit.load result)."""
+
+    def __init__(self, exported):
+        super().__init__()
+        self._exported = exported
+
+    def forward(self, *args):
+        vals = tuple(a._value if isinstance(a, Tensor) else jnp.asarray(a)
+                     for a in args)
+        out = self._exported.call(vals)
+        return jax.tree_util.tree_map(lambda x: Tensor(x), out)
+
+    def program(self):
+        return self._exported

@@ -47,6 +47,9 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor
 
 
+IGNORED_MODULES: tuple = ()  # populated by paddle.jit.ignore_module
+
+
 class _Undefined:
     """Sentinel for names not defined on some control-flow path (reference
     dy2static UndefinedVar). Any meaningful use raises."""
@@ -1134,6 +1137,9 @@ def convert_control_flow(fn: Callable) -> Callable:
     if getattr(fn, "_not_to_static", False):
         return fn
     target = fn.__func__ if inspect.ismethod(fn) else fn
+    mod = inspect.getmodule(target)
+    if mod is not None and mod in IGNORED_MODULES:
+        return fn
     if not isinstance(target, types.FunctionType):
         return fn
     try:

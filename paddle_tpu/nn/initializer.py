@@ -144,3 +144,56 @@ class Dirac(Initializer):
 
     def __call__(self, shape, dtype="float32"):
         return jax.nn.initializers.delta_orthogonal()(_random.next_key(), tuple(shape), convert_dtype(dtype))
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel init for transposed convs (reference
+    nn/initializer/Bilinear): weight[c_out, c_in, k, k] gets the separable
+    triangle filter so a stride-s deconv starts as bilinear interpolation."""
+
+    def __call__(self, shape, dtype="float32"):
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer needs a 4-D conv weight")
+        c_out, c_in, kh, kw = shape
+        f_h, f_w = (kh + 1) // 2, (kw + 1) // 2
+        ch = (kh - 1) / (2.0 * f_h) if kh % 2 == 0 else (kh - 1) / 2.0
+        cw = (kw - 1) / (2.0 * f_w) if kw % 2 == 0 else (kw - 1) / 2.0
+        og = np.ogrid[:kh, :kw]
+        filt = (1 - abs(og[0] - ch) / f_h) * (1 - abs(og[1] - cw) / f_w)
+        w = np.zeros(shape, np.dtype(dtype))
+        for i in range(c_out):
+            w[i, i % c_in] = filt
+        return Tensor(jnp.asarray(w))
+
+
+def calculate_gain(nonlinearity, param=None):
+    """Recommended init gain per activation (reference
+    nn/initializer/calculate_gain; the values are the published table)."""
+    import math
+
+    table = {
+        "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0, "conv3d": 1.0,
+        "conv_transpose1d": 1.0, "conv_transpose2d": 1.0,
+        "conv_transpose3d": 1.0, "sigmoid": 1.0,
+        "tanh": 5.0 / 3.0, "relu": math.sqrt(2.0),
+        "selu": 3.0 / 4.0,
+    }
+    if nonlinearity == "leaky_relu":
+        a = 0.01 if param is None else float(param)
+        return math.sqrt(2.0 / (1 + a ** 2))
+    if nonlinearity not in table:
+        raise ValueError(f"unsupported nonlinearity {nonlinearity!r}")
+    return table[nonlinearity]
+
+
+_global_weight_init = None
+_global_bias_init = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Default initializers for subsequently created parameters (reference
+    nn/initializer/set_global_initializer); Layer.create_parameter reads
+    these when no explicit initializer is given. Pass None to reset."""
+    global _global_weight_init, _global_bias_init
+    _global_weight_init = weight_init
+    _global_bias_init = bias_init

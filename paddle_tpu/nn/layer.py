@@ -130,6 +130,8 @@ class Layer:
         if init is None and attr is not None and getattr(attr, "initializer", None) is not None:
             init = attr.initializer
         if init is None:
+            init = I._global_bias_init if is_bias else I._global_weight_init
+        if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierUniform()
         value = init(shape, dtype)
         name = None

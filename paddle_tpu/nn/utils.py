@@ -1,0 +1,141 @@
+"""paddle.nn.utils (reference python/paddle/nn/utils/): parametrization
+hooks (weight/spectral norm) and parameter-vector/grad utilities."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops import api
+from .layer import Parameter
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters",
+           "clip_grad_norm_", "clip_grad_value_"]
+
+
+def _norm_except(v, dim):
+    """||v|| reduced over every axis except `dim` (dim=None: full norm)."""
+    if dim is None:
+        return jnp.sqrt(jnp.sum(jnp.square(v)))
+    axes = tuple(a for a in range(v.ndim) if a != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(v), axis=axes, keepdims=True))
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize layer.<name> as g * v/||v|| (reference
+    nn/utils/weight_norm_hook.py). v and g become the trainable params;
+    a pre-forward hook recomputes the weight each call."""
+    w = getattr(layer, name)
+    v = Parameter(w._value)
+    g = Parameter(_norm_except(w._value, dim))
+    setattr(layer, name + "_v", v)
+    setattr(layer, name + "_g", g)
+    # the original weight is no longer a trainable parameter
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def _recompute(lyr, inputs):
+        norm = _norm_except(v._value, dim)
+        lyr.__dict__[name] = Tensor(
+            g._value * v._value / jnp.maximum(norm, 1e-12))
+        return inputs
+
+    handle = layer.register_forward_pre_hook(_recompute)
+    layer.__dict__[name + "_wn_hook"] = handle
+    _recompute(layer, ())
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Fold g*v/||v|| back into a plain parameter and drop the hook."""
+    v = getattr(layer, name + "_v")
+    g = getattr(layer, name + "_g")
+    hook = layer.__dict__.pop(name + "_wn_hook", None)
+    if hook is not None:
+        hook.remove()
+    dimless = g._value.ndim == 0
+    norm = _norm_except(v._value, None if dimless else 0)
+    w = Parameter(g._value * v._value / jnp.maximum(norm, 1e-12))
+    for suffix in ("_v", "_g"):
+        layer._parameters.pop(name + suffix, None)
+    layer.__dict__.pop(name, None)
+    setattr(layer, name, w)
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Divide layer.<name> by its spectral norm each forward (reference
+    nn/utils/spectral_norm_hook.py), persisting the power-iteration
+    vectors as buffers."""
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    h = w.shape[dim]
+    width = int(np.prod([s for i, s in enumerate(w.shape) if i != dim]))
+    rng = np.random.RandomState(0)
+
+    def unit(n):
+        x = rng.normal(size=n).astype(np.float32)
+        return x / max(float(np.linalg.norm(x)), eps)
+
+    u = Tensor(jnp.asarray(unit(h)))
+    vv = Tensor(jnp.asarray(unit(width)))
+    orig = Parameter(w._value)
+    setattr(layer, name + "_orig", orig)
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def _recompute(lyr, inputs):
+        out = api.spectral_norm(orig, u, vv, dim, n_power_iterations, eps)
+        lyr.__dict__[name] = out
+        return inputs
+
+    handle = layer.register_forward_pre_hook(_recompute)
+    layer.__dict__[name + "_sn_hook"] = handle
+    _recompute(layer, ())
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    ps = list(parameters)
+    return Tensor(jnp.concatenate([p._value.reshape(-1) for p in ps]))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    v = vec._value if isinstance(vec, Tensor) else jnp.asarray(vec)
+    off = 0
+    for p in parameters:
+        n = int(np.prod(p.shape))
+        p._value = v[off:off + n].reshape(tuple(p.shape)).astype(
+            p._value.dtype)
+        off += n
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """In-place global-norm gradient clip over .grad (reference
+    nn/utils/clip_grad_norm_); returns the total norm."""
+    ps = [p for p in parameters if p.grad is not None]
+    if not ps:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(p.grad._value)) for p in ps]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(p.grad._value) ** norm_type) for p in ps])
+        ) ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError("non-finite gradient norm in clip_grad_norm_")
+    scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-12), 1.0)
+    for p in ps:
+        p.grad._value = p.grad._value * scale.astype(p.grad._value.dtype)
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._value = jnp.clip(p.grad._value, -clip_value, clip_value)

@@ -265,3 +265,19 @@ class CyclicLR(LRScheduler):
         elif self.mode == "exp_range":
             amp = amp * (self.gamma ** self.last_epoch)
         return self.base_lr + amp * pct
+
+
+class MultiplicativeDecay(LRScheduler):
+    """lr_t = lr_{t-1} * lr_lambda(t) (reference lr.py MultiplicativeDecay)."""
+
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1, verbose=False):
+        self._lr_lambda = lr_lambda
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        if self.last_epoch <= 0:
+            return self.base_lr
+        cur = self.base_lr
+        for i in range(1, self.last_epoch + 1):
+            cur = cur * self._lr_lambda(i)
+        return cur

@@ -27,15 +27,27 @@ class Optimizer:
         self._learning_rate = learning_rate
         self._grad_clip = grad_clip
         self._multi_precision = multi_precision
+        self._wd_mode = "l2"
         if isinstance(weight_decay, (int, float)):
             self._coupled_wd = float(weight_decay)  # L2 regularizer folded into grad
         elif weight_decay is not None and hasattr(weight_decay, "coeff"):
             self._coupled_wd = float(weight_decay.coeff)
+            # regularizer.L1Decay adds coeff*sign(p) instead of coeff*p
+            self._wd_mode = getattr(weight_decay, "mode", "l2")
         else:
             self._coupled_wd = 0.0
         # state: param-id -> {slot-name -> jax array}
         self._state: Dict[int, Dict[str, object]] = {}
         self._step_count = 0
+
+    def _wd_term(self, p_value):
+        """Coupled regularization gradient: coeff*p (L2) or coeff*sign(p)
+        (L1, reference regularizer.L1Decay)."""
+        import jax.numpy as _jnp
+
+        if self._wd_mode == "l1":
+            return self._coupled_wd * _jnp.sign(p_value)
+        return self._coupled_wd * p_value
 
     # ---- lr ----------------------------------------------------------------
     def get_lr(self) -> float:
@@ -118,7 +130,7 @@ class Optimizer:
                 # coupled L2 touches EVERY row (wd * p is dense): densify
                 # once and run the shared dense rule
                 gv = sr.to_dense()._value
-                gv = gv + self._coupled_wd * p._value.astype(gv.dtype)
+                gv = gv + self._wd_term(p._value).astype(gv.dtype)
                 self._state[id(p)] = self._apply_dense(p, gv, state, lr)
                 continue
             self._state[id(p)] = self._update_sparse(p, sr.merge(), state, lr)
@@ -126,7 +138,7 @@ class Optimizer:
             gv = g._value if isinstance(g, Tensor) else g
             state = self._get_state(p)
             if self._coupled_wd:
-                gv = gv + self._coupled_wd * p._value.astype(gv.dtype)
+                gv = gv + self._wd_term(p._value).astype(gv.dtype)
             if "master" in state:
                 new_master, new_state = self._update(state["master"], gv.astype(jnp.float32), state, lr)
                 new_state["master"] = new_master
@@ -204,7 +216,7 @@ class Optimizer:
             s = dict(s)
             wd_g = g
             if self._coupled_wd:
-                wd_g = g + self._coupled_wd * p.astype(g.dtype)
+                wd_g = g + self._wd_term(p).astype(g.dtype)
             if "master" in s:
                 master, ns = self._update(s["master"], wd_g.astype(jnp.float32), s, lr)
                 ns["master"] = master

@@ -392,3 +392,30 @@ __all__ = [
     "load_profiler_result",
     "benchmark",
 ]
+
+
+class SortedKeys(enum.Enum):
+    """Summary-table sort orders (reference profiler/profiler_statistic.py)."""
+
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView(enum.Enum):
+    """Summary report views (reference profiler/profiler.py SummaryView)."""
+
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8

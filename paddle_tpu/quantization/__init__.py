@@ -12,7 +12,7 @@ from .config import QuantConfig  # noqa: F401
 from .observers import AbsmaxObserver, BaseObserver, EMAObserver  # noqa: F401
 from .qat import QAT  # noqa: F401
 from .ptq import PTQ  # noqa: F401
-from .quanters import FakeQuanterWithAbsMax  # noqa: F401
+from .quanters import BaseQuanter, FakeQuanterWithAbsMax, quanter  # noqa: F401
 from .layers import QuantedLinear, QuantedConv2D  # noqa: F401
 
 __all__ = [

@@ -40,3 +40,32 @@ class FakeQuanterWithAbsMax:
 
     def scales(self):
         return self._scale
+
+
+class BaseQuanter:
+    """Abstract quanter interface (reference
+    paddle/quantization/factory.py BaseQuanter): __call__ fake-quantizes;
+    scales()/zero_points() expose the learned quantization params."""
+
+    def __call__(self, x):
+        raise NotImplementedError
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        return None
+
+
+def quanter(name):
+    """Class decorator registering a quanter factory under `name`
+    (reference quantization/factory.py quanter): the QuantConfig refers to
+    registered quanters by name."""
+    def deco(cls):
+        _QUANTER_REGISTRY[name] = cls
+        return cls
+
+    return deco
+
+
+_QUANTER_REGISTRY = {"FakeQuanterWithAbsMax": FakeQuanterWithAbsMax}

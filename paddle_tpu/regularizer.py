@@ -1,0 +1,28 @@
+"""paddle.regularizer (reference python/paddle/regularizer.py): weight
+decay configs consumed by the optimizers' coupled-decay path
+(optimizer.py _wd_term)."""
+from __future__ import annotations
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class L2Decay:
+    """grad += coeff * param."""
+
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+        self.mode = "l2"
+
+    def __repr__(self):
+        return f"L2Decay(coeff={self.coeff})"
+
+
+class L1Decay:
+    """grad += coeff * sign(param)."""
+
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+        self.mode = "l1"
+
+    def __repr__(self):
+        return f"L1Decay(coeff={self.coeff})"

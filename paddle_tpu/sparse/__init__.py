@@ -392,3 +392,70 @@ __all__ = [
     "rad2deg",
     "nn",
 ]
+
+
+def reshape(x, shape):
+    """Reshape a COO tensor by remapping linearized sparse coordinates
+    (reference sparse/unary reshape_coo_kernel)."""
+    import numpy as _np
+
+    old = tuple(int(s) for s in x.shape)
+    new = list(int(s) for s in shape)
+    if -1 in new:
+        known = int(_np.prod([s for s in new if s != -1]))
+        new[new.index(-1)] = int(_np.prod(old)) // max(known, 1)
+    if int(_np.prod(old)) != int(_np.prod(new)):
+        raise ValueError(f"reshape: {old} -> {tuple(new)} changes numel")
+    if isinstance(x, SparseCsrTensor):
+        x = x.to_sparse_coo()
+    strides_old = jnp.asarray(
+        _np.cumprod([1] + list(old[::-1]))[-2::-1].copy(), jnp.int64)
+    linear = (x._indices.astype(jnp.int64) * strides_old[:, None]).sum(0)
+    strides_new = _np.cumprod([1] + list(new[::-1]))[-2::-1].copy()
+    idx_new = jnp.stack([(linear // int(s)) % int(d)
+                         for s, d in zip(strides_new, new)])
+    return SparseCooTensor(idx_new.astype(jnp.int32), x._values, tuple(new))
+
+
+def isnan(x):
+    """Elementwise NaN mask over the stored values (reference
+    sparse/unary isnan: the zero pattern is never NaN)."""
+    vals = jnp.isnan(x._values)
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(x._crows, x._cols, vals, x.shape)
+    return SparseCooTensor(x._indices, vals, x.shape)
+
+
+def slice(x, axes, starts, ends):  # noqa: A001
+    """Slice a COO tensor along `axes` (reference sparse slice_coo_kernel):
+    keep entries inside the window, shift coordinates."""
+    if isinstance(x, SparseCsrTensor):
+        x = x.to_sparse_coo()
+    shape = list(int(s) for s in x.shape)
+    keep = jnp.ones(x._indices.shape[1], bool)
+    shifts = [0] * len(shape)
+    for ax, st, en in zip(axes, starts, ends):
+        st = st + shape[ax] if st < 0 else st
+        en = min(en + shape[ax] if en < 0 else en, shape[ax])
+        keep = keep & (x._indices[ax] >= st) & (x._indices[ax] < en)
+        shifts[ax] = st
+        shape[ax] = en - st
+    # boolean-compress on host semantics (eager API, like reference CPU
+    # slice); inside jit use capacity-padded masking instead
+    import numpy as _np
+
+    keep_np = _np.asarray(keep)
+    idx = _np.asarray(x._indices)[:, keep_np]
+    idx = idx - _np.asarray(shifts, idx.dtype)[:, None]
+    vals = _np.asarray(x._values)[keep_np]
+    return SparseCooTensor(jnp.asarray(idx), jnp.asarray(vals), tuple(shape))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Sparse input PCA: densify values (PCA output is dense regardless)
+    and run the dense routine (reference sparse.pca_lowrank densifies on
+    CPU too for the final SVD)."""
+    from ..linalg import pca_lowrank as _dense
+
+    return _dense(x.to_dense() if hasattr(x, "to_dense") else x,
+                  q=q, center=center, niter=niter)

@@ -198,3 +198,28 @@ class functional:
         from .conv import sparse_max_pool
 
         return sparse_max_pool(x, kernel_size, stride, padding)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-replica BatchNorm over sparse values (reference
+    sparse/nn/SyncBatchNorm). Inside a mesh program the value-statistics
+    reduce with psum over the data axis (same mechanism as the dense
+    SyncBatchNorm); outside a mesh it equals BatchNorm."""
+
+    def forward(self, x):
+        from ..distributed.collective import _axis_ctx
+
+        if not _axis_ctx.axes:
+            return super().forward(x)
+        import jax.numpy as _jnp
+        from jax import lax as _lax
+
+        axis = _axis_ctx.axes[-1]
+        vals = x.values()._value
+        n = _lax.psum(_jnp.asarray(vals.shape[0], _jnp.float32), axis)
+        mean = _lax.psum(vals.sum(0), axis) / n
+        var = _lax.psum(((vals - mean) ** 2).sum(0), axis) / n
+        y = (vals - mean) / _jnp.sqrt(var + self.epsilon)
+        y = y * self.weight._value + self.bias._value
+        return type(x)(x._indices, y, x.shape) if hasattr(x, "_indices") \
+            else x

@@ -1,0 +1,20 @@
+"""paddle.sysconfig (reference python/paddle/sysconfig.py): include/lib
+paths for building native extensions against this framework."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    """Directory of the native C++ sources/headers (the framework links no
+    separate SDK; custom ops build against the Python C API + these)."""
+    return os.path.join(_ROOT, "native", "src")
+
+
+def get_lib() -> str:
+    """Directory holding the compiled native runtime library."""
+    return os.path.join(_ROOT, "native", "_lib")

@@ -21,6 +21,7 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def register_custom_op(
@@ -108,3 +109,75 @@ def register_custom_op(
     if forward is not None:
         return deco(forward)
     return deco
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Decorator marking an API deprecated (reference
+    utils/deprecated.py): warns once per call site."""
+    import functools
+    import warnings
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = f"API {fn.__module__}.{fn.__name__} is deprecated"
+            if since:
+                msg += f" since {since}"
+            if update_to:
+                msg += f"; use {update_to} instead"
+            if reason:
+                msg += f" ({reason})"
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def run_check():
+    """Install sanity check (reference utils/install_check.py run_check):
+    one compiled matmul on the default backend + an 8-device CPU-mesh
+    collective, printing the verdict."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+
+    backend = jax.default_backend()
+    x = paddle.to_tensor(np.ones((4, 4), np.float32))
+    y = (x @ x).numpy()
+    assert np.allclose(y, 4.0), "matmul check failed"
+    print(f"paddle_tpu is installed successfully! backend={backend}, "
+          f"devices={len(jax.devices())}")
+
+
+def require_version(min_version, max_version=None):
+    """Assert the framework version lies in [min_version, max_version]
+    (reference utils/__init__.py require_version)."""
+    import paddle_tpu
+
+    def parse(v):
+        return tuple(int(x) for x in str(v).split(".")[:3])
+
+    cur = parse(paddle_tpu.__version__)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"version {paddle_tpu.__version__} < required {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"version {paddle_tpu.__version__} > allowed {max_version}")
+    return True
+
+
+def try_import(module_name, err_msg=None):
+    """Import or raise with an actionable message (reference
+    utils/lazy_import.py try_import)."""
+    import importlib
+
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            err_msg or f"{module_name} is required but not installed; this "
+            "environment forbids pip installs — gate the feature") from e

@@ -1,0 +1,101 @@
+"""paddle.audio.backends (reference python/paddle/audio/backends/): wave
+file io. The reference dispatches to soundfile when installed and its
+bundled wave_backend otherwise; this environment has no soundfile wheel,
+so the stdlib-wave backend IS the backend (16/32-bit PCM + float32 wav)."""
+from __future__ import annotations
+
+import wave
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["list_available_backends", "get_current_backend", "set_backend",
+           "load", "save", "info", "AudioInfo"]
+
+_backend = "wave_backend"
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def get_current_backend():
+    return _backend
+
+
+def set_backend(backend_name: str):
+    global _backend
+    if backend_name not in list_available_backends():
+        raise ValueError(
+            f"backend {backend_name!r} unavailable (soundfile is not "
+            "installed in this environment); available: "
+            f"{list_available_backends()}")
+    _backend = backend_name
+
+
+@dataclass
+class AudioInfo:
+    """Reference backends/backend.py AudioInfo."""
+
+    sample_rate: int
+    num_samples: int
+    num_channels: int
+    bits_per_sample: int
+    encoding: str
+
+
+def info(filepath: str) -> AudioInfo:
+    with wave.open(filepath, "rb") as f:
+        return AudioInfo(
+            sample_rate=f.getframerate(),
+            num_samples=f.getnframes(),
+            num_channels=f.getnchannels(),
+            bits_per_sample=f.getsampwidth() * 8,
+            encoding=f"PCM_{f.getsampwidth() * 8}",
+        )
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """Returns (waveform Tensor [C, T] (or [T, C]), sample_rate)."""
+    with wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        n = f.getnframes()
+        ch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(min(frame_offset, n))
+        count = n - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(count)
+    dt = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
+    data = np.frombuffer(raw, dtype=dt).reshape(-1, ch)
+    if normalize:
+        if width == 1:
+            wav = (data.astype(np.float32) - 128.0) / 128.0
+        else:
+            wav = data.astype(np.float32) / float(2 ** (width * 8 - 1))
+    else:
+        wav = data
+    if channels_first:
+        wav = wav.T
+    return Tensor(np.ascontiguousarray(wav)), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         encoding="PCM_16", bits_per_sample=16):
+    data = np.asarray(src.numpy() if isinstance(src, Tensor) else src)
+    if channels_first:
+        data = data.T
+    if data.ndim == 1:
+        data = data[:, None]
+    width = (bits_per_sample or 16) // 8
+    if np.issubdtype(data.dtype, np.floating):
+        peak = 2 ** ((width * 8) - 1) - 1
+        data = np.clip(np.round(data * peak), -peak - 1, peak)
+    dt = {2: np.int16, 4: np.int32}.get(width, np.int16)
+    with wave.open(filepath, "wb") as f:
+        f.setnchannels(data.shape[1])
+        f.setsampwidth(width)
+        f.setframerate(int(sample_rate))
+        f.writeframes(data.astype(dt).tobytes())

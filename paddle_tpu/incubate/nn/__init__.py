@@ -1,1 +1,11 @@
 from . import functional  # noqa: F401
+from .layers import (  # noqa: F401
+    FusedBiasDropoutResidualLayerNorm,
+    FusedDropoutAdd,
+    FusedEcMoe,
+    FusedFeedForward,
+    FusedLinear,
+    FusedMultiHeadAttention,
+    FusedMultiTransformer,
+    FusedTransformerEncoderLayer,
+)

@@ -128,3 +128,143 @@ from .strings import (  # noqa: E402
 
 __all__ = ["viterbi_decode", "Imdb", "Conll05st", "strings", "StringTensor",
            "Vocab", "tokenize"]
+
+
+class ViterbiDecoder:
+    """Layer twin of viterbi_decode (reference text/viterbi_decode.py
+    ViterbiDecoder): holds the transition matrix + tag convention."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression (reference text/datasets/uci_housing.py):
+    13 features -> price. Synthetic stand-in (no egress): linear ground
+    truth + noise, learnable by design."""
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        n = 404 if mode == "train" else 102
+        x = rng.randn(n, 13).astype(np.float32)
+        w = np.linspace(-2, 2, 13).astype(np.float32)
+        y = x @ w + 3.0 + rng.randn(n).astype(np.float32) * 0.1
+        self.data = [(x[i], np.asarray([y[i]], np.float32))
+                     for i in range(n)]
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram LM dataset (reference text/datasets/imikolov.py):
+    yields n-gram tuples from a synthetic Zipf corpus."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, download=True):
+        rng = np.random.RandomState(3 if mode == "train" else 4)
+        vocab = 2000
+        corpus = rng.zipf(1.3, size=20000) % vocab
+        self.word_idx = {i: i for i in range(vocab)}
+        self.data = []
+        if data_type.upper() == "NGRAM":
+            for i in range(len(corpus) - window_size):
+                self.data.append(tuple(
+                    np.asarray(corpus[i + j], np.int64)
+                    for j in range(window_size)))
+        else:  # SEQ: (input seq, shifted target seq)
+            seqlen = window_size
+            for i in range(0, len(corpus) - seqlen - 1, seqlen):
+                self.data.append((corpus[i:i + seqlen].astype(np.int64),
+                                  corpus[i + 1:i + seqlen + 1]
+                                  .astype(np.int64)))
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Movielens(Dataset):
+    """MovieLens-style rating dataset (reference
+    text/datasets/movielens.py): (user feats, movie feats, rating)."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        rng = np.random.RandomState(rand_seed)
+        n_users, n_movies = 500, 800
+        n = 8000
+        users = rng.randint(0, n_users, n)
+        movies = rng.randint(0, n_movies, n)
+        u_bias = rng.randn(n_users) * 0.5
+        m_bias = rng.randn(n_movies) * 0.5
+        ratings = np.clip(np.round(
+            3.0 + u_bias[users] + m_bias[movies] + rng.randn(n) * 0.3),
+            1, 5)
+        cut = int(n * (1 - test_ratio))
+        sl = slice(0, cut) if mode == "train" else slice(cut, n)
+        self.data = [
+            (np.asarray([users[i], users[i] % 2, users[i] % 7,
+                         users[i] % 21], np.int64),
+             np.asarray([movies[i], movies[i] % 19], np.int64),
+             np.asarray([ratings[i]], np.float32))
+            for i in range(*sl.indices(n))]
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class _WMTBase(Dataset):
+    """Synthetic translation pairs with a learnable copy-ish mapping:
+    target = source permuted through a fixed bijection (BOS/EOS framed)."""
+
+    def __init__(self, mode, src_dict_size, trg_dict_size, lang, seed):
+        rng = np.random.RandomState(seed + (0 if mode in ("train",) else 1))
+        self.src_vocab = min(src_dict_size, 1000) or 1000
+        self.trg_vocab = min(trg_dict_size, 1000) or 1000
+        perm = rng.permutation(self.trg_vocab)
+        n = 2000 if mode == "train" else 400
+        self.data = []
+        for _ in range(n):
+            ln = rng.randint(4, 12)
+            src = rng.randint(3, self.src_vocab, ln)
+            trg = perm[src % self.trg_vocab]
+            self.data.append((src.astype(np.int64),
+                              np.concatenate([[1], trg]).astype(np.int64),
+                              np.concatenate([trg, [2]]).astype(np.int64)))
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class WMT14(_WMTBase):
+    """Reference text/datasets/wmt14.py (en-fr)."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=1000,
+                 download=True):
+        super().__init__(mode, dict_size, dict_size, "enfr", 10)
+
+
+class WMT16(_WMTBase):
+    """Reference text/datasets/wmt16.py (en-de, separate dict sizes)."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=1000,
+                 trg_dict_size=1000, lang="en", download=True):
+        super().__init__(mode, src_dict_size, trg_dict_size, lang, 20)
+
+from . import datasets  # noqa: F401, E402  (reference import path)

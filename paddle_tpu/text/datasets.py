@@ -1,0 +1,15 @@
+"""paddle.text.datasets namespace (reference python/paddle/text/datasets/):
+the dataset classes live in text/__init__ here; this module is the
+reference import path."""
+from . import (  # noqa: F401
+    Conll05st,
+    Imdb,
+    Imikolov,
+    Movielens,
+    UCIHousing,
+    WMT14,
+    WMT16,
+)
+
+__all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+           "WMT14", "WMT16"]

@@ -1,4 +1,10 @@
 from . import datasets, models, ops, transforms  # noqa: F401
+from .datasets import (  # noqa: F401
+    DatasetFolder,
+    Flowers,
+    ImageFolder,
+    VOC2012,
+)
 from .models import (  # noqa: F401
     AlexNet,
     DenseNet,
@@ -42,3 +48,31 @@ from .models import (  # noqa: F401
     wide_resnet50_2,
     wide_resnet101_2,
 )
+
+# -- image backend knobs (reference vision/image.py) ------------------------
+_image_backend = "cv2"
+
+
+def set_image_backend(backend):
+    """'cv2'/'pil'/'tensor' accepted for API parity; loading here is
+    numpy-native either way (no cv2/PIL wheels in this environment)."""
+    global _image_backend
+    if backend not in ("cv2", "pil", "tensor"):
+        raise ValueError(f"unknown image backend {backend!r}")
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image file as an array (jpeg via the decode_jpeg op; .npy
+    directly)."""
+    import numpy as _np
+
+    if str(path).endswith(".npy"):
+        return _np.load(path)
+    from ..ops.kernels.vision_ops import decode_jpeg, read_file
+
+    return _np.asarray(decode_jpeg(read_file(path)))

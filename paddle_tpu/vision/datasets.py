@@ -107,3 +107,142 @@ def _synthetic_images(n, hw, classes, seed, channels=None):
             imgs = (0.5 + 0.5 * np.sin(2 * np.pi * (fx * xx + fy * yy)[None, ..., None] + c + phase)) + noise
         images[mask] = (np.clip(imgs, 0, 1) * 255).astype(np.uint8)
     return images, labels
+
+
+class DatasetFolder(Dataset):
+    """Directory-per-class image dataset (reference
+    datasets/folder.py DatasetFolder): root/<class>/<image files>."""
+
+    IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".npy")
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        exts = tuple(e.lower() for e in (extensions or self.IMG_EXTENSIONS))
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for f in sorted(files):
+                    path = os.path.join(dirpath, f)
+                    ok = (is_valid_file(path) if is_valid_file
+                          else f.lower().endswith(exts))
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(f"no valid image files under {root}")
+
+    @staticmethod
+    def _default_loader(path):
+        if path.endswith(".npy"):
+            return np.load(path)
+        from ..ops.kernels.vision_ops import read_file as _rf, \
+            decode_jpeg as _dj
+
+        try:
+            return np.asarray(_dj(_rf(path)))
+        except Exception:
+            # uncompressed fallback: raw bytes as grayscale square
+            data = np.frombuffer(open(path, "rb").read(), np.uint8)
+            side = int(np.sqrt(len(data)))
+            return data[:side * side].reshape(side, side)
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(label, np.int64)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(DatasetFolder):
+    """Unlabeled flat/recursive image folder (reference ImageFolder):
+    returns [img] per item."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        exts = tuple(e.lower() for e in (extensions or self.IMG_EXTENSIONS))
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for f in sorted(files):
+                path = os.path.join(dirpath, f)
+                ok = (is_valid_file(path) if is_valid_file
+                      else f.lower().endswith(exts))
+                if ok:
+                    self.samples.append(path)
+        if not self.samples:
+            raise RuntimeError(f"no valid image files under {root}")
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Flowers(Dataset):
+    """Flowers-102 (reference datasets/flowers.py). No-egress environment:
+    a deterministic synthetic stand-in with the real label cardinality
+    (102), learnable like the synthetic MNIST/CIFAR."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend="cv2"):
+        self.transform = transform
+        n = 6149 if mode == "train" else 1020
+        self.images, self.labels = _synthetic_images(
+            n=min(n, 2048), hw=32, classes=102,
+            seed=7 if mode == "train" else 8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32)[None] / 255.0
+        return img, np.asarray(self.labels[idx], np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation (reference datasets/voc2012.py):
+    (image, segmentation mask) pairs; synthetic stand-in with 21 classes."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend="cv2"):
+        self.transform = transform
+        n = 512 if mode == "train" else 128
+        rng = np.random.RandomState(11 if mode == "train" else 12)
+        self.images = (rng.rand(n, 3, 32, 32) * 255).astype(np.uint8)
+        masks = np.zeros((n, 32, 32), np.int64)
+        for i in range(n):  # blocky class regions, mask correlates w/ image
+            cls = rng.randint(0, 21)
+            y, x = rng.randint(0, 16, 2)
+            masks[i, y:y + 16, x:x + 16] = cls
+            self.images[i, :, y:y + 16, x:x + 16] = cls * 12
+        self.masks = masks
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32) / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.masks[idx]
+
+    def __len__(self):
+        return len(self.images)

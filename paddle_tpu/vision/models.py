@@ -582,7 +582,8 @@ class DenseNet(nn.Layer):
                  num_classes=1000, with_pool=True):
         super().__init__()
         block_cfg = {121: (6, 12, 24, 16), 161: (6, 12, 36, 24),
-                     169: (6, 12, 32, 32), 201: (6, 12, 48, 32)}[layers]
+                     169: (6, 12, 32, 32), 201: (6, 12, 48, 32),
+                     264: (6, 12, 64, 48)}[layers]
         num_init = 2 * growth_rate
         feats = [nn.Conv2D(3, num_init, 7, stride=2, padding=3, bias_attr=False),
                  nn.BatchNorm2D(num_init), nn.ReLU(), nn.MaxPool2D(3, 2, 1)]
@@ -619,29 +620,30 @@ def densenet121(pretrained=False, **kwargs):
 
 
 class _ShuffleUnit(nn.Layer):
-    def __init__(self, in_c, out_c, stride):
+    def __init__(self, in_c, out_c, stride, act="relu"):
         super().__init__()
         self.stride = stride
         branch = out_c // 2
+        Act = nn.Swish if act == "swish" else nn.ReLU
         if stride == 2:
             self.branch1 = nn.Sequential(
                 nn.Conv2D(in_c, in_c, 3, stride=2, padding=1, groups=in_c,
                           bias_attr=False),
                 nn.BatchNorm2D(in_c),
                 nn.Conv2D(in_c, branch, 1, bias_attr=False),
-                nn.BatchNorm2D(branch), nn.ReLU())
+                nn.BatchNorm2D(branch), Act())
             b2_in = in_c
         else:
             self.branch1 = None
             b2_in = in_c // 2
         self.branch2 = nn.Sequential(
             nn.Conv2D(b2_in, branch, 1, bias_attr=False),
-            nn.BatchNorm2D(branch), nn.ReLU(),
+            nn.BatchNorm2D(branch), Act(),
             nn.Conv2D(branch, branch, 3, stride=stride, padding=1,
                       groups=branch, bias_attr=False),
             nn.BatchNorm2D(branch),
             nn.Conv2D(branch, branch, 1, bias_attr=False),
-            nn.BatchNorm2D(branch), nn.ReLU())
+            nn.BatchNorm2D(branch), Act())
 
     def forward(self, x):
         import paddle_tpu as paddle
@@ -658,28 +660,33 @@ class _ShuffleUnit(nn.Layer):
 class ShuffleNetV2(nn.Layer):
     """Reference: vision/models/shufflenetv2.py:1."""
 
-    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
         super().__init__()
-        stage_out = {0.5: [24, 48, 96, 192, 1024],
+        stage_out = {0.25: [24, 24, 48, 96, 512],
+                     0.33: [24, 32, 64, 128, 512],
+                     0.5: [24, 48, 96, 192, 1024],
                      1.0: [24, 116, 232, 464, 1024],
                      1.5: [24, 176, 352, 704, 1024],
                      2.0: [24, 244, 488, 976, 2048]}[scale]
         repeats = [4, 8, 4]
+        Act = nn.Swish if act == "swish" else nn.ReLU
         self.conv1 = nn.Sequential(
             nn.Conv2D(3, stage_out[0], 3, stride=2, padding=1, bias_attr=False),
-            nn.BatchNorm2D(stage_out[0]), nn.ReLU())
+            nn.BatchNorm2D(stage_out[0]), Act())
         self.maxpool = nn.MaxPool2D(3, 2, 1)
         stages = []
         in_c = stage_out[0]
         for out_c, n in zip(stage_out[1:4], repeats):
-            units = [_ShuffleUnit(in_c, out_c, 2)]
-            units += [_ShuffleUnit(out_c, out_c, 1) for _ in range(n - 1)]
+            units = [_ShuffleUnit(in_c, out_c, 2, act)]
+            units += [_ShuffleUnit(out_c, out_c, 1, act)
+                      for _ in range(n - 1)]
             stages.append(nn.Sequential(*units))
             in_c = out_c
         self.stages = nn.LayerList(stages)
         self.conv5 = nn.Sequential(
             nn.Conv2D(in_c, stage_out[4], 1, bias_attr=False),
-            nn.BatchNorm2D(stage_out[4]), nn.ReLU())
+            nn.BatchNorm2D(stage_out[4]), Act())
         self.num_classes = num_classes
         self.with_pool = with_pool
         if with_pool:
@@ -941,3 +948,43 @@ class InceptionV3(nn.Layer):
 
 def inception_v3(pretrained=False, **kwargs):
     return InceptionV3(**kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return DenseNet(layers=161, growth_rate=48, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return DenseNet(layers=169, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return DenseNet(layers=201, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return DenseNet(layers=264, **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.25, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.33, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.5, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.5, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=2.0, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, act="swish", **kwargs)

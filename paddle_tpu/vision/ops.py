@@ -76,3 +76,53 @@ def decode_jpeg(x, mode="unchanged"):
     from ..ops import api
 
     return api.decode_jpeg(x, mode=mode)
+
+
+# -- round-5 parity: remaining reference vision/ops surface -----------------
+
+from ..ops.api import (  # noqa: F401, E402
+    distribute_fpn_proposals,
+    generate_proposals,
+    matrix_nms,
+    prior_box,
+    psroi_pool,
+    yolo_box,
+    yolo_loss,
+)
+
+
+class RoIAlign(Layer):
+    """Layer twin of roi_align (reference vision/ops.py RoIAlign)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale)
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+class PSRoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        c = x.shape[1] // (self.output_size * self.output_size) \
+            if isinstance(self.output_size, int) else None
+        return psroi_pool(x, boxes, boxes_num, c, self.spatial_scale,
+                          self.output_size)

@@ -111,3 +111,506 @@ class CenterCrop:
         sl[h_ax] = slice(i, i + th)
         sl[w_ax] = slice(j, j + tw)
         return arr[tuple(sl)]
+
+
+# ---------------------------------------------------------------------------
+# Round-5 parity: the full reference transform surface
+# (python/paddle/vision/transforms/transforms.py + functional.py). Host
+# numpy implementations; geometric warps use inverse-map bilinear sampling.
+
+def _as_hwc(img):
+    """Return (arr_hwc float, was_chw, orig_dtype)."""
+    arr = np.asarray(img)
+    chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4) and arr.shape[-1] not in (1, 3, 4)
+    # ambiguous smalls (e.g. 3x3 images) default to HWC like the reference
+    if arr.ndim == 3 and arr.shape[0] in (1, 3, 4) and arr.shape[-1] in (1, 3, 4):
+        chw = False
+    if arr.ndim == 2:
+        arr = arr[..., None]
+        return arr.astype(np.float32), "gray", arr.dtype
+    if chw:
+        return arr.transpose(1, 2, 0).astype(np.float32), True, arr.dtype
+    return arr.astype(np.float32), False, arr.dtype
+
+
+def _from_hwc(arr, was_chw, dtype):
+    if was_chw == "gray":
+        out = arr[..., 0]
+    elif was_chw:
+        out = arr.transpose(2, 0, 1)
+    else:
+        out = arr
+    if np.issubdtype(dtype, np.integer):
+        out = np.clip(np.round(out), 0, 255).astype(dtype)
+    else:
+        out = out.astype(dtype)
+    return out
+
+
+def _warp(img, inv_matrix, out_size=None, fill=0.0):
+    """Inverse-map warp with bilinear sampling: out(y,x) = img(M^-1 @ (x,y,1)).
+    inv_matrix: 3x3 mapping OUTPUT pixel coords -> INPUT coords."""
+    arr, chw, dt = _as_hwc(img)
+    h, w = arr.shape[:2]
+    oh, ow = out_size or (h, w)
+    ys, xs = np.meshgrid(np.arange(oh, dtype=np.float32),
+                         np.arange(ow, dtype=np.float32), indexing="ij")
+    ones = np.ones_like(xs)
+    coords = np.stack([xs, ys, ones], 0).reshape(3, -1)
+    src = inv_matrix @ coords
+    sx = src[0] / np.maximum(src[2], 1e-9)
+    sy = src[1] / np.maximum(src[2], 1e-9)
+    x0 = np.floor(sx).astype(np.int64)
+    y0 = np.floor(sy).astype(np.int64)
+    wx = (sx - x0).astype(np.float32)[:, None]
+    wy = (sy - y0).astype(np.float32)[:, None]
+    valid = (sx >= -1) & (sx <= w) & (sy >= -1) & (sy <= h)
+
+    def at(yy, xx):
+        inb = (xx >= 0) & (xx < w) & (yy >= 0) & (yy < h)
+        v = arr[np.clip(yy, 0, h - 1), np.clip(xx, 0, w - 1)]
+        return np.where(inb[:, None], v, np.float32(fill))
+
+    out = (at(y0, x0) * (1 - wx) * (1 - wy) + at(y0, x0 + 1) * wx * (1 - wy)
+           + at(y0 + 1, x0) * (1 - wx) * wy + at(y0 + 1, x0 + 1) * wx * wy)
+    out = np.where(valid[:, None], out, np.float32(fill))
+    return _from_hwc(out.reshape(oh, ow, arr.shape[2]), chw, dt)
+
+
+# -- functional -------------------------------------------------------------
+
+def to_tensor(pic, data_format="CHW"):
+    return ToTensor(data_format)(pic)
+
+
+def hflip(img):
+    arr = np.asarray(img)
+    chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+    return np.ascontiguousarray(np.flip(arr, -1 if chw else 1))
+
+
+def vflip(img):
+    arr = np.asarray(img)
+    chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+    return np.ascontiguousarray(np.flip(arr, -2 if chw else 0))
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr = np.asarray(img)
+    if isinstance(padding, int):
+        pl = pt = pr = pb = padding
+    elif len(padding) == 2:
+        pl, pt = padding
+        pr, pb = padding
+    else:
+        pl, pt, pr, pb = padding
+    chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+    h_ax, w_ax = ((1, 2) if chw else (0, 1))
+    pads = [(0, 0)] * arr.ndim
+    pads[h_ax] = (pt, pb)
+    pads[w_ax] = (pl, pr)
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    return np.pad(arr, pads, mode=mode, **kw)
+
+
+def crop(img, top, left, height, width):
+    arr = np.asarray(img)
+    chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+    sl = [slice(None)] * arr.ndim
+    h_ax, w_ax = ((1, 2) if chw else (0, 1))
+    sl[h_ax] = slice(top, top + height)
+    sl[w_ax] = slice(left, left + width)
+    return arr[tuple(sl)]
+
+
+def center_crop(img, output_size):
+    return CenterCrop(output_size)(img)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    return Normalize(mean, std, data_format)(img)
+
+
+def adjust_brightness(img, brightness_factor):
+    arr, chw, dt = _as_hwc(img)
+    return _from_hwc(arr * brightness_factor, chw, dt)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr, chw, dt = _as_hwc(img)
+    mean = arr.mean(axis=(0, 1), keepdims=True).mean()
+    return _from_hwc(mean + contrast_factor * (arr - mean), chw, dt)
+
+
+def _rgb_to_hsv(rgb):
+    r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+    mx = np.max(rgb, -1)
+    mn = np.min(rgb, -1)
+    diff = mx - mn + 1e-9
+    h = np.zeros_like(mx)
+    m = mx == r
+    h[m] = ((g - b) / diff % 6)[m]
+    m = mx == g
+    h[m] = ((b - r) / diff + 2)[m]
+    m = mx == b
+    h[m] = ((r - g) / diff + 4)[m]
+    h = h / 6.0
+    s = np.where(mx > 0, diff / (mx + 1e-9), 0.0)
+    return np.stack([h, s, mx], -1)
+
+
+def _hsv_to_rgb(hsv):
+    h, s, v = hsv[..., 0] * 6.0, hsv[..., 1], hsv[..., 2]
+    i = np.floor(h).astype(np.int64) % 6
+    f = h - np.floor(h)
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    choices = np.stack([
+        np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+        np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+        np.stack([t, p, v], -1), np.stack([v, p, q], -1)], 0)
+    return np.take_along_axis(choices, i[None, ..., None], 0)[0]
+
+
+def adjust_hue(img, hue_factor):
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    arr, chw, dt = _as_hwc(img)
+    scale = 255.0 if arr.max() > 1.5 else 1.0
+    hsv = _rgb_to_hsv(arr / scale)
+    hsv[..., 0] = (hsv[..., 0] + hue_factor) % 1.0
+    return _from_hwc(_hsv_to_rgb(hsv) * scale, chw, dt)
+
+
+def adjust_saturation(img, saturation_factor):
+    arr, chw, dt = _as_hwc(img)
+    gray = arr.mean(-1, keepdims=True)
+    return _from_hwc(gray + saturation_factor * (arr - gray), chw, dt)
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr, chw, dt = _as_hwc(img)
+    if arr.shape[-1] >= 3:
+        g = (0.299 * arr[..., 0] + 0.587 * arr[..., 1]
+             + 0.114 * arr[..., 2])[..., None]
+    else:
+        g = arr[..., :1]
+    return _from_hwc(np.repeat(g, num_output_channels, -1), chw, dt)
+
+
+def _affine_inv(center, angle, translate, scale, shear):
+    """Inverse affine matrix for output->input mapping (reference
+    functional.py _get_inverse_affine_matrix)."""
+    import math
+
+    rot = math.radians(angle)
+    sx, sy = [math.radians(s) for s in shear]
+    cx, cy = center
+    tx, ty = translate
+    # forward: T(center) R S Shear T(-center) T(translate)
+    a = math.cos(rot - sy) / math.cos(sy)
+    b = -math.cos(rot - sy) * math.tan(sx) / math.cos(sy) - math.sin(rot)
+    c = math.sin(rot - sy) / math.cos(sy)
+    d = -math.sin(rot - sy) * math.tan(sx) / math.cos(sy) + math.cos(rot)
+    M = np.array([[a, b, 0.0], [c, d, 0.0], [0, 0, 1]], np.float64) * 1.0
+    M[:2, :2] *= scale
+    fwd = (np.array([[1, 0, cx + tx], [0, 1, cy + ty], [0, 0, 1]])
+           @ M @ np.array([[1, 0, -cx], [0, 1, -cy], [0, 0, 1]]))
+    return np.linalg.inv(fwd)
+
+
+def affine(img, angle, translate, scale, shear, interpolation="bilinear",
+           fill=0, center=None):
+    arr = np.asarray(img)
+    chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+    h, w = (arr.shape[1], arr.shape[2]) if chw else arr.shape[:2]
+    if center is None:
+        center = ((w - 1) * 0.5, (h - 1) * 0.5)
+    shear = shear if isinstance(shear, (list, tuple)) else (shear, 0.0)
+    inv = _affine_inv(center, angle, translate, scale, shear)
+    return _warp(img, inv, fill=fill)
+
+
+def rotate(img, angle, interpolation="bilinear", expand=False, center=None,
+           fill=0):
+    return affine(img, angle, (0, 0), 1.0, (0.0, 0.0), interpolation, fill,
+                  center)
+
+
+def perspective(img, startpoints, endpoints, interpolation="bilinear",
+                fill=0):
+    """Projective warp from 4 start points to 4 end points (reference
+    functional.py perspective; solve the 8-dof homography)."""
+    A = []
+    bv = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        A.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        bv.append(sx)
+        A.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        bv.append(sy)
+    coeff = np.linalg.solve(np.asarray(A, np.float64),
+                            np.asarray(bv, np.float64))
+    inv = np.append(coeff, 1.0).reshape(3, 3)
+    return _warp(img, inv, fill=fill)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    arr = np.asarray(img) if not inplace else img
+    out = arr if inplace else arr.copy()
+    chw = out.ndim == 3 and out.shape[0] in (1, 3, 4)
+    if chw:
+        out[:, i:i + h, j:j + w] = v
+    else:
+        out[i:i + h, j:j + w] = v
+    return out
+
+
+# -- transform classes ------------------------------------------------------
+
+class BaseTransform:
+    """Reference transforms.py BaseTransform: keys route inputs to
+    _apply_image/_apply_boxes/...; subclasses override _apply_image."""
+
+    def __init__(self, keys=None):
+        self.keys = keys or ("image",)
+
+    def _apply_image(self, img):
+        return img
+
+    def __call__(self, inputs):
+        if isinstance(inputs, (list, tuple)) and len(self.keys) > 1:
+            return tuple(
+                getattr(self, f"_apply_{k}", lambda x: x)(v)
+                for k, v in zip(self.keys, inputs))
+        return self._apply_image(inputs)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = tuple(order)
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[..., None]
+        return arr.transpose(self.order)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            return vflip(img)
+        return img
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _factor(self):
+        return np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+
+    def _apply_image(self, img):
+        return adjust_brightness(img, self._factor()) if self.value else img
+
+
+class ContrastTransform(BrightnessTransform):
+    def _apply_image(self, img):
+        return adjust_contrast(img, self._factor()) if self.value else img
+
+
+class SaturationTransform(BrightnessTransform):
+    def _apply_image(self, img):
+        return adjust_saturation(img, self._factor()) if self.value else img
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if not self.value:
+            return img
+        return adjust_hue(img, np.random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.ts = [BrightnessTransform(brightness),
+                   ContrastTransform(contrast),
+                   SaturationTransform(saturation), HueTransform(hue)]
+
+    def _apply_image(self, img):
+        order = np.random.permutation(len(self.ts))
+        for i in order:
+            img = self.ts[i]._apply_image(img)
+        return img
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.args = (padding, fill, padding_mode)
+
+    def _apply_image(self, img):
+        return pad(img, *self.args)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        h, w = (arr.shape[1], arr.shape[2]) if chw else arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = np.random.randint(0, h - ch + 1)
+                left = np.random.randint(0, w - cw + 1)
+                return resize(crop(img, top, left, ch, cw), self.size,
+                              self.interpolation)
+        return resize(CenterCrop((min(h, w), min(h, w)))(img), self.size,
+                      self.interpolation)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="bilinear", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, (int, float)):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.kw = dict(interpolation=interpolation, center=center, fill=fill)
+
+    def _apply_image(self, img):
+        return rotate(img, np.random.uniform(*self.degrees), **self.kw)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="bilinear", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, (int, float)):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.translate = translate
+        self.scale_rng = scale
+        self.shear = shear
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        h, w = (arr.shape[1], arr.shape[2]) if chw else arr.shape[:2]
+        angle = np.random.uniform(*self.degrees)
+        tr = (0, 0)
+        if self.translate:
+            tr = (np.random.uniform(-self.translate[0], self.translate[0]) * w,
+                  np.random.uniform(-self.translate[1], self.translate[1]) * h)
+        sc = np.random.uniform(*self.scale_rng) if self.scale_rng else 1.0
+        sh = (0.0, 0.0)
+        if self.shear is not None:
+            s = self.shear
+            if isinstance(s, (int, float)):
+                sh = (np.random.uniform(-abs(s), abs(s)), 0.0)
+            elif len(s) == 2:
+                sh = (np.random.uniform(s[0], s[1]), 0.0)
+            else:
+                sh = (np.random.uniform(s[0], s[1]),
+                      np.random.uniform(s[2], s[3]))
+        return affine(img, angle, tr, sc, sh, fill=self.fill,
+                      center=self.center)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="bilinear", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        h, w = (arr.shape[1], arr.shape[2]) if chw else arr.shape[:2]
+        d = self.distortion_scale
+        dx, dy = int(d * w / 2), int(d * h / 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [(np.random.randint(0, dx + 1), np.random.randint(0, dy + 1)),
+               (w - 1 - np.random.randint(0, dx + 1),
+                np.random.randint(0, dy + 1)),
+               (w - 1 - np.random.randint(0, dx + 1),
+                h - 1 - np.random.randint(0, dy + 1)),
+               (np.random.randint(0, dx + 1),
+                h - 1 - np.random.randint(0, dy + 1))]
+        return perspective(img, start, end, fill=self.fill)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class RandomErasing(BaseTransform):
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        h, w = (arr.shape[1], arr.shape[2]) if chw else arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.random.uniform(*self.ratio)
+            eh = int(round(np.sqrt(target * ar)))
+            ew = int(round(np.sqrt(target / ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh + 1)
+                j = np.random.randint(0, w - ew + 1)
+                return erase(arr, i, j, eh, ew, self.value, self.inplace)
+        return img

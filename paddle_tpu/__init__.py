@@ -114,6 +114,7 @@ from .framework.containers import (  # noqa: F401, E402
 )
 from .hapi.model import Model, summary  # noqa: F401, E402
 from .api_extra import *  # noqa: F401, F403, E402 (reference __all__ parity)
+tensor_methods._install_extra_methods()
 
 # top-level inplace twins (paddle.tanh_(x) etc. — reference exposes the
 # method AND a function for each inplace op)

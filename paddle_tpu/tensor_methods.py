@@ -146,3 +146,40 @@ def _coerce_index(idx):
 
 
 install()
+
+
+def _install_extra_methods():
+    """Reference tensor_method_func entries backed by api_extra/linalg
+    (installed lazily at first paddle_tpu import — api_extra imports this
+    module's Tensor surface, so binding happens post-install)."""
+    from . import api_extra as X
+    from .linalg import multi_dot, pca_lowrank
+
+    for name in ("floor_mod", "broadcast_shape", "is_tensor", "scatter_nd",
+                 "tensordot", "is_complex", "is_integer",
+                 "is_floating_point", "polar", "create_parameter"):
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, getattr(X, name))
+    Tensor.multi_dot = multi_dot
+    Tensor.pca_lowrank = pca_lowrank
+
+    def create_tensor(self, dtype=None, name=None, persistable=False):
+        import jax.numpy as jnp
+
+        return Tensor(jnp.zeros((), dtype or self._value.dtype))
+
+    Tensor.create_tensor = create_tensor
+
+    def uniform_(self, min=-1.0, max=1.0, seed=0):  # noqa: A002
+        out = api.uniform(list(self.shape), min=min, max=max,
+                          dtype=str(self.dtype))
+        self._value = out._value
+        return self
+
+    def exponential_(self, lam=1.0):
+        out = api.exponential(self, lam=lam)
+        self._value = out._value.astype(self._value.dtype)
+        return self
+
+    Tensor.uniform_ = uniform_
+    Tensor.exponential_ = exponential_

@@ -187,3 +187,30 @@ def test_zoo_variant_factories():
     d = M.DenseNet(layers=264, growth_rate=4, num_classes=3)
     d.eval()
     assert tuple(d(x).shape) == (1, 3)
+
+
+def test_tensor_method_parity():
+    """Every reference tensor_method_func name is a Tensor method."""
+    path = pathlib.Path(R + "tensor/__init__.py")
+    if not path.exists():
+        pytest.skip("reference absent")
+    names = None
+    for node in ast.walk(ast.parse(path.read_text())):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "tensor_method_func":
+                    names = [ast.literal_eval(e) for e in node.value.elts]
+    assert names
+    from paddle_tpu.core.tensor import Tensor
+
+    missing = [n for n in names if not hasattr(Tensor, n)]
+    assert missing == [], missing
+
+
+def test_inplace_random_methods():
+    x = paddle.to_tensor(np.zeros((64,), np.float32))
+    out = x.uniform_(0.0, 1.0)
+    assert out is x and (x.numpy() >= 0).all() and (x.numpy() <= 1).all()
+    y = paddle.to_tensor(np.zeros((2000,), np.float32))
+    y.exponential_(4.0)
+    assert abs(float(y.numpy().mean()) - 0.25) < 0.05

@@ -116,3 +116,4 @@ from .ps import (  # noqa: F401,E402
     ProbabilityEntry,
     ShowClickEntry,
 )
+from . import passes  # noqa: F401,E402

@@ -117,3 +117,160 @@ def distributed_model(model):
 
 def distributed_optimizer(optimizer, strategy=None):
     return fleet.distributed_optimizer(optimizer, strategy)
+
+
+# -- round-5 parity: role makers, util base, data generators ----------------
+
+Fleet = _Fleet  # reference exports the class alongside the singleton
+
+
+class Role:
+    """Reference fleet/base/role_maker.py Role enum values."""
+
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class PaddleCloudRoleMaker:
+    """Env-var role maker (reference role_maker.py PaddleCloudRoleMaker):
+    reads the launcher's PADDLE_* environment, the same contract
+    distributed.launch writes."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        import os
+
+        self._is_collective = is_collective
+        self._rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self._size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self._endpoints = os.environ.get(
+            "PADDLE_TRAINER_ENDPOINTS", "127.0.0.1:0").split(",")
+        self._server_endpoints = [
+            e for e in os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST",
+                                      "").split(",") if e]
+        self._role = (Role.SERVER if os.environ.get("TRAINING_ROLE")
+                      == "PSERVER" else Role.WORKER)
+
+    def _worker_index(self):
+        return self._rank
+
+    def _worker_num(self):
+        return self._size
+
+    def _is_worker(self):
+        return self._role == Role.WORKER
+
+    def _is_server(self):
+        return self._role == Role.SERVER
+
+    def _is_first_worker(self):
+        return self._is_worker() and self._rank == 0
+
+    worker_index = _worker_index
+    worker_num = _worker_num
+    is_worker = _is_worker
+    is_server = _is_server
+    is_first_worker = _is_first_worker
+
+    def get_trainer_endpoints(self):
+        return self._endpoints
+
+    def get_pserver_endpoints(self):
+        return self._server_endpoints
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """Explicit-args role maker (reference UserDefinedRoleMaker)."""
+
+    def __init__(self, is_collective=False, current_id=0, role=Role.WORKER,
+                 worker_num=0, server_endpoints=None, **kwargs):
+        self._is_collective = is_collective
+        self._rank = current_id
+        self._size = worker_num
+        self._role = role
+        self._endpoints = []
+        self._server_endpoints = list(server_endpoints or [])
+
+
+class UtilBase:
+    """Cross-worker host utilities (reference fleet/base/util_factory.py):
+    object collectives + file sharding."""
+
+    def all_reduce(self, value, mode="sum"):
+        from ..objects import all_gather_object
+
+        vals = []
+        all_gather_object(vals, value)
+        if mode == "sum":
+            return sum(vals)
+        if mode == "max":
+            return max(vals)
+        if mode == "min":
+            return min(vals)
+        raise ValueError(f"unknown mode {mode!r}")
+
+    def barrier(self):
+        from ..objects import gloo_barrier
+
+        gloo_barrier()
+
+    def all_gather(self, value):
+        from ..objects import all_gather_object
+
+        out = []
+        all_gather_object(out, value)
+        return out
+
+    def get_file_shard(self, files):
+        """Rank-strided file split (reference util.get_file_shard)."""
+        from ..env import get_rank, get_world_size
+
+        return list(files)[get_rank()::get_world_size()]
+
+    def print_on_rank(self, message, rank_id=0):
+        from ..env import get_rank
+
+        if get_rank() == rank_id:
+            print(message)
+
+
+class MultiSlotDataGenerator:
+    """Slot-format data generator (reference
+    distributed/fleet/data_generator/data_generator.py): subclasses
+    implement generate_sample(line) yielding [(slot_name, [ints/floats]),
+    ...]; run_from_* emit the text slot format InMemoryDataset parses."""
+
+    def __init__(self):
+        self._proto_info = None
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "implement generate_sample(self, line) -> iterator")
+
+    def _format(self, record):
+        parts = []
+        for _name, values in record:
+            vals = values if isinstance(values, (list, tuple)) else [values]
+            parts.append(str(len(vals)))
+            parts.extend(str(v) for v in vals)
+        return " ".join(parts)
+
+    def run_from_memory(self, lines=()):
+        out = []
+        for line in lines or [None]:
+            for record in self.generate_sample(line)():
+                out.append(self._format(record))
+        return "\n".join(out)
+
+    def run_from_stdin(self):
+        import sys
+
+        for line in sys.stdin:
+            for record in self.generate_sample(line)():
+                sys.stdout.write(self._format(record) + "\n")
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    """String-valued slots (reference MultiSlotStringDataGenerator)."""

@@ -1,0 +1,97 @@
+"""paddle.distributed.passes (reference python/paddle/distributed/passes/):
+the pass-registry surface. The reference rewrites static ProgramDesc IR;
+here passes rewrite the op-tape Program (static/__init__.py) — each pass
+is a callable (program, context) -> None mutating the tape, registered by
+name, applied in order by PassManager."""
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+__all__ = ["new_pass", "PassManager", "PassContext"]
+
+_PASS_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_pass(name: str):
+    """Decorator registering a pass factory (reference
+    passes/pass_base.py register_pass)."""
+    def deco(fn):
+        _PASS_REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+class PassContext:
+    """Carries attributes between passes (reference PassContext)."""
+
+    def __init__(self):
+        self._attrs: Dict[str, object] = {}
+
+    def set_attr(self, key, value):
+        self._attrs[key] = value
+
+    def get_attr(self, key, default=None):
+        return self._attrs.get(key, default)
+
+
+class _Pass:
+    def __init__(self, name, fn, attrs):
+        self.name = name
+        self._fn = fn
+        self._attrs = dict(attrs)
+
+    def set_attr(self, key, value):
+        self._attrs[key] = value
+        return self
+
+    def apply(self, programs, context=None):
+        context = context or PassContext()
+        progs = programs if isinstance(programs, (list, tuple)) \
+            else [programs]
+        for prog in progs:
+            self._fn(prog, context, **self._attrs)
+        return context
+
+
+def new_pass(name: str, pass_attrs=None) -> _Pass:
+    if name not in _PASS_REGISTRY:
+        raise ValueError(
+            f"pass {name!r} is not registered; known: "
+            f"{sorted(_PASS_REGISTRY)}")
+    return _Pass(name, _PASS_REGISTRY[name], pass_attrs or {})
+
+
+class PassManager:
+    """Ordered pass application (reference passes/pass_base.py
+    PassManager)."""
+
+    def __init__(self, passes: List[_Pass]):
+        self._passes = list(passes)
+
+    def apply(self, programs, context=None):
+        context = context or PassContext()
+        for p in self._passes:
+            p.apply(programs, context)
+        return context
+
+    @property
+    def names(self):
+        return [p.name for p in self._passes]
+
+
+@register_pass("fuse_elewise_add_act")
+def _fuse_elewise_add_act(program, context, **attrs):
+    """No-op tape pass recorded for parity: XLA performs elementwise+act
+    fusion during compilation; the pass exists so reference pass lists
+    apply cleanly."""
+    context.set_attr("fuse_elewise_add_act", True)
+
+
+@register_pass("remove_dropout")
+def _remove_dropout(program, context, **attrs):
+    """Strip dropout ops from an inference tape (a REAL tape rewrite)."""
+    program._ops[:] = [
+        rec for rec in program._ops
+        if getattr(rec.opdef, "name", "") not in
+        ("dropout", "dropout2d", "dropout3d")]

@@ -217,3 +217,9 @@ def shutdown():
     if _agent is not None:
         _agent.shutdown()
         _agent = None
+
+
+def get_current_worker_info():
+    """This process's own WorkerInfo (reference rpc/api.py
+    get_current_worker_info)."""
+    return get_worker_info(None)

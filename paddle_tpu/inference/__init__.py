@@ -180,3 +180,122 @@ class GenerationPredictor:
 
 __all__ = ["Config", "Predictor", "PredictorTensor", "create_predictor",
            "GenerationPredictor"]
+
+
+# -- round-5 parity: enums + pool + conversion utilities --------------------
+
+import enum as _enum
+
+
+class DataType(_enum.Enum):
+    """Reference paddle_infer.DataType."""
+
+    FLOAT32 = 0
+    FLOAT16 = 1
+    INT64 = 2
+    INT32 = 3
+    UINT8 = 4
+    INT8 = 5
+    BOOL = 6
+    BFLOAT16 = 7
+    FLOAT64 = 8
+
+
+class PlaceType(_enum.Enum):
+    """Reference paddle_infer.PlaceType; kCUSTOM covers the TPU device."""
+
+    UNK = -1
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM = 3
+
+
+class PrecisionType(_enum.Enum):
+    Float32 = 0
+    Half = 1
+    Int8 = 2
+    Bfloat16 = 3
+
+
+def get_version() -> str:
+    from .. import __version__
+
+    return f"paddle_tpu inference {__version__} (StableHLO artifacts)"
+
+
+def get_num_bytes_of_data_type(dtype: "DataType") -> int:
+    return {DataType.FLOAT32: 4, DataType.FLOAT16: 2, DataType.INT64: 8,
+            DataType.INT32: 4, DataType.UINT8: 1, DataType.INT8: 1,
+            DataType.BOOL: 1, DataType.BFLOAT16: 2,
+            DataType.FLOAT64: 8}[dtype]
+
+
+def get_trt_compile_version():
+    """No TensorRT in an XLA/TPU serving stack (README descopes)."""
+    return (0, 0, 0)
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def _get_phi_kernel_name(op_name: str) -> str:
+    """Registry name passthrough (legacy-alias resolution happens at
+    registration time here)."""
+    return op_name
+
+
+def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
+                               mixed_params_file, mixed_precision=None,
+                               backend=None, keep_io_types=True,
+                               black_list=None, **kwargs):
+    """Rewrite a saved artifact's weights to bf16 (reference
+    convert_to_mixed_precision rewrites the program+params to fp16/bf16).
+    StableHLO artifacts carry weights inline, so this re-exports the
+    loaded callable with a bf16 cast wrapper is not possible post-hoc;
+    instead the weight-only path (quantization.quantize_for_generation)
+    covers serving-time precision. This utility converts separate
+    .pdparams sidecars when present."""
+    import shutil
+
+    import numpy as np
+
+    from ..framework.io import load as _load, save as _save
+
+    shutil.copyfile(model_file, mixed_model_file)
+    try:
+        state = _load(params_file)
+    except Exception:
+        shutil.copyfile(params_file, mixed_params_file)
+        return
+    for k, v in state.items():
+        arr = v.numpy() if hasattr(v, "numpy") else np.asarray(v)
+        if arr.dtype == np.float32:
+            state[k] = arr.astype("bfloat16" if mixed_precision in
+                                  (None, "bfloat16", PrecisionType.Bfloat16)
+                                  else np.float16)
+    _save(state, mixed_params_file)
+
+
+class XpuConfig:
+    """Kunlun config shell (reference XpuConfig); accepted by Config for
+    API compat, inert on TPU."""
+
+    def __init__(self, **kwargs):
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+
+class PredictorPool:
+    """N independent predictors over one Config (reference
+    paddle_infer.PredictorPool for multi-stream serving; here each
+    predictor is an independent compiled executable handle)."""
+
+    def __init__(self, config: Config, size: int = 1):
+        self._preds = [create_predictor(config) for _ in range(size)]
+
+    def retrive(self, idx: int) -> Predictor:  # reference spells it this way
+        return self._preds[idx]
+
+    retrieve = retrive

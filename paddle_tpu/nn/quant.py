@@ -1,0 +1,21 @@
+"""paddle.nn.quant (reference python/paddle/nn/quant/): the Stub layer —
+a placeholder that QAT replaces with a quanter observer in-place."""
+from __future__ import annotations
+
+from .layer import Layer
+
+__all__ = ["Stub"]
+
+
+class Stub(Layer):
+    """Quantization stub (reference nn/quant/stub.py Stub): identity until
+    the QAT pass swaps in the configured fake-quant observer."""
+
+    def __init__(self, observer=None):
+        super().__init__()
+        self._observer = observer
+
+    def forward(self, x):
+        if self._observer is not None:
+            return self._observer(x)
+        return x

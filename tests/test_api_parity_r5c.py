@@ -214,3 +214,63 @@ def test_inplace_random_methods():
     y = paddle.to_tensor(np.zeros((2000,), np.float32))
     y.exponential_(4.0)
     assert abs(float(y.numpy().mean()) - 0.25) < 0.05
+
+
+def test_fleet_rpc_passes_inference_parity():
+    import paddle_tpu.distributed as dist
+
+    for m, path in [
+        (dist.fleet, R + "distributed/fleet/__init__.py"),
+        (dist.rpc, R + "distributed/rpc/__init__.py"),
+        (dist.passes, R + "distributed/passes/__init__.py"),
+        (paddle.nn.quant, R + "nn/quant/__init__.py"),
+        (paddle.inference, R + "inference/__init__.py"),
+    ]:
+        ref = _ref_all(path)
+        if ref is None:
+            continue
+        missing = [n for n in ref if not hasattr(m, n)]
+        assert missing == [], f"{m.__name__}: {missing}"
+
+
+def test_pass_manager_rewrites_tape():
+    from paddle_tpu.distributed import passes
+    import paddle_tpu.static as static
+
+    paddle.enable_static()
+    try:
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2, 4])
+            paddle.nn.functional.dropout(paddle.tanh(x), 0.5)
+        n0 = prog.num_ops()
+        passes.PassManager([passes.new_pass("remove_dropout")]).apply(prog)
+        assert prog.num_ops() == n0 - 1
+    finally:
+        paddle.disable_static()
+    with pytest.raises(ValueError):
+        passes.new_pass("not_a_pass")
+
+
+def test_fleet_role_maker_and_util():
+    F = paddle.distributed.fleet
+    rm = F.PaddleCloudRoleMaker()
+    assert rm.is_worker() and rm.worker_num() >= 1
+    u = F.UtilBase()
+    assert u.get_file_shard(["a", "b", "c"]) == ["a", "b", "c"]
+    assert u.all_reduce(5, "sum") == 5  # single process
+
+    class Gen(F.MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def it():
+                yield [("d", [1.0]), ("s", [3, 4])]
+
+            return it
+
+    assert Gen().run_from_memory(["x"]) == "1 1.0 2 3 4"
+
+
+def test_inference_pool_and_bytes():
+    I = paddle.inference
+    assert I.get_num_bytes_of_data_type(I.DataType.FLOAT32) == 4
+    assert I.get_trt_compile_version() == (0, 0, 0)

@@ -14,7 +14,11 @@ class SGD(Optimizer):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
 
     def _update(self, p, g, state, lr):
-        return p - lr * g.astype(p.dtype), {}
+        # compute in fp32 and cast back: `lr` is an fp32 scalar, and jax
+        # promotion would otherwise silently upcast a bf16 (O2) param to
+        # fp32 on the first step
+        new_p = p.astype(jnp.float32) - lr * g.astype(jnp.float32)
+        return new_p.astype(p.dtype), {}
 
     def _update_sparse(self, p, sr, state, lr):
         """Rows-only SGD (reference phi/kernels/selected_rows/
@@ -50,7 +54,10 @@ class Momentum(Optimizer):
             step = g.astype(jnp.float32) + self._momentum * v
         else:
             step = v
-        return (p - lr * step.astype(p.dtype)), {"velocity": v}
+        # fp32 math, cast back (see SGD._update: fp32-lr promotion would
+        # leak the param to fp32 under O2)
+        return (p.astype(jnp.float32) - lr * step).astype(p.dtype), \
+            {"velocity": v}
 
 
 class Adam(Optimizer):

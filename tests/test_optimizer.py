@@ -232,3 +232,24 @@ class TestFleetMetaOptimizers:
             return np.asarray(w._value)
 
         np.testing.assert_allclose(run(True), run(False), rtol=1e-5, atol=1e-6)
+
+
+def test_sgd_momentum_preserve_bf16_param_dtype():
+    """An fp32 lr scalar must not promote O2 (bf16) params to fp32 on
+    update — the leak broke static-program retraces on step 2."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import amp
+
+    for opt_cls in (paddle.optimizer.SGD, paddle.optimizer.Momentum):
+        paddle.seed(0)
+        m = paddle.nn.Linear(4, 2)
+        m, opt = amp.decorate(
+            m, opt_cls(0.1, parameters=m.parameters()),
+            level="O2", dtype="bfloat16")
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        loss = m(paddle.cast(x, "bfloat16")).sum()
+        loss.backward()
+        opt.step()
+        assert str(m.weight._value.dtype) == "bfloat16", opt_cls

@@ -57,6 +57,7 @@ run_item() {  # $1 = item name; rc!=0 -> keep the item queued
     moebench)   timeout 2400 python tools/moebench.py --out MOEBENCH_r05.json ;;
     decodebench) timeout 2400 python tools/decodebench.py --preset large ;;
     sparsebench) timeout 1200 env SPARSEBENCH_TPU=1 python tools/sparsebench.py ;;
+    modelbench) timeout 3600 python tools/modelbench.py ;;
     *) echo "unknown item $1" >&2; return 1 ;;
   esac
 }
@@ -66,7 +67,7 @@ for i in $(seq 1 200); do
   # match actual tool invocations only — a shell whose COMMAND TEXT mentions
   # a tool name (e.g. the operator editing this queue via heredoc) must not
   # read as a chip holder
-  if pgrep -f "python tools/(mfu_probe|opbench|moebench|tpu_smoke|decodebench|sparsebench|profile_step)" > /dev/null; then
+  if pgrep -f "python tools/(mfu_probe|opbench|moebench|tpu_smoke|decodebench|sparsebench|profile_step|modelbench)" > /dev/null; then
     echo "[$(date -u +%T)] chip busy (another tool), waiting" >> "$LOG"; sleep 600; continue
   fi
   probe; rc=$?

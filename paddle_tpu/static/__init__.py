@@ -202,6 +202,9 @@ class Executor:
             id(program), program.num_ops(), train,
             tuple(feed_names),
             tuple((v.shape, str(v.dtype)) for v in feed_vals),
+            # two runs fetching different variables need different
+            # compiled programs — the fetch set is part of the identity
+            tuple(id(f) for f in fetch_list if isinstance(f, Tensor)),
         )
         fn = self._cache.get(key)
         if fn is None:
@@ -256,7 +259,12 @@ class Executor:
                 param_vals, grads, opt_state, lr)
             return [env[i] for i in fetch_ids], new_p, new_s
 
-        return jax.jit(run_fn)
+        # donate params + optimizer state: the training step overwrites
+        # both, so XLA can update in place instead of allocating a second
+        # copy of every parameter/moment buffer each step (TrainStep does
+        # the same for the dygraph path)
+        return jax.jit(run_fn, donate_argnums=(1, 2) if optimizer
+                       else ())
 
     def close(self):
         pass

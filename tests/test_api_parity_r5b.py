@@ -410,3 +410,56 @@ def test_hub_local_repo(tmp_path):
     assert "toy" in paddle.hub.list(str(tmp_path))
     assert "toy entrypoint" in paddle.hub.help(str(tmp_path), "toy")
     assert paddle.hub.load(str(tmp_path), "toy", scale=3) == {"scale": 3}
+
+
+def test_executor_fetch_list_not_cache_aliased():
+    """Two runs with different fetch_lists must not share a compiled
+    program (regression: the cache key omitted the fetch set)."""
+    paddle.enable_static()
+    try:
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2])
+            a = paddle.scale(x, 2.0)
+            b = paddle.scale(x, 3.0)
+        exe = static.Executor()
+        feed = {"x": np.ones(2, np.float32)}
+        r1 = exe.run(prog, feed=feed, fetch_list=[a])
+        r2 = exe.run(prog, feed=feed, fetch_list=[b])
+        r3 = exe.run(prog, feed=feed, fetch_list=[b, a])
+        assert np.allclose(r1[0], 2.0) and np.allclose(r2[0], 3.0)
+        assert np.allclose(r3[0], 3.0) and np.allclose(r3[1], 2.0)
+    finally:
+        paddle.disable_static()
+
+
+def test_executor_training_with_donation_stays_stable():
+    """Donated param/opt-state buffers: multi-step static training keeps
+    decreasing loss and param dtype (bf16 O2) across retraces."""
+    from paddle_tpu import amp
+
+    paddle.seed(0)
+    m = paddle.nn.Linear(8, 1)
+    m, opt = amp.decorate(
+        m, paddle.optimizer.Momentum(0.05, parameters=m.parameters()),
+        level="O2", dtype="bfloat16")
+    paddle.enable_static()
+    try:
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [16, 8])
+            y = static.data("y", [16, 1])
+            pred = m(paddle.cast(x, "bfloat16"))
+            loss = paddle.mean(paddle.square(
+                paddle.subtract(paddle.cast(pred, "float32"), y)))
+            opt.minimize(loss)
+        exe = static.Executor()
+        rng = np.random.RandomState(0)
+        feed = {"x": rng.randn(16, 8).astype(np.float32),
+                "y": rng.randn(16, 1).astype(np.float32)}
+        losses = [float(exe.run(prog, feed=feed, fetch_list=[loss])[0])
+                  for _ in range(8)]
+        assert losses[-1] < losses[0]
+        assert str(m.weight._value.dtype) == "bfloat16"
+    finally:
+        paddle.disable_static()

@@ -90,8 +90,31 @@ def _fuse_elewise_add_act(program, context, **attrs):
 
 @register_pass("remove_dropout")
 def _remove_dropout(program, context, **attrs):
-    """Strip dropout ops from an inference tape (a REAL tape rewrite)."""
-    program._ops[:] = [
-        rec for rec in program._ops
-        if getattr(rec.opdef, "name", "") not in
-        ("dropout", "dropout2d", "dropout3d")]
+    """Strip dropout ops from an inference tape — a REAL tape rewrite:
+    consumers of each dropout OUTPUT are rewired to its INPUT tensor, so
+    replay flows the live value instead of the stale trace-time constant
+    the env-fallback would otherwise read."""
+    from paddle_tpu.core.tensor import Tensor
+
+    replace = {}  # id(dropout output) -> its input Tensor
+    kept = []
+    for rec in program._ops:
+        if getattr(rec.opdef, "name", "") in ("dropout", "dropout2d",
+                                              "dropout3d"):
+            src = next(l for l in rec.leaves if isinstance(l, Tensor))
+            # chase chains of removed ops (dropout-of-dropout)
+            src = replace.get(id(src), src)
+            for out in rec.out_tensors:
+                replace[id(out)] = src
+            continue
+        if replace and any(isinstance(l, Tensor) and id(l) in replace
+                           for l in rec.leaves):
+            # new record, not in-place: records are SHARED with the
+            # program this one was cloned from, and the training tape
+            # must keep its dropout wiring
+            new_leaves = [replace.get(id(l), l) if isinstance(l, Tensor)
+                          else l for l in rec.leaves]
+            rec = type(rec)(rec.opdef, new_leaves, rec.treedef,
+                            rec.out_tensors)
+        kept.append(rec)
+    program._ops[:] = kept

@@ -20,7 +20,8 @@ memory_efficient_attention = scaled_dot_product_attention
 
 def fused_multi_head_attention(x, qkv_weight, qkv_bias, linear_weight,
                                linear_bias, num_heads, dropout_p=0.0,
-                               is_causal=False, training=True):
+                               is_causal=False, training=True,
+                               attn_mask=None):
     """Reference: incubate.nn.functional.fused_multi_head_attention
     (fused_attention_op.cu). QKV projection + SDPA + out projection; XLA fuses
     the projections into the attention kernel's neighborhood."""
@@ -36,7 +37,8 @@ def fused_multi_head_attention(x, qkv_weight, qkv_bias, linear_weight,
     k = api.squeeze(api.slice(qkv, axes=[2], starts=[1], ends=[2]), axis=[2])
     v = api.squeeze(api.slice(qkv, axes=[2], starts=[2], ends=[3]), axis=[2])
     out = api.scaled_dot_product_attention(
-        q, k, v, dropout_p=dropout_p, is_causal=is_causal, training=training
+        q, k, v, attn_mask=attn_mask, dropout_p=dropout_p,
+        is_causal=is_causal, training=training
     )
     out = api.reshape(out, [b, s, d])
     out = api.matmul(out, linear_weight)

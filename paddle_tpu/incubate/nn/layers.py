@@ -114,11 +114,16 @@ class FusedMultiHeadAttention(Layer):
 
     def forward(self, query, key=None, value=None, attn_mask=None,
                 cache=None):
+        if key is not None and key is not query:
+            raise NotImplementedError(
+                "FusedMultiHeadAttention packs QKV from one input "
+                "(reference fused_attention op is self-attention only); "
+                "use nn.MultiHeadAttention for cross-attention")
         residual = query
         x = self._ln(query) if self.normalize_before else query
         out = FF.fused_multi_head_attention(
             x, self.qkv_weight, self.qkv_bias, self.linear_weight,
-            self.linear_bias, self.num_heads,
+            self.linear_bias, self.num_heads, attn_mask=attn_mask,
             dropout_p=self.attn_dropout_rate, training=self.training)
         out = F.dropout(out, self.dropout_rate, training=self.training)
         out = api.add(out, residual)

@@ -171,8 +171,10 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
     lengths = getattr(states, "lengths", None)
     final_outputs, final_states = decoder.finalize(outputs, states, lengths)
     if not output_time_major and isinstance(final_outputs, Tensor):
-        perm = [1, 2, 0] if final_outputs.ndim == 3 else None
-        if perm:
+        # reference _transpose_batch_time: swap ONLY time<->batch, giving
+        # [batch, time, beam]
+        if final_outputs.ndim >= 2:
+            perm = [1, 0] + list(range(2, final_outputs.ndim))
             final_outputs = api.transpose(final_outputs, perm)
     if return_length:
         return final_outputs, final_states, Tensor(jnp.asarray(

@@ -43,6 +43,7 @@ def weight_norm(layer, name="weight", dim=0):
 
     handle = layer.register_forward_pre_hook(_recompute)
     layer.__dict__[name + "_wn_hook"] = handle
+    layer.__dict__[name + "_wn_dim"] = dim
     _recompute(layer, ())
     return layer
 
@@ -54,8 +55,10 @@ def remove_weight_norm(layer, name="weight"):
     hook = layer.__dict__.pop(name + "_wn_hook", None)
     if hook is not None:
         hook.remove()
+    # fold back along the SAME dim the hook normalized over
+    dim = layer.__dict__.pop(name + "_wn_dim", 0)
     dimless = g._value.ndim == 0
-    norm = _norm_except(v._value, None if dimless else 0)
+    norm = _norm_except(v._value, None if dimless else dim)
     w = Parameter(g._value * v._value / jnp.maximum(norm, 1e-12))
     for suffix in ("_v", "_g"):
         layer._parameters.pop(name + suffix, None)

@@ -104,10 +104,12 @@ def fft_c2r(x, axes=(-1,), normalization="backward", forward=False,
 
 
 def hfft2(x, s=None, axes=(-2, -1), norm="backward"):
-    """2-D hermitian c2r fft (reference fft.py hfft2 = fft_c2r over the
-    last axis composed with c2c over the leading one); inverse of ihfft2."""
-    x = jnp.fft.ifft(x, n=None if s is None else s[0], axis=axes[0],
-                     norm=norm)
+    """2-D hermitian c2r fft: FORWARD c2c over the leading axis, then the
+    c2r hfft over the last — matches scipy/paddle hfft2 exactly (an
+    earlier draft used ifft on the leading axis, which is its own inverse
+    pair but disagrees with the reference by construction)."""
+    x = jnp.fft.fft(x, n=None if s is None else s[0], axis=axes[0],
+                    norm=norm)
     return jnp.fft.hfft(x, n=None if s is None else s[1], axis=axes[1],
                         norm=norm)
 
@@ -115,15 +117,15 @@ def hfft2(x, s=None, axes=(-2, -1), norm="backward"):
 def ihfft2(x, s=None, axes=(-2, -1), norm="backward"):
     out = jnp.fft.ihfft(x, n=None if s is None else s[1], axis=axes[1],
                         norm=norm)
-    return jnp.fft.fft(out, n=None if s is None else s[0], axis=axes[0],
-                       norm=norm)
+    return jnp.fft.ifft(out, n=None if s is None else s[0], axis=axes[0],
+                        norm=norm)
 
 
 def hfftn(x, s=None, axes=None, norm="backward"):
     ax = tuple(axes) if axes is not None else tuple(range(-x.ndim, 0))
     pre, last = ax[:-1], ax[-1]
     for i, a in enumerate(pre):
-        x = jnp.fft.ifft(x, n=None if s is None else s[i], axis=a, norm=norm)
+        x = jnp.fft.fft(x, n=None if s is None else s[i], axis=a, norm=norm)
     return jnp.fft.hfft(x, n=None if s is None else s[-1], axis=last,
                         norm=norm)
 
@@ -133,6 +135,6 @@ def ihfftn(x, s=None, axes=None, norm="backward"):
     out = jnp.fft.ihfft(x, n=None if s is None else s[-1], axis=ax[-1],
                         norm=norm)
     for i, a in enumerate(ax[:-1]):
-        out = jnp.fft.fft(out, n=None if s is None else s[i], axis=a,
-                          norm=norm)
+        out = jnp.fft.ifft(out, n=None if s is None else s[i], axis=a,
+                           norm=norm)
     return out

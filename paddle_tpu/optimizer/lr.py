@@ -277,7 +277,6 @@ class MultiplicativeDecay(LRScheduler):
     def get_lr(self):
         if self.last_epoch <= 0:
             return self.base_lr
-        cur = self.base_lr
-        for i in range(1, self.last_epoch + 1):
-            cur = cur * self._lr_lambda(i)
-        return cur
+        # incremental (reference lr.py): one lr_lambda call per step, not
+        # a re-walk of the whole history
+        return self.last_lr * self._lr_lambda(self.last_epoch)

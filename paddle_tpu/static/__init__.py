@@ -76,6 +76,10 @@ class Program:
         import copy
 
         p = copy.copy(self)
+        # own op list: pass rewrites on a clone must not mutate the
+        # original program's tape (records themselves stay shared)
+        p._ops = list(self._ops)
+        p._feeds = dict(self._feeds)
         if for_test:
             p._train = None
         return p

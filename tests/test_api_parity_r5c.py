@@ -274,3 +274,93 @@ def test_inference_pool_and_bytes():
     I = paddle.inference
     assert I.get_num_bytes_of_data_type(I.DataType.FLOAT32) == 4
     assert I.get_trt_compile_version() == (0, 0, 0)
+
+
+def test_hermitian_fft_matches_scipy():
+    """hfft2/ihfft2/hfftn composition verified against scipy (regression:
+    an earlier draft used the inverse transform on the leading axes —
+    self-consistent but wrong in absolute terms)."""
+    import scipy.fft as sfft
+
+    rng = np.random.RandomState(0)
+    x = (rng.randn(4, 5) + 1j * rng.randn(4, 5))
+    got = paddle.fft.hfft2(paddle.to_tensor(x.astype(np.complex64))).numpy()
+    want = sfft.hfft2(x)
+    assert np.abs(got - want).max() / np.abs(want).max() < 1e-5
+    r = rng.randn(4, 6).astype(np.float32)
+    assert np.allclose(paddle.fft.ihfft2(paddle.to_tensor(r)).numpy(),
+                       sfft.ihfft2(r), atol=1e-6)
+    gn = paddle.fft.hfftn(paddle.to_tensor(x.astype(np.complex64))).numpy()
+    assert np.abs(gn - sfft.hfftn(x)).max() / np.abs(sfft.hfftn(x)).max() \
+        < 1e-5
+
+
+def test_fused_attention_honours_mask():
+    import paddle_tpu.incubate.nn as inn
+
+    paddle.seed(0)
+    attn = inn.FusedMultiHeadAttention(8, 2, dropout_rate=0.0,
+                                       attn_dropout_rate=0.0)
+    attn.eval()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(1, 4, 8).astype(np.float32))
+    base = attn(x).numpy()
+    # mask out positions 2,3 for every query
+    m = np.zeros((1, 2, 4, 4), np.float32)
+    m[..., 2:] = -1e9
+    masked = attn(x, attn_mask=paddle.to_tensor(m)).numpy()
+    assert not np.allclose(base, masked), "mask must change the output"
+    with pytest.raises(NotImplementedError):
+        attn(x, key=paddle.to_tensor(np.zeros((1, 4, 8), np.float32)))
+
+
+def test_remove_dropout_rewires_and_isolates_clone():
+    from paddle_tpu.distributed import passes
+    import paddle_tpu.static as static
+
+    paddle.enable_static()
+    try:
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4])
+            h = paddle.scale(x, 2.0)
+            d = paddle.nn.functional.dropout(h, 0.5)
+            out = paddle.scale(d, 1.0)
+        infer = prog.clone(for_test=True)
+        passes.PassManager([passes.new_pass("remove_dropout")]).apply(infer)
+        assert infer.num_ops() == prog.num_ops() - 1  # original untouched
+        exe = static.Executor()
+        feed = {"x": np.arange(4, dtype=np.float32)}
+        got = exe.run(infer, feed=feed, fetch_list=[out])[0]
+        # consumer rewired to dropout INPUT: output = 2x exactly (no stale
+        # trace-time constant, no dropout scaling)
+        assert np.allclose(got, 2 * feed["x"])
+    finally:
+        paddle.disable_static()
+
+
+def test_weight_norm_dim1_roundtrip():
+    from paddle_tpu.nn import utils as U
+
+    m = paddle.nn.Linear(4, 3)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 4)
+                         .astype(np.float32))
+    U.weight_norm(m, "weight", dim=1)
+    y1 = m(x)
+    U.remove_weight_norm(m, "weight")
+    assert np.allclose(y1.numpy(), m(x).numpy(), atol=1e-5)
+
+
+def test_multiplicative_decay_incremental():
+    calls = []
+
+    def lam(epoch):
+        calls.append(epoch)
+        return 0.5
+
+    sched = paddle.optimizer.lr.MultiplicativeDecay(1.0, lam)
+    for _ in range(5):
+        sched.step()
+    assert abs(sched() - 0.5 ** 5) < 1e-9
+    # one lambda call per step, not O(n^2) re-walks
+    assert len(calls) <= 6

@@ -143,14 +143,15 @@ def test_beam_search_matches_greedy_on_deterministic_cell():
                                beam_size=3)
     out, _ = nn.dynamic_decode(
         dec, inits=_t(np.zeros((2, 1), np.float32)), max_step_num=8)
-    ids = out.numpy()
+    ids = out.numpy()  # [batch, time, beam] (reference layout)
+    assert ids.shape == (2, 8, 3)
     cur, path = 1, []
     for _ in range(8):
         cur = int(np.argmax(M[cur]))
         path.append(cur)
         if cur == 0:
             break
-    assert ids[0, 0, :len(path)].tolist() == path
+    assert ids[0, :len(path), 0].tolist() == path
 
 
 def test_sparse_attention_matches_masked_dense():

@@ -1,7 +1,8 @@
 """paddle.audio.backends (reference python/paddle/audio/backends/): wave
 file io. The reference dispatches to soundfile when installed and its
 bundled wave_backend otherwise; this environment has no soundfile wheel,
-so the stdlib-wave backend IS the backend (16/32-bit PCM + float32 wav)."""
+so the stdlib-wave backend IS the backend (8/16/24/32-bit PCM; stdlib
+wave does not parse IEEE-float wavs)."""
 from __future__ import annotations
 
 import wave
@@ -68,8 +69,17 @@ def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
         f.setpos(min(frame_offset, n))
         count = n - frame_offset if num_frames < 0 else num_frames
         raw = f.readframes(count)
-    dt = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
-    data = np.frombuffer(raw, dtype=dt).reshape(-1, ch)
+    if width == 3:
+        # 24-bit PCM: widen each little-endian triple into int32
+        b = np.frombuffer(raw, dtype=np.uint8).reshape(-1, 3)
+        data = (b[:, 0].astype(np.int32)
+                | (b[:, 1].astype(np.int32) << 8)
+                | (b[:, 2].astype(np.int32) << 16))
+        data = np.where(data >= 1 << 23, data - (1 << 24), data)
+        data = data.reshape(-1, ch)
+    else:
+        dt = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
+        data = np.frombuffer(raw, dtype=dt).reshape(-1, ch)
     if normalize:
         if width == 1:
             wav = (data.astype(np.float32) - 128.0) / 128.0

@@ -14,7 +14,10 @@ __all__ = ["list", "help", "load"]
 _HUB_CONF = "hubconf.py"
 
 
-def _import_hubconf(repo_dir: str, source: str):
+_loaded = {}
+
+
+def _import_hubconf(repo_dir: str, source: str, force_reload: bool = False):
     if source == "github":
         raise RuntimeError(
             "paddle.hub github source needs network egress, which this "
@@ -23,29 +26,39 @@ def _import_hubconf(repo_dir: str, source: str):
         path = os.path.join(repo_dir, _HUB_CONF)
         if not os.path.exists(path):
             raise FileNotFoundError(f"no {_HUB_CONF} in {repo_dir}")
-        spec = importlib.util.spec_from_file_location("hubconf", path)
+        key = os.path.abspath(path)
+        if not force_reload and key in _loaded:
+            return _loaded[key]
+        # one module slot PER repo: a second repo's hubconf must not
+        # shadow the first's
+        mod_name = f"hubconf_{abs(hash(key)) & 0xffffffff:x}"
+        spec = importlib.util.spec_from_file_location(mod_name, path)
         mod = importlib.util.module_from_spec(spec)
-        sys.modules["hubconf"] = mod
+        sys.modules[mod_name] = mod
         spec.loader.exec_module(mod)
+        _loaded[key] = mod
         return mod
-    return importlib.import_module(repo_dir)
+    mod = importlib.import_module(repo_dir)
+    if force_reload:
+        mod = importlib.reload(mod)
+    return mod
 
 
 def list(repo_dir, source="local", force_reload=False):  # noqa: A001
     """Entrypoint names exported by the repo's hubconf (callables not
     starting with '_')."""
-    mod = _import_hubconf(repo_dir, source)
+    mod = _import_hubconf(repo_dir, source, force_reload)
     return [n for n in dir(mod)
             if callable(getattr(mod, n)) and not n.startswith("_")]
 
 
 def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
-    mod = _import_hubconf(repo_dir, source)
+    mod = _import_hubconf(repo_dir, source, force_reload)
     return getattr(mod, model).__doc__
 
 
 def load(repo_dir, model, source="local", force_reload=False, **kwargs):
-    mod = _import_hubconf(repo_dir, source)
+    mod = _import_hubconf(repo_dir, source, force_reload)
     entry = getattr(mod, model, None)
     if entry is None or not callable(entry):
         raise RuntimeError(f"no callable entrypoint {model!r} in {repo_dir}")

@@ -55,6 +55,13 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None,
     ext_ids = [id(t) for t in ext]
     wrt_ids = [id(t) for t in inputs]
     target_ids = [id(t) for t in targets]
+    ng_ids = {id(t) for t in (no_grad_set or [])}
+    tg = list(target_gradients) if target_gradients is not None else None
+    if tg is not None and len(tg) != len(targets):
+        raise ValueError("target_gradients must match targets")
+    tg_vals = None if tg is None else [
+        (t._value if isinstance(t, Tensor) else jnp.asarray(t))
+        for t in tg]
 
     def grad_fn(*vals):
         base_env = dict(zip(ext_ids, vals[:len(ext_ids)]))
@@ -71,9 +78,13 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None,
                 out = rec.opdef.fn(*a, **k)
                 outs = out if isinstance(out, (tuple, list)) else [out]
                 for t, v in zip(rec.out_tensors, outs):
+                    v = jax.lax.stop_gradient(v) if id(t) in ng_ids else v
                     env[id(t)] = v
-            total = sum(jnp.sum(env[i]) for i in target_ids)
-            return total
+            if tg_vals is None:
+                return sum(jnp.sum(env[i]) for i in target_ids)
+            # weighted cotangents: d(sum_i <w_i, t_i>)/d inputs
+            return sum(jnp.sum(env[i] * w)
+                       for i, w in zip(target_ids, tg_vals))
 
         return tuple(jax.grad(loss_of)(wrt_vals))
 
@@ -339,10 +350,13 @@ class ExponentialMovingAverage:
     def update(self):
         self._step += 1
         for p in self._params():
+            # zero-seeded accumulator + bias correction at apply() — the
+            # reference scheme; seeding with the param AND correcting
+            # would inflate weights by ~1/(1-decay**t)
             s = self._shadow.get(id(p))
             v = jnp.asarray(p._value, jnp.float32)
             if s is None:
-                s = v
+                s = jnp.zeros_like(v)
             s = self._decay * s + (1.0 - self._decay) * v
             self._shadow[id(p)] = s
 
@@ -389,28 +403,39 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
         return tuple(np.asarray(r, s.dtype).reshape(s.shape)
                      for r, s in zip(res, shapes))
 
+    skip_ids = {id(v) for v in (skip_vars_in_backward_input or [])}
+    skip_in = [id(t) in skip_ids for t in xs]
+    skip_out = [id(t) in skip_ids for t in outs]
+
     @jax.custom_vjp
     def call(*vals):
         r = jax.pure_callback(host, tuple(shapes), *vals)
         return r if len(r) > 1 else r[0]
 
     def fwd(*vals):
-        return call(*vals), vals
+        r = call(*vals)
+        router = r if isinstance(r, tuple) else (r,)
+        return r, (vals, router)
 
-    def bwd(vals, g):
+    def bwd(res, g):
+        vals, fwd_outs = res
         if backward_func is None:
             return tuple(jnp.zeros_like(v) for v in vals)
-        gs = g if isinstance(g, (list, tuple)) else [g]
+        gs = tuple(g) if isinstance(g, (list, tuple)) else (g,)
         bshapes = [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in vals]
 
         def bhost(*a):
-            n = len(vals)
-            res = backward_func(*[np.asarray(q) for q in a])
-            res = res if isinstance(res, (list, tuple)) else [res]
+            res_b = backward_func(*[np.asarray(q) for q in a])
+            res_b = res_b if isinstance(res_b, (list, tuple)) else [res_b]
             return tuple(np.asarray(r, s.dtype).reshape(s.shape)
-                         for r, s in zip(res, bshapes))
+                         for r, s in zip(res_b, bshapes))
 
-        return jax.pure_callback(bhost, tuple(bshapes), *vals, *gs)
+        # reference contract: backward_func(x..., out..., out@GRAD...),
+        # with skip_vars_in_backward_input removed from the x/out part
+        args = ([v for v, sk in zip(vals, skip_in) if not sk]
+                + [o for o, sk in zip(fwd_outs, skip_out) if not sk]
+                + list(gs))
+        return jax.pure_callback(bhost, tuple(bshapes), *args)
 
     call.defvjp(fwd, bwd)
     vals = [t._value if isinstance(t, Tensor) else t for t in xs]
@@ -604,14 +629,18 @@ def ctr_metric_bundle(input, label, ins_tag_weight=None):
 
 def exponential_decay(learning_rate, decay_steps, decay_rate,
                       staircase=False):
-    """Legacy lr-decay builder -> the scheduler object (reference moved
-    this to optimizer.lr; static kept the name)."""
-    from ..optimizer.lr import ExponentialDecay
+    """Legacy lr-decay builder: lr * rate^(step/decay_steps), optionally
+    staircased (reference base/layers/learning_rate_scheduler.py)."""
+    from ..optimizer.lr import LRScheduler
 
-    sched = ExponentialDecay(learning_rate=learning_rate, gamma=decay_rate)
-    sched._decay_steps = decay_steps
-    sched._staircase = staircase
-    return sched
+    class _LegacyExponentialDecay(LRScheduler):
+        def get_lr(self):
+            e = max(self.last_epoch, 0) / float(decay_steps)
+            if staircase:
+                e = float(int(e))
+            return self.base_lr * (decay_rate ** e)
+
+    return _LegacyExponentialDecay(learning_rate)
 
 
 def set_ipu_shard(*a, **k):

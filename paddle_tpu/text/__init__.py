@@ -127,7 +127,7 @@ from .strings import (  # noqa: E402
 )
 
 __all__ = ["viterbi_decode", "Imdb", "Conll05st", "strings", "StringTensor",
-           "Vocab", "tokenize"]
+           "Vocab", "tokenize", "ViterbiDecoder", "Imikolov", "Movielens", "UCIHousing", "WMT14", "WMT16"]
 
 
 class ViterbiDecoder:

@@ -205,7 +205,7 @@ class Flowers(Dataset):
         self.transform = transform
         n = 6149 if mode == "train" else 1020
         self.images, self.labels = _synthetic_images(
-            n=min(n, 2048), hw=32, classes=102,
+            n=min(n, 2048), hw=32, classes=102, channels=3,
             seed=7 if mode == "train" else 8)
 
     def __getitem__(self, idx):
@@ -213,7 +213,7 @@ class Flowers(Dataset):
         if self.transform is not None:
             img = self.transform(img)
         else:
-            img = img.astype(np.float32)[None] / 255.0
+            img = img.astype(np.float32).transpose(2, 0, 1) / 255.0
         return img, np.asarray(self.labels[idx], np.int64)
 
     def __len__(self):

@@ -340,8 +340,30 @@ def affine(img, angle, translate, scale, shear, interpolation="bilinear",
 
 def rotate(img, angle, interpolation="bilinear", expand=False, center=None,
            fill=0):
-    return affine(img, angle, (0, 0), 1.0, (0.0, 0.0), interpolation, fill,
-                  center)
+    """Counter-clockwise rotation (PIL/reference convention — affine's
+    matrix angle is clockwise, hence the negation). expand=True enlarges
+    the canvas to the rotated bounding box."""
+    import math
+
+    if not expand:
+        return affine(img, -angle, (0, 0), 1.0, (0.0, 0.0), interpolation,
+                      fill, center)
+    arr = np.asarray(img)
+    chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+    h, w = (arr.shape[1], arr.shape[2]) if chw else arr.shape[:2]
+    rad = math.radians(angle)
+    c, sn = abs(math.cos(rad)), abs(math.sin(rad))
+    ow = int(math.ceil(w * c + h * sn))
+    oh = int(math.ceil(w * sn + h * c))
+    # map output pixel (centered in the new canvas) back to input coords;
+    # forward rotation is CCW, so the inverse map applies CW (+rad)
+    cin = ((w - 1) * 0.5, (h - 1) * 0.5) if center is None else center
+    cout = ((ow - 1) * 0.5, (oh - 1) * 0.5)
+    inv = (np.array([[1, 0, cin[0]], [0, 1, cin[1]], [0, 0, 1]])
+           @ np.array([[math.cos(rad), -math.sin(rad), 0],
+                       [math.sin(rad), math.cos(rad), 0], [0, 0, 1]])
+           @ np.array([[1, 0, -cout[0]], [0, 1, -cout[1]], [0, 0, 1]]))
+    return _warp(img, inv, out_size=(oh, ow), fill=fill)
 
 
 def perspective(img, startpoints, endpoints, interpolation="bilinear",

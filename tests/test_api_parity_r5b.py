@@ -256,9 +256,9 @@ def test_exponential_moving_average():
             with ema.apply():
                 applied = w.numpy().copy()
             restored = w.numpy()
-        # shadow after 2 updates of 0.5-decay, bias-corrected
-        s = 0.5 * w0 + 0.5 * (w0 + 10)
-        assert np.allclose(applied, s / (1 - 0.5 ** 2), atol=1e-4)
+        # zero-seeded shadow, two updates at decay 0.5:
+        # s = 0.5*(0.5*w0) + 0.5*(w0+10) = 0.75*w0 + 5; corr = 1-0.25
+        assert np.allclose(applied, (0.75 * w0 + 5) / 0.75, atol=1e-4)
         assert np.allclose(restored, w0 + 10)
     finally:
         paddle.disable_static()

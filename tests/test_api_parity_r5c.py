@@ -364,3 +364,147 @@ def test_multiplicative_decay_incremental():
     assert abs(sched() - 0.5 ** 5) < 1e-9
     # one lambda call per step, not O(n^2) re-walks
     assert len(calls) <= 6
+
+
+def test_review2_fixes():
+    """Batch of round-5 review-2 regressions."""
+    from paddle_tpu.vision import transforms as T
+    import paddle_tpu.static as static
+
+    # rotate: counter-clockwise for positive angles (PIL convention) —
+    # a dot at the right-middle must move to TOP-middle under +90
+    img = np.zeros((33, 33), np.float32)
+    img[16, 28] = 1.0
+    r = T.rotate(img, 90)
+    yy, xx = np.unravel_index(np.argmax(r), r.shape)
+    assert yy < 10, (yy, xx)
+    # expand=True grows the canvas and keeps corners
+    sq = np.ones((20, 10), np.float32)
+    ex = T.rotate(sq, 45, expand=True)
+    assert ex.shape[0] >= 21 and ex.shape[1] >= 21
+    assert abs(ex.sum() - sq.sum()) / sq.sum() < 0.08  # content preserved
+
+    # EMA: apply() returns the bias-corrected running average, not an
+    # inflated value
+    paddle.enable_static()
+    try:
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [1, 2])
+            w = paddle.create_parameter([2, 1])
+            paddle.matmul(x, w)
+        ema = static.ExponentialMovingAverage(decay=0.9)
+        w0 = w.numpy().copy()
+        with static.program_guard(prog):
+            ema.update()
+            with ema.apply():
+                applied = w.numpy().copy()
+        # one update: s=(1-d)*w0, corrected: s/(1-d) = w0
+        assert np.allclose(applied, w0, atol=1e-5)
+    finally:
+        paddle.disable_static()
+
+    # exponential_decay respects decay_steps
+    sched = static.exponential_decay(0.1, decay_steps=100, decay_rate=0.9)
+    for _ in range(100):
+        sched.step()
+    assert abs(sched() - 0.1 * 0.9) < 1e-6
+
+    # Flowers is RGB like the reference
+    fl = paddle.vision.datasets.Flowers(mode="test")
+    assert fl[0][0].shape == (3, 32, 32)
+
+    # text star-import parity
+    import paddle_tpu.text as text
+
+    for n in ("ViterbiDecoder", "WMT16", "UCIHousing"):
+        assert n in text.__all__
+
+
+def test_py_func_backward_reference_contract(tmp_path):
+    import paddle_tpu.static as static
+
+    seen = {}
+
+    def fwd_host(x):
+        return x * 2
+
+    def bwd_host(x, out, dout):
+        seen["shapes"] = (x.shape, out.shape, dout.shape)
+        return dout * 2
+
+    paddle.enable_static()
+    try:
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [3])
+            out = paddle.zeros([3])
+            static.py_func(fwd_host, x, out, backward_func=bwd_host)
+            loss = paddle.sum(out)
+            (gx,) = static.gradients([loss], [x])
+        exe = static.Executor()
+        res = exe.run(prog, feed={"x": np.ones(3, np.float32)},
+                      fetch_list=[gx])
+        assert np.allclose(res[0], 2.0)
+        assert seen["shapes"] == ((3,), (3,), (3,))
+    finally:
+        paddle.disable_static()
+
+
+def test_gradients_target_gradients_and_no_grad_set():
+    import paddle_tpu.static as static
+
+    paddle.enable_static()
+    try:
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [3])
+            a = paddle.scale(x, 2.0)
+            (gx,) = static.gradients(
+                [a], [x],
+                target_gradients=[paddle.to_tensor(
+                    np.array([1., 10., 100.], np.float32))])
+        exe = static.Executor()
+        g = exe.run(prog, feed={"x": np.ones(3, np.float32)},
+                    fetch_list=[gx])[0]
+        assert np.allclose(g, [2., 20., 200.])
+        # no_grad_set blocks flow through the named variable
+        prog2 = static.Program()
+        with static.program_guard(prog2):
+            x2 = static.data("x", [3])
+            h = paddle.scale(x2, 3.0)
+            y2 = paddle.scale(h, 5.0)
+            (gx2,) = static.gradients([y2], [x2], no_grad_set=[h])
+        g2 = exe.run(prog2, feed={"x": np.ones(3, np.float32)},
+                     fetch_list=[gx2])[0]
+        assert np.allclose(g2, 0.0)
+    finally:
+        paddle.disable_static()
+
+
+def test_audio_24bit_and_hub_reload(tmp_path):
+    import struct
+    import wave as _wave
+
+    path = str(tmp_path / "p24.wav")
+    with _wave.open(path, "wb") as f:
+        f.setnchannels(1)
+        f.setsampwidth(3)
+        f.setframerate(8000)
+        vals = [0, 1 << 22, -(1 << 22)]
+        f.writeframes(b"".join(
+            struct.pack("<i", v)[:3] for v in vals))
+    out, sr = paddle.audio.load(path)
+    assert sr == 8000
+    assert np.allclose(out.numpy().ravel(), [0.0, 0.5, -0.5], atol=1e-6)
+
+    # hub: two repos don't shadow each other; force_reload picks up edits
+    r1, r2 = tmp_path / "r1", tmp_path / "r2"
+    r1.mkdir(), r2.mkdir()
+    (r1 / "hubconf.py").write_text("def which():\n    return 'one'\n")
+    (r2 / "hubconf.py").write_text("def which():\n    return 'two'\n")
+    assert paddle.hub.load(str(r1), "which") == "one"
+    assert paddle.hub.load(str(r2), "which") == "two"
+    assert paddle.hub.load(str(r1), "which") == "one"
+    (r1 / "hubconf.py").write_text("def which():\n    return 'edited'\n")
+    assert paddle.hub.load(str(r1), "which", force_reload=True) == "edited"

@@ -196,11 +196,29 @@ def ulysses_attention(q, k, v, axis_name, causal=False, scale=None,
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
     qf, kf, vf = to_full_seq(q), to_full_seq(k), to_full_seq(v)
-    if dense_fn is None:
-        of = dense_causal_attention(qf, kf, vf, causal=causal, scale=scale)
-    else:
+    if dense_fn is not None:
         of = dense_fn(qf, kf, vf)
+    else:
+        of = _full_seq_attention(qf, kf, vf, causal=causal, scale=scale)
     return to_shard_seq(of)
+
+
+def _full_seq_attention(qf, kf, vf, causal, scale):
+    """Post-all-to-all attention over the FULL sequence: route through the
+    Pallas flash kernel when enabled — the dense fallback materializes an
+    O(s_global^2) score matrix, which defeats the long-context point of
+    Ulysses (e.g. ~0.5 TB fp32 of scores at s=64k, h=32)."""
+    from ..core.flags import get_flag
+
+    if get_flag("use_flash_attention"):
+        try:
+            from ..ops.pallas.flash_attention import flash_attention
+
+            return flash_attention(qf, kf, vf, causal=causal,
+                                   scale=scale)
+        except Exception:  # lowering/shape constraints: dense fallback
+            pass
+    return dense_causal_attention(qf, kf, vf, causal=causal, scale=scale)
 
 
 # ------------------------------------------------------------------ SP utils

@@ -269,9 +269,14 @@ class PipelineParallel(Layer):
 
     def sync_layers_from_stacks(self):
         """Write stacked master values back into the per-stage layer params
-        (for eval/state_dict after training)."""
+        (for eval/state_dict after training). Skipped when the stacks have
+        not changed since the last sync — a per-forward re-gather of every
+        stage slice would tax eval loops for nothing."""
         if self._pp_degree <= 1:
             return
+        if not getattr(self, "_stacks_dirty", True):
+            return
+        self._stacks_dirty = False
         for i, g in enumerate(self._stack_order()):
             ps = [p for l in self._layers.get_stage_layers(g) for p in l.parameters()]
             for k, p in enumerate(ps):
@@ -412,12 +417,18 @@ class PipelineParallel(Layer):
         optimizer.clear_grad()
         if lr_scheduler is not None:
             lr_scheduler.step()
+        self._stacks_dirty = True  # layer views stale until next sync
         return Tensor(loss)
 
     def _train_batch_accumulate(self, inputs, labels, optimizer, lr_scheduler, scaler):
         """pp=1 path: plain microbatched gradient accumulation."""
         M = self.accumulate_steps
         total = inputs.shape[0]
+        if total % M != 0:
+            # same contract as the pp>1 schedule: a silent ceil() here
+            # would scale grads by n_micro/M (e.g. +25% at batch 10, M=4)
+            raise ValueError(
+                f"batch {total} not divisible by accumulate_steps {M}")
         step = max(total // M, 1)
         losses = []
         for i in range(0, total, step):

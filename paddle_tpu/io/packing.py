@@ -90,12 +90,49 @@ class PackedLMBatches:
 
     def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray,
                                          np.ndarray]]:
-        ids, seg, labels = pack_examples(list(self.docs), self.capacity,
-                                         self.pad_id,
-                                         split_docs=self.split_docs)
-        n = ids.shape[0]
-        stop = (n // self.batch_rows) * self.batch_rows if self.drop_last \
-            else n
-        for r in range(0, stop, self.batch_rows):
-            sl = slice(r, min(r + self.batch_rows, n))
-            yield ids[sl], seg[sl], labels[sl]
+        """Streaming: documents are pulled from the source in chunks of
+        ~batch_rows rows' worth and packed as they arrive — the whole
+        corpus is never resident. A one-shot generator source raises on
+        the second epoch instead of silently yielding nothing."""
+        it = iter(self.docs)
+        chunk_tokens = self.capacity * self.batch_rows
+        pending: list = []
+        pending_tok = 0
+        rows: list = []  # packed rows awaiting a full batch (carried
+        #                  across chunks — nothing is dropped mid-stream)
+        yielded = False
+
+        def pack_pending():
+            ids, seg, labels = pack_examples(pending, self.capacity,
+                                             self.pad_id,
+                                             split_docs=self.split_docs)
+            rows.extend(zip(ids, seg, labels))
+
+        def drain(final=False):
+            while len(rows) >= self.batch_rows or (
+                    final and rows and not self.drop_last):
+                take = rows[:self.batch_rows]
+                del rows[:self.batch_rows]
+                yield (np.stack([t[0] for t in take]),
+                       np.stack([t[1] for t in take]),
+                       np.stack([t[2] for t in take]))
+
+        for doc in it:
+            pending.append(doc)
+            pending_tok += len(doc)
+            if pending_tok >= 2 * chunk_tokens:
+                pack_pending()
+                pending, pending_tok = [], 0
+                for out in drain():
+                    yielded = True
+                    yield out
+        if pending:
+            pack_pending()
+        for out in drain(final=True):
+            yielded = True
+            yield out
+        if not yielded and iter(self.docs) is it:
+            raise RuntimeError(
+                "PackedLMBatches source is an exhausted one-shot "
+                "generator (second epoch?); pass a re-iterable (list, "
+                "Dataset) for multi-epoch training")

@@ -42,10 +42,11 @@ def init_kv_cache(batch: int, max_len: int, num_layers: int,
 
 def _sample_inside_jit(logits, do_sample, temperature, top_k, top_p, seed):
     """logits: [b, vocab] (last position). Returns ids [b] int32."""
-    if not do_sample:
+    if not do_sample or (temperature is not None and temperature <= 0.0):
+        # temperature 0 conventionally means deterministic decoding
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits.astype(jnp.float32)
-    if temperature and temperature != 1.0:
+    if temperature != 1.0:
         logits = logits / temperature
     if top_k:
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]

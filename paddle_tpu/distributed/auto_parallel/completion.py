@@ -74,10 +74,15 @@ class Completer:
         chosen = tuple(spec)
         prev = self.param_specs.get(free.pid)
         if prev is not None and prev != chosen:
-            # conflicting uses (e.g. tied weights used both ways):
-            # keep the intersection
+            # conflicting uses (e.g. tied weights used both ways): keep
+            # the intersection, PADDED to the longer spec — a zip over a
+            # degenerate shorter spec (e.g. () from an all-None resolve)
+            # would truncate and silently erase a real placement
+            n = max(len(prev), len(chosen))
+            pv = tuple(prev) + (None,) * (n - len(prev))
+            cv = tuple(chosen) + (None,) * (n - len(chosen))
             chosen = tuple(a if a == b else None
-                           for a, b in zip(prev, chosen))
+                           for a, b in zip(pv, cv))
         self.param_specs[free.pid] = chosen
         return tuple(chosen[p] if p is not None else None
                      for p in free.dim_map)
@@ -387,7 +392,9 @@ class Completer:
             cd = w_contract[0] if w_contract else None
             if cd is not None and self._div(wshape[cd], self.mp):
                 want[cd] = self.mp
-                self.comm_bytes += out_size * 4  # the row-parallel psum
+                # NOTE: the psum cost is counted once by the caller's
+                # contracted-dim check on the returned spec — adding it
+                # here too double-charged row-parallel layouts
         else:
             frees = [d for d in range(len(wshape))
                      if d not in w_contract and d not in w_batch]

@@ -149,7 +149,10 @@ class ParameterServer:
         if isinstance(entry, CountFilterEntry):
             admitted[uniq] |= counts[uniq] >= entry.count
         elif isinstance(entry, ProbabilityEntry):
-            fresh = ~admitted[uniq] & (counts[uniq] == 1)
+            # re-draw on EVERY push until admitted: a recurring hot id
+            # must eventually train (P(rejected after k pushes) =
+            # (1-p)^k -> 0), only persistently cold features stay out
+            fresh = ~admitted[uniq]
             rng = np.random.default_rng(
                 abs(hash((name, int(counts.sum())))) % (1 << 31))
             admitted[uniq] |= fresh & (rng.random(len(uniq))
@@ -242,6 +245,17 @@ class ParameterServer:
                 elif acc.kind == "adam":
                     state.update(m1=acc.m1, m2=acc.m2,
                                  b1p=acc.b1p, b2p=acc.b2p)
+                entry = cls._entries.get(name)
+                if entry is not None:
+                    # admission state must survive recovery: re-zeroed
+                    # counts would re-filter already-admitted hot ids
+                    state["entry_kind"] = np.asarray(type(entry).__name__)
+                    state["entry_arg"] = np.asarray(
+                        getattr(entry, "count",
+                                getattr(entry, "probability", 0.0)),
+                        np.float64)
+                    state["push_counts"] = cls._push_counts[name]
+                    state["admitted"] = cls._admitted[name]
                 with open(os.path.join(vdir, f"{name}.npz"), "wb") as f:
                     np.savez(f, **state)
                 names.append(name)
@@ -282,12 +296,27 @@ class ParameterServer:
                 elif kind == "adam":
                     acc.m1, acc.m2 = z["m1"], z["m2"]
                     acc.b1p, acc.b2p = z["b1p"], z["b2p"]
+                entry = push_counts = admitted = None
+                if "entry_kind" in z:
+                    ek = str(z["entry_kind"])
+                    arg = float(z["entry_arg"])
+                    entry = {"CountFilterEntry": CountFilterEntry(int(arg)),
+                             "ProbabilityEntry": ProbabilityEntry(arg),
+                             "ShowClickEntry": ShowClickEntry("show",
+                                                              "click"),
+                             }[ek]
+                    push_counts = z["push_counts"]
+                    admitted = z["admitted"]
             # swap under BOTH locks: a concurrent push must not land on
             # the orphaned pre-restore array
             with cls._lock(name):
                 with cls._meta_lock:
                     cls._tables[name] = table
                     cls._accessors[name] = acc
+                    if entry is not None:
+                        cls._entries[name] = entry
+                        cls._push_counts[name] = push_counts
+                        cls._admitted[name] = admitted
         return names
 
     @classmethod

@@ -76,9 +76,11 @@ def save_pdparams(state_dict, path: str) -> None:
 # fluid-era parameter suffixes (op_compat.yaml-era compat: linear/conv
 # parameters were published as `<op>_<i>.w_0` / `.b_0`)
 _FLUID_SUFFIXES = [(re.compile(r"\.w_0$"), ".weight"),
-                   (re.compile(r"\.b_0$"), ".bias"),
-                   (re.compile(r"\.w_1$"), ".weight"),
-                   (re.compile(r"\.b_1$"), ".bias")]
+                   (re.compile(r"\.b_0$"), ".bias")]
+# NOTE: .w_1/.b_1 deliberately do NOT alias to .weight/.bias — a scope
+# with both w_0 and w_1 holds two DISTINCT parameters, and collapsing
+# them would silently drop one; unmatched w_1 keys surface as
+# 'unexpected' so the caller sees them
 
 # batch_norm compat (op_compat.yaml: batch_norm {Scale: scale, Bias:
 # bias, Mean: mean, Variance: variance}); published vision state dicts

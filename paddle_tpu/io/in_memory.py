@@ -187,6 +187,10 @@ class InMemoryDataset:
             self._vals = np.zeros(0, np.float64)
             self._counts = np.zeros((0, len(self.slots)), np.int32)
         self._shuffled_size = self._counts.shape[0]
+        # ...and everyone must have POPPED before anyone starts the next
+        # epoch's deliveries, or a fast rank's epoch-N+1 records land in a
+        # slow rank's still-unpopped epoch-N inbox (cross-epoch mixing)
+        rpc.barrier(f"inmem_shuffle_done/{self.name}", world_size=n)
         self.local_shuffle(seed)
 
     # ------------------------------------------------------------ batches
@@ -247,9 +251,14 @@ class QueueDataset(InMemoryDataset):
                            "use InMemoryDataset")
 
     def __iter__(self):
-        """Yield batches file by file, parsing each file as it is reached."""
+        """Yield batches file by file, parsing each file as it is reached.
+        Records that don't fill a batch at a file boundary CARRY into the
+        next file — per-file drop-last would silently lose up to
+        batch_size-1 records of every file."""
         from .. import native
 
+        carry_vals = np.zeros(0, np.float64)
+        carry_counts = np.zeros((0, len(self.slots)), np.int32)
         for path in self._files:
             with open(path, "rb") as f:
                 data = f.read()
@@ -257,8 +266,23 @@ class QueueDataset(InMemoryDataset):
                 vals, counts = native.parse_slot_lines(data, len(self.slots))
             except RuntimeError:
                 vals, counts = self._parse_python(data)
+            vals = np.concatenate([carry_vals, vals])
+            counts = np.concatenate([carry_counts, counts], axis=0)
+            n = counts.shape[0]
+            full = (n // self.batch_size) * self.batch_size
             sub = InMemoryDataset(self.name + "#chunk")
             sub.init(batch_size=self.batch_size, slots=self.slots)
-            sub._vals, sub._counts = vals, counts
-            sub._order = np.arange(counts.shape[0])
+            rec_tok = counts.sum(axis=1)
+            split_tok = int(rec_tok[:full].sum())
+            sub._vals = vals[:split_tok]
+            sub._counts = counts[:full]
+            sub._order = np.arange(full)
+            yield from sub
+            carry_vals = vals[split_tok:]
+            carry_counts = counts[full:]
+        if carry_counts.shape[0]:
+            sub = InMemoryDataset(self.name + "#tail")
+            sub.init(batch_size=self.batch_size, slots=self.slots)
+            sub._vals, sub._counts = carry_vals, carry_counts
+            sub._order = np.arange(carry_counts.shape[0])
             yield from sub

@@ -49,6 +49,13 @@ def decorate(models, optimizers=None, level="O2", dtype="bfloat16", master_weigh
             for p in m.parameters():
                 if jnp.issubdtype(p.dtype, jnp.floating):
                     p._value = p._value.astype(dt)
+        # O2 keeps fp32 master weights in the optimizer unless explicitly
+        # disabled — without this the moments/updates accumulate in the
+        # low-precision dtype and convergence silently degrades
+        if optimizers is not None and master_weight is not False:
+            opt_list = optimizers if isinstance(optimizers, (list, tuple))                 else [optimizers]
+            for o in opt_list:
+                o._multi_precision = True
     if optimizers is None:
         return models
     return models, optimizers
@@ -157,6 +164,21 @@ class GradScaler:
             # state so the unscale_ -> clip -> step pattern is single-unscale)
         self._unscaled = True
         params = [p for p in optimizer._parameter_list if p.grad is not None]
+        if not params:
+            self._found_inf_t = jnp.zeros((), jnp.float32)
+            return
+        # sparse (SelectedRows) grads carry .values, not ._value — unscale
+        # the value rows in place, same found_inf semantics
+        dense, sparse = [], []
+        for p in params:
+            (sparse if hasattr(p.grad, "values")
+             and not hasattr(p.grad, "_value") else dense).append(p)
+        for p in sparse:
+            sr = p.grad
+            vals = sr.values._value * (1.0 / self._scale).astype(
+                sr.values._value.dtype)
+            sr.values._value = vals
+        params = dense
         if not params:
             self._found_inf_t = jnp.zeros((), jnp.float32)
             return

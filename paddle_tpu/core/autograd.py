@@ -110,13 +110,18 @@ def run_backward(
     grad_tensors: Optional[Sequence[Any]] = None,
     retain_graph: bool = False,
     sink: Optional[dict] = None,
+    capture: Optional[dict] = None,
 ):
     """egr::Backward equivalent (eager/backward.cc:421).
 
     When ``sink`` is given (paddle.grad path), leaf gradients accumulate into
     ``sink[id(leaf)]`` instead of each leaf's .grad slot, so partial-graph
     grads never pollute parameter .grad state.
-    """
+
+    ``capture`` maps (id(GradNode), out_idx) -> tensor id: the cotangent
+    arriving at that node OUTPUT is also recorded in sink — this is what
+    lets paddle.grad differentiate wrt INTERMEDIATE tensors, whose grads
+    never reach a leaf edge."""
     from .tensor import Tensor
 
     roots = [t for t in tensors if isinstance(t, Tensor)]
@@ -169,6 +174,12 @@ def run_backward(
         out_grads = pending.pop(node, None)
         if out_grads is None:
             continue
+        if capture and sink is not None:
+            for i, g in enumerate(out_grads):
+                tid = capture.get((id(node), i))
+                if tid is not None and g is not None:
+                    prev = sink.get(tid)
+                    sink[tid] = g if prev is None else prev + g
         if node.vjp_fn is None:
             raise RuntimeError(
                 f"Grad graph for op '{node.op_name}' was already freed; "
@@ -235,8 +246,22 @@ def grad(
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
 
+    if create_graph:
+        raise NotImplementedError(
+            "grad(create_graph=True): higher-order eager grads are not "
+            "built by this engine; use the functional transforms "
+            "(paddle.incubate.autograd jvp/vjp/Hessian) which compose "
+            "through jax")
+    # intermediate (non-leaf) inputs: capture the cotangent at their
+    # producing node's output slot
+    capture = {}
+    for t in inputs:
+        if isinstance(t, Tensor) and t._grad_node is not None:
+            node, idx = t._grad_node
+            capture[(id(node), idx)] = id(t)
     sink: dict = {}
-    run_backward(outputs, grad_outputs, retain_graph=retain_graph or create_graph, sink=sink)
+    run_backward(outputs, grad_outputs,
+                 retain_graph=retain_graph, sink=sink, capture=capture)
     results = []
     for t in inputs:
         g = sink.get(id(t))

@@ -55,6 +55,10 @@ def save_sharded(state: Any, path: str, async_save: bool = False,
     async_save=True returns immediately; call wait_all() (or save again) to
     join the background write."""
     path = os.path.abspath(path)
+    # join any in-flight async save first: two AsyncCheckpointers racing
+    # to finalize-rename the same directory corrupt the checkpoint, and
+    # rmtree below must not delete a directory still being written
+    wait_all()
     if os.path.exists(path):
         if not overwrite:
             raise FileExistsError(path)
@@ -122,6 +126,12 @@ def load_model_sharded(model, path: str, optimizer=None):
     """Restore into the model's CURRENT shardings (mesh-reshard on load)."""
     template = {"model": dict(model.state_dict())}
     if optimizer is not None:
+        # a FRESH optimizer has no accumulators yet (created lazily on the
+        # first step) — materialize them so the restore template's tree
+        # matches the saved moments/master-weights structure
+        if hasattr(optimizer, "init_state_tree"):
+            optimizer.init_state_tree(
+                list(getattr(optimizer, "_parameter_list", [])))
         template["optimizer"] = dict(optimizer.state_dict())
     restored = load_sharded(path, template)
     model.set_state_dict({k: Tensor(v) for k, v in restored["model"].items()})

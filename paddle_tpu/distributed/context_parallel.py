@@ -207,17 +207,23 @@ def _full_seq_attention(qf, kf, vf, causal, scale):
     """Post-all-to-all attention over the FULL sequence: route through the
     Pallas flash kernel when enabled — the dense fallback materializes an
     O(s_global^2) score matrix, which defeats the long-context point of
-    Ulysses (e.g. ~0.5 TB fp32 of scores at s=64k, h=32)."""
+    Ulysses (e.g. ~0.5 TB fp32 of scores at s=64k, h=32). Gating mirrors
+    scaled_dot_product_attention: flag + shape support + interpret mode on
+    CPU (raw pallas_call cannot lower on the CPU backend)."""
     from ..core.flags import get_flag
+    from ..ops import pallas as _pallas
+    from ..ops.pallas.flash_attention import (flash_attention,
+                                              flash_attention_platform,
+                                              supports)
 
-    if get_flag("use_flash_attention"):
-        try:
-            from ..ops.pallas.flash_attention import flash_attention
-
-            return flash_attention(qf, kf, vf, causal=causal,
-                                   scale=scale)
-        except Exception:  # lowering/shape constraints: dense fallback
-            pass
+    if get_flag("use_flash_attention") and supports(
+            qf.shape, kf.shape, None, 0.0, causal):
+        if _pallas.interpret_mode():
+            return flash_attention(qf, kf, vf, causal=causal, scale=scale,
+                                   interpret=True)
+        # platform_dependent dispatch: the Mosaic kernel on tpu lowering,
+        # the XLA composition on cpu — same trace works for both
+        return flash_attention_platform(qf, kf, vf, scale, causal)
     return dense_causal_attention(qf, kf, vf, causal=causal, scale=scale)
 
 

@@ -60,7 +60,17 @@ def shard_model_parameters(
             spec = _compose_zero(spec, p._value.shape, mesh, zero_axis)
         try:
             p._value = jax.device_put(p._value, NamedSharding(mesh, spec))
-        except Exception:
+        except Exception as e:
+            # replicating is a safe FALLBACK for dims indivisible by the
+            # axis, but a silent one converts mis-specified TP layouts
+            # into per-device memory blow-ups — say what happened
+            import warnings
+
+            warnings.warn(
+                f"shard_model_parameters: param shape "
+                f"{tuple(p._value.shape)} could not take spec {spec} on "
+                f"mesh {dict(mesh.shape)} ({type(e).__name__}: {e}); "
+                "REPLICATING instead", RuntimeWarning)
             p._value = jax.device_put(p._value, NamedSharding(mesh, PartitionSpec()))
     return model
 

@@ -54,8 +54,18 @@ class TrainStep:
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.params = [p for p in model.parameters() if p.trainable]
-        self.buffers = [b for b in model.buffers()]
-        self.opt_state = optimizer.init_state_tree(self.params)
+        # frozen params ride as runtime inputs like buffers — leaving them
+        # out would constant-fold their CURRENT values into the compiled
+        # step, silently ignoring later set_state_dict/EMA updates
+        self.buffers = [b for b in model.buffers()] +             [p for p in model.parameters() if not p.trainable]
+        # copy state leaves: init_state_tree shares arrays with the
+        # optimizer's own accumulator store, and donating shared buffers
+        # would invalidate optimizer.state_dict() on backends that honor
+        # donation (TPU/GPU)
+        self.opt_state = jax.tree_util.tree_map(
+            jnp.asarray, optimizer.init_state_tree(self.params))
+        self.opt_state = jax.tree_util.tree_map(
+            lambda x: x.copy() if hasattr(x, "copy") else x, self.opt_state)
         self._mesh = mesh
         self._step_i = 0
 
@@ -157,8 +167,13 @@ class TrainStep:
         return Tensor(loss)
 
     def sync_to_optimizer(self):
-        """Push compiled-state back so optimizer.state_dict() reflects training."""
-        self.optimizer.sync_state_from(self.params, self.opt_state)
+        """Push compiled-state back so optimizer.state_dict() reflects
+        training. COPIES are handed over: the live self.opt_state buffers
+        are donated to the next compiled step, and the optimizer must not
+        hold soon-to-be-invalidated arrays."""
+        copied = jax.tree_util.tree_map(
+            lambda x: x.copy() if hasattr(x, "copy") else x, self.opt_state)
+        self.optimizer.sync_state_from(self.params, copied)
 
     def lower(self, *batch):
         batch_vals = _tensor_leaves(batch)

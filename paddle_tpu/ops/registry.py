@@ -175,6 +175,9 @@ def _build_cached(opdef, key, treedef, const_leaves, tensor_idx, primal_pos):
 
     primal_set = set(primal_pos)
     n_tensors = len(tensor_idx)
+    was_list = [False]  # kernels returning a LIST: vjp cotangents must be
+    #                     passed as a tuple, so normalize here and restore
+    #                     the container after execution
 
     def rebuild(tensor_vals, rng_seed):
         vals = list(const_leaves)
@@ -183,18 +186,23 @@ def _build_cached(opdef, key, treedef, const_leaves, tensor_idx, primal_pos):
             vals[i] = v
         a, k = jax.tree_util.tree_unflatten(treedef, vals)
         if rng_seed is None:
-            return opdef.fn(*a, **k)
-        # RNG op: fold the traced per-call seed into every generator key so
-        # the cached executable stays stochastic across calls
-        prev = _random.default_generator.push_trace_seed(rng_seed)
-        try:
-            return opdef.fn(*a, **k)
-        finally:
-            _random.default_generator.pop_trace_seed(prev)
+            res = opdef.fn(*a, **k)
+        else:
+            # RNG op: fold the traced per-call seed into every generator
+            # key so the cached executable stays stochastic across calls
+            prev = _random.default_generator.push_trace_seed(rng_seed)
+            try:
+                res = opdef.fn(*a, **k)
+            finally:
+                _random.default_generator.pop_trace_seed(prev)
+        if isinstance(res, list):
+            was_list[0] = True
+            return tuple(res)
+        return res
 
     if not primal_pos:
         exec_f = jax.jit(lambda tensor_vals, rng_seed: rebuild(tensor_vals, rng_seed))
-        return (exec_f, None)
+        return (exec_f, None, was_list)
 
     def fwd(primal_vals, const_tensor_vals, rng_seed):
         it_p = iter(primal_vals)
@@ -212,7 +220,7 @@ def _build_cached(opdef, key, treedef, const_leaves, tensor_idx, primal_pos):
 
     fwd_exec = jax.jit(fwd)
     bwd_exec = jax.jit(lambda vjp_fn, cots: vjp_fn(cots))
-    return (fwd_exec, bwd_exec)
+    return (fwd_exec, bwd_exec, was_list)
 
 
 def _dispatch_cached(opdef, key, leaves, treedef, tensor_idx, tensors, primal_pos):
@@ -248,9 +256,11 @@ def _dispatch_cached(opdef, key, leaves, treedef, tensor_idx, tensors, primal_po
 
     if entry[1] is None:  # no-grad executable
         out = entry[0]([t._value for t in tensors], rng_seed)
+        if entry[2][0]:
+            out = list(out)
         return _wrap_outputs(opdef, out, node=None)
 
-    fwd_exec, bwd_exec = entry
+    fwd_exec, bwd_exec, was_list = entry
     primal_set = set(primal_pos)
     primal_vals = [tensors[k]._value for k in primal_pos]
     const_vals = [t._value for k, t in enumerate(tensors) if k not in primal_set]
@@ -264,6 +274,8 @@ def _dispatch_cached(opdef, key, leaves, treedef, tensor_idx, tensors, primal_po
             edges.append(("node", node, idx))
         else:
             edges.append(("leaf", t))
+    if was_list[0]:
+        out = list(out)
     out_list = out if isinstance(out, (tuple, list)) else [out]
     out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in out_list]
     node = GradNode(opdef.name, lambda cots: bwd_exec(vjp_fn, cots), edges, out_avals)
@@ -339,13 +351,22 @@ def dispatch(opdef: OpDef, args, kwargs):
     primal_set = set(primal_pos)
     const_vals = [t._value for k, t in enumerate(tensors) if k not in primal_set]
 
+    was_list = [False]
+
     def pure(*primals):
         it_p = iter(primals)
         it_c = iter(const_vals)
         tensor_vals = [next(it_p) if k in primal_set else next(it_c) for k in range(len(tensors))]
-        return run_with(tensor_vals)
+        res = run_with(tensor_vals)
+        if isinstance(res, list):
+            # vjp cotangent containers must match: normalize to tuple
+            was_list[0] = True
+            return tuple(res)
+        return res
 
     out, vjp_fn = jax.vjp(pure, *[tensors[k]._value for k in primal_pos])
+    if was_list[0]:
+        out = list(out)
 
     edges = []
     for k in primal_pos:
@@ -392,7 +413,11 @@ def _wrap_outputs(opdef, out, node):
             t.trainable = False
             t._grad_node = None
         wrapped.append(t)
-    return wrapped[0] if single else tuple(wrapped)
+    if single:
+        return wrapped[0]
+    # preserve the kernel's container: list-returning ops (unstack,
+    # tensor_split) must hand the user a list, as in the reference
+    return wrapped if isinstance(out, list) else tuple(wrapped)
 
 
 def _in_trace(x) -> bool:

@@ -508,3 +508,78 @@ def test_audio_24bit_and_hub_reload(tmp_path):
     assert paddle.hub.load(str(r1), "which") == "one"
     (r1 / "hubconf.py").write_text("def which():\n    return 'edited'\n")
     assert paddle.hub.load(str(r1), "which", force_reload=True) == "edited"
+
+
+def test_autograd_list_output_backward_and_intermediate_grad():
+    """Regression: list-returning ops (unstack) crashed backward with a
+    pytree mismatch; paddle.grad returned 'unused' for intermediates."""
+    from paddle_tpu.ops import api
+
+    x = paddle.to_tensor(np.ones((2, 3), np.float32))
+    x.stop_gradient = False
+    parts = api.unstack(x)
+    assert isinstance(parts, list)
+    (parts[0].sum() + parts[1].sum() * 2).backward()
+    assert np.allclose(x.grad.numpy(), [[1, 1, 1], [2, 2, 2]])
+
+    a = paddle.to_tensor(np.array([2.0], np.float32))
+    a.stop_gradient = False
+    h = a * 3
+    y = h * 5
+    (gh,) = paddle.grad(y, [h], retain_graph=True)
+    assert float(gh.numpy()) == 5.0
+    (ga,) = paddle.grad(y, [a])
+    assert float(ga.numpy()) == 15.0
+    with pytest.raises(NotImplementedError):
+        paddle.grad(y * 1, [a], create_graph=True)
+
+
+def test_decorate_enables_master_weights():
+    from paddle_tpu import amp
+
+    m = paddle.nn.Linear(4, 2)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+    assert not opt._multi_precision
+    m, opt = amp.decorate(m, opt, level="O2", dtype="bfloat16")
+    assert opt._multi_precision
+    # the state actually carries an fp32 master for bf16 params
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    loss = m(paddle.cast(x, "bfloat16")).sum()
+    loss.backward()
+    opt.step()
+    st = opt._state[id(m.weight)]
+    assert "master" in st and st["master"].dtype == np.float32
+
+
+def test_trainstep_tracks_frozen_param_updates():
+    from paddle_tpu.jit.trainer import TrainStep
+
+    paddle.seed(0)
+
+    class M(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.a = paddle.nn.Linear(4, 4)
+            self.b = paddle.nn.Linear(4, 1)
+
+    m = M()
+    for p in m.a.parameters():
+        p.trainable = False
+        p.stop_gradient = True
+    opt = paddle.optimizer.SGD(0.1, parameters=[p for p in m.parameters()
+                                                if p.trainable])
+    ce = paddle.nn.functional.mse_loss
+
+    def loss_fn(x, y):
+        return ce(m.b(m.a(x)), y)
+
+    step = TrainStep(m, loss_fn, opt)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    y = paddle.to_tensor(np.zeros((2, 1), np.float32))
+    l1 = float(step(x, y).numpy())
+    # mutate the FROZEN backbone; the compiled step must see it
+    m.a.weight.set_value(np.zeros((4, 4), np.float32))
+    l2 = float(step(x, y).numpy())
+    l3 = float(step(x, y).numpy())
+    # zeroed backbone -> predictions from bias only; loss must CHANGE
+    assert abs(l2 - l1) > 1e-6 or abs(l3 - l1) > 1e-6

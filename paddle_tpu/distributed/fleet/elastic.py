@@ -63,27 +63,50 @@ class ElasticManager:
             self._stop.wait(self.heartbeat_interval)
 
     def alive_nodes(self) -> Dict[int, float]:
-        """Scan heartbeat keys; a node is alive if its beat is within ttl."""
+        """Scan heartbeat keys; a node is alive if its beat VALUE changed
+        within ttl by THIS host's clock. Comparing a remote wall-clock
+        timestamp against the local clock would turn cross-host skew > ttl
+        into false dead/alive verdicts; only the local observation time of
+        a remote change is trustworthy."""
         now = time.time()
+        if not hasattr(self, "_last_seen"):
+            self._last_seen = {}  # rank -> (value, local time first seen)
         alive = {}
         for r in range(self.max_np):
+            if self.store.get(f"elastic/exit/{r}", blocking=False) is not None:
+                self._last_seen.pop(r, None)
+                continue  # departed cleanly: not alive, not a failure
             v = self.store.get(f"elastic/node/{r}", blocking=False)
-            if v is not None:
-                try:
-                    ts = float(v.decode())
-                except ValueError:
-                    continue
-                if now - ts <= self.ttl:
-                    alive[r] = ts
+            if v is None:
+                continue
+            prev = self._last_seen.get(r)
+            if prev is None or prev[0] != v:
+                self._last_seen[r] = (v, now)
+                alive[r] = now
+            elif now - prev[1] <= self.ttl:
+                alive[r] = prev[1]
         return alive
 
     def watch(self, expected_np: int) -> str:
-        """One membership check (reference: manager.py watch:120)."""
+        """One membership check (reference: manager.py watch:120).
+        Cleanly-exited ranks shrink the expectation instead of reading as
+        failures — a completed job must not restart forever."""
+        exited = 0
+        completed = 0
+        for r in range(self.max_np):
+            v = self.store.get(f"elastic/exit/{r}", blocking=False)
+            if v is not None:
+                exited += 1
+                if v.decode() == ElasticStatus.COMPLETED:
+                    completed += 1
         alive = self.alive_nodes()
         n = len(alive)
         for cb in self._watch_cbs:
             cb(alive)
-        if n == expected_np:
+        if exited and n == 0:
+            return (ElasticStatus.COMPLETED
+                    if completed == exited else ElasticStatus.ERROR)
+        if n + exited == expected_np:
             return ElasticStatus.HOLD
         if n < self.min_np:
             return ElasticStatus.ERROR
@@ -99,3 +122,7 @@ class ElasticManager:
             self._hb_thread.join(timeout=2.0)
         self.store.set(f"elastic/exit/{self.rank}",
                        ElasticStatus.COMPLETED if completed else ElasticStatus.ERROR)
+        try:  # drop the heartbeat so the departed rank never reads alive
+            self.store.delete(f"elastic/node/{self.rank}")
+        except Exception:
+            pass

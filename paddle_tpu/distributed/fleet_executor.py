@@ -159,10 +159,15 @@ class _MessageBus:
     _lock = threading.Lock()
     _cv = threading.Condition(_lock)
     _store: Dict[Any, Any] = {}
+    _dead_runs: "collections.OrderedDict" = None  # tombstoned run ids
 
     @classmethod
     def deliver(cls, key, value: Any) -> None:
         with cls._cv:
+            dead = cls._dead_runs
+            if dead is not None and key[0] in dead:
+                return  # late delivery for a finished/aborted run: drop —
+                #         no future reset targets it, it would leak forever
             cls._store[key] = value
             cls._cv.notify_all()
 
@@ -186,13 +191,22 @@ class _MessageBus:
     @classmethod
     def reset(cls, run_id=None) -> None:
         """Clear entries — only this run's when run_id is given (a faster
-        rank may already have delivered results for the NEXT run)."""
+        rank may already have delivered results for the NEXT run). The id
+        is tombstoned so stragglers delivering after the reset are dropped
+        instead of accumulating for the process lifetime."""
+        import collections
+
         with cls._cv:
             if run_id is None:
                 cls._store.clear()
             else:
                 for k in [k for k in cls._store if k[0] == run_id]:
                     del cls._store[k]
+                if cls._dead_runs is None:
+                    cls._dead_runs = collections.OrderedDict()
+                cls._dead_runs[run_id] = True
+                while len(cls._dead_runs) > 256:
+                    cls._dead_runs.popitem(last=False)
 
 
 class DistFleetExecutor(FleetExecutor):

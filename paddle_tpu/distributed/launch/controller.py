@@ -119,7 +119,8 @@ class CollectiveController:
         """
         ctx = self.ctx
         if ctx.nnodes <= 1:
-            return [f"127.0.0.1:{free_port()}" for _ in range(ctx.nproc_per_node)]
+            return [f"127.0.0.1:{free_port()}"
+                    for _ in range(ctx.nproc_per_node)]
         from ... import native
 
         if getattr(self, "_store", None) is not None:
@@ -128,11 +129,17 @@ class CollectiveController:
         host, port = ctx.master.split(":")
         store = native.TCPStore(host, int(port), is_master=(ctx.node_rank == 0),
                                 world_size=ctx.nnodes)
-        me = f"{_node_ip()}:{free_port()}"
-        store.set(f"peer/{attempt}/{ctx.node_rank}", me)
+        # publish ONE endpoint PER TRAINER PROCESS (the consumers --
+        # env.py trainer_endpoints, fleet role makers -- index the list by
+        # global rank, and every jax process needs a distinct id/port)
+        ip = _node_ip()
+        mine = [f"{ip}:{free_port()}" for _ in range(ctx.nproc_per_node)]
+        store.set(f"peer/{attempt}/{ctx.node_rank}", ",".join(mine))
         store.add(f"peers_ready/{attempt}", 1)
         store.wait_ge(f"peers_ready/{attempt}", ctx.nnodes)
-        peers = [store.get(f"peer/{attempt}/{i}").decode() for i in range(ctx.nnodes)]
+        peers = []
+        for i in range(ctx.nnodes):
+            peers.extend(store.get(f"peer/{attempt}/{i}").decode().split(","))
         self._store = store  # keep master alive for the job's lifetime
         return peers
 
@@ -154,11 +161,12 @@ class CollectiveController:
                 env["PADDLE_MASTER"] = ctx.master
             if ctx.nnodes > 1:
                 env["PADDLE_TRAINER_ENDPOINTS"] = ",".join(endpoints)
-                env["PADDLE_CURRENT_ENDPOINT"] = endpoints[ctx.node_rank]
-                # single-controller JAX: coordinator = node 0's endpoint
+                # per-TRAINER endpoint and jax process id: two local ranks
+                # must not share a bind port or a process slot
+                env["PADDLE_CURRENT_ENDPOINT"] = endpoints[rank]
                 env["JAX_COORDINATOR_ADDRESS"] = endpoints[0]
-                env["JAX_NUM_PROCESSES"] = str(ctx.nnodes)
-                env["JAX_PROCESS_ID"] = str(ctx.node_rank)
+                env["JAX_NUM_PROCESSES"] = str(world)
+                env["JAX_PROCESS_ID"] = str(rank)
             if ctx.devices is not None:
                 devs = ctx.devices.split(",")
                 per = max(1, len(devs) // ctx.nproc_per_node)

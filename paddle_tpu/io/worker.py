@@ -184,6 +184,13 @@ class _SlotRing:
         self.outstanding[s] += 1
         return s, self.segs[s]
 
+    def release(self, s):
+        """Undo an acquire whose payload never shipped (pack failure): the
+        parent will never ack it, so the count must roll back here or the
+        ring deadlocks when it wraps to slot s."""
+        if self.outstanding[s] > 0:
+            self.outstanding[s] -= 1
+
     def close(self):
         for seg in self.segs:
             if seg is not None:
@@ -227,7 +234,13 @@ def worker_loop(dataset, collate_fn, task_q, out_q, ack_q, done_event, wid,
                         slot, seg = ring.acquire(nbytes, ack_q, done_event)
                         if slot is None:
                             return
-                        payload = _pack(data, seg)
+                        try:
+                            payload = _pack(data, seg)
+                        except Exception:
+                            # roll the acquire back: an unacked slot would
+                            # deadlock the ring when it wraps around
+                            ring.release(slot)
+                            raise
                         out_q.put((epoch, i, wid, slot, seg.name, payload))
                         continue
                 out_q.put((epoch, i, wid, None, None, data))

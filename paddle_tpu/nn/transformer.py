@@ -12,6 +12,11 @@ from .norm import LayerNorm
 
 
 class MultiHeadAttention(Layer):
+    import collections as _collections
+
+    Cache = _collections.namedtuple("Cache", ["k", "v"])
+    StaticCache = _collections.namedtuple("StaticCache", ["k", "v"])
+
     def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None, vdim=None,
                  need_weights=False, weight_attr=None, bias_attr=None):
         super().__init__()
@@ -38,15 +43,48 @@ class MultiHeadAttention(Layer):
         if cache is not None:
             k = api.concat([cache[0], k], axis=1)
             v = api.concat([cache[1], v], axis=1)
-        out = F.scaled_dot_product_attention(
-            q, k, v, attn_mask=attn_mask, dropout_p=self.dropout if self.training else 0.0,
-            training=self.training,
-        )
+        weights = None
+        if self.need_weights:
+            # explicit-softmax path: the fused SDPA never materializes the
+            # probability tensor the (out, weights) contract returns
+            import math
+
+            scores = api.scale(
+                api.matmul(api.transpose(q, [0, 2, 1, 3]),
+                           api.transpose(k, [0, 2, 1, 3]),
+                           transpose_y=True),
+                1.0 / math.sqrt(self.head_dim))
+            if attn_mask is not None:
+                scores = api.add(scores, attn_mask)
+            weights = api.softmax(scores, axis=-1)
+            out = api.transpose(api.matmul(weights, api.transpose(
+                v, [0, 2, 1, 3])), [0, 2, 1, 3])
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask,
+                dropout_p=self.dropout if self.training else 0.0,
+                training=self.training,
+            )
         out = api.reshape(out, [b, sq, self.embed_dim])
         out = self.out_proj(out)
+        outs = (out,)
+        if self.need_weights:
+            outs = outs + (weights,)
         if cache is not None:
-            return out, (k, v)
-        return out
+            outs = outs + (self.Cache(k, v),)
+        return outs[0] if len(outs) == 1 else outs
+
+    def gen_cache(self, key, value=None, type=None):  # noqa: A002
+        """Empty incremental-decode cache (reference MHA.gen_cache): k/v
+        grow by concat on each cached forward."""
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+
+        b = key.shape[0]
+        empty = Tensor(jnp.zeros((b, 0, self.num_heads, self.head_dim),
+                                 jnp.float32))
+        return self.Cache(empty, empty)
 
 
 class TransformerEncoderLayer(Layer):
@@ -138,7 +176,12 @@ class TransformerDecoderLayer(Layer):
         residual = tgt
         if self.normalize_before:
             tgt = self.norm1(tgt)
-        tgt = self.self_attn(tgt, attn_mask=tgt_mask)
+        new_cache = None
+        if cache is not None:
+            tgt, new_cache = self.self_attn(tgt, attn_mask=tgt_mask,
+                                            cache=cache)
+        else:
+            tgt = self.self_attn(tgt, attn_mask=tgt_mask)
         tgt = residual + self.dropout1(tgt)
         if not self.normalize_before:
             tgt = self.norm1(tgt)
@@ -156,7 +199,12 @@ class TransformerDecoderLayer(Layer):
         tgt = residual + self.dropout3(tgt)
         if not self.normalize_before:
             tgt = self.norm3(tgt)
+        if new_cache is not None:
+            return tgt, new_cache
         return tgt
+
+    def gen_cache(self, memory):
+        return self.self_attn.gen_cache(memory)
 
 
 class TransformerDecoder(Layer):
@@ -168,13 +216,27 @@ class TransformerDecoder(Layer):
         self.num_layers = num_layers
         self.norm = norm
 
-    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None):
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
+                cache=None):
         out = tgt
-        for layer in self.layers:
-            out = layer(out, memory, tgt_mask=tgt_mask, memory_mask=memory_mask)
+        new_caches = []
+        for i, layer in enumerate(self.layers):
+            if cache is not None:
+                out, nc = layer(out, memory, tgt_mask=tgt_mask,
+                                memory_mask=memory_mask, cache=cache[i])
+                new_caches.append(nc)
+            else:
+                out = layer(out, memory, tgt_mask=tgt_mask,
+                            memory_mask=memory_mask)
         if self.norm is not None:
             out = self.norm(out)
+        if cache is not None:
+            return out, new_caches
         return out
+
+    def gen_cache(self, memory, do_zip=False):
+        caches = [layer.gen_cache(memory) for layer in self.layers]
+        return list(zip(*caches)) if do_zip else caches
 
 
 class Transformer(Layer):
@@ -190,13 +252,15 @@ class Transformer(Layer):
         else:
             enc_layer = TransformerEncoderLayer(d_model, nhead, dim_feedforward, dropout,
                                                 activation, attn_dropout, act_dropout, normalize_before)
-            self.encoder = TransformerEncoder(enc_layer, num_encoder_layers, LayerNorm(d_model) if normalize_before else None)
+            self.encoder = TransformerEncoder(enc_layer, num_encoder_layers,
+                                              LayerNorm(d_model))
         if custom_decoder is not None:
             self.decoder = custom_decoder
         else:
             dec_layer = TransformerDecoderLayer(d_model, nhead, dim_feedforward, dropout,
                                                 activation, attn_dropout, act_dropout, normalize_before)
-            self.decoder = TransformerDecoder(dec_layer, num_decoder_layers, LayerNorm(d_model) if normalize_before else None)
+            self.decoder = TransformerDecoder(dec_layer, num_decoder_layers,
+                                              LayerNorm(d_model))
 
     def forward(self, src, tgt, src_mask=None, tgt_mask=None, memory_mask=None):
         memory = self.encoder(src, src_mask=src_mask)

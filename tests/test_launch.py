@@ -98,7 +98,13 @@ class TestElastic:
         port = free_port()
         m = ElasticManager(host="127.0.0.1", port=port, rank=0, np_range=(1, 2),
                            heartbeat_interval=10.0, ttl=0.3)
-        m.store.set("elastic/node/1", str(time.time() - 100))  # stale peer
+        # liveness is CHANGE-based (local observation clock), immune to
+        # cross-host clock skew: a peer is alive on first sight, and dead
+        # once its value stops changing for ttl
+        m.store.set("elastic/node/1", "42")  # some peer value
         m._beat()
+        assert set(m.alive_nodes()) == {0, 1}  # first sight: alive
+        time.sleep(0.4)  # > ttl with no change from rank 1
+        m._beat()  # rank 0 keeps beating (value changes)
         assert set(m.alive_nodes()) == {0}
         m.exit()

@@ -500,7 +500,11 @@ class Exponential(ExponentialFamily):
 
 
 class Geometric(Distribution):
-    """Reference: python/paddle/distribution/geometric.py (failures before success)."""
+    """Reference: python/paddle/distribution/geometric.py. NOTE the
+    reference is internally inconsistent (its class docstring states the
+    failures convention k>=0, but its pmf/mean implement TRIALS:
+    P(X=k) = (1-p)^(k-1) p for k>=1, mean 1/p); this implementation
+    follows the reference's executable behavior (trials)."""
 
     def __init__(self, probs, name=None):
         self.probs = _param(probs)
@@ -808,7 +812,10 @@ class Binomial(Distribution):
         p = F.unsqueeze(F.broadcast_to(self.probs, list(self.batch_shape) or [1]), -1)
         n = float(self.total_count)
         log_comb = F.lgamma(_as_tensor(n + 1.0)) - F.lgamma(ks + 1.0) - F.lgamma(n - ks + 1.0)
-        lp = log_comb + ks * F.log(p) + (n - ks) * F.log(1.0 - p)
+        # clip like log_prob: p of exactly 0/1 makes 0*log(0) terms NaN
+        # where the entropy limit is 0
+        pc = F.clip(p, 1e-7, 1.0 - 1e-7)
+        lp = log_comb + ks * F.log(pc) + (n - ks) * F.log(1.0 - pc)
         prob = F.exp(lp)
         ent = -F.sum(prob * lp, axis=-1)
         return ent if self.batch_shape else F.squeeze(ent)

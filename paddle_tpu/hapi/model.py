@@ -29,6 +29,9 @@ class Model:
         self._optimizer = optimizer
         self._loss = loss
         self._metrics = metrics if isinstance(metrics, (list, tuple)) else ([metrics] if metrics else [])
+        # a re-prepare with a new optimizer/loss must invalidate the
+        # compiled step, or training silently continues with the old ones
+        self._train_step = None
         return self
 
     def _get_train_step(self):
@@ -102,7 +105,9 @@ class Model:
             if self._loss is not None:
                 losses.append(float(self._loss(out, y).item()))
             for m in self._metrics:
-                m.update(m.compute(out, y))
+                r = m.compute(out, y)
+                # reference contract: compute's outputs UNPACK into update
+                m.update(*r) if isinstance(r, tuple) else m.update(r)
         result = {"loss": [float(np.mean(losses))] if losses else []}
         for m in self._metrics:
             result[m.name()] = m.accumulate()
@@ -148,9 +153,19 @@ class Model:
             _save(self._optimizer.state_dict(), path + ".pdopt")
 
     def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        import os
+
         from ..framework.io import load as _load
 
-        self.network.set_state_dict(_load(path + ".pdparams"))
+        state = _load(path + ".pdparams")
+        if skip_mismatch:
+            own = self.network.state_dict()
+            state = {k: v for k, v in state.items()
+                     if k in own and tuple(own[k].shape) == tuple(v.shape)}
+        self.network.set_state_dict(state)
+        if not reset_optimizer and self._optimizer is not None                 and os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(_load(path + ".pdopt"))
+            self._train_step = None  # rebuild over the restored state
 
     def parameters(self):
         return self.network.parameters()

@@ -135,20 +135,20 @@ class Auc(Metric):
         preds = preds.reshape(-1)
         labels = labels.reshape(-1)
         idx = np.clip((preds * self.num_thresholds).astype(np.int64), 0, self.num_thresholds)
-        for i, l in zip(idx, labels):
-            if l:
-                self._stat_pos[i] += 1
-            else:
-                self._stat_neg[i] += 1
+        lab = labels.astype(bool)
+        nbins = self.num_thresholds + 1
+        self._stat_pos += np.bincount(idx[lab], minlength=nbins)[:nbins]
+        self._stat_neg += np.bincount(idx[~lab], minlength=nbins)[:nbins]
 
     def accumulate(self):
         tot_pos = self._stat_pos.sum()
         tot_neg = self._stat_neg.sum()
         if tot_pos == 0 or tot_neg == 0:
             return 0.0
-        # trapezoid over thresholds, descending
-        pos_cum = np.cumsum(self._stat_pos[::-1])
-        neg_cum = np.cumsum(self._stat_neg[::-1])
+        # trapezoid over thresholds, descending, anchored at (0,0) — the
+        # anchor carries the first trapezoid when the TOP bin holds mass
+        pos_cum = np.concatenate([[0], np.cumsum(self._stat_pos[::-1])])
+        neg_cum = np.concatenate([[0], np.cumsum(self._stat_neg[::-1])])
         tpr = pos_cum / tot_pos
         fpr = neg_cum / tot_neg
         return float(np.trapz(tpr, fpr))

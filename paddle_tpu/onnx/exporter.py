@@ -224,6 +224,14 @@ def _export_eqn(b: OnnxBuilder, eqn, name_of):
         nd = len(pr["window_strides"])
         if dn.lhs_spec != tuple(range(nd + 2)) or dn.rhs_spec != tuple(range(nd + 2)):
             raise NotImplementedError("conv layout != NCHW/OIHW")
+        if any(d != 1 for d in pr.get("lhs_dilation", ())) or \
+                int(pr.get("batch_group_count", 1)) != 1:
+            # transposed conv (input dilation): emitting a plain Conv node
+            # would compute a DIFFERENT operation — raise so the exporter's
+            # documented StableHLO fallback takes over
+            raise NotImplementedError(
+                "conv_general_dilated with lhs_dilation (transposed conv) "
+                "has no direct ONNX Conv mapping")
         pads = [lo for lo, _ in pr["padding"]] + [hi for _, hi in pr["padding"]]
         b.node("Conv", ins, outs,
                strides=list(pr["window_strides"]),

@@ -20,12 +20,16 @@ class QAT:
 
     def _wrap(self, layer, name=None):
         a, w = self.config.get_config(layer, name)
-        for src, dst in {**_DEFAULT_MAPPING,
-                         **getattr(self.config, "_qat_mapping", {})}.items():
-            if isinstance(layer, src):
-                return dst(layer,
-                           activation_quanter=a() if a else None,
-                           weight_quanter=w() if w else None)
+        # user mappings take precedence over the generic defaults — a
+        # Linear SUBCLASS registered by the user (e.g. a tensor-parallel
+        # linear) must not be shadowed by isinstance(layer, Linear)
+        user = getattr(self.config, "_qat_mapping", {})
+        for mapping in (user, _DEFAULT_MAPPING):
+            for src, dst in mapping.items():
+                if isinstance(layer, src):
+                    return dst(layer,
+                               activation_quanter=a() if a else None,
+                               weight_quanter=w() if w else None)
         return None
 
     def quantize(self, model: Layer, inplace: bool = False) -> Layer:

@@ -17,7 +17,9 @@ from ..ops import api as F
 
 def fake_quant_dequant(x: Tensor, scale: float, bits: int = 8) -> Tensor:
     bound = float(2 ** (bits - 1) - 1)
-    s = max(scale, 1e-8) / bound
+    # scale may be a traced array (QAT inside a compiled step)
+    s = (jnp.maximum(scale, 1e-8) if hasattr(scale, "dtype")
+         else max(scale, 1e-8)) / bound
     q = jnp.clip(jnp.round(x._value / s), -bound, bound) * s
     delta = Tensor(q - x._value)  # detached STE correction
     delta.stop_gradient = True
@@ -31,7 +33,17 @@ class FakeQuanterWithAbsMax:
         self._scale = None
 
     def __call__(self, x: Tensor) -> Tensor:
-        m = float(jnp.max(jnp.abs(x._value)))
+        import jax
+
+        m = jnp.max(jnp.abs(x._value))
+        if isinstance(m, jax.core.Tracer):
+            # inside a compiled step (TrainStep/jit): the moving average is
+            # python state and cannot update per traced call — use the
+            # current batch's absmax (stop-gradient, standard QAT inside
+            # graphs); the eager path keeps the EMA
+            scale = jax.lax.stop_gradient(m)
+            return fake_quant_dequant(x, scale, self.quant_bits)
+        m = float(m)
         if self._scale is None:
             self._scale = m
         else:

@@ -583,3 +583,105 @@ def test_trainstep_tracks_frozen_param_updates():
     l3 = float(step(x, y).numpy())
     # zeroed backbone -> predictions from bias only; loss must CHANGE
     assert abs(l2 - l1) > 1e-6 or abs(l3 - l1) > 1e-6
+
+
+def test_hapi_metric_plumbing_and_reload(tmp_path):
+    """evaluate must unpack compute's outputs into update (Precision/Auc
+    crashed); load restores optimizer state; re-prepare invalidates the
+    cached step."""
+    from paddle_tpu.metric import Accuracy, Metric
+
+    class TwoArg(Metric):
+        """Metric whose update REQUIRES compute's tuple to be unpacked
+        (the reference update(*compute(...)) contract)."""
+
+        def __init__(self):
+            super().__init__()
+            self.n = 0
+
+        def update(self, pred, label):
+            self.n += int(np.asarray(
+                label._value if hasattr(label, "_value") else label).size)
+
+        def reset(self):
+            self.n = 0
+
+        def accumulate(self):
+            return self.n
+
+        def name(self):
+            return "two_arg"
+
+    paddle.seed(0)
+
+    class Flat(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = paddle.nn.Linear(28 * 28, 2)
+
+        def forward(self, x):
+            return self.fc(paddle.reshape(x, [x.shape[0], -1]))
+
+    net = Flat()
+    model = paddle.Model(net)
+    opt = paddle.optimizer.Adam(1e-3, parameters=net.parameters())
+    model.prepare(opt, paddle.nn.CrossEntropyLoss(),
+                  metrics=[Accuracy(), TwoArg()])
+    ds = [(np.random.RandomState(i).rand(1, 28, 28).astype(np.float32),
+           np.int64(i % 2)) for i in range(32)]
+    model.fit(ds, batch_size=8, epochs=1, verbose=0)
+    res = model.evaluate(ds, batch_size=8, verbose=0)
+    assert res["two_arg"] == 32
+
+    path = str(tmp_path / "ck")
+    model.save(path)
+    model2 = paddle.Model(Flat())
+    opt2 = paddle.optimizer.Adam(1e-3, parameters=model2.network.parameters())
+    model2.prepare(opt2, paddle.nn.CrossEntropyLoss())
+    model2.load(path)
+    # optimizer moments restored (non-empty state)
+    assert opt2.state_dict(), "optimizer state must be restored from .pdopt"
+
+
+def test_auc_top_bin_anchor():
+    from paddle_tpu.metric import Auc
+
+    m = Auc(num_thresholds=4)
+    m.update(np.array([1.0, 1.0, 1.0, 1.0]), np.array([1, 0, 1, 0]))
+    assert abs(m.accumulate() - 0.5) < 1e-9
+
+
+def test_qat_trains_under_compiled_step():
+    from paddle_tpu.quantization import QAT, QuantConfig
+    from paddle_tpu.quantization.quanters import FakeQuanterWithAbsMax
+
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 8), paddle.nn.ReLU(),
+                               paddle.nn.Linear(8, 1))
+    cfg = QuantConfig(activation=FakeQuanterWithAbsMax,
+                      weight=FakeQuanterWithAbsMax)
+    q = QAT(cfg).quantize(net, inplace=True)
+    opt = paddle.optimizer.SGD(0.05, parameters=q.parameters())
+    from paddle_tpu.jit.trainer import TrainStep
+
+    mse = paddle.nn.functional.mse_loss
+
+    def loss_fn(x, y):
+        return mse(q(x), y)
+
+    step = TrainStep(q, loss_fn, opt)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8)
+                         .astype(np.float32))
+    y = paddle.to_tensor(np.zeros((4, 1), np.float32))
+    l1 = float(step(x, y).numpy())
+    for _ in range(5):
+        l2 = float(step(x, y).numpy())
+    assert np.isfinite(l2) and l2 < l1
+
+
+def test_binomial_entropy_degenerate_probs():
+    from paddle_tpu.distribution import Binomial
+
+    for pr in (0.0, 1.0):
+        e = Binomial(10, pr).entropy()
+        assert np.isfinite(float(np.asarray(e._value))), pr

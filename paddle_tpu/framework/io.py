@@ -16,17 +16,25 @@ from ..nn.layer import Parameter
 
 
 class _TensorPayload:
-    def __init__(self, array, is_param, name):
+    def __init__(self, array, is_param, name, trainable=True,
+                 stop_gradient=True):
         self.array = array
         self.is_param = is_param
         self.name = name
+        self.trainable = trainable
+        self.stop_gradient = stop_gradient
 
 
 def _pack(obj):
     if isinstance(obj, Tensor):
-        return _TensorPayload(np.asarray(obj._value), isinstance(obj, Parameter), obj.name)
+        return _TensorPayload(np.asarray(obj._value),
+                              isinstance(obj, Parameter), obj.name,
+                              trainable=getattr(obj, "trainable", True),
+                              stop_gradient=obj.stop_gradient)
     if isinstance(obj, dict):
         return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, tuple) and hasattr(obj, "_fields"):  # namedtuple
+        return type(obj)(*(_pack(v) for v in obj))
     if isinstance(obj, (list, tuple)):
         t = type(obj)
         return t(_pack(v) for v in obj)
@@ -37,10 +45,17 @@ def _unpack(obj, return_numpy=False):
     if isinstance(obj, _TensorPayload):
         if return_numpy:
             return obj.array
-        t = Parameter(obj.array, name=obj.name) if obj.is_param else Tensor(obj.array)
+        if obj.is_param:
+            t = Parameter(obj.array, name=obj.name,
+                          trainable=getattr(obj, "trainable", True))
+        else:
+            t = Tensor(obj.array)
+            t.stop_gradient = getattr(obj, "stop_gradient", True)
         return t
     if isinstance(obj, dict):
         return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, tuple) and hasattr(obj, "_fields"):  # namedtuple
+        return type(obj)(*(_unpack(v, return_numpy) for v in obj))
     if isinstance(obj, (list, tuple)):
         t = type(obj)
         return t(_unpack(v, return_numpy) for v in obj)

@@ -109,7 +109,16 @@ from ..ops.api import (  # noqa: F401
     npair_loss,
     hsigmoid_loss,
 )
-from ..ops.api import softmax as softmax_  # noqa: F401
+def softmax_(x, axis=-1, dtype=None, name=None):
+    """In-place softmax (reference F.softmax_): rebinds x's value like the
+    other *_ shims — the previous alias to the out-of-place op silently
+    left x untouched."""
+    out = _api.softmax(x, axis=axis)
+    x._value = out._value
+    x._grad_node = out._grad_node
+    if not out.stop_gradient:
+        x.stop_gradient = False
+    return x
 from ..ops import api as _api
 
 

@@ -169,8 +169,15 @@ def put_along_axis(x, indices, values, axis, reduce="assign"):
         return jnp.put_along_axis(x, indices, values, axis=axis, inplace=False)
     dims = list(range(x.ndim))
     if reduce == "add":
-        # build scatter via .at
-        idx = [jnp.arange(s).reshape([-1 if i == d else 1 for i in dims]) for d, s in enumerate(indices.shape)]
+        # broadcast indices/values against x on non-axis dims first (numpy
+        # put_along_axis semantics, paddle broadcast=True) — building the
+        # grid from indices.shape alone would touch only the given rows
+        bshape = [x.shape[d] if d != axis else indices.shape[d]
+                  for d in dims]
+        indices = jnp.broadcast_to(indices, bshape)
+        values = jnp.broadcast_to(values, bshape)
+        idx = [jnp.arange(s).reshape([-1 if i == d else 1 for i in dims])
+               for d, s in enumerate(indices.shape)]
         idx[axis] = indices
         return x.at[tuple(jnp.broadcast_arrays(*idx))].add(values)
     raise ValueError(f"unsupported reduce {reduce}")
@@ -287,9 +294,9 @@ def sort(x, axis=-1, descending=False, stable=True):
 
 
 def argsort(x, axis=-1, descending=False, stable=True):
-    idx = jnp.argsort(x, axis=axis, stable=stable)
-    if descending:
-        idx = jnp.flip(idx, axis=axis)
+    # flipping a stable ASCENDING argsort reverses tie order (anti-
+    # stable); jnp.argsort's descending flag preserves stability
+    idx = jnp.argsort(x, axis=axis, stable=stable, descending=descending)
     return idx.astype(jnp.int64)
 
 

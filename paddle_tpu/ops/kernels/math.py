@@ -137,7 +137,11 @@ def ceil(x):
 
 
 def round(x):
-    return jnp.round(x)
+    # paddle rounds half AWAY FROM ZERO (std::round); jnp.round is
+    # half-to-even
+    if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+        return jnp.sign(x) * jnp.floor(jnp.abs(x) + 0.5)
+    return jnp.asarray(x)
 
 
 def trunc(x):
@@ -258,19 +262,38 @@ def cumprod(x, dim=None, dtype=None):
     return jnp.cumprod(x, axis=dim, dtype=dtype)
 
 
+def _cum_argext(x, axis, op):
+    """Running (values, indices) for cummax/cummin: scan over (value, idx)
+    pairs keeping the FIRST extreme on ties, like the reference kernel."""
+    idx0 = jnp.broadcast_to(
+        jnp.expand_dims(jnp.arange(x.shape[axis]),
+                        tuple(d for d in range(x.ndim) if d != axis)),
+        x.shape)
+
+    def comb(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = op(bv, av) & (bv != av)
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+    vals, idxs = lax.associative_scan(comb, (x, idx0), axis=axis)
+    return vals, idxs.astype(jnp.int64)
+
+
 def cummax(x, axis=None):
+    """Returns (values, indices), matching paddle.cummax."""
     if axis is None:
         x = x.reshape(-1)
         axis = 0
-    vals = lax.associative_scan(jnp.maximum, x, axis=axis)
-    return vals
+    return _cum_argext(x, axis, jnp.greater)
 
 
 def cummin(x, axis=None):
+    """Returns (values, indices), matching paddle.cummin."""
     if axis is None:
         x = x.reshape(-1)
         axis = 0
-    return lax.associative_scan(jnp.minimum, x, axis=axis)
+    return _cum_argext(x, axis, jnp.less)
 
 
 def logaddexp(x, y):
@@ -401,11 +424,15 @@ def polygamma(x, n):
 
 
 def igamma(x, a):
-    return jax.scipy.special.gammainc(a, x)
+    """paddle.igamma(x, a) = regularized UPPER incomplete gamma with x as
+    the shape parameter and a as the integral's lower limit (note the
+    reference's unusual argument order): Q(x, a) = gammaincc(x, a)."""
+    return jax.scipy.special.gammaincc(x, a)
 
 
 def igammac(x, a):
-    return jax.scipy.special.gammaincc(a, x)
+    """Complement: the regularized LOWER incomplete gamma P(x, a)."""
+    return jax.scipy.special.gammainc(x, a)
 
 
 def sinc(x):

@@ -472,9 +472,43 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=F
         size = tuple(int(s) for s in size)
     else:
         size = (int(size),) * nsp
+    if mode == "area":
+        # reference 'area' = adaptive average pooling (block means), NOT a
+        # linear resample
+        out = x
+        for ax_i, new_len in enumerate(size):
+            axis = (1 + ax_i) if channel_last else (2 + ax_i)
+            old_len = out.shape[axis]
+            if new_len == old_len:
+                continue
+            # mean over each adaptive window [floor(i*old/new),
+            # ceil((i+1)*old/new)) along this axis
+            starts = (jnp.arange(new_len) * old_len) // new_len
+            ends = -(-(jnp.arange(1, new_len + 1) * old_len) // new_len)
+            pos = jnp.arange(old_len)
+            w = ((pos[None, :] >= starts[:, None])
+                 & (pos[None, :] < ends[:, None])).astype(out.dtype)
+            w = w / w.sum(axis=1, keepdims=True)
+            out = jnp.moveaxis(
+                jnp.tensordot(w, jnp.moveaxis(out, axis, 0), axes=1),
+                0, axis)
+        return out
+    if mode == "nearest" and not align_corners:
+        # reference nearest (align_corners=False): src = floor(dst*scale),
+        # not jax.image.resize's half-pixel rounding
+        out = x
+        for ax_i, new_len in enumerate(size):
+            axis = (1 + ax_i) if channel_last else (2 + ax_i)
+            old_len = out.shape[axis]
+            if new_len == old_len:
+                continue
+            src = jnp.clip((jnp.arange(new_len) * old_len) // new_len, 0,
+                           old_len - 1)
+            out = jnp.take(out, src, axis=axis)
+        return out
     method = {"nearest": "nearest", "linear": "linear", "bilinear": "bilinear",
               "trilinear": "trilinear", "bicubic": "bicubic",
-              "cubic": "bicubic", "area": "linear"}[mode]
+              "cubic": "bicubic"}[mode]
     if align_corners and mode != "nearest":
         # jax.image.resize only samples the half-pixel grid, so build the
         # corner-aligned grid explicitly: out coord i maps to

@@ -113,6 +113,11 @@ def topk(x, k, axis=-1, largest=True, sorted=True):
     xm = jnp.moveaxis(x, axis, -1) if moved else x
     if largest:
         vals, idx = jax.lax.top_k(xm, k)
+    elif jnp.issubdtype(xm.dtype, jnp.unsignedinteger) or             jnp.issubdtype(xm.dtype, jnp.signedinteger):
+        # negation wraps for unsigned and overflows INT_MIN: take the
+        # smallest k via a stable ascending argsort instead
+        idx = jnp.argsort(xm, axis=-1, stable=True)[..., :k]
+        vals = jnp.take_along_axis(xm, idx, axis=-1)
     else:
         vals, idx = jax.lax.top_k(-xm, k)
         vals = -vals

@@ -685,3 +685,76 @@ def test_binomial_entropy_degenerate_probs():
     for pr in (0.0, 1.0):
         e = Binomial(10, pr).entropy()
         assert np.isfinite(float(np.asarray(e._value))), pr
+
+
+def test_numeric_semantics_vs_reference():
+    """Batch-7 regressions: igamma orientation, cummax indices, stable
+    descending argsort, half-away rounding, put_along_axis broadcast,
+    area/nearest interpolation, unsigned topk, io payload flags."""
+    import scipy.special as sp
+
+    import paddle_tpu.nn.functional as F
+
+    x = paddle.to_tensor(np.array([2.0], np.float32))
+    a = paddle.to_tensor(np.array([1.0], np.float32))
+    assert abs(float(paddle.igamma(x, a).numpy())
+               - sp.gammaincc(2.0, 1.0)) < 1e-5
+    assert abs(float(paddle.igammac(x, a).numpy())
+               - sp.gammainc(2.0, 1.0)) < 1e-5
+
+    v, i = paddle.cummax(paddle.to_tensor(
+        np.array([3., 1., 4., 4., 2.], np.float32)))
+    assert np.allclose(v.numpy(), [3, 3, 4, 4, 4])
+    assert np.array_equal(i.numpy(), [0, 0, 2, 2, 2])
+
+    idx = paddle.argsort(paddle.to_tensor(
+        np.array([3., 1., 3., 2., 3.], np.float32)), descending=True)
+    assert np.array_equal(idx.numpy(), [0, 2, 4, 3, 1])  # stable ties
+
+    r = paddle.round(paddle.to_tensor(
+        np.array([0.5, 2.5, -0.5, -2.5], np.float32)))
+    assert np.allclose(r.numpy(), [1, 3, -1, -3])  # half away from zero
+
+    base = paddle.to_tensor(np.zeros((2, 3), np.float32))
+    out = paddle.put_along_axis(base, paddle.to_tensor(
+        np.array([[0, 1, 2]], np.int64)),
+        paddle.to_tensor(np.ones((1, 3), np.float32)), 1, reduce="add")
+    assert np.allclose(out.numpy(), 1.0)  # broadcast over BOTH rows
+
+    img = paddle.to_tensor(np.arange(16, dtype=np.float32)
+                           .reshape(1, 1, 4, 4))
+    area = F.interpolate(img, size=[2, 2], mode="area")
+    want = img.numpy().reshape(1, 1, 2, 2, 2, 2).mean(axis=(3, 5))
+    assert np.allclose(area.numpy(), want)
+    near = F.interpolate(paddle.to_tensor(
+        np.arange(3, dtype=np.float32).reshape(1, 1, 1, 3)),
+        size=[1, 2], mode="nearest")
+    assert np.allclose(near.numpy().ravel(), [0, 1])  # floor grid
+
+    tv, ti = paddle.topk(paddle.to_tensor(
+        np.array([0, 1, 5], np.uint8)), 2, largest=False)
+    assert np.array_equal(tv.numpy(), [0, 1])
+
+    z = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    F.softmax_(z)
+    assert abs(float(z.numpy().sum()) - 1.0) < 1e-6
+
+
+import collections
+
+_SaveNT = collections.namedtuple("_SaveNT", ["a", "t"])
+
+
+def test_io_preserves_flags_and_namedtuples(tmp_path):
+    from paddle_tpu.nn.layer import Parameter
+
+    p = Parameter(np.ones((2, 2), np.float32))
+    p.trainable = False
+    p.stop_gradient = True
+    NT = _SaveNT
+    path = str(tmp_path / "s.pd")
+    paddle.save({"p": p, "meta": NT(7, paddle.to_tensor(
+        np.zeros(2, np.float32)))}, path)
+    back = paddle.load(path)
+    assert isinstance(back["p"], Parameter) and back["p"].trainable is False
+    assert back["meta"].a == 7

@@ -96,7 +96,15 @@ class ProcessMesh:
         self._process_ids = arr.flatten().tolist()
         self._dim_names = list(dim_names) if dim_names else [f"d{i}" for i in range(arr.ndim)]
         devices = jax.devices()
-        devs = np.array([devices[i % len(devices)] for i in self._process_ids]).reshape(arr.shape)
+        if len(set(self._process_ids)) > len(devices):
+            # a modulo fallback would silently double-assign devices and
+            # corrupt every collective over the mesh
+            raise ValueError(
+                f"ProcessMesh needs {len(set(self._process_ids))} devices "
+                f"but only {len(devices)} are visible (set "
+                "xla_force_host_platform_device_count for CPU testing)")
+        devs = np.array([devices[i % len(devices)]
+                         for i in self._process_ids]).reshape(arr.shape)
         self.jax_mesh = Mesh(devs, tuple(self._dim_names))
 
     @property

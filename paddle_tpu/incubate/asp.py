@@ -30,26 +30,74 @@ def reset_excluded_layers(main_program=None):
     _excluded_names.clear()
 
 
-def create_mask(weight: np.ndarray, func_name: str = "mask_1d", n: int = 2,
-                m: int = 4) -> np.ndarray:
-    """n:m mask along the last axis (keep the n largest of every m)."""
-    w = np.abs(np.asarray(weight, np.float32))
-    orig_shape = w.shape
-    flat = w.reshape(-1, orig_shape[-1])
-    cols = orig_shape[-1]
-    pad = (-cols) % m
-    if pad:
-        flat = np.pad(flat, [(0, 0), (0, pad)])
+def _mask_1d(flat: np.ndarray, n: int, m: int) -> np.ndarray:
     groups = flat.reshape(flat.shape[0], -1, m)
     order = np.argsort(-groups, axis=-1)
     mask = np.zeros_like(groups)
     np.put_along_axis(mask, order[..., :n], 1.0, axis=-1)
-    mask = mask.reshape(flat.shape[0], -1)[:, :cols]
+    return mask.reshape(flat.shape[0], -1)
+
+
+def _mask_2d(flat: np.ndarray, n: int, m: int) -> np.ndarray:
+    """Row+column balanced n:m over m x m tiles (reference mask_2d_greedy:
+    keep the largest entries subject to <= n per row AND per column of
+    each tile)."""
+    rows, cols = flat.shape
+    rpad, cpad = (-rows) % m, (-cols) % m
+    wp = np.pad(flat, [(0, rpad), (0, cpad)])
+    R, C = wp.shape
+    out = np.zeros_like(wp)
+    for bi in range(0, R, m):
+        for bj in range(0, C, m):
+            tile = wp[bi:bi + m, bj:bj + m]
+            order = np.argsort(-tile, axis=None)
+            rcount = np.zeros(m, np.int64)
+            ccount = np.zeros(m, np.int64)
+            tm = np.zeros((m, m))
+            for flat_idx in order:
+                i, j = divmod(int(flat_idx), m)
+                if rcount[i] < n and ccount[j] < n:
+                    tm[i, j] = 1.0
+                    rcount[i] += 1
+                    ccount[j] += 1
+            out[bi:bi + m, bj:bj + m] = tm
+    return out[:rows, :cols]
+
+
+def create_mask(weight: np.ndarray, func_name: str = "mask_1d", n: int = 2,
+                m: int = 4) -> np.ndarray:
+    """n:m mask (keep the n largest of every m) along the REDUCTION axis.
+
+    The reference prunes fc/linear weights along in_features
+    (create_mask(weight.T).T for [in, out] layouts) so the pattern sits on
+    the GEMM reduction dim the sparse tensor cores consume; 2-D weights
+    here are transposed the same way. mask_2d_* produce row+column
+    balanced tiles."""
+    w = np.abs(np.asarray(weight, np.float32))
+    orig_shape = w.shape
+    transpose_2d = len(orig_shape) == 2
+    if transpose_2d:
+        w = w.T  # [out, in]: last axis = in_features (reduction)
+    shape = w.shape
+    flat = w.reshape(-1, shape[-1])
+    cols = shape[-1]
+    pad = (-cols) % m
+    if pad:
+        flat = np.pad(flat, [(0, 0), (0, pad)])
+    if func_name in ("mask_2d_greedy", "mask_2d_best"):
+        mask = _mask_2d(flat, n, m)
+    else:
+        mask = _mask_1d(flat, n, m)
+    mask = mask[:, :cols].reshape(shape)
+    if transpose_2d:
+        mask = mask.T
     return mask.reshape(orig_shape)
 
 
 def check_sparsity(weight: np.ndarray, n: int = 2, m: int = 4) -> bool:
     w = np.asarray(weight)
+    if w.ndim == 2:
+        w = w.T  # check along the reduction (in_features) axis
     flat = np.abs(w).reshape(-1, w.shape[-1])
     cols = w.shape[-1]
     pad = (-cols) % m

@@ -6,7 +6,6 @@ from __future__ import annotations
 from .ops.api import (  # noqa: F401
     cholesky,
     cholesky_solve,
-    cond,
     corrcoef,
     cov,
     det,
@@ -15,8 +14,6 @@ from .ops.api import (  # noqa: F401
     eigvals,
     eigvalsh,
     lstsq,
-    lu,
-    lu_unpack,
     matrix_power,
     matrix_rank,
     multi_dot,
@@ -29,6 +26,61 @@ from .ops.api import (  # noqa: F401
     triangular_solve,
 )
 from .ops.api import inverse as inv  # noqa: F401
+from .ops.api import lu as _lu_op  # noqa: F401
+from .ops.api import lu_unpack as _lu_unpack_op  # noqa: F401
+
+
+def cond(x, p=None, name=None):
+    """Matrix condition number (reference paddle.linalg.cond). NOTE: the
+    registry's `cond` is the CONTROL-FLOW op (lax.cond) — re-exporting it
+    here made the condition-number API unusable."""
+    from .ops import api as _api
+
+    if p in (None, 2, 2.0):
+        s = svd(x, full_matrices=False)[1]
+        return _api.divide(s[..., 0], s[..., -1])
+    if p in (-2, -2.0):
+        s = svd(x, full_matrices=False)[1]
+        return _api.divide(s[..., -1], s[..., 0])
+    if p in ("fro", "nuc", 1, -1, float("inf"), float("-inf")):
+        nx = norm(x, p=p, axis=(-2, -1))
+        ni = norm(inv(x), p=p, axis=(-2, -1))
+        return _api.multiply(nx, ni)
+    raise ValueError(f"unsupported p={p!r} for cond")
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    """paddle.linalg.lu: pivots are 1-INDEXED in the reference contract;
+    the kernel returns jax's 0-indexed pivots, converted here."""
+    from .ops import api as _api
+
+    lu_mat, piv = _lu_op(x)
+    piv1 = _api.add(piv, _as_int32_one(piv))
+    if get_infos:
+        import jax.numpy as jnp
+
+        from .core.tensor import Tensor
+
+        info = Tensor(jnp.zeros(x.shape[:-2], jnp.int32))
+        return lu_mat, piv1, info
+    return lu_mat, piv1
+
+
+def _as_int32_one(like):
+    import jax.numpy as jnp
+
+    from .core.tensor import Tensor
+
+    return Tensor(jnp.ones((), jnp.int32))
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """paddle.linalg.lu_unpack consumes the 1-indexed pivots lu() above
+    returns; the kernel expects 0-indexed."""
+    from .ops import api as _api
+
+    y0 = _api.subtract(y, _as_int32_one(y))
+    return _lu_unpack_op(x, y0, unpack_ludata, unpack_pivots)
 
 __all__ = [
     "cholesky", "norm", "cond", "cov", "corrcoef", "inv", "eig", "eigvals",

@@ -181,12 +181,28 @@ class GPTModel(nn.Layer):
         if self.config.use_rotary:
             import jax.numpy as jnp
 
-            d = self.config.hidden_size // self.config.num_heads
-            inv = 1.0 / (10000 ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
-            t = jnp.arange(seq_len, dtype=jnp.float32)
-            freqs = jnp.outer(t, inv)
-            emb = jnp.concatenate([freqs, freqs], axis=-1)
-            return Tensor(jnp.cos(emb)), Tensor(jnp.sin(emb))
+            import jax as _jax
+
+            cached = self._rope_cache
+            if cached is None or cached[0].shape[0] < seq_len:
+                # build once up to max_position_embeddings (llama.py does
+                # the same); slicing a cached table beats rebuilding the
+                # outer product on every forward / decode step
+                d = self.config.hidden_size // self.config.num_heads
+                n = max(seq_len, self.config.max_position_embeddings)
+                inv = 1.0 / (10000 ** (jnp.arange(0, d, 2,
+                                                  dtype=jnp.float32) / d))
+                t = jnp.arange(n, dtype=jnp.float32)
+                freqs = jnp.outer(t, inv)
+                emb = jnp.concatenate([freqs, freqs], axis=-1)
+                cached = (jnp.cos(emb), jnp.sin(emb))
+                if not isinstance(cached[0], _jax.core.Tracer):
+                    # never cache a TRACED table — it would escape the
+                    # trace and poison later calls; jit's own cache makes
+                    # the traced rebuild free anyway
+                    self._rope_cache = cached
+            return (Tensor(cached[0][:seq_len]),
+                    Tensor(cached[1][:seq_len]))
         return None
 
     def forward(self, input_ids, caches=None, pos=None, segments=None):

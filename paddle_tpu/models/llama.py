@@ -264,7 +264,9 @@ class LlamaForCausalLM(nn.Layer, GenerationMixin):
             if segments is not None:
                 seg_v = (segments._value if isinstance(segments, Tensor)
                          else jnp.asarray(segments))
-                same_doc = seg_v[:, 1:] == seg_v[:, :-1]
+                same_doc = (seg_v[:, 1:] == seg_v[:, :-1]) \
+                    & (seg_v[:, 1:] >= 0)  # padding (-1) pairs are not
+                #                            next-token examples either
                 shift_lab = jnp.where(same_doc, shift_lab, -100)
             shift_labels = api.reshape(Tensor(shift_lab), [-1])
             return F.cross_entropy(shift_logits, shift_labels)

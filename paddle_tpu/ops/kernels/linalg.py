@@ -331,4 +331,8 @@ def p_norm(x, porder=2.0, axis=-1, epsilon=1e-12, keepdim=False, asvector=False)
 
 
 def matrix_rank_tol(x, atol_tensor, use_default_tol=False, hermitian=False):
+    if use_default_tol:
+        # phi contract: the tol input is a placeholder here; use
+        # max_sv * max(m, n) * eps
+        return matrix_rank(x, tol=None, hermitian=hermitian)
     return matrix_rank(x, tol=atol_tensor, hermitian=hermitian)

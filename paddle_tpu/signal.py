@@ -100,6 +100,8 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
         sv = sv * jnp.sqrt(jnp.float32(n_fft))
     if onesided:
         frames = jnp.fft.irfft(sv, n=n_fft, axis=-2)  # [..., n_fft, F]
+    elif return_complex:
+        frames = jnp.fft.ifft(sv, axis=-2)  # complex reconstruction
     else:
         frames = jnp.fft.ifft(sv, axis=-2).real
     frames = frames * win[:, None]

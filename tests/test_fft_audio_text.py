@@ -124,15 +124,22 @@ class TestViterbi:
 
 class TestASP:
     def test_mask_2_4(self):
+        # 2-D (linear) weights prune along the REDUCTION axis (in_features
+        # = axis 0 of the [in, out] layout), like the reference's
+        # create_mask(weight.T).T
         w = np.random.RandomState(0).randn(8, 16).astype(np.float32)
         mask = asp.create_mask(w)
         assert mask.shape == w.shape
-        groups = mask.reshape(8, 4, 4)
+        groups = mask.T.reshape(16, 2, 4)  # along in_features
         assert (groups.sum(-1) == 2).all()
-        # kept entries are the 2 largest |w| per group
-        wg = np.abs(w).reshape(8, 4, 4)
+        wg = np.abs(w.T).reshape(16, 2, 4)
         kept = np.take_along_axis(wg, np.argsort(-wg, -1)[..., :2], -1).sum()
-        np.testing.assert_allclose((wg * groups).sum(), kept, rtol=1e-6)
+        np.testing.assert_allclose((np.abs(w) * mask).sum(), kept, rtol=1e-6)
+        assert asp.check_sparsity(w * mask)
+        # 2d-balanced algo: row AND column counts <= 2 per 4x4 tile
+        m2 = asp.create_mask(w, func_name="mask_2d_best")
+        t = m2.T[:4, :4]
+        assert (t.sum(0) <= 2).all() and (t.sum(1) <= 2).all()
 
     def test_prune_and_decorated_step_preserves_sparsity(self):
         paddle.seed(0)

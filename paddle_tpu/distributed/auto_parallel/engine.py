@@ -207,9 +207,13 @@ class Engine:
         reports = auto_tuner.tune(build_step, n_devices=n,
                                   axes=("dp", "mp"), top_k=99)
         self._planner_reports = list(reports)
+        batch_n = int(np.asarray(sample_ids).shape[0])
         for r in reports:
             if "error" not in r and r.get("optimal_seconds", 0) > 0:
-                scored.append((r["optimal_seconds"], dict(r["config"])))
+                cfg = dict(r["config"])
+                if batch_n % max(cfg.get("dp", 1), 1):
+                    continue  # dp must divide the batch to shard it
+                scored.append((r["optimal_seconds"], cfg))
 
         # pipeline candidates: stage compute from a sub-mesh compile,
         # bubble factor (pp-1)/M from the 1F1B schedule shape
@@ -232,7 +236,10 @@ class Engine:
                     continue
                 sub = auto_tuner.tune(build_step, n_devices=n // pp,
                                       axes=("dp", "mp"), top_k=1)
-                if not sub or "error" in sub[0]:
+                if not sub or "error" in sub[0] or \
+                        sub[0].get("optimal_seconds", 0) <= 0:
+                    # same guard as the SPMD/sep paths: a cost model with
+                    # no timing yields t=0 and pipeline would always win
                     continue
                 t = sub[0]["optimal_seconds"] / pp * (1.0 + (pp - 1) / M)
                 cfg = {**sub[0]["config"], "pp": pp}
@@ -266,7 +273,13 @@ class Engine:
                 self._trace_cache_key = None
 
         if not scored:
-            cfg = {"dp": n, "mp": 1}
+            # no timed candidate (e.g. a cost model without
+            # optimal_seconds): fall back to the LARGEST dp that divides
+            # the batch, mp for the rest — dp=n on an indivisible batch
+            # cannot even shard the input
+            dp = max(d for d in range(1, n + 1)
+                     if n % d == 0 and batch_n % d == 0)
+            cfg = {"dp": dp, "mp": n // dp}
             return build_mesh(**cfg), cfg
         scored.sort(key=lambda x: x[0])
         cfg = scored[0][1]

@@ -23,12 +23,37 @@ class HybridParallelClipGrad(ClipGradByGlobalNorm):
         super().__init__(clip_norm)
         self._hcg = hcg
 
-    def functional_clip(self, g_vals):
-        sq = 0.0
-        for g in g_vals:
-            sq = sq + jnp.sum(jnp.square(g.astype(jnp.float32)))
+    def functional_clip(self, g_vals, params=None):
+        """Global-norm clip aware of the hybrid topology. Over the MP axis
+        only TENSOR-PARALLEL params' norms are partial; replicated params
+        (layernorms, row-parallel biases) carry identical grads on every
+        mp rank and must be counted ONCE (reference
+        hybrid_parallel_optimizer.py buckets p.is_distributed separately).
+        Over pp/sharding axes every rank owns disjoint params, so the full
+        sum reduces."""
+        mp_axis = _bound_axis(self._hcg.get_model_parallel_group())
+
+        def _is_mp_sharded(p):
+            spec = getattr(p, "_pspec", None)
+            return spec is not None and any(
+                a == "mp" or (isinstance(a, (tuple, list)) and "mp" in a)
+                for a in spec)
+
+        sq_dist = 0.0
+        sq_rep = 0.0
+        for i, g in enumerate(g_vals):
+            term = jnp.sum(jnp.square(g.astype(jnp.float32)))
+            if (mp_axis is not None and params is not None
+                    and not _is_mp_sharded(params[i])):
+                sq_rep = sq_rep + term
+            else:
+                sq_dist = sq_dist + term
+        if mp_axis is not None:
+            t = Tensor(sq_dist)
+            sq_dist = all_reduce(
+                t, ReduceOp.SUM, self._hcg.get_model_parallel_group())._value
+        sq = sq_dist + sq_rep
         for group in (
-            self._hcg.get_model_parallel_group(),
             self._hcg.get_pipe_parallel_group(),
             self._hcg.get_sharding_parallel_group(),
         ):
@@ -41,7 +66,8 @@ class HybridParallelClipGrad(ClipGradByGlobalNorm):
 
     def __call__(self, params_grads):
         g_vals = [g._value if isinstance(g, Tensor) else g for _, g in params_grads]
-        clipped = self.functional_clip(g_vals)
+        clipped = self.functional_clip(g_vals,
+                                       params=[p for p, _ in params_grads])
         return [(p, Tensor(c)) for (p, _), c in zip(params_grads, clipped)]
 
 

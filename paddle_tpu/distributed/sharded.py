@@ -56,12 +56,26 @@ def sharded_fn(fn, mesh: Optional[Mesh] = None, in_specs=None, out_specs=None,
                 out = fn(*_to_tensors(vals))
             return _to_vals(out)
 
-        smapped = shard_map(
-            inner, mesh=m,
-            in_specs=in_specs if in_specs is not None else PartitionSpec(),
-            out_specs=out_specs if out_specs is not None else PartitionSpec(),
-            check_vma=check_vma,
-        )
+        try:
+            smapped = shard_map(
+                inner, mesh=m,
+                in_specs=in_specs if in_specs is not None
+                else PartitionSpec(),
+                out_specs=out_specs if out_specs is not None
+                else PartitionSpec(),
+                check_vma=check_vma,
+            )
+        except TypeError:
+            # older jax (the jax.experimental fallback import) spells the
+            # knob check_rep
+            smapped = shard_map(
+                inner, mesh=m,
+                in_specs=in_specs if in_specs is not None
+                else PartitionSpec(),
+                out_specs=out_specs if out_specs is not None
+                else PartitionSpec(),
+                check_rep=check_vma,
+            )
         return _to_tensors(smapped(*_to_vals(args)))
 
     return wrapper

@@ -6,6 +6,7 @@ from typing import Dict
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.tensor import Tensor
 from ..optimizer.optimizer import Optimizer
@@ -38,6 +39,10 @@ class LookAhead:
         return self.inner_optimizer.clear_grad(*a, **k)
 
     def __getattr__(self, item):
+        if item in ("functional_update", "init_state_tree"):
+            # delegation would hand TrainStep/static capture the INNER
+            # optimizer and silently skip the slow-weight interpolation
+            raise AttributeError(item)
         return getattr(self.inner_optimizer, item)
 
     def state_dict(self):
@@ -246,6 +251,11 @@ class GradientMerge:
                 continue
             p._grad = Tensor(acc / self.k_steps if self.avg else acc)
         self.inner_optimizer.step()
+        # clear the merged grads like the accumulation branch does: a
+        # backward/step loop without an explicit clear_grad would fold the
+        # previous cycle's merged gradient into the next accumulation
+        for p in params:
+            p.clear_grad()
         self._acc.clear()
         self._count = 0
         return True
@@ -276,6 +286,10 @@ class LocalSGD:
         self._count = 0
 
     def __getattr__(self, item):
+        if item in ("functional_update", "init_state_tree"):
+            # delegation would compile the INNER optimizer into TrainStep
+            # and silently skip the periodic averaging
+            raise AttributeError(item)
         return getattr(self.inner_optimizer, item)
 
     def minimize(self, loss):
@@ -286,8 +300,11 @@ class LocalSGD:
     def step(self):
         self.inner_optimizer.step()
         self._count += 1
-        if (self._count >= self.begin_step
-                and self._count % self.k_steps == 0):
+        # reference localsgd_optimizer: sync EVERY step until begin_step
+        # (the warmup phase is where divergence hurts most), then every
+        # k_steps
+        if (self._count < self.begin_step
+                or self._count % self.k_steps == 0):
             self._average_parameters()
             return True
         return False
@@ -303,10 +320,26 @@ class LocalSGD:
             n = 1
         if n <= 1:
             return
+        from ..distributed.collective import _bound_axis
+
+        in_mesh = _bound_axis(group) is not None
         for p in self.inner_optimizer._parameter_list:
             t = Tensor(p._value)
-            dist.all_reduce(t, group=group)
-            p._value = (t._value / n).astype(p._value.dtype)
+            reduced = dist.all_reduce(t, group=group)
+            if in_mesh:
+                p._value = (reduced._value / n).astype(p._value.dtype)
+            # outside a mesh trace the eager all_reduce is identity —
+            # dividing by n there would scale every parameter down n-fold
+            # instead of averaging; host-process averaging rides the
+            # object collectives:
+            else:
+                from ..distributed import objects as O
+
+                vals = []
+                O.all_gather_object(vals, np.asarray(p._value))
+                if len(vals) > 1:
+                    p._value = jnp.asarray(
+                        np.mean(vals, axis=0)).astype(p._value.dtype)
 
     def clear_grad(self):
         for p in self.inner_optimizer._parameter_list:

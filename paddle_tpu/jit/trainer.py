@@ -118,7 +118,16 @@ class TrainStep:
                     ]
                 clip = optimizer._grad_clip
                 if isinstance(clip, ClipGradByGlobalNorm):
-                    g_vals = clip.functional_clip(g_vals)
+                    import inspect as _inspect
+
+                    if "params" in _inspect.signature(
+                            clip.functional_clip).parameters:
+                        # hybrid clip: param identities distinguish
+                        # tensor-parallel from replicated norms
+                        g_vals = clip.functional_clip(g_vals,
+                                                      params=self.params)
+                    else:
+                        g_vals = clip.functional_clip(g_vals)
                 elif clip is not None:
                     pairs = clip([(p, Tensor(g)) for p, g in zip(self.params, g_vals)])
                     g_vals = [g._value for _, g in pairs]

@@ -107,6 +107,7 @@ from . import utils  # noqa: F401, E402
 from . import multiprocessing  # noqa: F401, E402
 from . import cost_model  # noqa: F401, E402
 from . import crypto  # noqa: F401, E402
+from . import resilience  # noqa: F401, E402
 from .framework.io import load, save  # noqa: F401, E402
 from .framework.containers import (  # noqa: F401, E402
     SelectedRows, TensorArray, array_length, array_read, array_write,

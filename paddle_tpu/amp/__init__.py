@@ -16,7 +16,8 @@ import numpy as np
 from ..core.dtype import convert_dtype
 from .state import amp_state
 
-__all__ = ["auto_cast", "amp_guard", "GradScaler", "decorate"]
+__all__ = ["auto_cast", "amp_guard", "GradScaler", "decorate",
+           "LossScaleBackoff"]
 
 
 @contextlib.contextmanager
@@ -233,6 +234,35 @@ class GradScaler:
         self._scale = jnp.asarray(float(state["scale"]), jnp.float32)
         self._good_steps = jnp.asarray(state.get("good_steps", 0), jnp.int32)
         self._bad_steps = jnp.asarray(state.get("bad_steps", 0), jnp.int32)
+
+
+class LossScaleBackoff:
+    """NaN-step-guard companion (resilience subsystem): feed it the compiled
+    TrainStep's per-step skip verdict and it drives a GradScaler's dynamic
+    scale with the same incr/decr_every_n schedule the scaler uses for its
+    own found_inf — skipped (non-finite) steps shrink the loss scale, clean
+    streaks grow it back. Lets fp16 runs recover from overflow-driven NaN
+    streaks instead of skipping forever.
+
+    Usage: ResilientTrainer(..., backoff=amp.LossScaleBackoff(scaler)).
+    """
+
+    def __init__(self, scaler: "GradScaler"):
+        self.scaler = scaler
+        self.skipped_steps = 0
+
+    @property
+    def scale(self) -> float:
+        return float(self.scaler._scale)
+
+    def on_step(self, skipped: bool):
+        sc = self.scaler
+        if not sc.is_use_dynamic_loss_scaling():
+            self.skipped_steps += int(bool(skipped))
+            return
+        sc._found_inf_t = jnp.asarray(1.0 if skipped else 0.0, jnp.float32)
+        sc._update_scale()
+        self.skipped_steps += int(bool(skipped))
 
 
 def is_float16_supported(device=None):

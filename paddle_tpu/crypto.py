@@ -11,7 +11,17 @@ from __future__ import annotations
 
 import os
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:  # optional dependency — gate, don't break package import without it
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ImportError:  # pragma: no cover
+    AESGCM = None
+
+
+def _require_aesgcm():
+    if AESGCM is None:
+        raise RuntimeError(
+            "paddle_tpu.crypto requires the 'cryptography' package")
+    return AESGCM
 
 _NONCE = 12
 _MAGIC = b"PTPUENC1"
@@ -22,7 +32,7 @@ class CipherUtils:
     def gen_key(length: int = 256) -> bytes:
         if length not in (128, 192, 256):
             raise ValueError("key length must be 128/192/256 bits")
-        return AESGCM.generate_key(bit_length=length)
+        return _require_aesgcm().generate_key(bit_length=length)
 
     @staticmethod
     def gen_key_to_file(length: int, path: str) -> bytes:
@@ -42,7 +52,7 @@ class Cipher:
 
     def encrypt(self, plaintext: bytes, key: bytes) -> bytes:
         nonce = os.urandom(_NONCE)
-        ct = AESGCM(key).encrypt(nonce, plaintext, _MAGIC)
+        ct = _require_aesgcm()(key).encrypt(nonce, plaintext, _MAGIC)
         return _MAGIC + nonce + ct
 
     def decrypt(self, ciphertext: bytes, key: bytes) -> bytes:
@@ -50,7 +60,7 @@ class Cipher:
             raise ValueError("not a paddle_tpu encrypted blob")
         nonce = ciphertext[len(_MAGIC):len(_MAGIC) + _NONCE]
         ct = ciphertext[len(_MAGIC) + _NONCE:]
-        return AESGCM(key).decrypt(nonce, ct, _MAGIC)
+        return _require_aesgcm()(key).decrypt(nonce, ct, _MAGIC)
 
     def encrypt_to_file(self, plaintext: bytes, key: bytes, path: str) -> None:
         with open(path, "wb") as f:

@@ -25,7 +25,7 @@ import numpy as np
 from ..core.tensor import Tensor
 
 __all__ = ["save_sharded", "load_sharded", "save_model_sharded",
-           "load_model_sharded"]
+           "load_model_sharded", "wait_all", "CheckpointSaveError"]
 
 
 def _to_arrays(obj):
@@ -46,37 +46,102 @@ def _checkpointer(async_save=False):
     return ocp.StandardCheckpointer()
 
 
+class CheckpointSaveError(RuntimeError):
+    """One or more (async) checkpoint saves failed; carries every cause."""
+
+    def __init__(self, errors):
+        super().__init__(
+            "checkpoint save failed: "
+            + "; ".join(f"{type(e).__name__}: {e}" for e in errors))
+        self.errors = list(errors)
+
+
+class _PendingSave:
+    """An in-flight async save plus its commit: the tmp->final swap runs
+    only after the background write finishes, so the previous checkpoint at
+    `final` survives until its replacement is durably complete."""
+
+    def __init__(self, ckptr, tmp: str, final: str):
+        self.ckptr = ckptr
+        self.tmp = tmp
+        self.final = final
+
+    def finish(self):
+        self.ckptr.wait_until_finished()
+        _commit_swap(self.tmp, self.final)
+
+    def close(self):
+        self.ckptr.close()
+
+
 _pending = []
+
+
+def _commit_swap(tmp: str, final: str):
+    """Atomically promote `tmp` to `final`; the old checkpoint is moved
+    aside first and deleted only after the new one is in place."""
+    old = None
+    if os.path.exists(final):
+        old = final + ".old"
+        if os.path.exists(old):
+            shutil.rmtree(old)
+        os.rename(final, old)
+    os.rename(tmp, final)
+    if old is not None:
+        shutil.rmtree(old)
 
 
 def save_sharded(state: Any, path: str, async_save: bool = False,
                  overwrite: bool = True):
     """Write a (nested) state of Tensors/arrays shard-wise. With
     async_save=True returns immediately; call wait_all() (or save again) to
-    join the background write."""
+    join the background write.
+
+    Crash-consistent overwrite: the write lands in `path + ".saving"` and is
+    renamed over the old checkpoint only once complete — a crash mid-save
+    can no longer destroy the previous (only good) checkpoint."""
     path = os.path.abspath(path)
     # join any in-flight async save first: two AsyncCheckpointers racing
     # to finalize-rename the same directory corrupt the checkpoint, and
-    # rmtree below must not delete a directory still being written
+    # the commit swap below must not race a directory still being written
     wait_all()
-    if os.path.exists(path):
-        if not overwrite:
-            raise FileExistsError(path)
-        shutil.rmtree(path)
+    if os.path.exists(path) and not overwrite:
+        raise FileExistsError(path)
+    tmp = path + ".saving"
+    if os.path.exists(tmp):  # debris from a crashed previous save
+        shutil.rmtree(tmp)
     ckptr = _checkpointer(async_save)
-    ckptr.save(path, _to_arrays(state))
+    try:
+        ckptr.save(tmp, _to_arrays(state))
+    except BaseException:
+        ckptr.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
     if async_save:
-        _pending.append(ckptr)
+        _pending.append(_PendingSave(ckptr, tmp, path))
     else:
         ckptr.close()
+        _commit_swap(tmp, path)
 
 
 def wait_all():
-    """Join all pending async saves."""
+    """Join ALL pending async saves. One failing save no longer leaks the
+    remaining checkpointers un-joined: every pending save is finished and
+    closed, then the collected failures re-raise as one aggregated error."""
+    errors = []
     while _pending:
         c = _pending.pop()
-        c.wait_until_finished()
-        c.close()
+        try:
+            c.finish()
+        except Exception as e:  # noqa: BLE001 — aggregated below
+            errors.append(e)
+        finally:
+            try:
+                c.close()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+    if errors:
+        raise CheckpointSaveError(errors)
 
 
 def _abstract_like(obj):

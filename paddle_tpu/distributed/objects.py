@@ -40,14 +40,22 @@ def _get_store():
     with _lock:
         if _store is None:
             from ..native import TCPStore
+            from ..resilience.retry import RetryPolicy
 
             master = os.environ.get("PADDLE_MASTER") \
                 or os.environ.get("COORDINATOR_ADDRESS") or "127.0.0.1:0"
             host, _, port_s = master.partition(":")
             port = int(os.environ.get("PADDLE_OBJECT_STORE_PORT",
                                       int(port_s or 0) + 17))
-            _store = TCPStore(host, port, is_master=get_rank() == 0,
-                              world_size=get_world_size(), timeout_s=120.0)
+            # non-master ranks may race the master's bind during (re)starts;
+            # collective init retries under the shared resilience policy
+            policy = RetryPolicy(max_attempts=5, base_delay=0.2,
+                                 max_delay=2.0, deadline=120.0,
+                                 retry_on=(RuntimeError, ConnectionError),
+                                 name="collective.store_init")
+            _store = policy.call(
+                TCPStore, host, port, is_master=get_rank() == 0,
+                world_size=get_world_size(), timeout_s=120.0)
         return _store
 
 

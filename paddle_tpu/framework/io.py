@@ -63,11 +63,22 @@ def _unpack(obj, return_numpy=False):
 
 
 def save(obj, path, protocol=4, **configs):
+    """Atomic save: pickle to `path + ".tmp"`, fsync, then os.replace — a
+    crash mid-write can never leave a truncated file at the destination
+    (the destination either keeps its old content or gets the complete new
+    one)."""
+    from ..resilience import chaos
+
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
         pickle.dump(_pack(obj), f, protocol=protocol)
+        f.flush()
+        os.fsync(f.fileno())
+        chaos.crash_point("io.save.before_replace")
+    os.replace(tmp, path)
 
 
 def load(path, return_numpy=False, **configs):

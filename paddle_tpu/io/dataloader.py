@@ -87,7 +87,7 @@ class DataLoader:
         batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
         collate_fn=None, num_workers=0, use_buffer_reader=True,
         prefetch_factor=2, use_shared_memory=True, timeout=0, worker_init_fn=None,
-        persistent_workers=False, mode="process",
+        persistent_workers=False, mode="process", worker_respawn=0,
     ):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
@@ -96,6 +96,10 @@ class DataLoader:
         self.worker_init_fn = worker_init_fn
         self.use_shared_memory = use_shared_memory
         self.persistent_workers = persistent_workers
+        # crashed process-workers: respawn up to this many times (resilience
+        # retry policy paces the restarts); 0 = fail fast as before
+        self.worker_respawn = int(worker_respawn)
+        self.timeout = timeout
         if mode not in ("process", "thread"):
             raise ValueError(f"mode must be 'process' or 'thread', got {mode!r}")
         self.mode = mode
@@ -223,7 +227,10 @@ class DataLoader:
         if pool is None or not pool.alive:
             pool = WorkerPool(self.dataset, worker_collate, self.num_workers,
                               self.worker_init_fn, self.use_shared_memory,
-                              self.prefetch_factor)
+                              self.prefetch_factor,
+                              respawn=self.worker_respawn,
+                              poll_timeout=(self.timeout
+                                            if self.timeout else 5.0))
             if self.persistent_workers:
                 self._pool = pool
         # default collate yields Tensors; a custom collate's output passes

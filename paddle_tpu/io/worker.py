@@ -203,12 +203,14 @@ class _SlotRing:
 
 def worker_loop(dataset, collate_fn, task_q, out_q, ack_q, done_event, wid,
                 num_workers, worker_init_fn, use_shared_memory, ring_size,
-                base_seed):
+                base_seed, incarnation=0):
     """Child-process main (reference worker.py:_worker_loop). Exits on the
-    None sentinel or when the parent's done_event is set."""
+    None sentinel or when the parent's done_event is set. `incarnation`
+    tags every result so the parent can discard output of a killed
+    predecessor instead of acking it into THIS worker's fresh slot ring."""
     from .dataloader import WorkerInfo, _worker_info
 
-    np.random.seed((base_seed + wid) % (1 << 31))
+    np.random.seed((base_seed + wid + (incarnation << 16)) % (1 << 31))
     _worker_info.info = WorkerInfo(wid, num_workers, dataset)
     if worker_init_fn is not None:
         worker_init_fn(wid)
@@ -241,11 +243,13 @@ def worker_loop(dataset, collate_fn, task_q, out_q, ack_q, done_event, wid,
                             # deadlock the ring when it wraps around
                             ring.release(slot)
                             raise
-                        out_q.put((epoch, i, wid, slot, seg.name, payload))
+                        out_q.put((epoch, i, wid, incarnation, slot,
+                                   seg.name, payload))
                         continue
-                out_q.put((epoch, i, wid, None, None, data))
+                out_q.put((epoch, i, wid, incarnation, None, None, data))
             except Exception as e:  # noqa: BLE001 — must cross the process
-                out_q.put((epoch, i, wid, None, None, _WorkerError(e)))
+                out_q.put((epoch, i, wid, incarnation, None, None,
+                           _WorkerError(e)))
     finally:
         ring.close()
 
@@ -255,11 +259,13 @@ class WorkerPool:
     across epochs; otherwise it is torn down at iterator exhaustion)."""
 
     def __init__(self, dataset, collate_fn, num_workers, worker_init_fn,
-                 use_shared_memory, prefetch_factor):
+                 use_shared_memory, prefetch_factor, respawn=0,
+                 poll_timeout=5.0):
         ctx = mp.get_context("fork")  # workers never touch jax; fork is cheap
+        self._ctx = ctx
         self.num_workers = num_workers
         self.prefetch = max(prefetch_factor, 1) * num_workers
-        ring_size = max(prefetch_factor, 1) + 1
+        self._ring_size = max(prefetch_factor, 1) + 1
         self.task_q = ctx.Queue()
         self.out_q = ctx.Queue()
         self.ack_qs = [ctx.Queue() for _ in range(num_workers)]
@@ -267,20 +273,51 @@ class WorkerPool:
         self._attached = {}    # segment name -> SharedMemory (parent mappings)
         self._slot_names = {}  # (wid, slot) -> current segment name
         self._epoch = 0
-        seed = int.from_bytes(os.urandom(4), "little")
-        self.procs = [
-            ctx.Process(
-                target=worker_loop,
-                args=(dataset, collate_fn, self.task_q, self.out_q,
-                      self.ack_qs[w], self.done_event, w, num_workers,
-                      worker_init_fn, use_shared_memory, ring_size, seed),
-                daemon=True)
-            for w in range(num_workers)
-        ]
+        self.poll_timeout = poll_timeout
+        # crashed-worker respawn budget, paced by the shared retry policy
+        # (resilience/retry.py). 0 keeps the historical fail-fast behavior.
+        # A SIGKILLed worker never unlinks its ring segments; the resource
+        # tracker reclaims them at interpreter exit, so a bounded respawn
+        # budget also bounds that leak.
+        from ..resilience.retry import RetryPolicy
+
+        self._respawns_left = int(respawn)
+        self._respawn_count = 0
+        self._respawn_policy = RetryPolicy(
+            max_attempts=max(int(respawn), 1), base_delay=0.05,
+            max_delay=1.0, name="dataloader.worker_respawn")
+        self._incarnation = [0] * num_workers
+        self._seed = int.from_bytes(os.urandom(4), "little")
+        self._worker_static = (dataset, collate_fn, num_workers,
+                               worker_init_fn, use_shared_memory)
+        self.procs = [self._spawn(w) for w in range(num_workers)]
         for p in self.procs:
             p.start()
         self.alive = True
         _POOLS.add(self)
+
+    def _spawn(self, wid):
+        dataset, collate_fn, num_workers, worker_init_fn, use_shm = \
+            self._worker_static
+        return self._ctx.Process(
+            target=worker_loop,
+            args=(dataset, collate_fn, self.task_q, self.out_q,
+                  self.ack_qs[wid], self.done_event, wid, num_workers,
+                  worker_init_fn, use_shm, self._ring_size, self._seed,
+                  self._incarnation[wid]),
+            daemon=True)
+
+    def _respawn(self, wid):
+        """Replace a dead worker: new incarnation, FRESH ack queue (acks for
+        the dead ring must never free slots in the new one)."""
+        self._respawns_left -= 1
+        self._respawn_count += 1
+        self._respawn_policy.backoff(self._respawn_count)
+        self.procs[wid].join(timeout=1.0)
+        self._incarnation[wid] += 1
+        self.ack_qs[wid] = self._ctx.Queue()
+        self.procs[wid] = self._spawn(wid)
+        self.procs[wid].start()
 
     def _decode(self, wid, slot, seg_name, payload, to_tensor):
         if slot is None:
@@ -315,19 +352,25 @@ class WorkerPool:
         return out
 
     def _get_result(self):
-        """out_q.get with a worker-liveness watchdog: a dead worker must
-        raise, not hang training (reference _DataLoaderIterMultiProcess
-        exit-watchdog)."""
+        """out_q.get with a worker-liveness watchdog: a dead worker either
+        respawns (budget permitting — returns None so the caller resubmits
+        in-flight tasks) or raises rather than hang training (reference
+        _DataLoaderIterMultiProcess exit-watchdog)."""
         while True:
             try:
-                return self.out_q.get(timeout=5.0)
+                return self.out_q.get(timeout=self.poll_timeout)
             except _queue.Empty:
                 dead = [w for w, p in enumerate(self.procs) if not p.is_alive()]
-                if dead:
+                if not dead:
+                    continue
+                if self._respawns_left < len(dead):
                     self.shutdown()
                     raise RuntimeError(
                         f"DataLoader worker(s) {dead} exited unexpectedly "
                         "(killed or crashed); aborting epoch")
+                for w in dead:
+                    self._respawn(w)
+                return None
 
     def run_epoch(self, index_batches, to_tensor):
         """Feed tasks with bounded in-flight count; decode on arrival (so
@@ -341,22 +384,37 @@ class WorkerPool:
         epoch = self._epoch
         n = len(index_batches)
         it = iter(enumerate(index_batches))
+        outstanding = {}  # batch idx -> index list, dispatched but unreceived
         for _ in range(min(self.prefetch, n)):
-            e, i = next(it)
-            self.task_q.put((epoch, e, i))
+            e, task = next(it)
+            self.task_q.put((epoch, e, task))
+            outstanding[e] = task
         results = {}
+        done = set()
         next_idx = 0
-        received = 0
-        while received < n:
-            r_epoch, i, wid, slot, seg_name, payload = self._get_result()
-            if r_epoch != epoch:
-                # stale batch from an abandoned epoch: free its slot, drop it
-                if slot is not None:
+        while len(done) < n:
+            r = self._get_result()
+            if r is None:
+                # worker(s) respawned: whatever the dead worker held (or
+                # already-queued duplicates) is resubmitted; duplicate
+                # results are deduped below
+                for e, task in outstanding.items():
+                    self.task_q.put((epoch, e, task))
+                continue
+            r_epoch, i, wid, inc, slot, seg_name, payload = r
+            current_inc = inc == self._incarnation[wid]
+            if r_epoch != epoch or not current_inc or i in done:
+                # stale epoch / dead incarnation / duplicate after respawn:
+                # free the slot (only a LIVE incarnation's ring wants the
+                # ack) and drop the payload
+                if slot is not None and current_inc:
                     self.ack_qs[wid].put(slot)
                 continue
-            received += 1
+            done.add(i)
+            outstanding.pop(i, None)
             for e, task in it:
                 self.task_q.put((epoch, e, task))
+                outstanding[e] = task
                 break
             if isinstance(payload, _WorkerError):
                 self.shutdown()

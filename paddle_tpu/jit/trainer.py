@@ -49,10 +49,18 @@ class TrainStep:
         in_shardings=None,
         out_shardings=None,
         mesh=None,
+        nan_guard: bool = False,
     ):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
+        # NaN/Inf step-guard (resilience subsystem): the finite-check and the
+        # where-select between updated and prior state compile INTO this one
+        # program, so donation and the single-dispatch property are preserved
+        # (the reference's check_finite_and_unscale + found_inf skip, fused).
+        self._nan_guard = bool(nan_guard)
+        self.skipped_steps = 0
+        self.last_skipped = False
         self.params = [p for p in model.parameters() if p.trainable]
         # frozen params ride as runtime inputs like buffers — leaving them
         # out would constant-fold their CURRENT values into the compiled
@@ -138,7 +146,24 @@ class TrainStep:
                         for v, sh in zip(new_p, self._param_shardings)
                     ]
                 new_buffer_vals = [b._value for b in self.buffers]  # BN stats updated in-place
-                return loss._value, new_p, new_buffer_vals, new_s
+                if not self._nan_guard:
+                    return loss._value, new_p, new_buffer_vals, new_s
+                # global-grad-norm finite check; overflow of the square-sum
+                # to inf is itself a (correct) skip signal
+                gsq = jnp.zeros((), jnp.float32)
+                for g in g_vals:
+                    gsq = gsq + jnp.sum(jnp.square(g.astype(jnp.float32)))
+                ok = jnp.isfinite(gsq) & jnp.isfinite(
+                    loss._value.astype(jnp.float32))
+                new_p = [jnp.where(ok, n, o)
+                         for n, o in zip(new_p, param_vals)]
+                new_buffer_vals = [jnp.where(ok, n, o)
+                                   for n, o in zip(new_buffer_vals,
+                                                   buffer_vals)]
+                new_s = jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(ok, n, o), new_s, opt_state)
+                skipped = (~ok).astype(jnp.int32)
+                return loss._value, new_p, new_buffer_vals, new_s, skipped
             finally:
                 _random.default_generator.pop_trace_seed(prev_seed)
                 for p, (v, gn, g, sg) in zip(self.params, saved):
@@ -161,9 +186,16 @@ class TrainStep:
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         seed = jnp.asarray(self._step_i, jnp.int32)
         self._step_i += 1
-        loss, new_p, new_b, new_s = self._jitted(
+        out = self._jitted(
             param_vals, buffer_vals, self.opt_state, lr, seed, batch_vals
         )
+        if self._nan_guard:
+            loss, new_p, new_b, new_s, skipped = out
+            n_skipped = int(skipped)  # one host-scalar read, like loss.item()
+            self.last_skipped = bool(n_skipped)
+            self.skipped_steps += n_skipped
+        else:
+            loss, new_p, new_b, new_s = out
         for p, v in zip(self.params, new_p):
             p._value = v
         for b, v in zip(self.buffers, new_b):

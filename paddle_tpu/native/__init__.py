@@ -178,7 +178,8 @@ class TCPStore:
     """
 
     def __init__(self, host: str, port: int, *, is_master: bool = False,
-                 world_size: int = 1, timeout_s: float = 60.0):
+                 world_size: int = 1, timeout_s: float = 60.0,
+                 connect_attempts: int = 3):
         lib = get_lib()
         self._lib = lib
         self._server = None
@@ -191,12 +192,32 @@ class TCPStore:
             port = bound.value
         self.host, self.port = host, port
         connect_host = "127.0.0.1" if is_master else host
-        self._client = lib.pt_store_client_connect(
-            connect_host.encode(), port, int(timeout_s * 1000))
-        if not self._client:
+
+        # transient connect failures (master not bound yet, connection
+        # refused during a rolling restart) retry under the shared policy;
+        # the deadline caps the TOTAL wait at the caller's timeout
+        from ..resilience.retry import RetryError, RetryPolicy
+
+        def _connect():
+            client = lib.pt_store_client_connect(
+                connect_host.encode(), port, int(timeout_s * 1000))
+            if not client:
+                raise ConnectionError(
+                    f"TCPStore: cannot connect to {host}:{port}")
+            return client
+
+        policy = RetryPolicy(max_attempts=connect_attempts, base_delay=0.05,
+                             max_delay=1.0, deadline=timeout_s,
+                             retry_on=(ConnectionError,),
+                             name="tcpstore.connect")
+        try:
+            self._client = policy.call(_connect)
+        except (RetryError, ConnectionError) as e:
             if self._server:
                 lib.pt_store_server_stop(self._server)
-            raise RuntimeError(f"TCPStore: cannot connect to {host}:{port}")
+                self._server = None
+            raise RuntimeError(
+                f"TCPStore: cannot connect to {host}:{port}") from e
         self._barrier_gen = 0
         self._named_barrier_gen: dict[str, int] = {}
 

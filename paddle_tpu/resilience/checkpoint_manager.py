@@ -1,0 +1,377 @@
+"""Crash-consistent checkpoint manager.
+
+Layout under `root`:
+
+    step_00000042/            committed checkpoint (atomic rename target)
+        manifest.json         per-array entries {file, shape, dtype, crc32},
+                              structure skeleton, user meta, format version
+        arr_0.bin ...         raw array bytes, one file per pytree leaf
+    step_00000050.tmp/        in-flight write (never read; GC'd on next save)
+
+Commit protocol (the reference's dist_saver writes rank shards then a
+"success" flag file; here the flag is the directory NAME so readers need no
+flag-ordering reasoning):
+
+    1. write every array file (fsync each)
+    2. write manifest.json.tmp, fsync, os.replace -> manifest.json
+    3. os.rename(step_N.tmp, step_N)        <- the commit point
+    4. only now GC older checkpoints (keep-last-N, never the last valid one)
+
+A crash at ANY point leaves either a fully committed directory or an ignored
+`.tmp` — `save_sharded(overwrite=True)`'s original delete-before-write hazard
+(losing the only good checkpoint) cannot happen. `restore_latest()` scans
+newest-first, re-verifies every checksum, and falls back to the previous
+checkpoint when it finds torn or bit-rotted state.
+
+`backend="orbax"` delegates the array payload to distributed/checkpoint.py's
+sharded writer (each host writes its addressable shards) while keeping this
+module's tmp-dir commit + manifest + GC around it.
+
+Chaos hooks (resilience/chaos.py) instrument each phase so tests can kill the
+write at every interesting spot.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import chaos
+
+__all__ = ["CheckpointManager", "CheckpointCorrupt", "RestoredCheckpoint"]
+
+MANIFEST = "manifest.json"
+_FORMAT_VERSION = 1
+_STEP_RE = re.compile(r"^step_(\d{8,})$")
+
+
+class CheckpointCorrupt(RuntimeError):
+    pass
+
+
+def _dtype_of(name: str):
+    """Resolve a dtype name, including jax's ml_dtypes (bfloat16 etc.)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import jax.numpy as jnp
+
+        return np.dtype(getattr(jnp, name))
+
+
+def _to_numpy(leaf):
+    from ..core.tensor import Tensor
+
+    if isinstance(leaf, Tensor):
+        leaf = leaf._value
+    return np.asarray(leaf)
+
+
+def _is_array_leaf(obj) -> bool:
+    from ..core.tensor import Tensor
+
+    if isinstance(obj, (Tensor, np.ndarray)):
+        return True
+    return hasattr(obj, "shape") and hasattr(obj, "dtype") \
+        and not isinstance(obj, (dict, list, tuple))
+
+
+def _encode(obj, leaves: List[np.ndarray]):
+    """State pytree -> JSON skeleton + ordered array leaves."""
+    if _is_array_leaf(obj):
+        leaves.append(_to_numpy(obj))
+        return {"k": "a", "i": len(leaves) - 1}
+    if isinstance(obj, dict):
+        return {"k": "d", "v": {str(k): _encode(v, leaves)
+                                for k, v in obj.items()}}
+    if isinstance(obj, tuple):
+        return {"k": "t", "v": [_encode(v, leaves) for v in obj]}
+    if isinstance(obj, list):
+        return {"k": "l", "v": [_encode(v, leaves) for v in obj]}
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return {"k": "p", "v": obj}
+    raise TypeError(f"checkpoint state has unsupported leaf type "
+                    f"{type(obj).__name__}")
+
+
+def _decode(skel, leaves: List[Any]):
+    kind = skel["k"]
+    if kind == "a":
+        return leaves[skel["i"]]
+    if kind == "d":
+        return {k: _decode(v, leaves) for k, v in skel["v"].items()}
+    if kind == "t":
+        return tuple(_decode(v, leaves) for v in skel["v"])
+    if kind == "l":
+        return [_decode(v, leaves) for v in skel["v"]]
+    if kind == "p":
+        return skel["v"]
+    raise CheckpointCorrupt(f"unknown skeleton kind {kind!r}")
+
+
+def _fsync_file(f):
+    f.flush()
+    os.fsync(f.fileno())
+
+
+def _fsync_dir(path: str):
+    """Durable rename needs the parent directory synced too (best-effort on
+    filesystems without O_DIRECTORY support)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # pragma: no cover
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # pragma: no cover
+        pass
+    finally:
+        os.close(fd)
+
+
+class RestoredCheckpoint:
+    """restore_latest() result: committed step, state pytree, user meta."""
+
+    def __init__(self, step: int, state: Any, meta: Dict, path: str):
+        self.step = step
+        self.state = state
+        self.meta = meta
+        self.path = path
+
+    def __repr__(self):  # pragma: no cover
+        return f"RestoredCheckpoint(step={self.step}, path={self.path!r})"
+
+
+class CheckpointManager:
+    """Crash-consistent save/restore over a checkpoint root directory.
+
+    Args:
+        root: directory holding all `step_*` checkpoints.
+        keep_last_n: committed checkpoints retained by GC (the newest valid
+            checkpoint is NEVER removed regardless of this value).
+        backend: "npy" (self-contained raw-array files + crc32 checksums) or
+            "orbax" (sharded multi-host payload via distributed/checkpoint.py,
+            wrapped in this manager's commit protocol).
+    """
+
+    def __init__(self, root: str, keep_last_n: int = 3, backend: str = "npy"):
+        if backend not in ("npy", "orbax"):
+            raise ValueError(f"unknown checkpoint backend {backend!r}")
+        self.root = os.path.abspath(root)
+        self.keep_last_n = max(int(keep_last_n), 1)
+        self.backend = backend
+        self.last_scan_report: List[Tuple[str, str]] = []  # (path, reason)
+        os.makedirs(self.root, exist_ok=True)
+
+    # -- naming ------------------------------------------------------------
+    def _dir_for(self, step: int) -> str:
+        return os.path.join(self.root, f"step_{int(step):08d}")
+
+    def all_steps(self) -> List[int]:
+        """Committed step numbers, ascending (validity not yet checked)."""
+        steps = []
+        try:
+            names = os.listdir(self.root)
+        except FileNotFoundError:  # pragma: no cover
+            return []
+        for name in names:
+            m = _STEP_RE.match(name)
+            if m and os.path.isdir(os.path.join(self.root, name)):
+                steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    # -- save --------------------------------------------------------------
+    def save(self, step: int, state: Any, meta: Optional[Dict] = None):
+        """Write checkpoint for `step`; commit atomically; GC old ones.
+
+        Any crash (or injected fault) before the commit rename leaves the
+        previous checkpoints untouched; a crash after it at worst skips GC.
+        """
+        final = self._dir_for(step)
+        tmp = final + ".tmp"
+        if os.path.exists(tmp):  # stale debris from a previous crash
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        chaos.crash_point("ckpt.begin")
+
+        arrays = []
+        if self.backend == "orbax":
+            from ..distributed.checkpoint import save_sharded
+
+            skeleton = None  # orbax restores its own tree structure
+            save_sharded(state, os.path.join(tmp, "arrays"), async_save=False)
+            chaos.crash_point("ckpt.array")
+        else:
+            leaves: List[np.ndarray] = []
+            skeleton = _encode(state, leaves)
+            for i, arr in enumerate(leaves):
+                fname = f"arr_{i}.bin"
+                buf = arr.tobytes()
+                with open(os.path.join(tmp, fname), "wb") as f:
+                    f.write(buf)
+                    _fsync_file(f)
+                arrays.append({
+                    "file": fname,
+                    "shape": list(arr.shape),
+                    "dtype": arr.dtype.name,
+                    "crc32": zlib.crc32(buf) & 0xFFFFFFFF,
+                })
+                chaos.crash_point("ckpt.array")
+
+        chaos.crash_point("ckpt.before_manifest")
+        manifest = {
+            "version": _FORMAT_VERSION,
+            "step": int(step),
+            "backend": self.backend,
+            "meta": meta or {},
+            "skeleton": skeleton,
+            "arrays": arrays,
+        }
+        mpath = os.path.join(tmp, MANIFEST)
+        with open(mpath + ".tmp", "w") as f:
+            json.dump(manifest, f)
+            _fsync_file(f)
+        os.replace(mpath + ".tmp", mpath)
+        _fsync_dir(tmp)
+
+        chaos.crash_point("ckpt.before_commit")
+        if os.path.exists(final):  # same-step re-save: replace atomically
+            old = final + ".replaced"
+            if os.path.exists(old):
+                shutil.rmtree(old)
+            os.rename(final, old)
+            os.rename(tmp, final)
+            shutil.rmtree(old)
+        else:
+            os.rename(tmp, final)  # <- the commit point
+        _fsync_dir(self.root)
+
+        chaos.crash_point("ckpt.before_gc")
+        self._gc()
+        return final
+
+    # -- GC ----------------------------------------------------------------
+    def _gc(self):
+        """Delete committed checkpoints beyond keep_last_n (oldest first) and
+        any stale `.tmp` debris. The newest VALID checkpoint is never deleted:
+        keepers are counted from validated directories, so a corrupt newest
+        cannot shadow the good one into deletion."""
+        for name in os.listdir(self.root):
+            full = os.path.join(self.root, name)
+            if name.endswith((".tmp", ".replaced")) and os.path.isdir(full):
+                shutil.rmtree(full, ignore_errors=True)
+        steps = self.all_steps()
+        valid_kept = 0
+        keep: set = set()
+        for s in reversed(steps):  # newest first
+            if valid_kept < self.keep_last_n \
+                    and self.validate(self._dir_for(s)) is None:
+                keep.add(s)
+                valid_kept += 1
+        if valid_kept == 0:
+            return  # nothing provably good — delete nothing
+        for s in steps:
+            if s not in keep:
+                shutil.rmtree(self._dir_for(s), ignore_errors=True)
+
+    # -- validation / restore ---------------------------------------------
+    def validate(self, path: str) -> Optional[str]:
+        """None if `path` is a complete, checksum-valid checkpoint; otherwise
+        a human-readable corruption reason."""
+        mpath = os.path.join(path, MANIFEST)
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+        except FileNotFoundError:
+            return "missing manifest"
+        except (OSError, json.JSONDecodeError) as e:
+            return f"unreadable manifest: {e}"
+        if manifest.get("version") != _FORMAT_VERSION:
+            return f"unsupported version {manifest.get('version')!r}"
+        if manifest.get("backend") == "orbax":
+            if not os.path.isdir(os.path.join(path, "arrays")):
+                return "missing orbax payload"
+            return None  # orbax validates its own array metadata on load
+        for entry in manifest.get("arrays", ()):
+            fpath = os.path.join(path, entry["file"])
+            try:
+                with open(fpath, "rb") as f:
+                    buf = f.read()
+            except OSError:
+                return f"missing array file {entry['file']}"
+            if (zlib.crc32(buf) & 0xFFFFFFFF) != entry["crc32"]:
+                return f"checksum mismatch in {entry['file']}"
+        return None
+
+    def _load(self, path: str, template: Optional[Any]) -> Tuple[Any, Dict]:
+        with open(os.path.join(path, MANIFEST)) as f:
+            manifest = json.load(f)
+        if manifest.get("backend") == "orbax":
+            from ..distributed.checkpoint import load_sharded
+
+            state = load_sharded(os.path.join(path, "arrays"),
+                                 template=template)
+            return state, manifest.get("meta", {})
+        leaves = []
+        for entry in manifest["arrays"]:
+            with open(os.path.join(path, entry["file"]), "rb") as f:
+                buf = f.read()
+            arr = np.frombuffer(buf, dtype=_dtype_of(entry["dtype"]))
+            leaves.append(arr.reshape(entry["shape"]))
+        state = _decode(manifest["skeleton"], leaves)
+        if template is not None:
+            state = _place_like(state, template)
+        return state, manifest.get("meta", {})
+
+    def restore_latest(self, template: Optional[Any] = None
+                       ) -> Optional[RestoredCheckpoint]:
+        """Newest valid checkpoint (validating manifest + checksums), falling
+        back to older ones on corruption; None when nothing valid exists.
+        `template` (a pytree of Tensors/arrays matching the saved structure)
+        places restored arrays onto the template leaves' shardings."""
+        self.last_scan_report = []
+        for step in reversed(self.all_steps()):
+            path = self._dir_for(step)
+            reason = self.validate(path)
+            if reason is not None:
+                self.last_scan_report.append((path, reason))
+                continue
+            try:
+                state, meta = self._load(path, template)
+            except Exception as e:  # torn beyond what validate caught
+                self.last_scan_report.append((path, f"load failed: {e}"))
+                continue
+            return RestoredCheckpoint(step, state, meta, path)
+        return None
+
+
+def _place_like(state, template):
+    """Pair restored numpy leaves with template leaves; device_put onto the
+    template's sharding when it has one (mesh-reshard on load, same contract
+    as distributed/checkpoint.load_sharded)."""
+    import jax
+
+    from ..core.tensor import Tensor
+
+    if _is_array_leaf(template):
+        t = template._value if isinstance(template, Tensor) else template
+        arr = np.asarray(state)
+        sharding = getattr(t, "sharding", None)
+        if sharding is not None:
+            return jax.device_put(arr, sharding)
+        return jax.numpy.asarray(arr)
+    if isinstance(template, dict):
+        return {k: _place_like(state[k], template[k]) for k in template}
+    if isinstance(template, (list, tuple)):
+        out = [_place_like(s, t) for s, t in zip(state, template)]
+        return tuple(out) if isinstance(template, tuple) else out
+    return state

@@ -1,0 +1,115 @@
+"""Preemption-aware shutdown.
+
+Production TPU pools preempt VMs with a SIGTERM grace window (maintenance
+events, spot reclaims — see PAPERS.md on preemptible TPU fleets); the
+reference reacts through its elastic manager's membership watch. Here both
+signals land in one PreemptionHandler: POSIX signals set a flag the training
+loop polls between steps (never mid-XLA-dispatch), and an elastic-manager
+hook maps "membership shrank" onto the same flag, so the ResilientTrainer
+has exactly one preemption source to honor with a final synchronized
+checkpoint + clean exit.
+"""
+from __future__ import annotations
+
+import signal as _signal
+import threading
+from typing import Callable, List, Optional, Tuple
+
+__all__ = ["PreemptionHandler"]
+
+
+class PreemptionHandler:
+    """Latches SIGTERM/SIGINT (and elastic membership loss) into a flag.
+
+    Usage:
+        handler = PreemptionHandler()
+        with handler:                       # installs signal handlers
+            trainer.run(..., preemption=handler)
+
+    Signal handlers only install from the main thread (CPython rule); from
+    other threads install() degrades to manual trigger()-only mode.
+    """
+
+    def __init__(self, signals: Tuple[int, ...] = (_signal.SIGTERM,
+                                                   _signal.SIGINT)):
+        self.signals = tuple(signals)
+        self._event = threading.Event()
+        self._prev: List[Tuple[int, object]] = []
+        self._installed = False
+        self.reason: Optional[str] = None
+        self.count = 0
+        self._callbacks: List[Callable[[str], None]] = []
+
+    # -- flag --------------------------------------------------------------
+    @property
+    def requested(self) -> bool:
+        return self._event.is_set()
+
+    def trigger(self, reason: str = "manual"):
+        """Latch preemption programmatically (elastic hook, chaos harness)."""
+        self.count += 1
+        if not self._event.is_set():
+            self.reason = reason
+            self._event.set()
+        for cb in self._callbacks:
+            try:
+                cb(reason)
+            except Exception:  # noqa: BLE001 — callbacks must not kill the handler
+                pass
+
+    def reset(self):
+        self._event.clear()
+        self.reason = None
+
+    def add_callback(self, cb: Callable[[str], None]):
+        self._callbacks.append(cb)
+
+    # -- signals -----------------------------------------------------------
+    def _on_signal(self, signum, frame):  # noqa: ARG002
+        self.trigger(f"signal:{_signal.Signals(signum).name}")
+
+    def install(self):
+        if self._installed:
+            return self
+        try:
+            for sig in self.signals:
+                prev = _signal.signal(sig, self._on_signal)
+                self._prev.append((sig, prev))
+            self._installed = True
+        except ValueError:  # not in main thread: trigger()-only mode
+            for sig, prev in self._prev:
+                _signal.signal(sig, prev)
+            self._prev.clear()
+        return self
+
+    def uninstall(self):
+        if not self._installed:
+            return
+        for sig, prev in self._prev:
+            try:
+                _signal.signal(sig, prev)
+            except (ValueError, OSError):  # pragma: no cover
+                pass
+        self._prev.clear()
+        self._installed = False
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+    # -- elastic integration ----------------------------------------------
+    def attach_elastic(self, manager, expected_np: int):
+        """Watch the ElasticManager's membership: a shrink below expected_np
+        (a peer's heartbeat vanished — host loss or TPU maintenance event)
+        latches preemption so this rank checkpoints and exits cleanly rather
+        than hanging in a collective with a dead peer."""
+
+        def _cb(alive):
+            if len(alive) < expected_np and not self.requested:
+                self.trigger(f"elastic:{len(alive)}/{expected_np} alive")
+
+        manager.add_watch_callback(_cb)
+        return self

@@ -1,0 +1,440 @@
+"""Fault-tolerant training runtime (paddle_tpu/resilience/).
+
+Uses the chaos harness to kill checkpoint saves at every injected crash
+point, poison gradients with NaNs, deliver fake preemption signals, and
+kill dataloader workers — then asserts the runtime recovers exactly as the
+crash-consistency design promises.
+"""
+import os
+import signal
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import amp, nn, optimizer
+from paddle_tpu.distributed import checkpoint as dckpt
+from paddle_tpu.resilience import (
+    CheckpointManager, PreemptionHandler, RetryError, RetryPolicy, chaos,
+)
+from paddle_tpu.resilience.trainer import ResilientTrainer
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.clear()
+    yield
+    chaos.clear()
+
+
+def _build():
+    paddle.seed(3)
+    return nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+
+
+def _batches(n=10, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(rng.randn(8, 4).astype(np.float32),
+             rng.randn(8, 1).astype(np.float32)) for _ in range(n)]
+
+
+def _trainer(root, save_every=4, **kw):
+    m = _build()
+    opt = optimizer.SGD(0.1, parameters=m.parameters())
+    loss_fn = nn.MSELoss()
+    return ResilientTrainer(m, lambda a, b: loss_fn(m(a), b), opt,
+                            CheckpointManager(root), save_every=save_every,
+                            **kw)
+
+
+def _params(tr):
+    return [np.asarray(p._value) for p in tr.step.params]
+
+
+# ------------------------------------------------------------ retry policy
+class TestRetryPolicy:
+    def test_retries_then_succeeds(self):
+        sleeps = []
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise ConnectionError("refused")
+            return "ok"
+
+        pol = RetryPolicy(max_attempts=5, base_delay=0.01,
+                          sleep=sleeps.append)
+        assert pol.call(flaky) == "ok"
+        assert calls["n"] == 3 and len(sleeps) == 2
+
+    def test_gives_up_with_cause(self):
+        pol = RetryPolicy(max_attempts=2, base_delay=0.0, sleep=lambda s: None)
+        with pytest.raises(RetryError) as ei:
+            pol.call(lambda: (_ for _ in ()).throw(OSError("nope")))
+        assert ei.value.attempts == 2
+        assert isinstance(ei.value.last_exception, OSError)
+
+    def test_filter_passes_through_non_transient(self):
+        pol = RetryPolicy(max_attempts=5, retry_on=(OSError,),
+                          sleep=lambda s: None)
+        with pytest.raises(ValueError):
+            pol.call(lambda: (_ for _ in ()).throw(ValueError("fatal")))
+
+    def test_backoff_schedule_and_jitter_bounds(self):
+        pol = RetryPolicy(base_delay=0.1, multiplier=2.0, max_delay=0.5,
+                          jitter=0.5)
+        assert pol.delay_for(1) == pytest.approx(0.1)
+        assert pol.delay_for(2) == pytest.approx(0.2)
+        assert pol.delay_for(10) == pytest.approx(0.5)  # capped
+        for attempt in (1, 2, 3):
+            d = pol.delay_for(attempt)
+            for _ in range(20):
+                j = pol._jittered(d)
+                assert d * 0.5 <= j <= d
+
+    def test_deadline_stops_retrying(self):
+        pol = RetryPolicy(max_attempts=0, base_delay=10.0, deadline=0.5,
+                          sleep=lambda s: None)
+        with pytest.raises(RetryError):
+            pol.call(lambda: (_ for _ in ()).throw(OSError("x")))
+
+    def test_decorator(self):
+        from paddle_tpu.resilience import retrying
+
+        calls = {"n": 0}
+
+        @retrying(max_attempts=3, base_delay=0.0, sleep=lambda s: None)
+        def f():
+            calls["n"] += 1
+            if calls["n"] < 2:
+                raise OSError
+            return 7
+
+        assert f() == 7
+
+
+# ------------------------------------------------- crash-consistent commits
+class TestCheckpointManager:
+    def test_save_restore_roundtrip_with_meta(self, tmp_path):
+        m = CheckpointManager(str(tmp_path))
+        state = {"a": np.arange(6, dtype=np.float32).reshape(2, 3),
+                 "b": [np.ones(3, np.float32), 7, "tag", None]}
+        m.save(1, state, meta={"epoch": 2})
+        r = m.restore_latest()
+        assert r.step == 1 and r.meta == {"epoch": 2}
+        np.testing.assert_array_equal(np.asarray(r.state["a"]),
+                                      state["a"])
+        assert r.state["b"][1:] == [7, "tag", None]
+
+    def test_gc_keeps_last_n_and_tmp_debris_removed(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), keep_last_n=2)
+        state = {"w": np.ones(4, np.float32)}
+        for s in (1, 2, 3, 4):
+            m.save(s, state)
+        assert m.all_steps() == [3, 4]
+        assert not any(n.endswith(".tmp") for n in os.listdir(tmp_path))
+
+    @pytest.mark.parametrize("point", [
+        "ckpt.begin", "ckpt.array", "ckpt.before_manifest",
+        "ckpt.before_commit",
+    ])
+    def test_crash_at_every_point_keeps_previous_valid(self, tmp_path, point):
+        m = CheckpointManager(str(tmp_path), keep_last_n=2)
+        state1 = {"w": np.full(4, 1.0, np.float32)}
+        state2 = {"w": np.full(4, 2.0, np.float32)}
+        m.save(1, state1)
+        chaos.inject_crash(point)
+        with pytest.raises(chaos.InjectedCrash):
+            m.save(2, state2)
+        r = m.restore_latest()
+        assert r.step == 1
+        np.testing.assert_array_equal(np.asarray(r.state["w"]),
+                                      state1["w"])
+        # the torn write must not block a subsequent healthy save
+        m.save(2, state2)
+        assert m.restore_latest().step == 2
+
+    def test_crash_after_commit_only_skips_gc(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), keep_last_n=1)
+        m.save(1, {"w": np.ones(2, np.float32)})
+        chaos.inject_crash("ckpt.before_gc")
+        with pytest.raises(chaos.InjectedCrash):
+            m.save(2, {"w": np.zeros(2, np.float32)})
+        assert m.restore_latest().step == 2  # committed before the "crash"
+        m.save(3, {"w": np.ones(2, np.float32)})  # GC catches up
+        assert m.all_steps() == [3]
+
+    def test_restore_falls_back_on_corruption(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), keep_last_n=3)
+        for s in (1, 2):
+            m.save(s, {"w": np.full(4, float(s), np.float32)})
+        with open(os.path.join(m._dir_for(2), "arr_0.bin"), "r+b") as f:
+            f.write(b"\xde\xad\xbe\xef")
+        r = m.restore_latest()
+        assert r.step == 1
+        assert any("checksum mismatch" in reason
+                   for _, reason in m.last_scan_report)
+
+    def test_missing_manifest_is_invalid(self, tmp_path):
+        m = CheckpointManager(str(tmp_path))
+        m.save(1, {"w": np.ones(2, np.float32)})
+        os.remove(os.path.join(m._dir_for(1), "manifest.json"))
+        assert m.restore_latest() is None
+
+    def test_gc_never_removes_last_valid(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), keep_last_n=1)
+        m.save(1, {"w": np.ones(2, np.float32)})
+        m.save(2, {"w": np.zeros(2, np.float32)})
+        # corrupt the newest AFTER commit, then GC again: the older valid
+        # one is gone already (keep_last_n=1), but GC must not delete the
+        # corrupt-newest when nothing else is provably good
+        os.remove(os.path.join(m._dir_for(2), "manifest.json"))
+        m._gc()
+        assert m.all_steps() == [2]  # nothing provably good -> no deletion
+
+    def test_orbax_backend_roundtrip(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), backend="orbax")
+        w = paddle.to_tensor(np.arange(4, dtype=np.float32))
+        m.save(7, {"w": w}, meta={"note": "sharded"})
+        r = m.restore_latest()
+        assert r.step == 7 and r.meta["note"] == "sharded"
+        np.testing.assert_array_equal(np.asarray(r.state["w"]),
+                                      np.arange(4, dtype=np.float32))
+
+
+# ----------------------------------------------------- satellite: io.save
+class TestAtomicSave:
+    def test_crash_mid_save_keeps_old_file(self, tmp_path):
+        path = str(tmp_path / "m.pdparams")
+        paddle.save({"w": paddle.to_tensor(np.ones(3, np.float32))}, path)
+        chaos.inject_crash("io.save.before_replace")
+        with pytest.raises(chaos.InjectedCrash):
+            paddle.save({"w": paddle.to_tensor(np.zeros(3, np.float32))},
+                        path)
+        got = paddle.load(path)
+        np.testing.assert_array_equal(got["w"].numpy(),
+                                      np.ones(3, np.float32))
+        # and the retry write goes through, replacing atomically
+        paddle.save({"w": paddle.to_tensor(np.zeros(3, np.float32))}, path)
+        np.testing.assert_array_equal(paddle.load(path)["w"].numpy(),
+                                      np.zeros(3, np.float32))
+
+
+# ------------------------------------------- satellite: sharded checkpoint
+class TestShardedCheckpointSafety:
+    def test_failed_overwrite_keeps_previous(self, tmp_path):
+        path = str(tmp_path / "ck")
+        dckpt.save_sharded({"w": paddle.to_tensor(np.ones(4, np.float32))},
+                           path)
+
+        class Boom:
+            def save(self, *a, **k):
+                raise RuntimeError("disk died")
+
+            def close(self):
+                pass
+
+        orig = dckpt._checkpointer
+        dckpt._checkpointer = lambda async_save=False: Boom()
+        try:
+            with pytest.raises(RuntimeError, match="disk died"):
+                dckpt.save_sharded(
+                    {"w": paddle.to_tensor(np.zeros(4, np.float32))}, path)
+        finally:
+            dckpt._checkpointer = orig
+        got = dckpt.load_sharded(path)
+        np.testing.assert_array_equal(np.asarray(got["w"]),
+                                      np.ones(4, np.float32))
+
+    def test_async_save_commits_on_wait_all(self, tmp_path):
+        path = str(tmp_path / "ck")
+        dckpt.save_sharded({"w": paddle.to_tensor(np.full(4, 2.0,
+                                                          np.float32))},
+                           path, async_save=True)
+        dckpt.wait_all()
+        got = dckpt.load_sharded(path)
+        np.testing.assert_array_equal(np.asarray(got["w"]),
+                                      np.full(4, 2.0, np.float32))
+
+    def test_wait_all_joins_all_and_aggregates(self):
+        class FailPending:
+            def __init__(self):
+                self.closed = False
+
+            def finish(self):
+                raise RuntimeError("async boom")
+
+            def close(self):
+                self.closed = True
+
+        a, b = FailPending(), FailPending()
+        dckpt._pending.extend([a, b])
+        with pytest.raises(dckpt.CheckpointSaveError) as ei:
+            dckpt.wait_all()
+        assert len(ei.value.errors) == 2
+        assert a.closed and b.closed
+        assert not dckpt._pending  # nothing leaked un-joined
+
+
+# ------------------------------------------------------ resilient training
+class TestResilientTrainer:
+    def test_killed_during_save_resumes_bit_identical(self, tmp_path):
+        batches = _batches()
+        ref = _trainer(str(tmp_path / "ref"), save_every=0)
+        ref.run(batches, epochs=1)
+        ref_params = _params(ref)
+
+        root = str(tmp_path / "crash")
+        tr = _trainer(root, save_every=4)
+        # first periodic save (step 4) lands; the one at step 8 is killed
+        # mid-commit — the training "process" dies with it
+        chaos.inject_crash("ckpt.before_commit", after=1)
+        with pytest.raises(chaos.InjectedCrash):
+            tr.run(batches, epochs=1)
+
+        # a fresh process: new model/optimizer/trainer over the same root
+        tr2 = _trainer(root, save_every=4)
+        rep = tr2.run(batches, epochs=1)
+        assert rep["resumed_from"] == 4  # step-8 save was torn; step 4 valid
+        assert rep["status"] == "completed" and rep["step"] == 10
+        for got, want in zip(_params(tr2), ref_params):
+            np.testing.assert_array_equal(got, want)
+
+    def test_nan_guard_skips_exactly_poisoned_steps(self, tmp_path):
+        batches = _batches()
+        poisoned = _trainer(str(tmp_path / "a"), save_every=0)
+        chaos.poison_steps([3, 7])
+        rep = poisoned.run(batches, epochs=1)
+        assert rep["steps_skipped"] == 2
+        assert poisoned.step.skipped_steps == 2
+
+        # reference: same batches minus the poisoned steps — the guard must
+        # make poisoned steps EXACT no-ops (bit-identical params otherwise)
+        clean = _trainer(str(tmp_path / "b"), save_every=0)
+        rep2 = clean.run([b for i, b in enumerate(batches)
+                          if i not in (3, 7)], epochs=1)
+        assert rep2["steps_skipped"] == 0
+        for got, want in zip(_params(poisoned), _params(clean)):
+            np.testing.assert_array_equal(got, want)
+
+    def test_nan_guard_keeps_single_program_and_donation(self):
+        from paddle_tpu.jit.trainer import TrainStep
+
+        m = _build()
+        opt = optimizer.SGD(0.1, parameters=m.parameters())
+        loss_fn = nn.MSELoss()
+        step = TrainStep(m, lambda a, b: loss_fn(m(a), b), opt,
+                         nan_guard=True)
+        x, y = _batches(1)[0]
+        lowered = step.lower(paddle.to_tensor(x), paddle.to_tensor(y))
+        # the guard's where-select compiles INTO the one program...
+        assert "select" in lowered.as_text()
+        # ...and params/opt-state buffers stay donated (aliased in-place)
+        assert "input_output_alias" in lowered.compile().as_text()
+        step(paddle.to_tensor(x), paddle.to_tensor(y))
+        assert step.skipped_steps == 0
+
+    def test_preemption_signal_final_save_and_resume(self, tmp_path):
+        batches = _batches()
+        root = str(tmp_path / "pre")
+        tr = _trainer(root, save_every=0)
+
+        def feed():
+            for i, b in enumerate(batches):
+                if i == 3:
+                    chaos.fake_preemption(signal.SIGTERM)
+                yield b
+
+        prev = signal.getsignal(signal.SIGTERM)
+        rep = tr.run(feed, epochs=1)
+        assert rep["status"] == "preempted"
+        assert rep["preempt_reason"] == "signal:SIGTERM"
+        assert rep["step"] == 3
+        # handler uninstalled again after run()
+        assert signal.getsignal(signal.SIGTERM) == prev
+
+        tr2 = _trainer(root, save_every=0)
+        rep2 = tr2.run(batches, epochs=1)
+        assert rep2["status"] == "completed"
+        assert rep2["resumed_from"] == 3 and rep2["steps_run"] == 7
+
+        ref = _trainer(str(tmp_path / "ref"), save_every=0)
+        ref.run(batches, epochs=1)
+        for got, want in zip(_params(tr2), _params(ref)):
+            np.testing.assert_array_equal(got, want)
+
+    def test_elastic_membership_loss_latches_preemption(self):
+        class FakeElastic:
+            def __init__(self):
+                self.cbs = []
+
+            def add_watch_callback(self, cb):
+                self.cbs.append(cb)
+
+        mgr = FakeElastic()
+        h = PreemptionHandler().attach_elastic(mgr, expected_np=4)
+        for cb in mgr.cbs:
+            cb({0: 0.0, 1: 0.0, 2: 0.0, 3: 0.0})
+        assert not h.requested
+        for cb in mgr.cbs:
+            cb({0: 0.0, 1: 0.0})  # two peers vanished
+        assert h.requested and h.reason.startswith("elastic:")
+
+    def test_loss_scale_backoff_shrinks_on_skip(self):
+        scaler = amp.GradScaler(init_loss_scaling=1024.0,
+                                incr_every_n_steps=2,
+                                decr_every_n_nan_or_inf=1)
+        backoff = amp.LossScaleBackoff(scaler)
+        backoff.on_step(True)
+        assert backoff.scale == pytest.approx(512.0)
+        backoff.on_step(False)
+        backoff.on_step(False)
+        assert backoff.scale == pytest.approx(1024.0)
+        assert backoff.skipped_steps == 1
+
+
+# ------------------------------------------------- dataloader worker chaos
+class TestWorkerRespawn:
+    def test_killed_worker_respawns_and_epoch_completes(self, tmp_path):
+        from paddle_tpu.io import DataLoader
+
+        flag = str(tmp_path / "died_once")
+
+        class DieOnce:
+            def __len__(self):
+                return 32
+
+            def __getitem__(self, i):
+                if i == 9:
+                    try:
+                        with open(flag, "x"):
+                            pass
+                        os._exit(17)  # first incarnation hard-crashes
+                    except FileExistsError:
+                        pass  # respawned incarnation survives
+                return np.full((4,), i, np.float32)
+
+        dl = DataLoader(DieOnce(), batch_size=4, num_workers=2,
+                        mode="process", worker_respawn=2, timeout=1.0)
+        got = sorted(float(b.numpy()[0][0]) for b in dl)
+        assert got == [float(i) for i in range(0, 32, 4)]
+
+    def test_default_still_fails_fast(self):
+        from paddle_tpu.io import DataLoader
+
+        class Suicide:
+            def __len__(self):
+                return 16
+
+            def __getitem__(self, i):
+                if i == 5:
+                    os._exit(17)
+                return np.full((4,), i, np.float32)
+
+        dl = DataLoader(Suicide(), batch_size=4, num_workers=2,
+                        mode="process", timeout=1.0)
+        with pytest.raises(RuntimeError, match="exited unexpectedly"):
+            for _ in dl:
+                pass

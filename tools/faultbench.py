@@ -1,0 +1,241 @@
+"""Fault-injection benchmark for the resilience runtime (ISSUE r6).
+
+Scripted chaos run over paddle_tpu/resilience/: kills checkpoint saves at
+every instrumented crash point, corrupts committed checkpoints on disk,
+poisons gradients with NaNs, and delivers fake preemption signals — then
+verifies the runtime recovers exactly as the crash-consistency design
+promises, and writes one JSON artifact summarizing the outcome.
+
+Scenarios (all CPU, deterministic, a few seconds total):
+  * crash_sweep     — inject a crash at each of the four checkpoint-commit
+                      crash points mid-training; a fresh trainer must resume
+                      from the last COMMITTED step (never a torn one).
+  * corruption      — truncate / bit-flip / delete pieces of the newest
+                      committed checkpoint; restore_latest() must detect it
+                      and fall back to the previous valid step.
+  * nan_guard       — poison specific global steps; the compiled guard must
+                      skip exactly those steps and training must end at the
+                      same params as a run that never saw the poisoned
+                      batches.
+  * preemption      — deliver SIGTERM mid-epoch; the run must commit a final
+                      checkpoint, report "preempted", and a restarted
+                      trainer must finish the epoch from where it left off.
+
+Usage: python tools/faultbench.py [--out FAULTBENCH_r06.json]
+"""
+import argparse
+import json
+import os
+import signal
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import tools.cpu_force  # noqa: F401  (stay off the TPU tunnel)
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CRASH_POINTS = ["ckpt.begin", "ckpt.array", "ckpt.before_manifest",
+                "ckpt.before_commit"]
+
+
+def _build():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    paddle.seed(3)
+    return nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+
+
+def _batches(n=12, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(rng.randn(8, 4).astype(np.float32),
+             rng.randn(8, 1).astype(np.float32)) for _ in range(n)]
+
+
+def _trainer(root, save_every=3, **kw):
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.resilience import CheckpointManager
+    from paddle_tpu.resilience.trainer import ResilientTrainer
+
+    m = _build()
+    opt = optimizer.SGD(0.1, parameters=m.parameters())
+    loss_fn = nn.MSELoss()
+    return ResilientTrainer(m, lambda a, b: loss_fn(m(a), b), opt,
+                            CheckpointManager(root), save_every=save_every,
+                            **kw)
+
+
+def _params(tr):
+    return [np.asarray(p._value) for p in tr.step.params]
+
+
+def bench_crash_sweep(tmp):
+    """Crash every commit stage once; resume must land on a committed step."""
+    from paddle_tpu.resilience import chaos
+    from paddle_tpu.resilience.chaos import InjectedCrash
+
+    rows = []
+    for point in CRASH_POINTS:
+        chaos.clear()
+        root = os.path.join(tmp, "sweep_" + point.replace(".", "_"))
+        tr = _trainer(root)
+        batches = _batches()
+        # survive the save at step 3, die inside the save at step 6 —
+        # "ckpt.array" fires once per leaf, the others once per save
+        import jax
+
+        n_leaves = len(jax.tree_util.tree_leaves(tr._state()))
+        chaos.inject_crash(point,
+                           after=n_leaves if point == "ckpt.array" else 1)
+        crashed = False
+        try:
+            tr.run(batches)
+        except InjectedCrash:
+            crashed = True
+        chaos.clear()
+        tr2 = _trainer(root)
+        rep = tr2.run(batches)
+        rows.append({
+            "crash_point": point,
+            "crashed": crashed,
+            "resumed_from": tr2.resumed_from,
+            "resume_on_committed_step": tr2.resumed_from == 3,
+            "finished_step": rep["step"],
+            "torn_dirs_left": sum(
+                d.endswith((".tmp", ".replaced")) for d in os.listdir(root)),
+        })
+    ok = all(r["crashed"] and r["resume_on_committed_step"]
+             and r["finished_step"] == len(_batches())
+             and r["torn_dirs_left"] == 0 for r in rows)
+    return {"ok": ok, "saves_survived": sum(r["crashed"] for r in rows),
+            "rows": rows}
+
+
+def bench_corruption(tmp):
+    """Damage the newest committed checkpoint three ways; restore_latest
+    must catch each and fall back to the previous valid step."""
+    from paddle_tpu.resilience import CheckpointManager
+
+    rows = []
+    for kind in ("truncate_array", "flip_bytes", "drop_manifest"):
+        root = os.path.join(tmp, "corrupt_" + kind)
+        tr = _trainer(root)
+        tr.run(_batches())  # commits steps 3, 6, 9, 12
+        mgr = CheckpointManager(root)
+        newest = sorted(d for d in os.listdir(root) if d.startswith("step_"))[-1]
+        victim = os.path.join(root, newest)
+        arrs = sorted(f for f in os.listdir(victim) if f.startswith("arr_"))
+        if kind == "truncate_array":
+            with open(os.path.join(victim, arrs[0]), "r+b") as f:
+                f.truncate(max(os.path.getsize(f.name) // 2, 1))
+        elif kind == "flip_bytes":
+            with open(os.path.join(victim, arrs[-1]), "r+b") as f:
+                f.seek(0)
+                f.write(b"\xff\xff\xff\xff")
+        else:
+            os.remove(os.path.join(victim, "manifest.json"))
+        tr2 = _trainer(root)
+        restored = tr2.restore()
+        caught = [r for r in mgr.last_scan_report]  # noqa: F841 (per-manager)
+        rows.append({
+            "kind": kind,
+            "fallback_step": restored.step if restored else None,
+            "caught": [(os.path.basename(p), reason)
+                       for p, reason in tr2.manager.last_scan_report],
+        })
+    ok = all(r["fallback_step"] == 9 and len(r["caught"]) == 1 for r in rows)
+    return {"ok": ok, "corrupt_restores_caught": sum(
+        len(r["caught"]) for r in rows), "rows": rows}
+
+
+def bench_nan_guard(tmp):
+    """Poisoned steps must be skipped in-program, bit-identically to a run
+    that never saw those batches."""
+    from paddle_tpu.resilience import chaos
+
+    poisoned = {2, 5, 9}
+    batches = _batches()
+    chaos.poison_steps(poisoned)
+    tr = _trainer(os.path.join(tmp, "nan_guarded"), save_every=0)
+    rep = tr.run(batches, resume=False)
+    chaos.clear()
+    clean = [b for i, b in enumerate(batches) if i not in poisoned]
+    ref = _trainer(os.path.join(tmp, "nan_ref"), save_every=0)
+    ref.run(clean, resume=False)
+    identical = all(np.array_equal(a, b)
+                    for a, b in zip(_params(tr), _params(ref)))
+    return {"ok": rep["steps_skipped"] == len(poisoned) and identical,
+            "steps_poisoned": len(poisoned),
+            "steps_skipped": rep["steps_skipped"],
+            "bit_identical_to_clean_run": identical}
+
+
+def bench_preemption(tmp):
+    """SIGTERM mid-epoch → committed final save → restarted run finishes."""
+    from paddle_tpu.resilience import chaos
+
+    root = os.path.join(tmp, "preempt")
+    batches = _batches()
+    tr = _trainer(root, save_every=0)
+
+    def feed():
+        for i, b in enumerate(batches):
+            if i == 5:
+                chaos.fake_preemption(signal.SIGTERM)
+            yield b
+
+    rep1 = tr.run(feed)
+    tr2 = _trainer(root, save_every=0)
+    rep2 = tr2.run(batches)
+    ok = (rep1["status"] == "preempted" and rep2["status"] == "completed"
+          and tr2.resumed_from == rep1["step"]
+          and rep1["steps_run"] + rep2["steps_run"] == len(batches))
+    return {"ok": ok, "first_run": {k: rep1[k] for k in
+                                    ("status", "step", "steps_run")},
+            "resumed_from": tr2.resumed_from,
+            "second_run": {k: rep2[k] for k in
+                           ("status", "step", "steps_run")},
+            "preemption_resumes": int(ok)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(_REPO,
+                                                  "FAULTBENCH_r06.json"))
+    args = ap.parse_args()
+
+    import jax
+
+    from paddle_tpu.resilience import chaos
+
+    out = {"backend": jax.default_backend(),
+           "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+           "scenarios": {}}
+    with tempfile.TemporaryDirectory() as tmp:
+        for name, fn in [("crash_sweep", bench_crash_sweep),
+                         ("corruption", bench_corruption),
+                         ("nan_guard", bench_nan_guard),
+                         ("preemption", bench_preemption)]:
+            chaos.clear()
+            chaos.reset_stats()
+            t0 = time.perf_counter()
+            res = fn(tmp)
+            res["wall_s"] = round(time.perf_counter() - t0, 3)
+            res["chaos_stats"] = dict(chaos.stats)
+            out["scenarios"][name] = res
+            print(f"[faultbench] {name}: {'PASS' if res['ok'] else 'FAIL'} "
+                  f"({res['wall_s']}s)")
+    out["all_ok"] = all(s["ok"] for s in out["scenarios"].values())
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"[faultbench] wrote {args.out} (all_ok={out['all_ok']})")
+    return 0 if out["all_ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -87,8 +87,18 @@ def run_child(preset: str) -> int:
 
     import paddle_tpu as paddle
     from paddle_tpu import amp, optimizer
+    from paddle_tpu.core import flags as _flags
+    from paddle_tpu.jit import compile_cache as _compile_cache
     from paddle_tpu.jit.trainer import TrainStep
     from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    # step-time optimization knobs (all flag-gated, all env-overridable as
+    # FLAGS_xxx; tools/stepbench.py measures each on/off):
+    #   FLAGS_jit_compile_cache_dir  persistent XLA cache -> warm starts
+    #   FLAGS_jit_fast_dispatch      AOT executable dispatch on the hot loop
+    #   FLAGS_use_autotune (+ FLAGS_autotune_cache_dir)  flash block tuning
+    #   FLAGS_io_device_prefetch     device-resident double buffering
+    _compile_cache.maybe_enable_from_flags()
 
     cfg = GPTConfig(
         vocab_size=p.get("vocab_size", 50304),
@@ -118,6 +128,7 @@ def run_child(preset: str) -> int:
     # varlen path (native pack_varlen -> segments -> segmented/varlen
     # flash attention) instead of a fixed rectangular batch
     packed = os.environ.get("BENCH_PACKED") == "1" and not cfg.use_rotary
+    resilient = False
     if packed:
         from paddle_tpu.io.packing import pack_examples
 
@@ -149,9 +160,28 @@ def run_child(preset: str) -> int:
             with amp.auto_cast(level=amp_level, dtype="bfloat16"):
                 return model(ids, labels=ids)
 
-        step = TrainStep(model, loss_fn, opt)
-        ids = paddle.to_tensor(
-            np.random.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+        resilient = os.environ.get("BENCH_RESILIENT") == "1"
+        trainer = None
+        if resilient:
+            # measure the production-shaped loop: ResilientTrainer's TrainStep
+            # (NaN step-guard compiled in) + one async crash-consistent
+            # checkpoint at the end — resilience overhead shows up honestly
+            # in the number instead of only in microbenches
+            import tempfile
+
+            from paddle_tpu.resilience import CheckpointManager, ResilientTrainer
+
+            trainer = ResilientTrainer(
+                model, loss_fn, opt,
+                CheckpointManager(tempfile.mkdtemp(prefix="benchckpt_"),
+                                  async_save=True),
+                save_every=0, nan_guard=True)
+            step = trainer.step
+        else:
+            step = TrainStep(model, loss_fn, opt)
+        ids_np = np.random.randint(
+            0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+        ids = paddle.to_tensor(ids_np)
 
     t0 = time.time()
     loss = step(ids)
@@ -161,13 +191,30 @@ def run_child(preset: str) -> int:
     float(step(ids).item())  # warm
     # sync via value fetch: block_until_ready has been observed returning
     # early through tunneled transports, inflating throughput
-    t0 = time.time()
-    for _ in range(timed_steps):
-        loss = step(ids)
-    float(loss.item())
-    dt = time.time() - t0
+    prefetch = (not packed) and bool(_flags.get_flag("io_device_prefetch"))
+    if prefetch:
+        # feed the timed loop through the double-buffered prefetcher, the
+        # same path a real input pipeline takes with the flag on
+        from paddle_tpu.io import DevicePrefetcher
+
+        batches = DevicePrefetcher(
+            (ids_np for _ in range(timed_steps)))
+        t0 = time.time()
+        for dev_ids in batches:
+            loss = step(paddle.Tensor(dev_ids))
+        float(loss.item())
+        dt = time.time() - t0
+    else:
+        t0 = time.time()
+        for _ in range(timed_steps):
+            loss = step(ids)
+        float(loss.item())
+        dt = time.time() - t0
     sps = timed_steps / dt
     tokens_per_sec = sps * batch * seq
+    if resilient:  # commit one async crash-consistent checkpoint
+        trainer.save()
+        trainer.manager.wait()
 
     # FLOPs/token: 6*N (fwd+bwd matmuls) + 12*L*h*s attention term
     flops_per_token = 6.0 * n_params + 12.0 * cfg.num_layers * cfg.hidden_size * seq
@@ -198,6 +245,11 @@ def run_child(preset: str) -> int:
         "preset": preset,
         "flash_attention": bool(_flags.get_flag("use_flash_attention")),
         "packed_varlen": packed,
+        "resilient": resilient,
+        "device_prefetch": prefetch,
+        "fast_dispatch": bool(_flags.get_flag("jit_fast_dispatch")),
+        "compile_cache": _compile_cache.cache_dir() or "",
+        "autotune": bool(_flags.get_flag("use_autotune")),
         "final_loss": round(float(loss.item()), 4),
     }
     if on_accel:

@@ -12,6 +12,9 @@ decorator is zero-risk to wrap on.
 """
 from __future__ import annotations
 
+import json
+import os
+import tempfile
 import threading
 import time
 from collections import OrderedDict
@@ -25,19 +28,89 @@ flags.define_flag("use_autotune", False,
                   "Time candidate kernel configs on first use and cache the winner.")
 flags.define_flag("autotune_cache_size", 512,
                   "Max cached autotune decisions (LRU eviction).")
+flags.define_flag(
+    "autotune_cache_dir", "",
+    "Directory for the persistent autotune cache. Empty = in-memory only. "
+    "Winners are keyed by (kernel, shapes, dtypes, backend) and survive "
+    "process restarts, so a warm start skips candidate timing entirely.")
 
 _CACHE: "OrderedDict[tuple, dict]" = OrderedDict()
 _LOCK = threading.Lock()
 
+# persistent layer: key-string -> winner config, lazily loaded per cache dir
+_DISK: Optional[Dict[str, dict]] = None
+_DISK_DIR: Optional[str] = None
+_STATS = {"hits": 0, "misses": 0, "disk_hits": 0, "tunes": 0,
+          "disk_errors": 0}
+
+_CACHE_FILE = "autotune_cache.json"
+
 
 def clear_cache():
+    global _DISK, _DISK_DIR
     with _LOCK:
         _CACHE.clear()
+        _DISK = None
+        _DISK_DIR = None
+        for k in _STATS:
+            _STATS[k] = 0
 
 
 def cache_info():
     with _LOCK:
-        return {"entries": len(_CACHE), "keys": list(_CACHE)}
+        return {"entries": len(_CACHE), "keys": list(_CACHE),
+                **{k: v for k, v in _STATS.items()}}
+
+
+def _cache_path(cache_dir: str) -> str:
+    return os.path.join(cache_dir, _CACHE_FILE)
+
+
+def _disk_load(cache_dir: str) -> Dict[str, dict]:
+    """Load (lazily, once per dir) the persistent winner table. A corrupt or
+    unreadable file degrades to an empty table — tuning reruns, never fails."""
+    global _DISK, _DISK_DIR
+    if _DISK is not None and _DISK_DIR == cache_dir:
+        return _DISK
+    table: Dict[str, dict] = {}
+    path = _cache_path(cache_dir)
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            raw = json.load(f)
+        if isinstance(raw, dict):
+            table = {str(k): v for k, v in raw.items()
+                     if isinstance(v, dict)}
+        else:
+            _STATS["disk_errors"] += 1
+    except FileNotFoundError:
+        pass
+    except (OSError, ValueError, UnicodeDecodeError):
+        _STATS["disk_errors"] += 1
+    _DISK, _DISK_DIR = table, cache_dir
+    return table
+
+
+def _disk_store(cache_dir: str, key_str: str, cfg: dict):
+    """Read-merge-write with an atomic rename, so a crash mid-write never
+    leaves a truncated file (concurrent writers lose entries, not files)."""
+    table = _disk_load(cache_dir)
+    table[key_str] = cfg
+    path = _cache_path(cache_dir)
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=cache_dir, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                json.dump(table, f, indent=0, sort_keys=True)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+    except OSError:
+        _STATS["disk_errors"] += 1  # read-only dir etc.: keep going in-memory
 
 
 def _block(x):
@@ -69,7 +142,8 @@ def autotune(candidates: Iterable[dict], key_extra: Callable = None):
             key = (fn.__module__, fn.__qualname__,
                    tuple((tuple(a.shape), str(a.dtype))
                          for a in args if hasattr(a, "shape")),
-                   key_extra(*args, **kwargs) if key_extra else None)
+                   key_extra(*args, **kwargs) if key_extra else None,
+                   jax.default_backend())
             traced = any(isinstance(a, jax.core.Tracer) for a in args)
             if traced:
                 # inside a jit trace wall-clock timing is meaningless (it
@@ -83,11 +157,26 @@ def autotune(candidates: Iterable[dict], key_extra: Callable = None):
             entry = _CACHE.get(key)
             if entry is not None:
                 with _LOCK:
+                    _STATS["hits"] += 1
                     try:
                         _CACHE.move_to_end(key)
                     except KeyError:
                         pass
                 return fn(*args, **kwargs, **entry)
+            cache_dir = str(flags.get_flag("autotune_cache_dir") or "")
+            key_str = repr(key)
+            if cache_dir:
+                with _LOCK:
+                    disk_cfg = _disk_load(cache_dir).get(key_str)
+                # accept only configs a known candidate produced: a stale or
+                # hand-edited file must not inject arbitrary kwargs
+                if disk_cfg in cands:
+                    with _LOCK:
+                        _STATS["disk_hits"] += 1
+                        _CACHE[key] = disk_cfg
+                    return fn(*args, **kwargs, **disk_cfg)
+            with _LOCK:
+                _STATS["misses"] += 1
             best, best_t = None, None
             for cfg in cands:
                 try:
@@ -99,11 +188,14 @@ def autotune(candidates: Iterable[dict], key_extra: Callable = None):
             if best is None:
                 best = cands[0]
             with _LOCK:
+                _STATS["tunes"] += 1
                 _CACHE[key] = best
                 _CACHE.move_to_end(key)
                 limit = flags.get_flag("autotune_cache_size")
                 while limit > 0 and len(_CACHE) > limit:
                     _CACHE.popitem(last=False)
+                if cache_dir:
+                    _disk_store(cache_dir, key_str, best)
             return fn(*args, **kwargs, **best)
 
         wrapper.__wrapped__ = fn

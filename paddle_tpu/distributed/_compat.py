@@ -1,0 +1,132 @@
+"""jax API-drift compatibility — the ONE place version skew is absorbed.
+
+The distributed stack is written against the newer jax surface
+(`shard_map(axis_names=..., check_vma=...)`, `lax.axis_size`, `lax.pvary`,
+`jax.typeof(...).vma`), but deployment containers pin older releases where
+those spell differently or don't exist:
+
+  * ``shard_map``: new API takes ``axis_names`` (the MANUAL axes) and
+    ``check_vma``; old API takes the complement set ``auto`` (the axes left
+    under GSPMD) and ``check_rep``. We translate.
+  * ``lax.axis_size(name)``: on old jax the size of a bound mesh axis is
+    recovered with the ``psum(1, name)`` identity, which constant-folds to a
+    python int inside shard_map.
+  * ``lax.pvary``: only needed where varying-manual-axes typing exists; on
+    old jax it is the identity.
+  * ``jax.typeof(x).vma``: vma typing absent on old jax — ShapeDtypeStructs
+    are built without it.
+
+Everything under distributed/ (pipeline, context_parallel, sharded, fleet,
+collective) and ops/pallas imports these helpers instead of touching the
+drifting jax surface directly, so the next version bump is a one-file fix.
+"""
+from __future__ import annotations
+
+import inspect
+
+import jax
+from jax import lax
+
+__all__ = ["shard_map", "axis_size", "pvary", "shape_dtype_struct",
+           "NEW_SHARD_MAP_API"]
+
+try:  # jax>=0.5: public jax.shard_map
+    from jax import shard_map as _sm_mod
+
+    _raw_shard_map = (_sm_mod.shard_map
+                      if hasattr(_sm_mod, "shard_map") else _sm_mod)
+except Exception:  # pragma: no cover — old jax
+    from jax.experimental.shard_map import shard_map as _raw_shard_map
+
+_PARAMS = frozenset(inspect.signature(_raw_shard_map).parameters)
+NEW_SHARD_MAP_API = "axis_names" in _PARAMS
+
+
+def shard_map(f, mesh, in_specs, out_specs, axis_names=None, check_vma=False):
+    """New-API-shaped shard_map that also runs on old jax.
+
+    ``axis_names``: the mesh axes that go MANUAL inside ``f`` (None = all).
+    On old jax this is translated to the ``auto`` complement; ``check_vma``
+    becomes ``check_rep`` (and is forced off for partial-manual mappings,
+    which old jax cannot rep-check).
+    """
+    if NEW_SHARD_MAP_API:
+        kwargs = {"check_vma" if "check_vma" in _PARAMS else "check_rep":
+                  check_vma}
+        if axis_names is not None:
+            kwargs["axis_names"] = frozenset(axis_names)
+        return _raw_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, **kwargs)
+    kwargs = {"check_rep": bool(check_vma)}
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        if auto:
+            kwargs["auto"] = auto
+            kwargs["check_rep"] = False  # old jax: no rep-check under auto
+    return _raw_shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kwargs)
+
+
+def axis_size(axis_name) -> int:
+    """Size of a bound mesh axis, inside a shard_map/pmap trace.
+
+    ``lax.psum(1, name)`` is the classic identity: a python-int operand is
+    folded to ``size * 1`` statically, so the result is a concrete int on
+    every jax version that can bind the axis at all.
+    """
+    fn = getattr(lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def pvary(x, axis_name):
+    """lax.pvary where it exists (varying-manual-axes typing).
+
+    Old jax has no vma types, but its shard_map rep-checker tracks the same
+    property as "replicated over axis_name", and constants ARE replicated —
+    so an identity fallback makes e.g. lax.switch reject branch sets that mix
+    pvary'd constants with data-derived values. Mixing in a zero built from
+    ``axis_index`` (device-varying by definition) demotes the constant to
+    unreplicated without changing its value.
+    """
+    fn = getattr(lax, "pvary", None)
+    if fn is not None:
+        return fn(x, axis_name)
+    return x + (0 * lax.axis_index(axis_name)).astype(x.dtype)
+
+
+def platform_dependent(*args, tpu, default):
+    """lax.platform_dependent with a pallas branch that is safe on old jax.
+
+    Modern jax prunes branches for platforms the lowering does not target,
+    so a Mosaic ``pallas_call`` inside ``tpu=`` never reaches the CPU
+    lowering rule. Old jax lowers EVERY branch for the active backend and
+    dies with "Only interpret mode is supported on CPU backend" — there the
+    branch is chosen at TRACE time from the default backend instead (old
+    jax cannot multi-platform-export pallas programs anyway, so nothing is
+    lost).
+    """
+    if NEW_SHARD_MAP_API:
+        return lax.platform_dependent(*args, tpu=tpu, default=default)
+    fn = tpu if jax.default_backend() == "tpu" else default
+    return fn(*args)
+
+
+if not hasattr(jax, "shard_map"):
+    # old jax: expose the translated entry point at its modern public path so
+    # callers written as `jax.shard_map(..., check_vma=...)` (including the
+    # test-suite) run unchanged. New jax is never touched.
+    jax.shard_map = shard_map
+
+
+def shape_dtype_struct(shape, dtype, like=None):
+    """ShapeDtypeStruct carrying `like`'s varying-manual-axes type when the
+    running jax tracks one (so pallas kernels compose with
+    shard_map(check_vma=True)); a plain struct otherwise."""
+    typeof = getattr(jax, "typeof", None)
+    if like is not None and typeof is not None:
+        vma = getattr(typeof(like), "vma", None)
+        if vma:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)

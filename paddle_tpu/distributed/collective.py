@@ -134,7 +134,9 @@ def _axis_size(axis_name: str, group: Optional[Group]) -> int:
     """Size of a bound mesh axis, resolved INSIDE the trace (the binding mesh
     may differ from the global one, and groups may predate the mesh)."""
     try:
-        return int(lax.axis_size(axis_name))
+        from ._compat import axis_size as _compat_axis_size
+
+        return int(_compat_axis_size(axis_name))
     except Exception:
         pass
     from .mesh import get_mesh

@@ -45,6 +45,15 @@ class DistributedStrategy:
         self.gradient_merge = False
         self.gradient_merge_configs = {}
         self.find_unused_parameters = False
+        # explicit-DP comm/compute overlap (reference: DataParallel
+        # comm_buffer_size_MB / build_groups coalescing): when bucketed
+        # all-reduce is on, fleet.dp_train_step builds a TrainStep whose
+        # gradient reduction is coalesced into grad_bucket_mb-sized pmean
+        # buckets that XLA overlaps with the remaining backward
+        self.dp_comm_configs = {
+            "bucketed_allreduce": False,
+            "grad_bucket_mb": 4,
+        }
 
 
 class _Fleet:
@@ -117,6 +126,27 @@ def distributed_model(model):
 
 def distributed_optimizer(optimizer, strategy=None):
     return fleet.distributed_optimizer(optimizer, strategy)
+
+
+def dp_train_step(model, loss_fn, optimizer, strategy=None, mesh=None,
+                  dp_axis="dp", **kwargs):
+    """Build a TrainStep on the explicit data-parallel path.
+
+    With ``strategy.dp_comm_configs['bucketed_allreduce']`` on (or no
+    strategy at all), gradients are reduced in ``grad_bucket_mb``-sized
+    coalesced pmean buckets that XLA overlaps with the remaining backward
+    (distributed/grad_buckets.py); otherwise one coalesced all-reduce runs
+    after the full backward (still the explicit shard_map path, so the two
+    are directly comparable — tools/stepbench.py does exactly that).
+    """
+    from ...jit.trainer import TrainStep
+
+    cfg = (strategy.dp_comm_configs if strategy is not None
+           else DistributedStrategy().dp_comm_configs)
+    bucket_mb = (cfg.get("grad_bucket_mb", 4)
+                 if cfg.get("bucketed_allreduce", True) else -1)
+    return TrainStep(model, loss_fn, optimizer, mesh=mesh, dp_axis=dp_axis,
+                     grad_bucket_mb=bucket_mb, **kwargs)
 
 
 # -- round-5 parity: role makers, util base, data generators ----------------

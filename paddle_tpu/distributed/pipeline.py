@@ -64,12 +64,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:
-    from jax import shard_map as _sm
-
-    shard_map = _sm.shard_map if hasattr(_sm, "shard_map") else _sm
-except Exception:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore
+from ._compat import NEW_SHARD_MAP_API, shard_map
 
 
 def _zeros_like_tree(tree):
@@ -90,6 +85,25 @@ def _squeeze0(tree):
 
 def _expand0(tree):
     return jax.tree_util.tree_map(lambda x: jnp.expand_dims(x, 0), tree)
+
+
+def _rank_shard_map(body, mesh, n, axis, in_specs, out_specs):
+    """shard_map over `axis` handing `body` its stage id as the FIRST arg.
+
+    New jax: partial-manual over `axis` (other mesh axes stay under GSPMD)
+    with lax.axis_index for the id. Old jax cannot lower axis_index inside
+    a partial-auto shard_map — it becomes a PartitionId instruction the
+    SPMD partitioner rejects (and XLA check-fails outright when sharded
+    operands feed the manual subgroup) — so there the WHOLE mesh goes
+    manual: axes other than `axis` carry replicated data and redundant
+    compute, which is correct if wasteful, and axis_index lowers cleanly
+    inside a fully-manual region.
+    """
+    wrapped = lambda *a: body(lax.axis_index(axis), *a)
+    axis_names = frozenset({axis}) if NEW_SHARD_MAP_API else None
+    return shard_map(
+        wrapped, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        axis_names=axis_names, check_vma=False)
 
 
 def pipeline_1f1b(
@@ -115,9 +129,8 @@ def pipeline_1f1b(
     fwd_perm = [(i, i + 1) for i in range(S - 1)]
     bwd_perm = [(i + 1, i) for i in range(S - 1)]
 
-    def body(stage_params_l, loss_params_l, xs_l, labels_l):
+    def body(sid, stage_params_l, loss_params_l, xs_l, labels_l):
         params = _squeeze0(stage_params_l)  # local stage's params
-        sid = lax.axis_index(axis)
         is_first = sid == 0
         is_last = sid == S - 1
 
@@ -214,8 +227,7 @@ def pipeline_1f1b(
         P(),
         P(),
     )
-    fn = shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                   axis_names=frozenset({axis}), check_vma=False)
+    fn = _rank_shard_map(body, mesh, n_stages, axis, in_specs, out_specs)
     d_stage, d_loss_p, d_xs, loss = fn(stage_params, loss_params, xs, labels)
     return loss, d_stage, d_loss_p, d_xs
 
@@ -239,9 +251,8 @@ def pipeline_fthenb(
     fwd_perm = [(i, i + 1) for i in range(S - 1)]
     stage_ckpt = jax.checkpoint(stage_fn)
 
-    def forward(stage_params_l, loss_params_l, xs_l, labels_l):
+    def forward(sid, stage_params_l, loss_params_l, xs_l, labels_l):
         params = _squeeze0(stage_params_l)
-        sid = lax.axis_index(axis)
         is_first = sid == 0
         is_last = sid == S - 1
         mb_shape = xs_l.shape[1:]
@@ -267,8 +278,7 @@ def pipeline_fthenb(
         P(),
         P(),
     )
-    fn = shard_map(forward, mesh=mesh, in_specs=in_specs, out_specs=P(),
-                   axis_names=frozenset({axis}), check_vma=False)
+    fn = _rank_shard_map(forward, mesh, n_stages, axis, in_specs, P())
 
     def total(sp, lp, x):
         return fn(sp, lp, x, labels)
@@ -348,8 +358,7 @@ def pipeline_interleave(
     else:
         h_aval = jax.ShapeDtypeStruct(xs.shape[1:], xs.dtype)
 
-    def body(sp_l, sh_l, lp_l, xs_l, labels_l):
-        r = lax.axis_index(axis)
+    def body(r, sp_l, sh_l, lp_l, xs_l, labels_l):
 
         def fwd_slot(t):
             q = t - r
@@ -484,8 +493,7 @@ def pipeline_interleave(
         P(),
         P(),
     )
-    fn = shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                   axis_names=frozenset({axis}), check_vma=False)
+    fn = _rank_shard_map(body, mesh, S, axis, in_specs, out_specs)
     d_stage, d_shared, d_loss_p, d_xs, loss = fn(
         stage_params, shared_params, loss_params, xs, labels)
     return loss, d_stage, d_shared, d_loss_p, d_xs

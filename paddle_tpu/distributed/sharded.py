@@ -17,12 +17,7 @@ from ..core.tensor import Tensor
 from .collective import axis_context
 from .mesh import get_mesh
 
-try:  # jax>=0.5: public shard_map
-    from jax import shard_map as _shard_map_mod
-
-    shard_map = _shard_map_mod.shard_map if hasattr(_shard_map_mod, "shard_map") else _shard_map_mod
-except Exception:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore
+from ._compat import shard_map  # noqa: F401 — re-exported; see _compat.py
 
 
 def _to_vals(x):
@@ -56,26 +51,14 @@ def sharded_fn(fn, mesh: Optional[Mesh] = None, in_specs=None, out_specs=None,
                 out = fn(*_to_tensors(vals))
             return _to_vals(out)
 
-        try:
-            smapped = shard_map(
-                inner, mesh=m,
-                in_specs=in_specs if in_specs is not None
-                else PartitionSpec(),
-                out_specs=out_specs if out_specs is not None
-                else PartitionSpec(),
-                check_vma=check_vma,
-            )
-        except TypeError:
-            # older jax (the jax.experimental fallback import) spells the
-            # knob check_rep
-            smapped = shard_map(
-                inner, mesh=m,
-                in_specs=in_specs if in_specs is not None
-                else PartitionSpec(),
-                out_specs=out_specs if out_specs is not None
-                else PartitionSpec(),
-                check_rep=check_vma,
-            )
+        smapped = shard_map(
+            inner, mesh=m,
+            in_specs=in_specs if in_specs is not None
+            else PartitionSpec(),
+            out_specs=out_specs if out_specs is not None
+            else PartitionSpec(),
+            check_vma=check_vma,
+        )
         return _to_tensors(smapped(*_to_vals(args)))
 
     return wrapper

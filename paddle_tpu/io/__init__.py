@@ -32,3 +32,4 @@ from .packing import (  # noqa: F401,E402
     PackedLMBatches,
     pack_examples,
 )
+from .prefetch import DevicePrefetcher, maybe_prefetch  # noqa: F401,E402

@@ -26,6 +26,7 @@ import numpy as np
 from ..core import random as _random
 from ..core.tensor import Tensor
 from ..nn.layer import Layer, Parameter
+from .compile_cache import enable_persistent_cache  # noqa: F401
 from .trainer import TrainStep  # noqa: F401
 
 

@@ -1,0 +1,92 @@
+"""Persistent XLA compilation cache + AOT fast dispatch.
+
+Reference analogs: the reference's Program cache is in-process only — every
+fresh trainer pays full Program->executable build cost. XLA ships a
+content-addressed persistent compilation cache (keyed on serialized HLO +
+compile options + backend); wiring it up turns the second process launch of
+an identical train step into a disk read instead of a multi-second compile.
+
+Two pieces:
+  - enable_persistent_cache(): point jax at an on-disk cache directory and
+    drop the "only cache things that took >1s / >64KB" thresholds so even
+    bench-sized programs hit it. Idempotent; safe to call before or after
+    the first compile (earlier is better — entries written after enabling).
+  - TrainStep AOT fast dispatch (FLAGS_jit_fast_dispatch, jit/trainer.py):
+    `jitted.lower(...).compile()` once, then call the compiled executable
+    directly — skipping jax.jit's per-call python dispatch (signature
+    hashing, cache probing) on the hot path. Falls back to the normal jit
+    callable if the input signature ever changes.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from ..core import flags
+
+flags.define_flag(
+    "jit_compile_cache_dir", "",
+    "Directory for the persistent XLA compilation cache. Empty = disabled. "
+    "Set (or call jit.enable_persistent_cache) to make warm process starts "
+    "skip recompilation of unchanged train steps.")
+flags.define_flag(
+    "jit_fast_dispatch", False,
+    "AOT-compile TrainStep on first call and dispatch the compiled "
+    "executable directly, bypassing jax.jit python dispatch overhead.")
+
+_enabled_dir: Optional[str] = None
+
+
+def enable_persistent_cache(cache_dir: Optional[str] = None) -> str:
+    """Enable jax's on-disk compilation cache at `cache_dir`.
+
+    Defaults to FLAGS_jit_compile_cache_dir, else ~/.cache/paddle_tpu/xla.
+    Returns the directory in use. Subsequent calls with the same dir are
+    no-ops; a different dir re-points the cache.
+    """
+    global _enabled_dir
+    if cache_dir is None:
+        cache_dir = str(flags.get_flag("jit_compile_cache_dir") or "")
+    if not cache_dir:
+        cache_dir = os.path.join(
+            os.path.expanduser("~"), ".cache", "paddle_tpu", "xla")
+    cache_dir = os.path.abspath(cache_dir)
+    if _enabled_dir == cache_dir:
+        return cache_dir
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # default thresholds skip sub-second / small programs — exactly the ones
+    # CI and benches compile over and over
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    try:
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except AttributeError:  # knob added in later jax; older caches everything
+        pass
+    # jax probes cache eligibility ONCE per process at the first compile; if
+    # anything compiled before this call, re-arm the probe so the new dir is
+    # actually used (no-op when nothing compiled yet)
+    try:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:
+        pass
+    _enabled_dir = cache_dir
+    flags.set_flags({"jit_compile_cache_dir": cache_dir})
+    return cache_dir
+
+
+def maybe_enable_from_flags() -> Optional[str]:
+    """Enable the persistent cache iff FLAGS_jit_compile_cache_dir is set
+    (e.g. via the FLAGS_jit_compile_cache_dir env var). Called by bench
+    entrypoints so a single env var turns on warm starts."""
+    d = str(flags.get_flag("jit_compile_cache_dir") or "")
+    if d:
+        return enable_persistent_cache(d)
+    return None
+
+
+def cache_dir() -> Optional[str]:
+    return _enabled_dir

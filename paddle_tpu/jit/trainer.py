@@ -50,6 +50,8 @@ class TrainStep:
         out_shardings=None,
         mesh=None,
         nan_guard: bool = False,
+        dp_axis: Optional[str] = None,
+        grad_bucket_mb: Optional[int] = None,
     ):
         self.model = model
         self.loss_fn = loss_fn
@@ -76,6 +78,17 @@ class TrainStep:
             lambda x: x.copy() if hasattr(x, "copy") else x, self.opt_state)
         self._mesh = mesh
         self._step_i = 0
+        # Explicit data-parallel path: shard_map over `dp_axis` with the
+        # gradient all-reduce coalesced into fixed-byte buckets, each bucket
+        # its own pmean so XLA's latency-hiding scheduler overlaps it with
+        # the remaining backward (distributed/grad_buckets.py). None keeps
+        # the implicit GSPMD path (grads reduced wherever XLA places them).
+        self._dp_axis = dp_axis
+        if grad_bucket_mb is None:
+            self._bucket_bytes = None  # resolve from FLAGS at trace time
+        else:
+            self._bucket_bytes = (int(grad_bucket_mb) << 20
+                                  if grad_bucket_mb >= 0 else 1 << 62)
 
         # ZeRO stage placements (distributed/sharding.py): optimizer state is
         # sharded in all stages; grads carry a reduce-scatter constraint in
@@ -119,6 +132,15 @@ class TrainStep:
                     (g._value if g is not None else jnp.zeros_like(p._value))
                     for g, p in zip(grads, self.params)
                 ]
+                loss_val = loss._value
+                if self._dp_axis is not None:
+                    # explicit DP: bucketed all-reduce BEFORE clipping so the
+                    # clip sees globally-reduced grads (GSPMD-path parity)
+                    from ..distributed.grad_buckets import bucket_reduce
+
+                    g_vals = bucket_reduce(g_vals, self._dp_axis,
+                                           self._bucket_bytes)
+                    loss_val = jax.lax.pmean(loss_val, self._dp_axis)
                 if self._grad_shardings is not None:  # ZeRO-2/3 reduce-scatter
                     g_vals = [
                         jax.lax.with_sharding_constraint(g, sh)
@@ -147,14 +169,14 @@ class TrainStep:
                     ]
                 new_buffer_vals = [b._value for b in self.buffers]  # BN stats updated in-place
                 if not self._nan_guard:
-                    return loss._value, new_p, new_buffer_vals, new_s
+                    return loss_val, new_p, new_buffer_vals, new_s
                 # global-grad-norm finite check; overflow of the square-sum
                 # to inf is itself a (correct) skip signal
                 gsq = jnp.zeros((), jnp.float32)
                 for g in g_vals:
                     gsq = gsq + jnp.sum(jnp.square(g.astype(jnp.float32)))
                 ok = jnp.isfinite(gsq) & jnp.isfinite(
-                    loss._value.astype(jnp.float32))
+                    loss_val.astype(jnp.float32))
                 new_p = [jnp.where(ok, n, o)
                          for n, o in zip(new_p, param_vals)]
                 new_buffer_vals = [jnp.where(ok, n, o)
@@ -163,7 +185,7 @@ class TrainStep:
                 new_s = jax.tree_util.tree_map(
                     lambda n, o: jnp.where(ok, n, o), new_s, opt_state)
                 skipped = (~ok).astype(jnp.int32)
-                return loss._value, new_p, new_buffer_vals, new_s, skipped
+                return loss_val, new_p, new_buffer_vals, new_s, skipped
             finally:
                 _random.default_generator.pop_trace_seed(prev_seed)
                 for p, (v, gn, g, sg) in zip(self.params, saved):
@@ -172,12 +194,65 @@ class TrainStep:
                     b._value = v
 
         donate_argnums = (0, 1, 2) if donate else ()
-        self._jitted = jax.jit(
-            step,
-            donate_argnums=donate_argnums,
-            in_shardings=in_shardings,
-            out_shardings=out_shardings,
-        )
+        if dp_axis is not None:
+            from jax.sharding import PartitionSpec as _P
+
+            from ..distributed._compat import shard_map as _shard_map
+            from ..distributed.mesh import get_mesh as _get_mesh
+
+            dp_mesh = mesh if mesh is not None else _get_mesh()
+            if dp_mesh is None or dp_axis not in dp_mesh.axis_names:
+                raise ValueError(
+                    f"dp_axis={dp_axis!r} needs a mesh with that axis "
+                    "(pass mesh= or distributed.set_mesh first)")
+            if self._grad_shardings is not None or \
+                    self._param_shardings is not None:
+                raise ValueError(
+                    "bucketed DP (dp_axis=) and ZeRO stages are mutually "
+                    "exclusive — ZeRO's reduce-scatter already overlaps")
+            if in_shardings is not None or out_shardings is not None:
+                raise ValueError(
+                    "dp_axis= replaces in_shardings/out_shardings: the "
+                    "shard_map specs define the placement")
+            # state replicated over dp, batch split on its leading dim;
+            # outputs replicated (grads/loss are pmean'ed inside)
+            smapped = _shard_map(
+                step, mesh=dp_mesh,
+                in_specs=(_P(), _P(), _P(), _P(), _P(), _P(dp_axis)),
+                out_specs=_P(),
+                axis_names=frozenset({dp_axis}), check_vma=False)
+            self._jitted = jax.jit(smapped, donate_argnums=donate_argnums)
+        else:
+            self._jitted = jax.jit(
+                step,
+                donate_argnums=donate_argnums,
+                in_shardings=in_shardings,
+                out_shardings=out_shardings,
+            )
+        # AOT fast dispatch (jit/compile_cache.py): the lowered+compiled
+        # executable for the (single) input signature, built lazily
+        self._aot = None
+        self._aot_sig = None
+
+    @staticmethod
+    def _arg_signature(args):
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        return (treedef, tuple(
+            (tuple(getattr(v, "shape", ())),
+             str(getattr(v, "dtype", type(v).__name__))) for v in leaves))
+
+    def _dispatch(self, *args):
+        from ..core.flags import get_flag
+
+        if not get_flag("jit_fast_dispatch"):
+            return self._jitted(*args)
+        sig = self._arg_signature(args)
+        if self._aot is None or sig != self._aot_sig:
+            # new shape/dtype signature: AOT-compile for it (first time), or
+            # fall through jit for a shape-polymorphic caller
+            self._aot = self._jitted.lower(*args).compile()
+            self._aot_sig = sig
+        return self._aot(*args)
 
     def __call__(self, *batch):
         batch_vals = _tensor_leaves(batch)
@@ -186,7 +261,7 @@ class TrainStep:
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         seed = jnp.asarray(self._step_i, jnp.int32)
         self._step_i += 1
-        out = self._jitted(
+        out = self._dispatch(
             param_vals, buffer_vals, self.opt_state, lr, seed, batch_vals
         )
         if self._nan_guard:

@@ -32,6 +32,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from ...distributed._compat import platform_dependent as _platform_dependent
+
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30
@@ -40,11 +42,11 @@ NEG_INF = -1e30
 def _sds(shape, dtype, like):
     """ShapeDtypeStruct carrying `like`'s varying-manual-axes type, so the
     kernels compose with shard_map(check_vma=True) — e.g. as ring-attention
-    chunks over the 'sep' axis."""
-    vma = getattr(jax.typeof(like), "vma", None)
-    if vma:
-        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
-    return jax.ShapeDtypeStruct(shape, dtype)
+    chunks over the 'sep' axis. (Version skew — jax.typeof absent on old
+    jax — is absorbed by distributed/_compat.py.)"""
+    from ...distributed._compat import shape_dtype_struct
+
+    return shape_dtype_struct(shape, dtype, like)
 
 
 # ------------------------------------------------------------------- forward
@@ -376,7 +378,7 @@ def flash_attention_platform(q, k, v, scale=None, causal=False,
 
 
 def _platform_fwd(q, k, v, scale, causal, block_q, block_k):
-    return jax.lax.platform_dependent(
+    return _platform_dependent(
         q, k, v,
         tpu=lambda q, k, v: _fwd(q, k, v, scale, causal, block_q, block_k,
                                  False),
@@ -388,7 +390,7 @@ def _platform_fwd_rule(q, k, v, scale, causal, block_q, block_k):
 
 
 def _platform_bwd_rule(scale, causal, block_q, block_k, res, g):
-    return jax.lax.platform_dependent(
+    return _platform_dependent(
         *res, g,
         tpu=lambda *a: _bwd(scale, causal, block_q, block_k, False,
                             a[:5], a[5]),

@@ -159,12 +159,22 @@ class CheckpointManager:
             wrapped in this manager's commit protocol).
     """
 
-    def __init__(self, root: str, keep_last_n: int = 3, backend: str = "npy"):
+    def __init__(self, root: str, keep_last_n: int = 3, backend: str = "npy",
+                 async_save: bool = False):
         if backend not in ("npy", "orbax"):
             raise ValueError(f"unknown checkpoint backend {backend!r}")
         self.root = os.path.abspath(root)
         self.keep_last_n = max(int(keep_last_n), 1)
         self.backend = backend
+        # async: the host snapshot is taken on the caller thread (so donated
+        # device buffers are never read after the step that invalidates
+        # them), then file writes + the commit rename happen on a background
+        # thread. wait() — called implicitly by the next save() — joins it
+        # and re-raises any write error. Commit order is preserved: at most
+        # one save is in flight.
+        self.async_save = bool(async_save)
+        self._thread: Optional[Any] = None
+        self._error: Optional[BaseException] = None
         self.last_scan_report: List[Tuple[str, str]] = []  # (path, reason)
         os.makedirs(self.root, exist_ok=True)
 
@@ -190,43 +200,106 @@ class CheckpointManager:
         return steps[-1] if steps else None
 
     # -- save --------------------------------------------------------------
-    def save(self, step: int, state: Any, meta: Optional[Dict] = None):
+    def save(self, step: int, state: Any, meta: Optional[Dict] = None,
+             asynchronous: Optional[bool] = None):
         """Write checkpoint for `step`; commit atomically; GC old ones.
 
         Any crash (or injected fault) before the commit rename leaves the
         previous checkpoints untouched; a crash after it at worst skips GC.
+
+        With `asynchronous` (default: the manager's `async_save`), the state
+        is snapshotted to host memory before returning and the write+commit
+        runs on a background thread; call wait() (or just the next save(),
+        which implies it) to block until the commit and surface any error.
+        The orbax backend always writes synchronously (its payload writer
+        reads live device shards).
         """
+        if asynchronous is None:
+            asynchronous = self.async_save
+        self.wait()  # one in-flight save at a time; ordered commits
+        if self.backend == "orbax" or not asynchronous:
+            return self._save_now(step, state, meta)
+        leaves: List[np.ndarray] = []
+        skeleton = _encode(state, leaves)  # device->host copies happen HERE
+        # host numpy leaves may alias caller arrays mutated by later steps —
+        # copy them; _encode already copied device arrays to fresh host
+        # buffers via np.asarray
+        leaves = [np.array(a, copy=True) for a in leaves]
+        meta = json.loads(json.dumps(meta or {}))  # freeze user meta too
+        self._error = None
+
+        def _worker():
+            try:
+                self._write_npy(step, skeleton, leaves, meta)
+            except BaseException as e:  # surfaced at wait()/next save()
+                self._error = e
+
+        import threading
+
+        self._thread = threading.Thread(
+            target=_worker, name="ckpt-save", daemon=True)
+        self._thread.start()
+        return self._dir_for(step)
+
+    def wait(self):
+        """Block until the in-flight async save (if any) commits; re-raise
+        its error. Idempotent; no-op when nothing is pending."""
+        t = self._thread
+        if t is None:
+            return
+        t.join()
+        self._thread = None
+        if self._error is not None:
+            e, self._error = self._error, None
+            raise e
+
+    def _save_now(self, step: int, state: Any, meta: Optional[Dict]):
+        if self.backend == "orbax":
+            return self._write_orbax(step, state, meta)
+        leaves: List[np.ndarray] = []
+        skeleton = _encode(state, leaves)
+        return self._write_npy(step, skeleton, leaves, meta)
+
+    def _write_orbax(self, step: int, state: Any, meta: Optional[Dict]):
         final = self._dir_for(step)
         tmp = final + ".tmp"
         if os.path.exists(tmp):  # stale debris from a previous crash
             shutil.rmtree(tmp)
         os.makedirs(tmp)
         chaos.crash_point("ckpt.begin")
+        from ..distributed.checkpoint import save_sharded
 
+        save_sharded(state, os.path.join(tmp, "arrays"), async_save=False)
+        chaos.crash_point("ckpt.array")
+        return self._finalize(step, tmp, final, skeleton=None, arrays=[],
+                              meta=meta)
+
+    def _write_npy(self, step: int, skeleton, leaves: List[np.ndarray],
+                   meta: Optional[Dict]):
+        final = self._dir_for(step)
+        tmp = final + ".tmp"
+        if os.path.exists(tmp):  # stale debris from a previous crash
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        chaos.crash_point("ckpt.begin")
         arrays = []
-        if self.backend == "orbax":
-            from ..distributed.checkpoint import save_sharded
-
-            skeleton = None  # orbax restores its own tree structure
-            save_sharded(state, os.path.join(tmp, "arrays"), async_save=False)
+        for i, arr in enumerate(leaves):
+            fname = f"arr_{i}.bin"
+            buf = arr.tobytes()
+            with open(os.path.join(tmp, fname), "wb") as f:
+                f.write(buf)
+                _fsync_file(f)
+            arrays.append({
+                "file": fname,
+                "shape": list(arr.shape),
+                "dtype": arr.dtype.name,
+                "crc32": zlib.crc32(buf) & 0xFFFFFFFF,
+            })
             chaos.crash_point("ckpt.array")
-        else:
-            leaves: List[np.ndarray] = []
-            skeleton = _encode(state, leaves)
-            for i, arr in enumerate(leaves):
-                fname = f"arr_{i}.bin"
-                buf = arr.tobytes()
-                with open(os.path.join(tmp, fname), "wb") as f:
-                    f.write(buf)
-                    _fsync_file(f)
-                arrays.append({
-                    "file": fname,
-                    "shape": list(arr.shape),
-                    "dtype": arr.dtype.name,
-                    "crc32": zlib.crc32(buf) & 0xFFFFFFFF,
-                })
-                chaos.crash_point("ckpt.array")
+        return self._finalize(step, tmp, final, skeleton, arrays, meta)
 
+    def _finalize(self, step: int, tmp: str, final: str, skeleton, arrays,
+                  meta: Optional[Dict]):
         chaos.crash_point("ckpt.before_manifest")
         manifest = {
             "version": _FORMAT_VERSION,
@@ -338,6 +411,7 @@ class CheckpointManager:
         back to older ones on corruption; None when nothing valid exists.
         `template` (a pytree of Tensors/arrays matching the saved structure)
         places restored arrays onto the template leaves' shardings."""
+        self.wait()  # a just-issued async save must be visible (or raise)
         self.last_scan_report = []
         for step in reversed(self.all_steps()):
             path = self._dir_for(step)

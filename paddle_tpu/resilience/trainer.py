@@ -209,6 +209,7 @@ class ResilientTrainer:
                 preempt.uninstall()
 
     def _finish(self, report: Dict[str, Any]) -> Dict[str, Any]:
+        self.manager.wait()  # run() must not return before the final commit
         self.step.sync_to_optimizer()
         report["step"] = int(self.step._step_i)
         report["steps_skipped"] = (int(self.step.skipped_steps)

@@ -1,0 +1,325 @@
+"""Per-phase step-time benchmark for the PR-2 optimization layer.
+
+Breaks one training step into its overlappable phases and measures each
+optimization on/off on the CPU-mesh GPT preset (8 virtual devices):
+
+  data    — host batch wait + host->device transfer, with and without the
+            double-buffered DevicePrefetcher (io/prefetch.py) hiding a
+            deliberately slow host loader;
+  compute — the compiled TrainStep itself, with and without AOT fast
+            dispatch (FLAGS_jit_fast_dispatch);
+  reduce  — explicit data-parallel gradient all-reduce, single coalesced
+            pmean vs fixed-byte buckets XLA can overlap with the backward
+            (distributed/grad_buckets.py);
+  save    — crash-consistent checkpoint commit, synchronous vs async
+            (resilience/checkpoint_manager.py background write);
+  compile — cold vs warm process start with the persistent XLA compilation
+            cache (jit/compile_cache.py), measured in child subprocesses
+            sharing one cache dir;
+  autotune— flash-attention block tuning, cold (times every candidate) vs
+            warm (persistent winner cache hit, core/autotune.py).
+
+Prints ONE JSON line on stdout and appends it to STEPBENCH.jsonl.
+
+Usage: python tools/stepbench.py [--steps N] [--quick]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# must happen before jax import: CPU mesh with 8 virtual devices
+if "--child-compile" not in sys.argv:
+    _xla = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in _xla:
+        os.environ["XLA_FLAGS"] = (
+            _xla + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def _gpt_pieces(batch=8, seq=128):
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                    num_heads=4, max_position_embeddings=max(seq, 128),
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    ids_np = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    return cfg, model, ids_np
+
+
+def _make_step(model, mesh=None, dp_axis=None, grad_bucket_mb=None):
+    from paddle_tpu import optimizer
+    from paddle_tpu.jit.trainer import TrainStep
+
+    opt = optimizer.AdamW(1e-4, parameters=model.parameters())
+    return TrainStep(model, lambda ids: model(ids, labels=ids), opt,
+                     mesh=mesh, dp_axis=dp_axis, grad_bucket_mb=grad_bucket_mb)
+
+
+def _steps_per_sec(step, ids, n):
+    import paddle_tpu as paddle
+
+    t = paddle.to_tensor(ids)
+    float(step(t).item())  # compile
+    float(step(t).item())  # warm
+    t0 = time.perf_counter()
+    for _ in range(n):
+        loss = step(t)
+    float(loss.item())
+    return n / (time.perf_counter() - t0)
+
+
+# -- data phase: slow host loader, prefetch off/on ---------------------------
+def bench_data_phase(n_steps: int):
+    import paddle_tpu as paddle
+    from paddle_tpu.io import DevicePrefetcher
+
+    _, model, ids_np = _gpt_pieces()
+    step = _make_step(model)
+    float(step(paddle.to_tensor(ids_np)).item())  # compile outside the clock
+    delay_s = 0.01  # deliberate host-loader cost per batch
+
+    def loader(n):
+        for _ in range(n):
+            time.sleep(delay_s)
+            yield ids_np
+
+    # OFF: data wait serializes with compute
+    t_data = t_compute = 0.0
+    t0 = time.perf_counter()
+    it = loader(n_steps)
+    for _ in range(n_steps):
+        d0 = time.perf_counter()
+        host = next(it)
+        t = paddle.to_tensor(host)
+        t_data += time.perf_counter() - d0
+        c0 = time.perf_counter()
+        float(step(t).item())
+        t_compute += time.perf_counter() - c0
+    off_sps = n_steps / (time.perf_counter() - t0)
+
+    # ON: prefetcher overlaps loader + transfer with compute
+    pf = DevicePrefetcher(loader(n_steps), depth=2)
+    t_data_on = 0.0
+    t0 = time.perf_counter()
+    for dev in pf:
+        d0 = time.perf_counter()
+        t = paddle.Tensor(dev)
+        t_data_on += time.perf_counter() - d0
+        float(step(t).item())
+    on_sps = n_steps / (time.perf_counter() - t0)
+    return {
+        "loader_delay_ms": delay_s * 1000,
+        "data_ms_per_step_off": round(t_data / n_steps * 1000, 3),
+        "data_ms_per_step_on": round(
+            (t_data_on + pf.stats["wait_s"]) / n_steps * 1000, 3),
+        "compute_ms_per_step": round(t_compute / n_steps * 1000, 3),
+        "steps_per_sec_off": round(off_sps, 3),
+        "steps_per_sec_on": round(on_sps, 3),
+        "speedup": round(on_sps / off_sps, 3),
+    }
+
+
+# -- reduce phase: explicit DP, single vs bucketed all-reduce ----------------
+def bench_reduce_phase(n_steps: int):
+    import jax
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    _, model_single, ids_np = _gpt_pieces()
+    single = _make_step(model_single, mesh=mesh, dp_axis="dp",
+                        grad_bucket_mb=-1)
+    sps_single = _steps_per_sec(single, ids_np, n_steps)
+    _, model_bucketed, _ = _gpt_pieces()
+    bucketed = _make_step(model_bucketed, mesh=mesh, dp_axis="dp",
+                          grad_bucket_mb=1)
+    sps_bucketed = _steps_per_sec(bucketed, ids_np, n_steps)
+    return {
+        "mesh": "dp=8 (cpu virtual)",
+        "reduce_ms_per_step_single": round(1000 / sps_single, 3),
+        "reduce_ms_per_step_bucketed": round(1000 / sps_bucketed, 3),
+        "steps_per_sec_single": round(sps_single, 3),
+        "steps_per_sec_bucketed": round(sps_bucketed, 3),
+        "speedup": round(sps_bucketed / sps_single, 3),
+    }
+
+
+# -- compute phase: jit dispatch vs AOT fast dispatch ------------------------
+def bench_dispatch(n_steps: int):
+    from paddle_tpu.core import flags
+
+    _, model, ids_np = _gpt_pieces()
+    step = _make_step(model)
+    flags.set_flags({"jit_fast_dispatch": False})
+    sps_jit = _steps_per_sec(step, ids_np, n_steps)
+    flags.set_flags({"jit_fast_dispatch": True})
+    sps_aot = _steps_per_sec(step, ids_np, n_steps)
+    flags.set_flags({"jit_fast_dispatch": False})
+    return {
+        "compute_ms_per_step_jit": round(1000 / sps_jit, 3),
+        "compute_ms_per_step_aot": round(1000 / sps_aot, 3),
+        "steps_per_sec_jit": round(sps_jit, 3),
+        "steps_per_sec_aot": round(sps_aot, 3),
+        "speedup": round(sps_aot / sps_jit, 3),
+    }
+
+
+# -- save phase: sync vs async checkpoint ------------------------------------
+def bench_save_phase(n_saves: int):
+    from paddle_tpu.resilience.checkpoint_manager import CheckpointManager
+
+    state = {"params": [np.random.RandomState(i).rand(256, 256).astype(
+        np.float32) for i in range(8)]}
+
+    sync = CheckpointManager(tempfile.mkdtemp(prefix="sb_sync_"))
+    t0 = time.perf_counter()
+    for i in range(n_saves):
+        sync.save(i, state)
+    sync_s = (time.perf_counter() - t0) / n_saves
+
+    asy = CheckpointManager(tempfile.mkdtemp(prefix="sb_async_"),
+                            async_save=True)
+    lat = 0.0
+    t0 = time.perf_counter()
+    for i in range(n_saves):
+        s0 = time.perf_counter()
+        asy.save(i, state)  # returns after snapshot; commit in background
+        lat += time.perf_counter() - s0
+    asy.wait()
+    total_s = (time.perf_counter() - t0) / n_saves
+    return {
+        "state_mb": round(sum(a.nbytes for a in state["params"]) / 2**20, 1),
+        "save_ms_sync": round(sync_s * 1000, 3),
+        "save_ms_async_caller": round(lat / n_saves * 1000, 3),
+        "save_ms_async_total": round(total_s * 1000, 3),
+        "caller_latency_reduction": round(
+            1 - (lat / n_saves) / sync_s, 3),
+    }
+
+
+# -- compile cache: cold vs warm process start -------------------------------
+def bench_compile_cache():
+    cache_dir = tempfile.mkdtemp(prefix="sb_xla_")
+    times = []
+    for label in ("cold", "warm"):
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   FLAGS_jit_compile_cache_dir=cache_dir)
+        env.pop("XLA_FLAGS", None)  # single device is enough for this probe
+        res = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child-compile",
+             cache_dir],
+            env=env, capture_output=True, text=True, timeout=900)
+        if res.returncode != 0:
+            log(f"compile-cache child ({label}) failed:\n" + res.stderr[-2000:])
+            return {"error": f"{label} child rc={res.returncode}"}
+        times.append(json.loads(res.stdout.strip().splitlines()[-1]))
+    cold, warm = times
+    return {
+        "cache_dir_entries": len(os.listdir(cache_dir)),
+        "compile_s_cold": cold["compile_s"],
+        "compile_s_warm": warm["compile_s"],
+        "warm_start_reduction": round(
+            1 - warm["compile_s"] / cold["compile_s"], 3)
+        if cold["compile_s"] > 0 else None,
+    }
+
+
+def child_compile(cache_dir: str) -> int:
+    """Subprocess body: enable the persistent cache, build the GPT TrainStep,
+    report time-to-first-step (trace + XLA compile + run)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.jit import enable_persistent_cache
+
+    enable_persistent_cache(cache_dir)
+    _, model, ids_np = _gpt_pieces()
+    step = _make_step(model)
+    t0 = time.perf_counter()
+    float(step(paddle.to_tensor(ids_np)).item())
+    print(json.dumps({"compile_s": round(time.perf_counter() - t0, 3)}),
+          flush=True)
+    return 0
+
+
+# -- autotune: cold tuning vs persistent-cache warm start --------------------
+def bench_autotune():
+    import jax.numpy as jnp
+
+    from paddle_tpu.core import autotune, flags
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention_tuned
+
+    cache_dir = tempfile.mkdtemp(prefix="sb_at_")
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.rand(1, 512, 4, 32).astype(np.float32))
+    out = {}
+    for label in ("cold", "warm"):
+        autotune.clear_cache()  # drop in-memory winners; disk persists
+        flags.set_flags({"use_autotune": True,
+                         "autotune_cache_dir": cache_dir})
+        t0 = time.perf_counter()
+        flash_attention_tuned(q, q, q, causal=False, interpret=True)
+        out[f"first_call_s_{label}"] = round(time.perf_counter() - t0, 3)
+        out[f"info_{label}"] = {
+            k: v for k, v in autotune.cache_info().items() if k != "keys"}
+    flags.set_flags({"use_autotune": False, "autotune_cache_dir": ""})
+    out["warm_start_reduction"] = round(
+        1 - out["first_call_s_warm"] / out["first_call_s_cold"], 3)
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--saves", type=int, default=5)
+    ap.add_argument("--quick", action="store_true",
+                    help="skip the subprocess compile-cache probe")
+    args = ap.parse_args()
+
+    import jax
+
+    result = {"tool": "stepbench", "backend": jax.default_backend(),
+              "devices": len(jax.devices()),
+              "ts": time.strftime("%Y-%m-%dT%H:%M:%S")}
+    for name, fn in [
+        ("data_prefetch", lambda: bench_data_phase(args.steps)),
+        ("reduce_bucketing", lambda: bench_reduce_phase(args.steps)),
+        ("compute_dispatch", lambda: bench_dispatch(args.steps)),
+        ("save_async", lambda: bench_save_phase(args.saves)),
+        ("autotune_cache", bench_autotune),
+    ] + ([] if args.quick else [("compile_cache", bench_compile_cache)]):
+        log(f"--- {name}")
+        try:
+            result[name] = fn()
+            log(json.dumps(result[name]))
+        except Exception as e:  # a broken phase must not erase the others
+            import traceback
+
+            traceback.print_exc()
+            result[name] = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
+    print(json.dumps(result), flush=True)
+    with open(os.path.join(_REPO, "STEPBENCH.jsonl"), "a") as f:
+        f.write(json.dumps(result) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) >= 3 and sys.argv[1] == "--child-compile":
+        sys.exit(child_compile(sys.argv[2]))
+    sys.exit(main())

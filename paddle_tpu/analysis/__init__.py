@@ -1,0 +1,31 @@
+"""Program Doctor: jaxpr-level static analysis for training programs.
+
+Reference analog: the reference's compile-time program checks — PIR passes
+and op sanity checks over ProgramDesc — which our XLA path lacked entirely.
+`analyze()` traces a function with `jax.make_jaxpr` (no device execution;
+works under JAX_PLATFORMS=cpu) and runs the registered rules over the
+jaxpr, returning a Report of structured Findings.
+
+Entry points:
+  - analyze(fn, *args, mesh=..., donate_argnums=..., ...) -> Report
+  - analyze_jaxpr(closed_jaxpr, ...) -> Report
+  - lint_train_step(train_step, batch) -> Report   (what FLAGS_jit_lint uses)
+  - python -m paddle_tpu.analysis                   (lint model-zoo presets)
+
+Rules (ids): collective-axis, dtype-promotion, recompile-hazard, donation,
+dead-output, host-sync, pallas-tiling, prefetch-effects. See README
+"Static analysis" for the table and severities.
+"""
+from .analyzer import (  # noqa: F401
+    ProgramInfo,
+    analyze,
+    analyze_jaxpr,
+    analyze_program,
+    eqn_source,
+    iter_eqns,
+    lint_train_step,
+    trace_program,
+)
+from .findings import Finding, LintError, Report, Severity  # noqa: F401
+from .registry import Rule, all_rules, get_rule, register_rule  # noqa: F401
+from .rules.pallas_tiling import lint_block_shape  # noqa: F401

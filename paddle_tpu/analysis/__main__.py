@@ -1,0 +1,79 @@
+"""CLI: lint the model-zoo presets (trace-only, CPU-safe).
+
+Usage:
+    python -m paddle_tpu.analysis [presets...] [--json FILE]
+        [--fail-on error|warning|info] [--list-rules] [--dp N]
+
+Default presets: all (gpt llama bert pallas). Exit code 1 when any finding
+reaches --fail-on severity (default: error). `--dp N` lints under a dp=N
+mesh so the explicit data-parallel path (collectives included) is covered —
+requires N visible devices (XLA_FLAGS=--xla_force_host_platform_device_count).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="Program Doctor: static lints over model-zoo presets")
+    ap.add_argument("presets", nargs="*", help="subset of presets to lint")
+    ap.add_argument("--json", metavar="FILE",
+                    help="write the full report as JSON ('-' for stdout)")
+    ap.add_argument("--fail-on", default="error",
+                    choices=["error", "warning", "info"],
+                    help="exit 1 if any finding reaches this severity")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--dp", type=int, default=0,
+                    help="also bind a dp=N mesh while linting")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from . import Severity, all_rules
+    from .presets import PRESETS, lint_presets
+
+    if args.list_rules:
+        for r in all_rules():
+            tag = " (heuristic)" if r.heuristic else ""
+            print(f"{r.id:18s} {r.severity!s:7s}{tag}  {r.title}")
+        return 0
+
+    names = args.presets or list(PRESETS)
+    unknown = set(names) - set(PRESETS)
+    if unknown:
+        ap.error(f"unknown preset(s) {sorted(unknown)}; "
+                 f"known: {sorted(PRESETS)}")
+
+    if args.dp:
+        from ..distributed import mesh as _mesh
+
+        _mesh.set_mesh(_mesh.build_mesh(dp=args.dp))
+
+    fail_at = Severity[args.fail_on.upper()]
+    rows = lint_presets(names)
+    worst = -1
+    payload = []
+    for label, report in rows:
+        print(report)
+        payload.append(report.to_dict())
+        if report.findings:
+            worst = max(worst, int(report.max_severity))
+    total = sum(len(r.findings) for _, r in rows)
+    print(f"\nlinted {len(rows)} target(s): {total} finding(s)")
+
+    if args.json:
+        out = json.dumps({"targets": payload}, indent=2)
+        if args.json == "-":
+            print(out)
+        else:
+            with open(args.json, "w") as f:
+                f.write(out + "\n")
+    return 1 if worst >= int(fail_at) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

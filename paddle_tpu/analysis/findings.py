@@ -1,0 +1,134 @@
+"""Finding / Report data model for the Program Doctor static analyzer.
+
+Reference analog: the PIR pass diagnostics + op sanity checks the reference
+runs over ProgramDesc at compile time (SURVEY.md §3.3) — each check emits a
+structured diagnostic with op provenance instead of failing deep inside the
+executor. Here a Finding pins a lint to a jaxpr equation and its python
+source line, so "psum over a dead axis" points at the model code, not at an
+XLA stack trace three layers down.
+"""
+from __future__ import annotations
+
+import enum
+import json
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+class Severity(enum.IntEnum):
+    INFO = 0
+    WARNING = 1
+    ERROR = 2
+
+    def __str__(self):  # "ERROR", not "Severity.ERROR" — for report tables
+        return self.name
+
+
+def parse_severity(s) -> "Severity":
+    if isinstance(s, Severity):
+        return s
+    return Severity[str(s).upper()]
+
+
+@dataclass
+class Finding:
+    """One lint hit: rule id + severity + where + how to fix."""
+
+    rule: str
+    severity: Severity
+    message: str
+    fix_hint: str = ""
+    primitive: str = ""      # jaxpr primitive name, "" for program-level
+    eqn_index: int = -1      # index in the (flattened) eqn walk, -1 = program
+    source: str = ""         # "file.py:123 (fn)" provenance from source_info
+
+    def format(self) -> str:
+        loc = f" at {self.source}" if self.source else ""
+        prim = f" [{self.primitive}]" if self.primitive else ""
+        hint = f"\n    hint: {self.fix_hint}" if self.fix_hint else ""
+        return f"{self.severity}:{self.rule}{prim}{loc}: {self.message}{hint}"
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "severity": str(self.severity),
+            "message": self.message,
+            "fix_hint": self.fix_hint,
+            "primitive": self.primitive,
+            "eqn_index": self.eqn_index,
+            "source": self.source,
+        }
+
+
+class LintError(RuntimeError):
+    """Raised by Report.raise_if / FLAGS_jit_lint=raise on severe findings."""
+
+    def __init__(self, findings: List[Finding]):
+        self.findings = list(findings)
+        lines = "\n".join("  " + f.format() for f in self.findings)
+        super().__init__(
+            f"static analysis found {len(self.findings)} blocking "
+            f"finding(s):\n{lines}")
+
+
+@dataclass
+class Report:
+    """All findings from one analyze() pass, sorted most-severe-first."""
+
+    findings: List[Finding] = field(default_factory=list)
+    target: str = ""  # human label of what was linted ("TrainStep(gpt)", ...)
+
+    def extend(self, findings):
+        self.findings.extend(findings)
+
+    def sort(self):
+        self.findings.sort(key=lambda f: (-int(f.severity), f.eqn_index))
+        return self
+
+    def by_rule(self, rule: str) -> List[Finding]:
+        return [f for f in self.findings if f.rule == rule]
+
+    def at_least(self, severity) -> List[Finding]:
+        sev = parse_severity(severity)
+        return [f for f in self.findings if f.severity >= sev]
+
+    @property
+    def errors(self) -> List[Finding]:
+        return self.at_least(Severity.ERROR)
+
+    @property
+    def warnings(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == Severity.WARNING]
+
+    @property
+    def max_severity(self) -> Optional[Severity]:
+        if not self.findings:
+            return None
+        return max(f.severity for f in self.findings)
+
+    def raise_if(self, severity=Severity.ERROR):
+        bad = self.at_least(severity)
+        if bad:
+            raise LintError(bad)
+        return self
+
+    def to_dict(self) -> dict:
+        return {
+            "target": self.target,
+            "counts": {
+                "error": len(self.errors),
+                "warning": len(self.warnings),
+                "info": len(self.findings) - len(self.errors) - len(self.warnings),
+            },
+            "findings": [f.to_dict() for f in self.findings],
+        }
+
+    def to_json(self, **kw) -> str:
+        return json.dumps(self.to_dict(), **kw)
+
+    def __str__(self) -> str:
+        head = f"lint {self.target or '<program>'}: "
+        if not self.findings:
+            return head + "clean (0 findings)"
+        body = "\n".join("  " + f.format() for f in self.findings)
+        return (head + f"{len(self.findings)} finding(s)\n" + body)

@@ -1,0 +1,65 @@
+"""Rule registry for the static analyzer.
+
+Reference analog: the reference's pass registry (paddle/pir/pass registry +
+REGISTER_OP_CHECK hooks) — passes self-register under a stable id so drivers
+iterate "all registered checks" without a hand-maintained list. A rule here
+is a pure function ProgramInfo -> Iterable[Finding]; registration order is
+import order of paddle_tpu.analysis.rules.*.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterable, List, Optional
+
+from .findings import Severity
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str                 # stable kebab-case id, e.g. "collective-axis"
+    title: str
+    severity: Severity      # default/most-severe level this rule emits
+    doc: str
+    check: Callable         # ProgramInfo -> Iterable[Finding]
+    heuristic: bool = False  # True = may mis-fire; documented in ROADMAP
+
+
+_RULES: Dict[str, Rule] = {}
+
+
+def register_rule(id: str, title: str, severity: Severity, doc: str = "",
+                  heuristic: bool = False):
+    """Decorator: register `fn(program) -> Iterable[Finding]` as a rule."""
+
+    def deco(fn):
+        if id in _RULES:
+            raise ValueError(f"duplicate rule id {id!r}")
+        _RULES[id] = Rule(id=id, title=title, severity=severity,
+                          doc=doc or (fn.__doc__ or "").strip(),
+                          check=fn, heuristic=heuristic)
+        return fn
+
+    return deco
+
+
+def all_rules() -> List[Rule]:
+    from . import rules as _rules  # noqa: F401  (registers on first import)
+
+    return list(_RULES.values())
+
+
+def get_rule(id: str) -> Rule:
+    all_rules()
+    return _RULES[id]
+
+
+def resolve_rules(ids: Optional[Iterable[str]] = None) -> List[Rule]:
+    rules = all_rules()
+    if ids is None:
+        return rules
+    ids = set(ids)
+    unknown = ids - {r.id for r in rules}
+    if unknown:
+        raise KeyError(f"unknown rule id(s) {sorted(unknown)}; "
+                       f"known: {sorted(r.id for r in rules)}")
+    return [r for r in rules if r.id in ids]

@@ -1,0 +1,14 @@
+"""Rule modules self-register on import (see ../registry.py).
+
+Import order here fixes the display order of `all_rules()` — keep it in
+rule-id order so the README table and `python -m paddle_tpu.analysis
+--list-rules` stay aligned.
+"""
+from . import collectives  # noqa: F401
+from . import dtypes  # noqa: F401
+from . import recompile  # noqa: F401
+from . import donation  # noqa: F401
+from . import deadcode  # noqa: F401
+from . import syncpoints  # noqa: F401
+from . import pallas_tiling  # noqa: F401
+from . import prefetch  # noqa: F401

@@ -1,0 +1,103 @@
+"""collective-axis: collectives vs. the active mesh.
+
+Reference analog: process-group sanity checks in the reference's collective
+passes (a ProcessGroup over ranks outside the world raises at build time).
+T3 (arXiv:2401.16677, PAPERS.md) measures collective/compute mismatch as a
+dominant silent tax — a psum over the wrong axis is either a trace-time
+NameError (best case) or a size-1 no-op that silently drops the gradient
+sync (worst case: every replica trains on its own shard and diverges).
+"""
+from __future__ import annotations
+
+from ..analyzer import ProgramInfo, eqn_source, iter_eqns
+from ..findings import Finding, Severity
+from ..registry import register_rule
+
+# primitive name -> params key(s) that may carry axis names
+_COLLECTIVES = {
+    "psum": ("axes",),
+    "pmax": ("axes",),
+    "pmin": ("axes",),
+    "pbroadcast": ("axes",),
+    "ppermute": ("axis_name",),
+    "pgather": ("axes", "axis_name"),
+    "all_gather": ("axis_name",),
+    "all_to_all": ("axis_name",),
+    "reduce_scatter": ("axis_name",),
+    "axis_index": ("axis_name",),
+    "psum_scatter": ("axes", "axis_name"),
+}
+
+
+def _axis_names(eqn):
+    names = []
+    for key in _COLLECTIVES.get(eqn.primitive.name, ()):
+        v = eqn.params.get(key)
+        if v is None:
+            continue
+        for ax in (v if isinstance(v, (tuple, list)) else (v,)):
+            if isinstance(ax, str):
+                names.append(ax)
+    return names
+
+
+def _param_meshes(eqn):
+    """Meshes bound by the eqn itself (shard_map carries its mesh)."""
+    out = []
+    for v in eqn.params.values():
+        if hasattr(v, "axis_names") and hasattr(v, "shape"):
+            out.append(v)
+    return out
+
+
+@register_rule(
+    "collective-axis", "Collective over a nonexistent or size-1 mesh axis",
+    Severity.ERROR,
+    doc="psum/all_gather/ppermute/... must name an axis of the active mesh "
+        "(or an enclosing shard_map). A missing axis raises at trace time; "
+        "a size-1 axis makes the collective a silent no-op.")
+def check(program: ProgramInfo):
+    # axes the trace had to invent (see analyzer.trace_program): the program
+    # references them but nothing binds them
+    for ax in program.unbound_axes:
+        known = sorted(set(program.axis_env) - set(program.unbound_axes))
+        yield Finding(
+            rule="collective-axis", severity=Severity.ERROR,
+            message=f"collective references axis {ax!r} which no mesh or "
+                    f"shard_map binds (bound axes: {known or 'none'})",
+            fix_hint="pass the mesh that defines the axis (distributed."
+                     "set_mesh / TrainStep(mesh=...)) or fix the axis name")
+    unbound = set(program.unbound_axes)
+
+    allowed = set(program.axis_env)
+    for idx, eqn in iter_eqns(program.closed_jaxpr):
+        for m in _param_meshes(eqn):
+            allowed.update(str(a) for a in m.axis_names)
+    for idx, eqn in iter_eqns(program.closed_jaxpr):
+        local = set()
+        for m in _param_meshes(eqn):
+            local.update(str(a) for a in m.axis_names)
+        for ax in _axis_names(eqn):
+            if ax in unbound:
+                continue  # already an ERROR above
+            if ax not in allowed and ax not in local:
+                yield Finding(
+                    rule="collective-axis", severity=Severity.ERROR,
+                    message=f"{eqn.primitive.name} over axis {ax!r} not in "
+                            f"the active mesh axes {sorted(allowed)}",
+                    primitive=eqn.primitive.name, eqn_index=idx,
+                    source=eqn_source(eqn),
+                    fix_hint="use a mesh axis name, or rebuild the mesh "
+                             "with this axis (distributed.build_mesh)")
+                continue
+            size = program.axis_size(ax)
+            if size == 1:
+                yield Finding(
+                    rule="collective-axis", severity=Severity.WARNING,
+                    message=f"{eqn.primitive.name} over axis {ax!r} of size "
+                            "1 — a no-op collective (wrong mesh shape, or "
+                            "dead code on single-device runs?)",
+                    primitive=eqn.primitive.name, eqn_index=idx,
+                    source=eqn_source(eqn),
+                    fix_hint="size the mesh axis >1 or drop the collective "
+                             "on single-device configs")

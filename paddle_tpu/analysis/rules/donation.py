@@ -1,0 +1,65 @@
+"""donation: donated buffers that cannot or should not be donated.
+
+Reference analog: the reference's allocator reuses op output buffers by
+liveness analysis over the ProgramDesc; donation is our XLA equivalent
+(jit/trainer.py donates params/buffers/opt-state). Two statically-visible
+misuses: a donated input the program never consumes (its HBM is freed while
+the CALLER may still hold the array — any later read is use-after-donation),
+and a donated input with no shape/dtype-matching output (XLA cannot alias
+it, silently copies, and the donation buys nothing while still invalidating
+the caller's reference).
+"""
+from __future__ import annotations
+
+from collections import Counter
+
+from ..analyzer import ProgramInfo, aval_of, iter_eqns
+from ..findings import Finding, Severity
+from ..registry import register_rule
+
+
+def _sig(v):
+    a = aval_of(v)
+    return (tuple(getattr(a, "shape", ())), str(getattr(a, "dtype", "")))
+
+
+@register_rule(
+    "donation", "Donated-buffer misuse",
+    Severity.WARNING,
+    doc="Donated inputs must be consumed by the program and have a "
+        "shape/dtype-matching output for XLA to alias; identity "
+        "passthrough (input returned unchanged) is fine and not flagged.")
+def check(program: ProgramInfo):
+    if not program.donated_invars:
+        return
+    used = set()
+    for _, eqn in iter_eqns(program.closed_jaxpr):
+        used.update(id(v) for v in eqn.invars)
+    outvars = program.jaxpr.outvars
+    used.update(id(v) for v in outvars)
+
+    # multiset of output signatures available for aliasing
+    avail = Counter(_sig(v) for v in outvars)
+    for v in program.donated_invars:
+        if id(v) not in used:
+            yield Finding(
+                rule="donation", severity=Severity.WARNING,
+                message=f"donated buffer {_sig(v)[1]}{list(_sig(v)[0])} is "
+                        "never used by the program — its memory is "
+                        "freed/reused while the caller may still hold the "
+                        "array (use-after-donation on TPU/GPU)",
+                fix_hint="drop it from donate_argnums, or actually "
+                         "consume it in the step")
+            continue
+        sig = _sig(v)
+        if avail[sig] > 0:
+            avail[sig] -= 1
+        else:
+            yield Finding(
+                rule="donation", severity=Severity.WARNING,
+                message=f"donated buffer {sig[1]}{list(sig[0])} has no "
+                        "shape/dtype-matching output left to alias — XLA "
+                        "copies (donation wasted) and still invalidates "
+                        "the caller's array",
+                fix_hint="return an updated value for every donated "
+                         "buffer, or stop donating this one")

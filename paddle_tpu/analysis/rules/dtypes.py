@@ -1,0 +1,102 @@
+"""dtype-promotion: silent f64 upcasts and low-precision accumulation.
+
+Reference analog: the reference's AMP op lists + check_finite pass decide
+per-op dtypes at program build time; nothing in our XLA path stops a numpy
+float64 scalar from upcasting a whole activation tree (2x memory, and f64 is
+EMULATED on TPU — ~100x slower), or a bf16 reduce from accumulating in bf16
+(loss of ~8 mantissa bits across a long sum).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..analyzer import ProgramInfo, aval_of, eqn_source, iter_eqns
+from ..findings import Finding, Severity
+from ..registry import register_rule
+
+_WIDE = ("float64", "complex128")
+_LOW = ("bfloat16", "float16")
+# reductions whose output dtype == accumulate dtype
+_ACCUM_PRIMS = ("reduce_sum", "cumsum", "reduce_window_sum", "dot_general")
+_MAX_REPORTS = 8  # one bad const can fan out to hundreds of f64 eqns
+
+
+def _dt(v):
+    a = aval_of(v)
+    d = getattr(a, "dtype", None)
+    return str(d) if d is not None else ""
+
+
+@register_rule(
+    "dtype-promotion", "f64 upcast / low-precision accumulation",
+    Severity.WARNING, heuristic=True,
+    doc="Flags equations that INTRODUCE float64/complex128 (emulated on "
+        "TPU), f64 program inputs/consts, host-side float64 arrays fed to "
+        "the program, and bf16/f16 reductions that accumulate in the input "
+        "precision.")
+def check(program: ProgramInfo):
+    n = 0
+    # f64 reaching the program from outside
+    for v in program.jaxpr.invars:
+        if _dt(v) in _WIDE and n < _MAX_REPORTS:
+            n += 1
+            yield Finding(
+                rule="dtype-promotion", severity=Severity.WARNING,
+                message=f"program input is {_dt(v)} "
+                        f"(shape {tuple(getattr(aval_of(v), 'shape', ()))})",
+                fix_hint="cast at the boundary: jnp.asarray(x, jnp.float32) "
+                         "— f64 is emulated on TPU and doubles HBM traffic")
+    for c in program.closed_jaxpr.consts:
+        if str(getattr(c, "dtype", "")) in _WIDE and n < _MAX_REPORTS:
+            n += 1
+            yield Finding(
+                rule="dtype-promotion", severity=Severity.WARNING,
+                message=f"captured constant is {c.dtype} "
+                        f"(shape {tuple(getattr(c, 'shape', ()))})",
+                fix_hint="build the constant with an explicit f32/i32 dtype "
+                         "(np.arange/np.asarray default to float64)")
+    # host-side f64 arrays in the example args (with x64 off these are
+    # silently downcast at trace — a different surprise, same root cause)
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves((program.args, program.kwargs)):
+        if isinstance(leaf, np.ndarray) and str(leaf.dtype) in _WIDE \
+                and n < _MAX_REPORTS:
+            n += 1
+            yield Finding(
+                rule="dtype-promotion", severity=Severity.WARNING,
+                message=f"host numpy array argument is {leaf.dtype} (shape "
+                        f"{leaf.shape}) — silently cast to f32 at trace "
+                        "time (or upcast everything if x64 is on)",
+                fix_hint="convert once at the data boundary: "
+                         ".astype(np.float32)")
+
+    for idx, eqn in iter_eqns(program.closed_jaxpr):
+        in_dts = [_dt(v) for v in eqn.invars]
+        out_dts = [_dt(v) for v in eqn.outvars]
+        if n < _MAX_REPORTS and any(d in _WIDE for d in out_dts) \
+                and not any(d in _WIDE for d in in_dts):
+            n += 1
+            yield Finding(
+                rule="dtype-promotion", severity=Severity.WARNING,
+                message=f"{eqn.primitive.name} introduces "
+                        f"{[d for d in out_dts if d in _WIDE][0]} from "
+                        f"{sorted(set(d for d in in_dts if d))}",
+                primitive=eqn.primitive.name, eqn_index=idx,
+                source=eqn_source(eqn),
+                fix_hint="pass an explicit dtype (python floats + x64, "
+                         "np.float64 scalars, and jnp.float64 casts are the "
+                         "usual culprits)")
+        if eqn.primitive.name in _ACCUM_PRIMS:
+            fin = [d for d in in_dts if d in _LOW]
+            if fin and out_dts and out_dts[0] in _LOW:
+                yield Finding(
+                    rule="dtype-promotion", severity=Severity.WARNING,
+                    message=f"{eqn.primitive.name} accumulates in "
+                            f"{out_dts[0]} — long sums lose ~8 mantissa "
+                            "bits vs an f32 accumulator",
+                    primitive=eqn.primitive.name, eqn_index=idx,
+                    source=eqn_source(eqn),
+                    fix_hint="accumulate in f32: preferred_element_type="
+                             "jnp.float32 (dot_general) or .astype("
+                             "jnp.float32) before the reduce")

@@ -1,0 +1,66 @@
+"""prefetch-effects: ordered side effects under double-buffered input.
+
+Reference analog: buffered_reader.cc assumes the compute op is pure — the
+reference forbids side-effectful ops between reader and executor. Our
+DevicePrefetcher (io/prefetch.py) runs batch N+1's host->device transfer and
+the producer iterator CONCURRENTLY with step N's compute; any ordered
+effect inside the step (debug prints, io_callback writes) therefore
+interleaves arbitrarily with batch production — logs no longer reflect step
+order, and an io_callback that touches the same files as the data loader
+races it. Effects also force XLA to serialize around them, defeating the
+overlap the prefetcher exists to create.
+"""
+from __future__ import annotations
+
+from ..analyzer import ProgramInfo, eqn_source, iter_eqns
+from ..findings import Finding, Severity
+from ..registry import register_rule
+
+# tracing artifacts, not host-visible side effects: NamedAxisEffect marks
+# collectives bound to a mesh axis; neither orders anything on the host
+_BENIGN_EFFECTS = {"NamedAxisEffect", "RefEffect"}
+
+
+def _real_effects(effs):
+    return [e for e in (effs or ())
+            if type(e).__name__ not in _BENIGN_EFFECTS]
+
+
+@register_rule(
+    "prefetch-effects", "Side effects inside a step that runs under "
+    "double-buffered prefetch",
+    Severity.WARNING,
+    doc="Flags equations carrying jax effects (ordered/debug/io) in a "
+        "program that will run with DevicePrefetcher overlap — effect "
+        "order is NOT step order once batches are produced ahead.")
+def check(program: ProgramInfo):
+    if not _real_effects(getattr(program.closed_jaxpr, "effects", None)):
+        return
+    prefetch_on = program.context.get("prefetch_active")
+    if prefetch_on is None:  # not told -> read the flag (best effort)
+        try:
+            from ...core.flags import get_flag
+
+            import paddle_tpu.io.prefetch  # noqa: F401  defines the flag
+            prefetch_on = bool(get_flag("io_device_prefetch"))
+        except Exception:
+            prefetch_on = False
+    qualifier = ("runs under double-buffered prefetch"
+                 if prefetch_on else
+                 "would interleave with prefetch if "
+                 "FLAGS_io_device_prefetch is enabled")
+    for idx, eqn in iter_eqns(program.closed_jaxpr):
+        effs = _real_effects(getattr(eqn, "effects", None))
+        if not effs:
+            continue
+        names = sorted({type(e).__name__ for e in effs})
+        yield Finding(
+            rule="prefetch-effects", severity=Severity.WARNING,
+            message=f"{eqn.primitive.name} carries ordered effect(s) "
+                    f"{names} and this step {qualifier} — host-visible "
+                    "order will not match step order, and XLA serializes "
+                    "around the effect",
+            primitive=eqn.primitive.name, eqn_index=idx,
+            source=eqn_source(eqn),
+            fix_hint="keep the step pure: hoist the effect out of the "
+                     "compiled program (log from returned values instead)")

@@ -1,0 +1,56 @@
+"""recompile-hazard: things that defeat the jit/AOT caches.
+
+Reference analog: the reference caches one Program per (shape, dtype)
+signature; our jit path caches per jax signature AND the AOT fast-dispatch
+path (jit/compile_cache.py FLAGS_jit_fast_dispatch) keys its single compiled
+executable on TrainStep._arg_signature — (treedef, (shape, dtype) per leaf).
+Weak-typed python scalars have no stable dtype in that signature (they show
+up as 'float'/'int'), and their promotion rules differ from concrete arrays,
+so the same step can produce different output dtypes depending on who calls
+it. Non-hashable statics are worse: jax.jit raises outright.
+"""
+from __future__ import annotations
+
+from ..analyzer import ProgramInfo, aval_of
+from ..findings import Finding, Severity
+from ..registry import register_rule
+
+
+def _is_hashable(v) -> bool:
+    try:
+        hash(v)
+        return True
+    except TypeError:
+        return False
+
+
+@register_rule(
+    "recompile-hazard", "Weak-typed scalars / non-hashable statics",
+    Severity.ERROR,
+    doc="Weak-typed python-scalar inputs (promotion changes result dtypes "
+        "and the AOT fast-dispatch signature can't pin them) -> WARNING; "
+        "non-hashable static arguments (jax.jit raises, every call is a "
+        "cache miss at best) -> ERROR.")
+def check(program: ProgramInfo):
+    for v in program.jaxpr.invars:
+        a = aval_of(v)
+        if getattr(a, "weak_type", False):
+            yield Finding(
+                rule="recompile-hazard", severity=Severity.WARNING,
+                message="weak-typed scalar input (a python int/float "
+                        "reached the traced function) — promotion differs "
+                        "from concrete arrays and the AOT fast-dispatch "
+                        "signature (jit/compile_cache.py) records it as a "
+                        "shapeless leaf",
+                fix_hint="wrap at the call site: jnp.asarray(x, "
+                         "jnp.float32) / jnp.asarray(i, jnp.int32)")
+    for name, val in program.static_args.items():
+        if not _is_hashable(val):
+            yield Finding(
+                rule="recompile-hazard", severity=Severity.ERROR,
+                message=f"static argument {name!r} is non-hashable "
+                        f"({type(val).__name__}) — jax.jit static_argnums "
+                        "raises on it, and any dict-keyed compile cache "
+                        "misses every call",
+                fix_hint="freeze it (tuple / frozenset / dataclass("
+                         "frozen=True)) or pass it as a traced array")

@@ -11,6 +11,7 @@ the dual-mode property the reference engineers via shared phi kernels.
 """
 from __future__ import annotations
 
+import warnings
 from typing import Callable, List, Optional, Sequence
 
 import jax
@@ -19,9 +20,17 @@ import numpy as np
 
 from ..core import autograd as _ag
 from ..core import random as _random
+from ..core.flags import define_flag, get_flag
 from ..core.tensor import Tensor
 from ..nn.clip import ClipGradByGlobalNorm
 from ..nn.layer import Layer
+
+define_flag(
+    "jit_lint", "off",
+    "Static-analysis gate for compiled train steps (analysis/): 'off', "
+    "'warn' (lint on first call, emit findings as warnings), or 'raise' "
+    "(additionally fail fast on ERROR-severity findings). Trace-only — "
+    "adds one make_jaxpr trace before the first compile, nothing per-step.")
 
 
 def _tensor_leaves(x):
@@ -193,7 +202,11 @@ class TrainStep:
                 for b, (v,) in zip(self.buffers, saved_buf):
                     b._value = v
 
+        self._step_fn = step  # analysis.lint_train_step traces this
+        self._donate = bool(donate)
+        self._linted = False
         donate_argnums = (0, 1, 2) if donate else ()
+        self._dp_size = None
         if dp_axis is not None:
             from jax.sharding import PartitionSpec as _P
 
@@ -201,10 +214,20 @@ class TrainStep:
             from ..distributed.mesh import get_mesh as _get_mesh
 
             dp_mesh = mesh if mesh is not None else _get_mesh()
-            if dp_mesh is None or dp_axis not in dp_mesh.axis_names:
+            # same check the collective-axis lint does, enforced at runtime:
+            # a missing axis must not surface as a bare KeyError/NameError
+            # from deep inside shard_map
+            if dp_mesh is None:
                 raise ValueError(
-                    f"dp_axis={dp_axis!r} needs a mesh with that axis "
-                    "(pass mesh= or distributed.set_mesh first)")
+                    f"dp_axis={dp_axis!r} needs an active mesh but none is "
+                    "set — pass mesh= or call distributed.set_mesh(...) "
+                    "(distributed.build_mesh(dp=N) makes one)")
+            if dp_axis not in dp_mesh.axis_names:
+                sizes = dict(dp_mesh.shape)
+                raise ValueError(
+                    f"dp_axis={dp_axis!r} is not an axis of the active "
+                    f"mesh — available axes and sizes: {sizes}")
+            self._dp_size = int(dict(dp_mesh.shape)[dp_axis])
             if self._grad_shardings is not None or \
                     self._param_shardings is not None:
                 raise ValueError(
@@ -214,6 +237,7 @@ class TrainStep:
                 raise ValueError(
                     "dp_axis= replaces in_shardings/out_shardings: the "
                     "shard_map specs define the placement")
+            self._mesh = dp_mesh  # resolved mesh, for lint + introspection
             # state replicated over dp, batch split on its leading dim;
             # outputs replicated (grads/loss are pmean'ed inside)
             smapped = _shard_map(
@@ -254,12 +278,45 @@ class TrainStep:
             self._aot_sig = sig
         return self._aot(*args)
 
+    def _check_dp_batch(self, batch_vals):
+        """Fail with a readable error before shard_map pads or crashes."""
+        for leaf in jax.tree_util.tree_leaves(batch_vals):
+            shape = tuple(getattr(leaf, "shape", ()))
+            if shape and shape[0] % self._dp_size != 0:
+                raise ValueError(
+                    f"dp_axis={self._dp_axis!r} (size {self._dp_size}) "
+                    f"cannot split a batch leaf of shape {shape}: leading "
+                    f"dim {shape[0]} is not divisible by {self._dp_size}")
+
+    def _maybe_lint(self, batch):
+        """FLAGS_jit_lint: lint-on-first-trace (analysis/), warn or raise."""
+        mode = str(get_flag("jit_lint")).lower()
+        if mode in ("", "0", "off", "false", "no"):
+            return
+        from .. import analysis
+
+        try:
+            report = analysis.lint_train_step(self, batch)
+        except Exception as e:  # lint must never take down training
+            warnings.warn(f"FLAGS_jit_lint: lint trace skipped "
+                          f"({type(e).__name__}: {e})")
+            return
+        if mode == "raise":
+            report.raise_if(analysis.Severity.ERROR)
+        for f in report.findings:
+            warnings.warn(f"[jit_lint] {f.format()}")
+
     def __call__(self, *batch):
         batch_vals = _tensor_leaves(batch)
         param_vals = [p._value for p in self.params]
         buffer_vals = [b._value for b in self.buffers]
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         seed = jnp.asarray(self._step_i, jnp.int32)
+        if not self._linted:
+            self._linted = True
+            if self._dp_size is not None:
+                self._check_dp_batch(batch_vals)
+            self._maybe_lint(batch)
         self._step_i += 1
         out = self._dispatch(
             param_vals, buffer_vals, self.opt_state, lr, seed, batch_vals

@@ -156,8 +156,6 @@ def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-10
 
 
 def sequence_mask(lengths, maxlen=None, dtype="int64"):
-    import jax.numpy as jnp
-
     if maxlen is None:
         maxlen = int(lengths.max().item())
     rng = _api.arange(0, maxlen, 1, dtype="int64")

@@ -114,7 +114,7 @@ def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
 
     rowv = _np.asarray(row)
     cp = _np.asarray(colptr)
-    wv = _np.asarray(edge_weight, _np.float64)
+    wv = _np.asarray(edge_weight, _np.float32)
     seeds = _np.asarray(jax.random.randint(
         next_key(), (len(_np.asarray(input_nodes)),), 0, 2 ** 31 - 1))
     out_nb, out_cnt, out_eid = [], [], []

@@ -252,6 +252,15 @@ def run_child(preset: str) -> int:
         "autotune": bool(_flags.get_flag("use_autotune")),
         "final_loss": round(float(loss.item()), 4),
     }
+    # runtime-emitted telemetry (observability/): with FLAGS_metrics=on the
+    # TrainStep itself recorded per-step loss/gnorm/phase times — attach its
+    # aggregate so the bench artifact carries the runtime's own accounting
+    from paddle_tpu.observability import telemetry as _obs_telemetry
+
+    if _obs_telemetry.enabled():
+        tele = _obs_telemetry.get_telemetry()
+        tele.finalize()
+        result["telemetry"] = tele.summary()
     if on_accel:
         # persist chip evidence the moment it exists — a commit message or a
         # lost stdout pipe is not evidence (VERDICT r03 weak #1)

@@ -18,11 +18,13 @@ import tempfile
 import threading
 import time
 from collections import OrderedDict
+from collections.abc import MutableMapping
 from typing import Callable, Dict, Iterable, List, Optional
 
 import jax
 
 from . import flags
+from ..observability.registry import counter as _obs_counter
 
 flags.define_flag("use_autotune", False,
                   "Time candidate kernel configs on first use and cache the winner.")
@@ -40,8 +42,48 @@ _LOCK = threading.Lock()
 # persistent layer: key-string -> winner config, lazily loaded per cache dir
 _DISK: Optional[Dict[str, dict]] = None
 _DISK_DIR: Optional[str] = None
-_STATS = {"hits": 0, "misses": 0, "disk_hits": 0, "tunes": 0,
-          "disk_errors": 0}
+# Stats live in the unified metrics registry (observability/) as the labeled
+# counter autotune_cache_events_total{event=...}; _STATS keeps the historical
+# mutable-dict contract (`_STATS["hits"] += 1`, iteration, cache_info()
+# spreading) as a thin view over it. always=True: these counters predate the
+# observability layer and must keep counting with FLAGS_metrics off.
+_EVENTS = _obs_counter(
+    "autotune_cache_events_total",
+    "Autotune decision-cache events: hits, misses, disk_hits, tunes, "
+    "disk_errors, evictions.",
+    labelnames=("event",), always=True)
+
+
+class _StatsView(MutableMapping):
+    """dict-shaped view over autotune_cache_events_total."""
+
+    _KEYS = ("hits", "misses", "disk_hits", "tunes", "disk_errors",
+             "evictions")
+
+    def __getitem__(self, k):
+        if k not in self._KEYS:
+            raise KeyError(k)
+        return int(_EVENTS.value(event=k))
+
+    def __setitem__(self, k, v):
+        if k not in self._KEYS:
+            raise KeyError(k)
+        _EVENTS._set_raw(float(v), (str(k),))
+
+    def __delitem__(self, k):
+        raise TypeError("autotune stats keys are fixed")
+
+    def __iter__(self):
+        return iter(self._KEYS)
+
+    def __len__(self):
+        return len(self._KEYS)
+
+    def __repr__(self):
+        return f"_StatsView({dict(self.items())})"
+
+
+_STATS = _StatsView()
 
 _CACHE_FILE = "autotune_cache.json"
 
@@ -60,6 +102,14 @@ def cache_info():
     with _LOCK:
         return {"entries": len(_CACHE), "keys": list(_CACHE),
                 **{k: v for k, v in _STATS.items()}}
+
+
+def stats_snapshot():
+    """cache_info() without the per-entry key list — the form telemetry
+    embeds in every step record, so it must stay O(1) in cache size."""
+    with _LOCK:
+        entries = len(_CACHE)
+    return {"entries": entries, **{k: _STATS[k] for k in _StatsView._KEYS}}
 
 
 def _cache_path(cache_dir: str) -> str:
@@ -194,6 +244,7 @@ def autotune(candidates: Iterable[dict], key_extra: Callable = None):
                 limit = flags.get_flag("autotune_cache_size")
                 while limit > 0 and len(_CACHE) > limit:
                     _CACHE.popitem(last=False)
+                    _STATS["evictions"] += 1
                 if cache_dir:
                     _disk_store(cache_dir, key_str, best)
             return fn(*args, **kwargs, **best)

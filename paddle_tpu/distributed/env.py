@@ -73,12 +73,21 @@ def init_parallel_env(strategy=None):
     global _initialized
     if _initialized:
         return ParallelEnv()
+    from ..observability.registry import counter as _obs_counter
+    from ..observability.spans import span as _span
+
     coord = os.environ.get("PADDLE_MASTER") or os.environ.get("COORDINATOR_ADDRESS")
     nproc = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
     pid = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
-    if coord and nproc > 1:
-        jax.distributed.initialize(
-            coordinator_address=coord, num_processes=nproc, process_id=pid
-        )
+    # the DCN rendezvous is the single biggest cold-start unknown in a
+    # multi-host job — make its duration a first-class span
+    with _span("dist.init_parallel_env", cat="dist",
+               args={"nproc": nproc, "rank": pid}):
+        if coord and nproc > 1:
+            jax.distributed.initialize(
+                coordinator_address=coord, num_processes=nproc, process_id=pid
+            )
+    _obs_counter("distributed_init_total",
+                 "init_parallel_env completions.").inc()
     _initialized = True
     return ParallelEnv()

@@ -29,6 +29,19 @@ import numpy as np
 from jax import lax
 
 from ..core.flags import define_flag, get_flag
+from ..observability.registry import counter as _obs_counter
+from ..observability.registry import gauge as _obs_gauge
+from ..observability.spans import span as _span
+
+# trace-time observability: bucket_reduce runs while XLA traces the step, so
+# these record how the reduction was SCHEDULED (bucket count/shape), and the
+# spans make bucket construction visible on the unified timeline
+_FLUSHES = _obs_counter(
+    "grad_bucket_flushes_total",
+    "Gradient all-reduce buckets emitted at trace time.")
+_BUCKETS = _obs_gauge(
+    "grad_bucket_count",
+    "Bucket count of the most recently traced bucketed all-reduce.")
 
 define_flag(
     "grad_bucket_mb", 4,
@@ -87,17 +100,22 @@ def bucket_reduce(g_vals, axis_name: str, bucket_bytes: int = None,
     reduce_ = lax.pmean if mean else lax.psum
     shapes = [tuple(g.shape) for g in g_vals]
     out = [None] * len(g_vals)
-    for idxs in partition_buckets(shapes, [g.dtype for g in g_vals],
-                                  bucket_bytes):
-        if len(idxs) == 1:
-            i = idxs[0]
-            out[i] = reduce_(g_vals[i], axis_name)
-            continue
-        flat = jnp.concatenate([g_vals[i].ravel() for i in idxs])
-        red = reduce_(flat, axis_name)
-        off = 0
-        for i in idxs:
-            n = int(np.prod(shapes[i], dtype=np.int64) or 1)
-            out[i] = red[off:off + n].reshape(shapes[i])
-            off += n
+    buckets = partition_buckets(shapes, [g.dtype for g in g_vals],
+                                bucket_bytes)
+    _BUCKETS.set(len(buckets))
+    for idxs in buckets:
+        with _span("dist.bucket_flush", cat="dist",
+                   args={"tensors": len(idxs)}):
+            _FLUSHES.inc()
+            if len(idxs) == 1:
+                i = idxs[0]
+                out[i] = reduce_(g_vals[i], axis_name)
+                continue
+            flat = jnp.concatenate([g_vals[i].ravel() for i in idxs])
+            red = reduce_(flat, axis_name)
+            off = 0
+            for i in idxs:
+                n = int(np.prod(shapes[i], dtype=np.int64) or 1)
+                out[i] = red[off:off + n].reshape(shapes[i])
+                off += n
     return out

@@ -33,6 +33,8 @@ import jax
 
 from ..core.flags import define_flag, get_flag
 from ..core.tensor import Tensor
+from ..observability.registry import counter as _obs_counter
+from ..observability.spans import span as _span
 from ..profiler.timer import benchmark
 
 define_flag(
@@ -47,6 +49,17 @@ define_flag(
 )
 
 _DONE = object()
+
+# process-wide prefetch counters in the unified metrics registry (ISSUE r9):
+# always=True because DevicePrefetcher.stats — the legacy per-instance view —
+# must keep counting with FLAGS_metrics off (tests/test_perf_overlap.py)
+_BATCHES = _obs_counter(
+    "io_prefetch_batches_total",
+    "Batches yielded by DevicePrefetcher across all instances.", always=True)
+_WAIT_S = _obs_counter(
+    "io_prefetch_wait_seconds_total",
+    "Cumulative consumer-side wait (starvation) in DevicePrefetcher.__next__.",
+    always=True)
 
 
 class DevicePrefetcher:
@@ -64,10 +77,19 @@ class DevicePrefetcher:
         self._it = iter(iterable)
         self._q: "queue.Queue" = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
-        self.stats = {"batches": 0, "wait_s": 0.0}
+        self._batches = 0
+        self._wait_s = 0.0
         self._thread = threading.Thread(
             target=self._produce, name="device-prefetch", daemon=True)
         self._thread.start()
+
+    @property
+    def stats(self):
+        """Per-instance counters, MIGRATED (r9) onto the metrics registry:
+        now a computed snapshot — mutating the returned dict is a no-op (see
+        MIGRATION.md). The process-wide totals are the registry counters
+        io_prefetch_batches_total / io_prefetch_wait_seconds_total."""
+        return {"batches": self._batches, "wait_s": self._wait_s}
 
     # -- producer side -------------------------------------------------
     def _place(self, batch):
@@ -96,7 +118,9 @@ class DevicePrefetcher:
     def _produce(self):
         try:
             for batch in self._it:
-                if not self._put(("ok", self._place(batch))):
+                with _span("io.prefetch.place", cat="io"):
+                    placed = self._place(batch)
+                if not self._put(("ok", placed)):
                     return
         except BaseException as e:  # re-raised consumer-side, in order
             self._put(("err", e))
@@ -111,16 +135,20 @@ class DevicePrefetcher:
         if self._stop.is_set():
             raise StopIteration
         t0 = time.perf_counter()
-        kind, payload = self._q.get()
-        benchmark().record_reader(time.perf_counter() - t0)
-        self.stats["wait_s"] += time.perf_counter() - t0
+        with _span("io.prefetch.wait", cat="io"):
+            kind, payload = self._q.get()
+        waited = time.perf_counter() - t0
+        benchmark().record_reader(waited)
+        self._wait_s += waited
+        _WAIT_S.inc(waited)
         if kind == "err":
             self._stop.set()
             raise payload
         if payload is _DONE:
             self._stop.set()
             raise StopIteration
-        self.stats["batches"] += 1
+        self._batches += 1
+        _BATCHES.inc()
         return payload
 
     def close(self):

@@ -20,11 +20,12 @@ Two pieces:
 from __future__ import annotations
 
 import os
-from typing import Optional
+from typing import Dict, Optional
 
 import jax
 
 from ..core import flags
+from ..observability.registry import counter as _obs_counter
 
 flags.define_flag(
     "jit_compile_cache_dir", "",
@@ -90,3 +91,98 @@ def maybe_enable_from_flags() -> Optional[str]:
 
 def cache_dir() -> Optional[str]:
     return _enabled_dir
+
+
+# -- observability (ISSUE r9 satellite): compile-cache hit/miss/evict -------
+# counters, registered with the same registry autotune's stats live in.
+# `always=True`: these back the cache_info() contract, which must keep
+# counting with FLAGS_metrics off (same rule as autotune._STATS).
+_EVENTS = _obs_counter(
+    "jit_compile_cache_events_total",
+    "TrainStep compile events by outcome: hit = persistent cache served the "
+    "executable, miss = full XLA compile, evict = AOT executable replaced "
+    "on an input-signature change.",
+    labelnames=("event",), always=True)
+
+_HIT_TIME_S = 0.5  # compiles faster than this with a live cache dir = hit
+
+
+def _dir_entries(d: str) -> int:
+    try:
+        return len(os.listdir(d))
+    except OSError:
+        return -1
+
+
+def note_compile(seconds: float, entries_before: Optional[int] = None
+                 ) -> str:
+    """Record one TrainStep compile; classify persistent-cache hit vs miss.
+
+    With a persistent cache dir live, a MISS writes a new cache entry, so
+    entry-count growth (entries_before vs now) is authoritative; callers who
+    didn't probe beforehand fall back to the compile-time heuristic (cache
+    hits deserialize in well under _HIT_TIME_S). Without a cache dir every
+    compile is a miss by definition. Returns the classification.
+    """
+    event = "miss"
+    if _enabled_dir:
+        if entries_before is not None and entries_before >= 0:
+            after = _dir_entries(_enabled_dir)
+            if after >= 0 and after <= entries_before:
+                event = "hit"
+        elif 0.0 < float(seconds) < _HIT_TIME_S:
+            event = "hit"
+    _EVENTS.inc(event=event)
+    return event
+
+
+def note_evict() -> None:
+    """An AOT executable was dropped (input-signature change)."""
+    _EVENTS.inc(event="evict")
+
+
+def entries_probe() -> Optional[int]:
+    """Current persistent-cache entry count (None when cache disabled) —
+    pass to note_compile(entries_before=...) for exact hit/miss calls."""
+    if not _enabled_dir:
+        return None
+    return _dir_entries(_enabled_dir)
+
+
+def cache_info() -> Dict[str, object]:
+    """Snapshot mirroring autotune.cache_info()'s shape: counters + dir."""
+    return {
+        "dir": _enabled_dir,
+        "hits": int(_EVENTS.value(event="hit")),
+        "misses": int(_EVENTS.value(event="miss")),
+        "evictions": int(_EVENTS.value(event="evict")),
+    }
+
+
+class _StatsView:
+    """Dict-like legacy view over the registry counters (read-only keys
+    hits/misses/evictions), so code expecting a stats mapping keeps working."""
+
+    _KEYS = ("hits", "misses", "evictions")
+
+    def __getitem__(self, k: str) -> int:
+        info = cache_info()
+        if k not in self._KEYS:
+            raise KeyError(k)
+        return int(info[k])
+
+    def __iter__(self):
+        return iter(self._KEYS)
+
+    def __len__(self):
+        return len(self._KEYS)
+
+    def items(self):
+        info = cache_info()
+        return [(k, int(info[k])) for k in self._KEYS]
+
+    def __repr__(self):
+        return f"_StatsView({dict(self.items())})"
+
+
+_STATS = _StatsView()

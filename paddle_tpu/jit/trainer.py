@@ -11,6 +11,7 @@ the dual-mode property the reference engineers via shared phi kernels.
 """
 from __future__ import annotations
 
+import time
 import warnings
 from typing import Callable, List, Optional, Sequence
 
@@ -24,6 +25,9 @@ from ..core.flags import define_flag, get_flag
 from ..core.tensor import Tensor
 from ..nn.clip import ClipGradByGlobalNorm
 from ..nn.layer import Layer
+from ..observability import flight_recorder as _flight
+from ..observability import telemetry as _telemetry
+from ..observability.spans import span as _span
 
 define_flag(
     "jit_lint", "off",
@@ -61,10 +65,18 @@ class TrainStep:
         nan_guard: bool = False,
         dp_axis: Optional[str] = None,
         grad_bucket_mb: Optional[int] = None,
+        telemetry: Optional[bool] = None,
     ):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
+        # Per-step telemetry (observability/): when on, the compiled program
+        # additionally returns the pre-clip gradient global-norm and __call__
+        # emits one step record (loss/gnorm/lr/throughput/phases) through
+        # observability.telemetry. Resolved at CONSTRUCTION time because it
+        # changes the program's output arity; None follows FLAGS_metrics.
+        self._telemetry = (_telemetry.enabled() if telemetry is None
+                          else bool(telemetry))
         # NaN/Inf step-guard (resilience subsystem): the finite-check and the
         # where-select between updated and prior state compile INTO this one
         # program, so donation and the single-dispatch property are preserved
@@ -155,6 +167,16 @@ class TrainStep:
                         jax.lax.with_sharding_constraint(g, sh)
                         for g, sh in zip(g_vals, self._grad_shardings)
                     ]
+                gsq = None
+                if self._nan_guard or self._telemetry:
+                    # PRE-clip gradient global-norm square-sum: the standard
+                    # logged quantity, shared by the step-guard (NaN/Inf is
+                    # not repaired by clipping, so checking it pre-clip is
+                    # equivalent) and the telemetry gnorm output
+                    gsq = jnp.zeros((), jnp.float32)
+                    for g in g_vals:
+                        gsq = gsq + jnp.sum(jnp.square(
+                            g.astype(jnp.float32)))
                 clip = optimizer._grad_clip
                 if isinstance(clip, ClipGradByGlobalNorm):
                     import inspect as _inspect
@@ -177,24 +199,22 @@ class TrainStep:
                         for v, sh in zip(new_p, self._param_shardings)
                     ]
                 new_buffer_vals = [b._value for b in self.buffers]  # BN stats updated in-place
-                if not self._nan_guard:
-                    return loss_val, new_p, new_buffer_vals, new_s
-                # global-grad-norm finite check; overflow of the square-sum
-                # to inf is itself a (correct) skip signal
-                gsq = jnp.zeros((), jnp.float32)
-                for g in g_vals:
-                    gsq = gsq + jnp.sum(jnp.square(g.astype(jnp.float32)))
-                ok = jnp.isfinite(gsq) & jnp.isfinite(
-                    loss_val.astype(jnp.float32))
-                new_p = [jnp.where(ok, n, o)
-                         for n, o in zip(new_p, param_vals)]
-                new_buffer_vals = [jnp.where(ok, n, o)
-                                   for n, o in zip(new_buffer_vals,
-                                                   buffer_vals)]
-                new_s = jax.tree_util.tree_map(
-                    lambda n, o: jnp.where(ok, n, o), new_s, opt_state)
-                skipped = (~ok).astype(jnp.int32)
-                return loss_val, new_p, new_buffer_vals, new_s, skipped
+                out = [loss_val, new_p, new_buffer_vals, new_s]
+                if self._nan_guard:
+                    # finite check; overflow of the square-sum to inf is
+                    # itself a (correct) skip signal
+                    ok = jnp.isfinite(gsq) & jnp.isfinite(
+                        loss_val.astype(jnp.float32))
+                    out[1] = [jnp.where(ok, n, o)
+                              for n, o in zip(new_p, param_vals)]
+                    out[2] = [jnp.where(ok, n, o)
+                              for n, o in zip(new_buffer_vals, buffer_vals)]
+                    out[3] = jax.tree_util.tree_map(
+                        lambda n, o: jnp.where(ok, n, o), new_s, opt_state)
+                    out.append((~ok).astype(jnp.int32))
+                if self._telemetry:
+                    out.append(jnp.sqrt(gsq))
+                return tuple(out)
             finally:
                 _random.default_generator.pop_trace_seed(prev_seed)
                 for p, (v, gn, g, sg) in zip(self.params, saved):
@@ -257,6 +277,8 @@ class TrainStep:
         # executable for the (single) input signature, built lazily
         self._aot = None
         self._aot_sig = None
+        self._n_params = None  # resolved lazily for the telemetry MFU
+        self._batch_dims = None  # (samples, tokens) cached per signature
 
     @staticmethod
     def _arg_signature(args):
@@ -269,13 +291,42 @@ class TrainStep:
         from ..core.flags import get_flag
 
         if not get_flag("jit_fast_dispatch"):
-            return self._jitted(*args)
+            if not self._telemetry:
+                return self._jitted(*args)
+            # plain-jit path: infer compile events from tracing-cache growth
+            size_fn = getattr(self._jitted, "_cache_size", None)
+            before = size_fn() if callable(size_fn) else None
+            out = self._jitted(*args)
+            if before is not None and callable(size_fn) and \
+                    size_fn() > before:
+                from . import compile_cache as _cc
+
+                _cc.note_compile(0.0)
+                _telemetry.get_telemetry().event(
+                    "compile" if before == 0 else "recompile",
+                    what="train_step", aot=False)
+            return out
         sig = self._arg_signature(args)
         if self._aot is None or sig != self._aot_sig:
             # new shape/dtype signature: AOT-compile for it (first time), or
             # fall through jit for a shape-polymorphic caller
-            self._aot = self._jitted.lower(*args).compile()
+            from . import compile_cache as _cc
+
+            recompile = self._aot is not None
+            if recompile:
+                _cc.note_evict()  # signature change replaces the executable
+                self._batch_dims = None  # new signature: rescan batch shape
+            entries = _cc.entries_probe()
+            t0 = time.perf_counter()
+            with _span("jit.compile", cat="jit"):
+                self._aot = self._jitted.lower(*args).compile()
+            dt = time.perf_counter() - t0
             self._aot_sig = sig
+            _cc.note_compile(dt, entries_before=entries)
+            if self._telemetry and _telemetry.enabled():
+                _telemetry.get_telemetry().event(
+                    "recompile" if recompile else "compile",
+                    what="train_step", seconds=round(dt, 4), aot=True)
         return self._aot(*args)
 
     def _check_dp_batch(self, batch_vals):
@@ -318,9 +369,14 @@ class TrainStep:
                 self._check_dp_batch(batch_vals)
             self._maybe_lint(batch)
         self._step_i += 1
-        out = self._dispatch(
-            param_vals, buffer_vals, self.opt_state, lr, seed, batch_vals
-        )
+        t0 = time.perf_counter() if self._telemetry else 0.0
+        with _span("jit.train_step", cat="jit"):
+            out = self._dispatch(
+                param_vals, buffer_vals, self.opt_state, lr, seed, batch_vals
+            )
+        gnorm = None
+        if self._telemetry:
+            out, gnorm = out[:-1], out[-1]
         if self._nan_guard:
             loss, new_p, new_b, new_s, skipped = out
             n_skipped = int(skipped)  # one host-scalar read, like loss.item()
@@ -337,7 +393,67 @@ class TrainStep:
         if sched is not None:
             sched.step()
         self.optimizer._step_count += 1
+        if self._telemetry:
+            self._emit_step(loss, gnorm, float(lr), t0, batch_vals)
         return Tensor(loss)
+
+    def _emit_step(self, loss, gnorm, lr_f, t0, batch_vals):
+        """Build and stage this step's telemetry record (telemetry path only).
+        Reading loss/gnorm to host scalars is the step's natural sync point,
+        so compute_s measured after it covers the device work."""
+        try:
+            loss_f = float(loss)
+            gnorm_f = float(gnorm) if gnorm is not None else None
+        except (TypeError, ValueError):
+            loss_f = gnorm_f = None
+        compute_s = time.perf_counter() - t0
+        if self._n_params is None:
+            self._n_params = int(sum(
+                int(np.prod(p._value.shape)) for p in self.params))
+        if self._batch_dims is None:
+            # batch shapes are static per compiled signature; scan once
+            samples = tokens = None
+            for leaf in jax.tree_util.tree_leaves(batch_vals):
+                shape = tuple(getattr(leaf, "shape", ()))
+                if not shape:
+                    continue
+                if samples is None:
+                    samples = int(shape[0])
+                if tokens is None and len(shape) >= 2 and \
+                        jnp.issubdtype(getattr(leaf, "dtype", jnp.float32),
+                                       jnp.integer):
+                    tokens = int(shape[0]) * int(shape[1])
+                if samples is not None and tokens is not None:
+                    break
+            self._batch_dims = (samples, tokens)
+        samples, tokens = self._batch_dims
+        core = {
+            "step": self._step_i - 1,
+            "loss": loss_f,
+            "grad_norm": gnorm_f,
+            "lr": lr_f,
+            "compute_s": compute_s,
+            "skipped": self.last_skipped if self._nan_guard else False,
+            # on the fused single-program path the all-reduce overlaps the
+            # backward inside XLA; no host-observable reduce wait exists
+            "reduce_overlapped": True,
+        }
+        if samples:
+            core["samples"] = samples
+        if tokens:
+            core["tokens"] = tokens
+            core["flops"] = 6.0 * self._n_params * tokens
+        try:
+            from ..core import autotune as _autotune
+            from . import compile_cache as _cc
+
+            core["autotune"] = _autotune.stats_snapshot()
+            core["compile_cache"] = dict(_cc.cache_info())
+        except Exception:
+            pass
+        _telemetry.get_telemetry().on_step(core)
+        if self._nan_guard and self.last_skipped:
+            _flight.on_nan_skip(self._step_i - 1, loss=loss_f)
 
     def sync_to_optimizer(self):
         """Push compiled-state back so optimizer.state_dict() reflects

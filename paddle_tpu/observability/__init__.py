@@ -1,0 +1,45 @@
+"""paddle_tpu.observability — unified observability layer (ISSUE r9).
+
+One registry, four capabilities:
+
+  * metrics registry (registry.py): Counter/Gauge/Histogram with labels,
+    thread-safe, near-zero overhead while FLAGS_metrics is off;
+  * sinks (sinks.py): append-only JSONL event log + atomic Prometheus
+    textfile exporter under FLAGS_metrics_dir;
+  * per-step telemetry (telemetry.py): the runtime emits loss / grad-norm /
+    lr / throughput / MFU / per-phase times from inside jit.TrainStep and
+    resilience.ResilientTrainer;
+  * span tracing (spans.py) + crash flight recorder (flight_recorder.py):
+    one span ring shared by the profiler, the chrome-trace merge, and the
+    atomic crash dumps triggered by the NaN guard / preemption / uncaught
+    exceptions.
+
+Importing this package registers FLAGS_metrics, FLAGS_metrics_dir, and
+FLAGS_flight_recorder_steps.
+"""
+from . import flight_recorder, registry, sinks, spans, telemetry  # noqa: F401
+from .flight_recorder import FlightRecorder, get_flight_recorder  # noqa: F401
+from .registry import (REGISTRY, Counter, Gauge, Histogram,  # noqa: F401
+                       MetricsRegistry, counter, default_registry, gauge,
+                       histogram, metrics_enabled)
+from .sinks import (JsonlEventLog, parse_prometheus_text,  # noqa: F401
+                    prometheus_text, write_prometheus_textfile)
+from .spans import record_span, span  # noqa: F401
+from .telemetry import StepTelemetry, get_telemetry  # noqa: F401
+
+__all__ = [
+    "REGISTRY", "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "counter", "gauge", "histogram", "default_registry", "metrics_enabled",
+    "JsonlEventLog", "prometheus_text", "write_prometheus_textfile",
+    "parse_prometheus_text", "span", "record_span", "StepTelemetry",
+    "get_telemetry", "FlightRecorder", "get_flight_recorder", "reset_all",
+]
+
+
+def reset_all() -> None:
+    """Zero metrics, clear spans, and drop telemetry/flight singletons —
+    test isolation helper."""
+    registry.REGISTRY.reset()
+    spans.clear()
+    telemetry.reset()
+    flight_recorder.reset()

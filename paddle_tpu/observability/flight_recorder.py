@@ -1,0 +1,188 @@
+"""Crash flight recorder: bounded ring of recent step records + span tails,
+dumped atomically on failure.
+
+Motivation (ISSUE r9): the NaN step-guard skips a poisoned step and the
+PreemptionHandler exits cleanly, but neither leaves forensics — after the
+process is gone there is no record of WHAT the last N steps looked like.
+The flight recorder is an aircraft-style black box: telemetry keeps pushing
+step records into a ring bounded by FLAGS_flight_recorder_steps, and on a
+trigger (NaN guard trip, preemption, uncaught trainer exception, or an
+explicit `dump()`) the ring + recent spans + a full metrics snapshot are
+written with the same tmp+os.replace discipline as CheckpointManager — a
+crash mid-dump can never leave a torn file for the post-mortem tooling.
+
+Dumps land in FLAGS_metrics_dir/flight/ (or ./flight_recorder when no
+metrics dir is set). The whole module is inert while FLAGS_metrics is off.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from . import spans
+from .registry import counter, default_registry, metrics_enabled
+from .sinks import _json_default
+
+from ..core.flags import define_flag, get_flag
+
+define_flag(
+    "flight_recorder_steps", 64,
+    "Ring-buffer capacity of the crash flight recorder: how many of the "
+    "most recent per-step telemetry records survive into a crash dump.")
+
+_DUMPS = counter("flight_recorder_dumps_total",
+                 "Flight-recorder dumps written, by trigger reason.",
+                 labelnames=("reason",), always=True)
+
+_EVENT_RING = 256
+_SPAN_TAIL = 200
+
+
+class FlightRecorder:
+    """Bounded in-memory black box; `dump()` serializes it atomically."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        if capacity is None:
+            capacity = max(int(get_flag("flight_recorder_steps")), 1)
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._steps: deque = deque(maxlen=capacity)
+        self._events: deque = deque(maxlen=_EVENT_RING)
+        self._dump_count = 0
+
+    # -- feeding -----------------------------------------------------------
+    def record_step(self, record: Dict[str, Any]) -> None:
+        """Push one per-step telemetry record (dict is kept by REFERENCE so
+        late phase merges — e.g. save time added after the step — are still
+        visible in a later dump)."""
+        with self._lock:
+            self._steps.append(record)
+
+    def note(self, kind: str, **data) -> None:
+        """Record an irregular event (compile, nan_skip, preemption, ...)."""
+        ev = {"kind": str(kind), "ts": time.time()}
+        ev.update(data)
+        with self._lock:
+            self._events.append(ev)
+
+    # -- reading -----------------------------------------------------------
+    def steps(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._steps)
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    # -- dumping -----------------------------------------------------------
+    def _dump_dir(self, directory: Optional[str]) -> str:
+        if directory:
+            return os.path.abspath(directory)
+        mdir = str(get_flag("metrics_dir") or "")
+        if mdir:
+            return os.path.join(os.path.abspath(mdir), "flight")
+        return os.path.abspath("flight_recorder")
+
+    def dump(self, reason: str, exc: Optional[BaseException] = None,
+             directory: Optional[str] = None) -> str:
+        """Write the black box to disk atomically; returns the dump path."""
+        with self._lock:
+            self._dump_count += 1
+            n = self._dump_count
+            steps = list(self._steps)
+            events = list(self._events)
+        payload: Dict[str, Any] = {
+            "kind": "flight_recorder_dump",
+            "reason": str(reason),
+            "ts": time.time(),
+            "time": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "pid": os.getpid(),
+            "capacity": self.capacity,
+            "steps": steps,
+            "events": events,
+            "spans": spans.tail(_SPAN_TAIL),
+            "metrics": default_registry().snapshot(),
+        }
+        if exc is not None:
+            payload["exception"] = {
+                "type": type(exc).__name__,
+                "message": str(exc),
+                "traceback": "".join(traceback.format_exception(
+                    type(exc), exc, exc.__traceback__))[-8000:],
+            }
+        d = self._dump_dir(directory)
+        os.makedirs(d, exist_ok=True)
+        safe = "".join(c if c.isalnum() or c in "-_" else "_"
+                       for c in str(reason))[:48]
+        path = os.path.join(
+            d, f"flight_{time.strftime('%Y%m%d_%H%M%S')}_{os.getpid()}"
+               f"_{n:03d}_{safe}.json")
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                json.dump(payload, f, default=_json_default)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)  # <- the commit point
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        _DUMPS.inc(reason=safe or "manual")
+        return path
+
+
+_recorder: Optional[FlightRecorder] = None
+_recorder_lock = threading.Lock()
+
+
+def get_flight_recorder() -> FlightRecorder:
+    global _recorder
+    with _recorder_lock:
+        if _recorder is None:
+            _recorder = FlightRecorder()
+        return _recorder
+
+
+def reset() -> None:
+    """Drop the singleton (tests; also re-reads FLAGS_flight_recorder_steps)."""
+    global _recorder
+    with _recorder_lock:
+        _recorder = None
+
+
+# -- runtime trigger hooks (called by jit/, resilience/) --------------------
+def on_nan_skip(step: int, loss: Optional[float] = None) -> Optional[str]:
+    """NaN step-guard tripped: leave forensics. No-op while metrics are off
+    (the guard itself still skips the step either way)."""
+    if not metrics_enabled():
+        return None
+    rec = get_flight_recorder()
+    rec.note("nan_skip", step=int(step), loss=loss)
+    return rec.dump("nan_guard")
+
+
+def on_preemption(reason: str) -> Optional[str]:
+    """PreemptionHandler latched (SIGTERM / elastic shrink)."""
+    if not metrics_enabled():
+        return None
+    rec = get_flight_recorder()
+    rec.note("preemption", reason=str(reason))
+    return rec.dump(f"preemption_{reason}")
+
+
+def on_exception(exc: BaseException) -> Optional[str]:
+    """Uncaught exception escaping ResilientTrainer.run."""
+    if not metrics_enabled():
+        return None
+    rec = get_flight_recorder()
+    rec.note("exception", type=type(exc).__name__, message=str(exc)[:500])
+    return rec.dump("exception", exc=exc)

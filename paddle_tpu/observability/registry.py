@@ -1,0 +1,303 @@
+"""Process-wide metrics registry: Counter / Gauge / Histogram with labels.
+
+Reference analogs: the reference scatters runtime counters across private
+module state (phi autotune cache stats, buffered-reader queue depths, the
+profiler's benchmark timer); monitoring systems then re-derive them from
+logs. Here every subsystem registers through ONE registry so a live training
+run exports a single consistent snapshot — the Prometheus client-library
+model (textfile exporter, sinks.py) without the dependency.
+
+Overhead contract (ISSUE r9): recording is a dict lookup + float add under a
+per-metric lock — O(100ns). Metrics default to respecting FLAGS_metrics
+("off" makes `inc/set/observe` return immediately); subsystems whose legacy
+stats must keep counting regardless (autotune._STATS, DevicePrefetcher.stats,
+compile-cache counters — their back-compat views read through the registry)
+register with `always=True`.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ..core.flags import define_flag, get_flag
+
+define_flag(
+    "metrics", "off",
+    "Unified observability layer (observability/): 'on' enables per-step "
+    "training telemetry, metric sinks, span recording, and the crash "
+    "flight recorder; 'off' reduces the whole layer to near-zero-overhead "
+    "no-ops (legacy cache/prefetch counters keep counting).")
+define_flag(
+    "metrics_dir", "",
+    "Directory for metric sinks: events.jsonl (append-only telemetry "
+    "event log), paddle_tpu.prom (Prometheus textfile exporter), and "
+    "flight/ (crash flight-recorder dumps). Empty = in-memory only.")
+
+_TRUE = ("1", "on", "true", "yes")
+
+
+def metrics_enabled() -> bool:
+    return str(get_flag("metrics")).lower() in _TRUE
+
+
+# default histogram bounds: latencies in seconds, 100µs .. 100s
+_DEFAULT_BUCKETS = (1e-4, 3e-4, 1e-3, 3e-3, 1e-2, 3e-2, 0.1, 0.3,
+                    1.0, 3.0, 10.0, 30.0, 100.0)
+
+
+class _Metric:
+    """Base: one named metric holding per-label-set values."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, doc: str = "",
+                 labelnames: Sequence[str] = (), always: bool = False):
+        self.name = name
+        self.doc = doc
+        self.labelnames = tuple(labelnames)
+        self.always = bool(always)
+        self._lock = threading.Lock()
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    # -- label plumbing ----------------------------------------------------
+    def _key(self, labels: Dict[str, str]) -> Tuple[str, ...]:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"metric {self.name!r} takes labels {self.labelnames}, "
+                f"got {tuple(sorted(labels))}")
+        return tuple(str(labels[n]) for n in self.labelnames)
+
+    def labels(self, **labels) -> "_Bound":
+        return _Bound(self, self._key(labels))
+
+    def _enabled(self) -> bool:
+        return self.always or metrics_enabled()
+
+    # -- reading -----------------------------------------------------------
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+    def total(self) -> float:
+        """Sum across all label sets (0.0 when never recorded)."""
+        with self._lock:
+            return sum(self._values.values())
+
+    def samples(self) -> List[Tuple[Dict[str, str], float]]:
+        with self._lock:
+            items = list(self._values.items())
+        return [(dict(zip(self.labelnames, k)), v) for k, v in items]
+
+    # -- internal write (also used by back-compat stat views) --------------
+    def _set_raw(self, value: float, key: Tuple[str, ...] = ()):
+        with self._lock:
+            self._values[key] = float(value)
+
+    def _add_raw(self, amount: float, key: Tuple[str, ...] = ()):
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def reset(self):
+        with self._lock:
+            self._values.clear()
+
+
+class _Bound:
+    """A metric bound to one label set (`metric.labels(x=...)`)."""
+
+    __slots__ = ("_metric", "_key")
+
+    def __init__(self, metric: _Metric, key: Tuple[str, ...]):
+        self._metric = metric
+        self._key = key
+
+    def inc(self, amount: float = 1.0):
+        if self._metric._enabled():
+            self._metric._add_raw(float(amount), self._key)
+
+    def set(self, value: float):
+        if self._metric._enabled():
+            self._metric._set_raw(float(value), self._key)
+
+    def observe(self, value: float):
+        self._metric.observe(value, **dict(
+            zip(self._metric.labelnames, self._key)))
+
+    def value(self) -> float:
+        with self._metric._lock:
+            return self._metric._values.get(self._key, 0.0)
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels):
+        if amount < 0:
+            raise ValueError("counters only go up")
+        if self._enabled():
+            self._add_raw(float(amount), self._key(labels))
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, **labels):
+        if self._enabled():
+            self._set_raw(float(value), self._key(labels))
+
+    def inc(self, amount: float = 1.0, **labels):
+        if self._enabled():
+            self._add_raw(float(amount), self._key(labels))
+
+    def dec(self, amount: float = 1.0, **labels):
+        self.inc(-amount, **labels)
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram (Prometheus semantics): per label set it
+    keeps bucket counts for `le` bounds plus _sum and _count."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, doc: str = "",
+                 labelnames: Sequence[str] = (), always: bool = False,
+                 buckets: Iterable[float] = _DEFAULT_BUCKETS):
+        super().__init__(name, doc, labelnames, always)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        # per label-key: [bucket_counts..., +Inf_count, sum]
+        self._hist: Dict[Tuple[str, ...], List[float]] = {}
+
+    def observe(self, value: float, **labels):
+        if not self._enabled():
+            return
+        key = self._key(labels)
+        v = float(value)
+        with self._lock:
+            row = self._hist.get(key)
+            if row is None:
+                row = self._hist[key] = [0.0] * (len(self.buckets) + 2)
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    row[i] += 1
+            row[-2] += 1  # +Inf / _count
+            row[-1] += v  # _sum
+            self._values[key] = row[-2]  # expose count via value()
+
+    def stats(self, **labels) -> Dict[str, float]:
+        key = self._key(labels)
+        with self._lock:
+            row = self._hist.get(key)
+            if row is None:
+                return {"count": 0, "sum": 0.0}
+            return {"count": row[-2], "sum": row[-1]}
+
+    def samples(self):  # prometheus expansion handled by the text writer
+        with self._lock:
+            items = list(self._hist.items())
+        out = []
+        for key, row in items:
+            base = dict(zip(self.labelnames, key))
+            for i, b in enumerate(self.buckets):
+                out.append((dict(base, le=repr(b)), row[i],
+                            self.name + "_bucket"))
+            out.append((dict(base, le="+Inf"), row[-2], self.name + "_bucket"))
+            out.append((base, row[-1], self.name + "_sum"))
+            out.append((dict(base), row[-2], self.name + "_count"))
+        return out
+
+    def reset(self):
+        with self._lock:
+            self._values.clear()
+            self._hist.clear()
+
+
+class MetricsRegistry:
+    """Name -> metric table. Registration is idempotent: re-registering the
+    same (name, kind) returns the existing metric, so subsystems can declare
+    their metrics at import time in any order."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _get_or_create(self, cls, name, doc, labelnames, always, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls):
+                    raise ValueError(
+                        f"metric {name!r} already registered as {m.kind}")
+                return m
+            m = cls(name, doc, labelnames, always, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, doc: str = "",
+                labelnames: Sequence[str] = (),
+                always: bool = False) -> Counter:
+        return self._get_or_create(Counter, name, doc, labelnames, always)
+
+    def gauge(self, name: str, doc: str = "", labelnames: Sequence[str] = (),
+              always: bool = False) -> Gauge:
+        return self._get_or_create(Gauge, name, doc, labelnames, always)
+
+    def histogram(self, name: str, doc: str = "",
+                  labelnames: Sequence[str] = (), always: bool = False,
+                  buckets: Iterable[float] = _DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, doc, labelnames, always,
+                                   buckets=buckets)
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def metrics(self) -> List[_Metric]:
+        with self._lock:
+            return list(self._metrics.values())
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        """Plain-dict view {metric: {"label=a|label2=b": value}} — what the
+        flight recorder embeds in crash dumps."""
+        out: Dict[str, Dict[str, float]] = {}
+        for m in self.metrics():
+            if isinstance(m, Histogram):
+                vals = {}
+                with m._lock:
+                    for key, row in m._hist.items():
+                        lbl = "|".join(f"{n}={v}" for n, v in
+                                       zip(m.labelnames, key))
+                        vals[lbl or "_"] = {"count": row[-2], "sum": row[-1]}
+                out[m.name] = vals
+                continue
+            out[m.name] = {
+                "|".join(f"{n}={v}" for n, v in lbls.items()) or "_": val
+                for lbls, val in m.samples()}
+        return out
+
+    def reset(self):
+        """Zero every metric (tests / fresh runs); registrations survive."""
+        for m in self.metrics():
+            m.reset()
+
+
+REGISTRY = MetricsRegistry()
+
+
+def default_registry() -> MetricsRegistry:
+    return REGISTRY
+
+
+def counter(name: str, doc: str = "", labelnames: Sequence[str] = (),
+            always: bool = False) -> Counter:
+    return REGISTRY.counter(name, doc, labelnames, always)
+
+
+def gauge(name: str, doc: str = "", labelnames: Sequence[str] = (),
+          always: bool = False) -> Gauge:
+    return REGISTRY.gauge(name, doc, labelnames, always)
+
+
+def histogram(name: str, doc: str = "", labelnames: Sequence[str] = (),
+              always: bool = False,
+              buckets: Iterable[float] = _DEFAULT_BUCKETS) -> Histogram:
+    return REGISTRY.histogram(name, doc, labelnames, always, buckets=buckets)

@@ -1,0 +1,150 @@
+"""Unified span tracing across subsystems.
+
+One process-wide bounded span ring that every runtime component writes
+through `span("name")`: TrainStep dispatch, DevicePrefetcher waits,
+grad-bucket construction, CheckpointManager save/commit, collective init,
+and `profiler.RecordEvent`'s pure-Python fallback. Three consumers:
+
+  * the native HostTracer (native/src/tracer.cc) — when the C++ tracer is
+    available AND actively recording, spans are mirrored through
+    trace_push/trace_pop so they land in the existing chrome-trace merge
+    (profiler/xplane.py) exactly like hand-annotated RecordEvents;
+  * the profiler's pure-Python fallback — when the native library is absent,
+    `Profiler` collects spans from THIS ring between start/stop (the
+    fallback RecordEvent's docstring promised and r6–r8 silently dropped);
+  * the crash flight recorder — `tail(n)` returns the most recent spans for
+    post-mortem dumps regardless of any profiler session.
+
+Clock: time.monotonic_ns(), the same steady clock family as the native
+tracer's now_ns, so merged timelines share an axis.
+
+Recording is gated: a span records when FLAGS_metrics is on, a profiler
+fallback session is open, or the native tracer is live — otherwise
+`span()` is a two-attribute-check no-op (near-zero overhead off).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from .registry import metrics_enabled
+
+_MAX_SPANS = 65536
+
+_lock = threading.Lock()
+_ring: deque = deque(maxlen=_MAX_SPANS)
+_seq = 0
+_session_depth = 0  # profiler fallback sessions currently open
+
+
+def session(on: bool) -> None:
+    """Open/close a pure-Python profiler recording session (profiler/)."""
+    global _session_depth
+    with _lock:
+        _session_depth = max(_session_depth + (1 if on else -1), 0)
+
+
+def _native_live() -> bool:
+    try:
+        from .. import native
+
+        return native.available() and native.trace_enabled()
+    except Exception:
+        return False
+
+
+def enabled() -> bool:
+    return _session_depth > 0 or metrics_enabled() or _native_live()
+
+
+def mark() -> int:
+    """Sequence watermark; `since(mark())` later returns spans recorded
+    after this point (profiler fallback session collection)."""
+    with _lock:
+        return _seq
+
+
+def record_span(name: str, begin_ns: int, end_ns: int, cat: str = "span",
+                args: Optional[Dict] = None) -> None:
+    """Append one completed span to the ring (also the RecordEvent-fallback
+    entry point). Caller supplies monotonic_ns timestamps."""
+    global _seq
+    span_d = {
+        "name": str(name),
+        "begin_ns": int(begin_ns),
+        "end_ns": int(end_ns),
+        "tid": threading.get_ident() & 0xFFFF,
+        "cat": cat,
+    }
+    if args:
+        span_d["args"] = args
+    with _lock:
+        _seq += 1
+        _ring.append((_seq, span_d))
+
+
+def since(watermark: int) -> List[Dict]:
+    with _lock:
+        return [s for q, s in _ring if q > watermark]
+
+
+def tail(n: int = 200) -> List[Dict]:
+    with _lock:
+        items = list(_ring)[-int(n):]
+    return [s for _, s in items]
+
+
+def clear() -> None:
+    global _seq
+    with _lock:
+        _ring.clear()
+        _seq = 0
+
+
+class span:
+    """Context manager recording one span into the unified ring, mirrored
+    to the native tracer when it is live.
+
+        with span("ckpt.commit", cat="io", args={"step": 7}):
+            ...
+    """
+
+    __slots__ = ("name", "cat", "args", "_t0", "_native", "_on")
+
+    def __init__(self, name: str, cat: str = "span",
+                 args: Optional[Dict] = None):
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self._t0 = 0
+        self._native = False
+        self._on = False
+
+    def __enter__(self):
+        self._on = enabled()
+        if self._on:
+            self._t0 = time.monotonic_ns()
+            if _native_live():
+                try:
+                    from .. import native
+
+                    native.trace_push(self.name)
+                    self._native = True
+                except Exception:
+                    self._native = False
+        return self
+
+    def __exit__(self, *exc):
+        if self._on:
+            if self._native:
+                try:
+                    from .. import native
+
+                    native.trace_pop()
+                except Exception:
+                    pass
+            record_span(self.name, self._t0, time.monotonic_ns(),
+                        cat=self.cat, args=self.args)
+        return False

@@ -21,6 +21,7 @@ import time
 from typing import Callable, Iterable, Optional
 
 from .. import native
+from ..observability import spans as _obs_spans
 
 
 class ProfilerState(enum.Enum):
@@ -108,23 +109,37 @@ def export_protobuf(dir_name: str, worker_name: Optional[str] = None) -> Callabl
 class RecordEvent:
     """User-annotated host span (reference: paddle.profiler.RecordEvent).
 
-    Falls back to a pure-Python span list if the native library is absent.
+    Falls back to the pure-Python span ring (observability/spans.py) when the
+    native library is absent: spans recorded between Profiler.start/stop are
+    collected from that ring and merged into the exported chrome trace, so
+    annotations survive on hosts without the C++ tracer (r6–r8 silently
+    dropped them). Outside a recording context the fallback is a no-op, same
+    as the native tracer when disabled.
     """
 
     def __init__(self, name: str, event_type: TracerEventType = TracerEventType.UserDefined):
         self.name = name
         self.event_type = event_type
         self._begun = False
+        self._t0 = 0
 
     def begin(self):
+        self._t0 = 0
         if native.available():
             native.trace_push(self.name)
+        elif _obs_spans.enabled():
+            self._t0 = time.monotonic_ns()
         self._begun = True
 
     def end(self):
-        if self._begun and native.available():
-            native.trace_pop()
+        if self._begun:
+            if native.available():
+                native.trace_pop()
+            elif self._t0:
+                _obs_spans.record_span(self.name, self._t0,
+                                       time.monotonic_ns(), cat="user")
         self._begun = False
+        self._t0 = 0
 
     def __enter__(self):
         self.begin()
@@ -190,6 +205,7 @@ class Profiler:
         self._device_trace_dir = None
         self._last_device_dir = None   # kept after stop for export merge
         self._clock_sync = None        # (host steady_ns, epoch_ns) pair
+        self._span_mark = 0            # python span-ring watermark (fallback)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -247,6 +263,11 @@ class Profiler:
         if native.available():
             native.trace_clear()
             native.trace_enable(True)
+        else:
+            # pure-Python fallback: open a span-ring session and note the
+            # watermark — stop collects everything recorded after it
+            _obs_spans.session(True)
+            self._span_mark = _obs_spans.mark()
         if ProfilerTarget.TPU in self.targets or ProfilerTarget.GPU in self.targets:
             # device timeline is XLA's: delegate to jax.profiler (xplane)
             try:
@@ -269,6 +290,9 @@ class Profiler:
         if native.available():
             self._spans = native.trace_spans()
             native.trace_enable(False)
+        else:
+            self._spans = _obs_spans.since(self._span_mark)
+            _obs_spans.session(False)
         if self._device_trace_dir is not None:
             try:
                 import jax

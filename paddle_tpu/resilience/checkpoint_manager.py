@@ -42,6 +42,14 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from . import chaos
+from ..observability.registry import counter as _obs_counter
+from ..observability.spans import span as _span
+
+_SAVES = _obs_counter(
+    "checkpoint_saves_total",
+    "Checkpoint saves by outcome: committed = the atomic rename landed, "
+    "failed = the write raised before the commit point.",
+    labelnames=("outcome",))
 
 __all__ = ["CheckpointManager", "CheckpointCorrupt", "RestoredCheckpoint"]
 
@@ -283,23 +291,35 @@ class CheckpointManager:
         os.makedirs(tmp)
         chaos.crash_point("ckpt.begin")
         arrays = []
-        for i, arr in enumerate(leaves):
-            fname = f"arr_{i}.bin"
-            buf = arr.tobytes()
-            with open(os.path.join(tmp, fname), "wb") as f:
-                f.write(buf)
-                _fsync_file(f)
-            arrays.append({
-                "file": fname,
-                "shape": list(arr.shape),
-                "dtype": arr.dtype.name,
-                "crc32": zlib.crc32(buf) & 0xFFFFFFFF,
-            })
-            chaos.crash_point("ckpt.array")
+        with _span("ckpt.write", cat="io", args={"step": int(step)}):
+            for i, arr in enumerate(leaves):
+                fname = f"arr_{i}.bin"
+                buf = arr.tobytes()
+                with open(os.path.join(tmp, fname), "wb") as f:
+                    f.write(buf)
+                    _fsync_file(f)
+                arrays.append({
+                    "file": fname,
+                    "shape": list(arr.shape),
+                    "dtype": arr.dtype.name,
+                    "crc32": zlib.crc32(buf) & 0xFFFFFFFF,
+                })
+                chaos.crash_point("ckpt.array")
         return self._finalize(step, tmp, final, skeleton, arrays, meta)
 
     def _finalize(self, step: int, tmp: str, final: str, skeleton, arrays,
                   meta: Optional[Dict]):
+        try:
+            out = self._finalize_inner(step, tmp, final, skeleton, arrays,
+                                       meta)
+        except BaseException:
+            _SAVES.inc(outcome="failed")
+            raise
+        _SAVES.inc(outcome="committed")
+        return out
+
+    def _finalize_inner(self, step: int, tmp: str, final: str, skeleton,
+                        arrays, meta: Optional[Dict]):
         chaos.crash_point("ckpt.before_manifest")
         manifest = {
             "version": _FORMAT_VERSION,
@@ -317,16 +337,17 @@ class CheckpointManager:
         _fsync_dir(tmp)
 
         chaos.crash_point("ckpt.before_commit")
-        if os.path.exists(final):  # same-step re-save: replace atomically
-            old = final + ".replaced"
-            if os.path.exists(old):
+        with _span("ckpt.commit", cat="io", args={"step": int(step)}):
+            if os.path.exists(final):  # same-step re-save: replace atomically
+                old = final + ".replaced"
+                if os.path.exists(old):
+                    shutil.rmtree(old)
+                os.rename(final, old)
+                os.rename(tmp, final)
                 shutil.rmtree(old)
-            os.rename(final, old)
-            os.rename(tmp, final)
-            shutil.rmtree(old)
-        else:
-            os.rename(tmp, final)  # <- the commit point
-        _fsync_dir(self.root)
+            else:
+                os.rename(tmp, final)  # <- the commit point
+            _fsync_dir(self.root)
 
         chaos.crash_point("ckpt.before_gc")
         self._gc()

@@ -48,9 +48,20 @@ class PreemptionHandler:
     def trigger(self, reason: str = "manual"):
         """Latch preemption programmatically (elastic hook, chaos harness)."""
         self.count += 1
-        if not self._event.is_set():
+        first = not self._event.is_set()
+        if first:
             self.reason = reason
             self._event.set()
+            # flight-recorder forensics on the FIRST latch only (repeat
+            # signals in the grace window must not spam dumps); lazy import
+            # keeps signal-handler context cheap, and observability failures
+            # must never break the shutdown path
+            try:
+                from ..observability import flight_recorder as _flight
+
+                _flight.on_preemption(reason)
+            except Exception:
+                pass
         for cb in self._callbacks:
             try:
                 cb(reason)

@@ -12,6 +12,7 @@ work.
 """
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, Iterable, Optional, Sequence, Union
 
 import numpy as np
@@ -19,6 +20,8 @@ import numpy as np
 from . import chaos
 from .checkpoint_manager import CheckpointManager
 from .preemption import PreemptionHandler
+from ..observability import flight_recorder as _flight
+from ..observability import telemetry as _telemetry
 
 __all__ = ["ResilientTrainer"]
 
@@ -176,14 +179,27 @@ class ResilientTrainer:
         if not preempt._installed:
             preempt.install()
             installed_here = True
+        # per-step telemetry (observability/): this loop owns the phases the
+        # compiled step can't see — host data wait before the step, blocking
+        # checkpoint time after it
+        tele = _telemetry.get_telemetry() if _telemetry.enabled() else None
         try:
             while self._epoch < epochs:
-                it = batches() if callable(batches) else batches
-                for i, batch in enumerate(it):
+                it = iter(batches() if callable(batches) else batches)
+                i = -1
+                while True:
+                    t_data = time.perf_counter()
+                    try:
+                        batch = next(it)
+                    except StopIteration:
+                        break
+                    i += 1
                     if i < self._offset:
                         continue  # replayed prefix of a resumed epoch
+                    if tele is not None:
+                        tele.pre_phase("data", time.perf_counter() - t_data)
                     if preempt.requested:
-                        self.save()
+                        self._timed_save(tele)
                         report["status"] = "preempted"
                         report["preempt_reason"] = preempt.reason
                         return self._finish(report)
@@ -199,14 +215,26 @@ class ResilientTrainer:
                     self._offset = i + 1
                     if self.save_every and \
                             self.step._step_i % self.save_every == 0:
-                        self.save()
+                        self._timed_save(tele)
                 self._epoch += 1
                 self._offset = 0
-            self.save()
+            self._timed_save(tele)
             return self._finish(report)
+        except BaseException as e:
+            # black-box forensics for anything escaping the loop (chaos
+            # InjectedCrash included); the exception itself still propagates
+            _flight.on_exception(e)
+            raise
         finally:
             if installed_here:
                 preempt.uninstall()
+
+    def _timed_save(self, tele):
+        t0 = time.perf_counter()
+        out = self.save()
+        if tele is not None:
+            tele.post_phase("save", time.perf_counter() - t0)
+        return out
 
     def _finish(self, report: Dict[str, Any]) -> Dict[str, Any]:
         self.manager.wait()  # run() must not return before the final commit
@@ -215,6 +243,10 @@ class ResilientTrainer:
         report["steps_skipped"] = (int(self.step.skipped_steps)
                                    - report.pop("steps_skipped_start"))
         report["steps_skipped_total"] = int(self.step.skipped_steps)
+        if _telemetry.enabled():
+            tele = _telemetry.get_telemetry()
+            tele.finalize()  # flush the staged record + Prometheus textfile
+            report["telemetry"] = tele.summary()
         return report
 
 

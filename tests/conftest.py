@@ -29,6 +29,10 @@ import pytest  # noqa: E402
 
 
 def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavier integration tests excluded from the tier-1 "
+        "`-m 'not slow'` sweep (still run by plain pytest and the benches)")
     backend = jax.default_backend()
     if backend != "cpu" or jax.device_count() < 8:
         raise RuntimeError(

@@ -258,6 +258,55 @@ def child_compile(cache_dir: str) -> int:
     return 0
 
 
+# -- runtime telemetry: phases from the live runtime (observability/) --------
+def bench_runtime_telemetry(n_steps: int):
+    """PR r9: instead of re-timing phases externally (the benches above),
+    read them from the per-step telemetry the runtime itself emits — one
+    ResilientTrainer run with FLAGS_metrics=on, phases averaged straight out
+    of events.jsonl."""
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.core import flags
+    from paddle_tpu.observability import reset_all
+    from paddle_tpu.resilience import ResilientTrainer
+
+    mdir = tempfile.mkdtemp(prefix="sb_obs_")
+    reset_all()
+    flags.set_flags({"metrics": "on", "metrics_dir": mdir})
+    try:
+        _, model, ids_np = _gpt_pieces()
+        opt = optimizer.AdamW(1e-4, parameters=model.parameters())
+        trainer = ResilientTrainer(
+            model, lambda ids: model(ids, labels=ids), opt,
+            tempfile.mkdtemp(prefix="sb_obs_ckpt_"),
+            save_every=max(n_steps // 2, 1), nan_guard=True)
+        batches = [(paddle.to_tensor(ids_np),)] * n_steps
+        report = trainer.run(batches, epochs=1, resume=False)
+        with open(os.path.join(mdir, "events.jsonl")) as f:
+            records = [json.loads(line) for line in f]
+        steps = [r for r in records if r.get("kind") == "step"]
+        phases = {}
+        for p in ("data", "compute", "reduce", "save"):
+            phases[f"{p}_ms_avg"] = round(
+                sum(s["phases"].get(p, 0.0) for s in steps)
+                / max(len(steps), 1) * 1000, 3)
+        return {
+            "metrics_dir": mdir,
+            "step_records": len(steps),
+            "compile_events": sum(
+                1 for r in records if r.get("kind") in ("compile",
+                                                        "recompile")),
+            **phases,
+            "last_grad_norm": steps[-1].get("grad_norm") if steps else None,
+            "samples_per_s_last": steps[-1].get("samples_per_s")
+            if steps else None,
+            "summary": report.get("telemetry"),
+        }
+    finally:
+        flags.set_flags({"metrics": "off", "metrics_dir": ""})
+        reset_all()
+
+
 # -- autotune: cold tuning vs persistent-cache warm start --------------------
 def bench_autotune():
     import jax.numpy as jnp
@@ -302,6 +351,7 @@ def main() -> int:
         ("reduce_bucketing", lambda: bench_reduce_phase(args.steps)),
         ("compute_dispatch", lambda: bench_dispatch(args.steps)),
         ("save_async", lambda: bench_save_phase(args.saves)),
+        ("runtime_telemetry", lambda: bench_runtime_telemetry(args.steps)),
         ("autotune_cache", bench_autotune),
     ] + ([] if args.quick else [("compile_cache", bench_compile_cache)]):
         log(f"--- {name}")

@@ -15,6 +15,8 @@ the direct (non-jaxpr) entry the tests and kernel authors can call.
 """
 from __future__ import annotations
 
+import os
+import re
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -23,8 +25,55 @@ from ..analyzer import ProgramInfo, eqn_source, iter_eqns
 from ..findings import Finding, Severity
 from ..registry import register_rule
 
-VMEM_BYTES = 16 * 1024 * 1024  # per-core budget the blocks must fit in
+VMEM_BYTES = 16 * 1024 * 1024  # fallback per-core budget (v4/v5e class)
 _VMEM_WARN_FRACTION = 0.75
+
+_vmem_cached: Optional[int] = None
+
+
+def vmem_limit_bytes(refresh: bool = False) -> int:
+    """Per-core VMEM budget the block estimate is checked against.
+
+    Resolution order — most explicit wins:
+      1. PALLAS_VMEM_BYTES env var (tests / odd topologies);
+      2. --xla_tpu_scoped_vmem_limit_kib inside XLA_FLAGS (the knob real
+         runs use to re-split VMEM between Mosaic and XLA);
+      3. a vmem section in the local device's memory_stats() when the
+         backend reports one (real TPU runtimes);
+      4. the 16 MiB VMEM_BYTES fallback (lint must work on CPU hosts where
+         none of the above exists).
+    """
+    global _vmem_cached
+    if _vmem_cached is not None and not refresh:
+        return _vmem_cached
+    limit = None
+    env = os.environ.get("PALLAS_VMEM_BYTES")
+    if env:
+        try:
+            limit = int(env)
+        except ValueError:
+            limit = None
+    if limit is None:
+        m = re.search(r"--xla_tpu_scoped_vmem_limit_kib=(\d+)",
+                      os.environ.get("XLA_FLAGS", ""))
+        if m:
+            limit = int(m.group(1)) * 1024
+    if limit is None:
+        try:
+            import jax
+
+            dev = jax.local_devices()[0]
+            stats = dev.memory_stats() or {}
+            for key in ("vmem_size_bytes", "bytes_limit_vmem", "vmem_limit"):
+                if stats.get(key):
+                    limit = int(stats[key])
+                    break
+        except Exception:
+            limit = None
+    if not limit or limit <= 0:
+        limit = VMEM_BYTES
+    _vmem_cached = limit
+    return limit
 
 _SUBLANE_MIN = {
     "float32": 8, "int32": 8, "uint32": 8,
@@ -103,8 +152,11 @@ def _block_bytes(dims: List[Optional[int]], dtype) -> int:
         "per-dtype native tile (f32 (8,128), bf16 (16,128), int8/fp8 "
         "(32,128)) unless they span the whole array dim; array dims should "
         "divide by block dims (ragged grids run padded steps); the in+out "
-        "blocks x2 (double buffering) must fit ~16 MiB VMEM.")
+        "blocks x2 (double buffering) must fit the per-core VMEM budget "
+        "(PALLAS_VMEM_BYTES / --xla_tpu_scoped_vmem_limit_kib / device "
+        "memory_stats when available, 16 MiB fallback).")
 def check(program: ProgramInfo):
+    vmem_bytes = vmem_limit_bytes()
     for idx, eqn in iter_eqns(program.closed_jaxpr):
         if eqn.primitive.name != "pallas_call":
             continue
@@ -132,22 +184,22 @@ def check(program: ProgramInfo):
                              "(/opt guide: f32 (8,128), bf16 (16,128)) and "
                              "pad the array once up front if needed")
         est = 2 * total  # the Mosaic pipeline double-buffers every block
-        if est > VMEM_BYTES:
+        if est > vmem_bytes:
             yield Finding(
                 rule="pallas-tiling", severity=Severity.ERROR,
                 message=f"{name}: estimated VMEM for blocks is "
                         f"{est / 2**20:.1f} MiB (x2 double buffering) — "
-                        f"over the ~{VMEM_BYTES // 2**20} MiB/core budget; "
+                        f"over the ~{vmem_bytes // 2**20} MiB/core budget; "
                         "this fails at Mosaic compile time on real TPU",
                 primitive="pallas_call", eqn_index=idx, source=src,
                 fix_hint="shrink block rows (grid over more steps) or "
                          "lower the kernel's block_* parameters")
-        elif est > _VMEM_WARN_FRACTION * VMEM_BYTES:
+        elif est > _VMEM_WARN_FRACTION * vmem_bytes:
             yield Finding(
                 rule="pallas-tiling", severity=Severity.WARNING,
                 message=f"{name}: estimated VMEM for blocks is "
                         f"{est / 2**20:.1f} MiB of ~"
-                        f"{VMEM_BYTES // 2**20} MiB — no headroom for "
+                        f"{vmem_bytes // 2**20} MiB — no headroom for "
                         "scratch/semaphores; compile may still fail",
                 primitive="pallas_call", eqn_index=idx, source=src,
                 fix_hint="shrink block rows or split the kernel")

@@ -12,10 +12,144 @@ parallelism happens across mesh axes inside compiled programs.
 from __future__ import annotations
 
 import os
+import threading
+import time
+from typing import Dict, Optional
 
 import jax
 
 _initialized = False
+
+
+class InProcStore:
+    """In-process, thread-safe store with the native TCPStore's API
+    (set/get/add/wait_ge/delete/num_keys/barrier).
+
+    The cross-rank observability layer (observability/cluster.py) and the
+    synchronized checkpoint commit (resilience/checkpoint_manager.py) talk to
+    "a store" — on a real multi-host job that is native.TCPStore over the
+    rendezvous port; in tests and single-process simulations N threads
+    share ONE InProcStore and behave like N ranks. Barrier semantics are
+    client-stateless (wave counting), so one shared instance serves every
+    simulated rank.
+    """
+
+    def __init__(self, world_size: int = 1):
+        self.world_size = int(world_size)
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._kv: Dict[str, bytes] = {}
+        self._counters: Dict[str, int] = {}
+
+    def set(self, key: str, value) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        with self._cv:
+            self._kv[str(key)] = bytes(value)
+            self._cv.notify_all()
+
+    def get(self, key: str, *, blocking: bool = True,
+            timeout_s: float = 60.0) -> Optional[bytes]:
+        key = str(key)
+        deadline = time.monotonic() + float(timeout_s)
+        with self._cv:
+            while key not in self._kv:
+                if not blocking:
+                    return None
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(f"InProcStore.get({key!r}) timed out")
+                self._cv.wait(remaining)
+            return self._kv[key]
+
+    def add(self, key: str, delta: int = 1) -> int:
+        with self._cv:
+            v = self._counters.get(str(key), 0) + int(delta)
+            self._counters[str(key)] = v
+            self._kv[str(key)] = str(v).encode()
+            self._cv.notify_all()
+            return v
+
+    def wait_ge(self, key: str, target: int, *,
+                timeout_s: float = 60.0) -> int:
+        key = str(key)
+        deadline = time.monotonic() + float(timeout_s)
+        with self._cv:
+            while self._counters.get(key, 0) < int(target):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"InProcStore.wait_ge({key!r}, {target}) timed out at "
+                        f"{self._counters.get(key, 0)}")
+                self._cv.wait(remaining)
+            return self._counters[key]
+
+    def delete(self, key: str) -> None:
+        with self._cv:
+            self._kv.pop(str(key), None)
+            self._counters.pop(str(key), None)
+
+    def num_keys(self) -> int:
+        with self._lock:
+            return len(self._kv)
+
+    def barrier(self, name: str = "default",
+                world_size: Optional[int] = None) -> None:
+        """Rendezvous of `world_size` callers. Client-stateless generation
+        tracking: the n-th arrival belongs to wave ceil(n/world) and waits
+        for that wave to fill, so a reused name re-rendezvouses correctly
+        no matter which thread calls through which reference."""
+        world = int(world_size or self.world_size)
+        n = self.add(f"/barrier/{name}", 1)
+        wave = (n + world - 1) // world
+        self.wait_ge(f"/barrier/{name}", world * wave)
+
+    def close(self) -> None:  # API parity with native.TCPStore
+        pass
+
+
+_store = None
+_store_lock = threading.Lock()
+
+
+def get_store(world_size: Optional[int] = None, *, timeout_s: float = 60.0):
+    """Process-group KV store, resolved once per process.
+
+    Multi-host (PADDLE_MASTER set, world > 1, native lib built): the native
+    TCPStore — rank 0 hosts the server on the master endpoint, everyone
+    connects. Otherwise a process-local InProcStore singleton, which N
+    threads can share to simulate N ranks (tests, single-host runs).
+    """
+    global _store
+    with _store_lock:
+        if _store is not None:
+            return _store
+        world = int(world_size if world_size is not None
+                    else get_world_size())
+        master = os.environ.get("PADDLE_MASTER", "")
+        if world > 1 and master and ":" in master:
+            from .. import native
+
+            if native.available():
+                host, _, port = master.rpartition(":")
+                _store = native.TCPStore(
+                    host, int(port), is_master=(get_rank() == 0),
+                    world_size=world, timeout_s=timeout_s)
+                return _store
+        _store = InProcStore(world_size=world)
+        return _store
+
+
+def reset_store() -> None:
+    """Drop the cached store (tests / re-init after env changes)."""
+    global _store
+    with _store_lock:
+        if _store is not None:
+            try:
+                _store.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        _store = None
 
 
 class ParallelEnv:

@@ -327,6 +327,12 @@ class TrainStep:
                 _telemetry.get_telemetry().event(
                     "recompile" if recompile else "compile",
                     what="train_step", seconds=round(dt, 4), aot=True)
+                # XLA's own accounting of what we just built: compiled
+                # peak/temp/code bytes, flops, bytes-accessed (memory.py
+                # gauges + `executable` event; never raises)
+                from ..observability import memory as _memory
+
+                _memory.note_executable("train_step", self._aot)
         return self._aot(*args)
 
     def _check_dp_batch(self, batch_vals):

@@ -1,0 +1,322 @@
+"""Online anomaly detection over per-step telemetry.
+
+Rolling-window detectors consume the SAME step records telemetry already
+assembles (no second instrumentation path) and turn "the run started
+degrading at step 4017" from a post-hoc grep into a live, structured
+`anomaly` event — with the flight recorder dumped at the moment of
+detection, the anomaly attached, so the black box covers the steps that
+LED INTO the regression (the Gemma-on-TPU production stance: step-time and
+loss distributions are first-class signals, not log archaeology).
+
+Five detectors, all O(window) per step, all host-side (nothing touches
+the compiled program):
+
+  * loss_spike        — loss z-score over a rolling window (robust floor on
+                        sigma so flat-loss phases don't divide by ~0);
+  * grad_norm_spike   — same statistic over the pre-clip global grad-norm;
+  * step_time_regression — step wall time > ratio x rolling median for
+                        `patience` consecutive steps (excludes compile
+                        steps via the record's own compile events);
+  * throughput_collapse — tokens/s (or samples/s) < collapse_frac x rolling
+                        median for `patience` consecutive steps;
+  * compile_cache_collapse — the compile-cache miss counter moving on
+                        `patience` consecutive steps: a recompile storm
+                        (hit-rate collapse) in steady state.
+
+Detectors only fire once warm (min_points) and re-arm after `cooldown`
+steps, so one bad phase produces one anomaly + one dump, not a dump per
+step. Everything is inert unless FLAGS_metrics=on AND FLAGS_anomaly=on
+(ResilientTrainer checks both before constructing an engine).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from . import flight_recorder, telemetry
+from .registry import counter, metrics_enabled
+from ..core.flags import define_flag, get_flag
+
+define_flag(
+    "anomaly", "off",
+    "Online anomaly engine over per-step telemetry: 'on' runs the rolling "
+    "detectors (loss/grad-norm spike, step-time regression, throughput "
+    "collapse, compile-cache collapse) inside ResilientTrainer and dumps "
+    "the flight recorder when one fires. Needs FLAGS_metrics=on.")
+
+_ANOMALIES = counter("anomaly_events_total",
+                     "Anomalies detected by the online engine, by kind.",
+                     labelnames=("kind",))
+
+_TRUE = ("1", "on", "true", "yes")
+
+
+def anomaly_enabled() -> bool:
+    return metrics_enabled() and str(get_flag("anomaly")).lower() in _TRUE
+
+
+class RollingDetector:
+    """Base: keeps a bounded window of a scalar field; subclasses decide."""
+
+    kind = "anomaly"
+    field = "loss"
+
+    def __init__(self, window: int = 32, min_points: int = 8,
+                 cooldown: int = 25):
+        self.window = deque(maxlen=int(window))
+        self.min_points = int(min_points)
+        self.cooldown = int(cooldown)
+        self._cooldown_until = -1
+
+    def value(self, rec: Dict[str, Any]) -> Optional[float]:
+        v = rec.get(self.field)
+        try:
+            return float(v) if v is not None else None
+        except (TypeError, ValueError):
+            return None
+
+    def check(self, v: float, rec: Dict[str, Any]) -> Optional[Dict]:
+        raise NotImplementedError
+
+    def observe(self, rec: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        v = self.value(rec)
+        if v is None:
+            return None
+        step = int(rec.get("step", -1))
+        out = None
+        if len(self.window) >= self.min_points and \
+                step > self._cooldown_until:
+            out = self.check(v, rec)
+            if out is not None:
+                self._cooldown_until = step + self.cooldown
+                out.setdefault("kind", self.kind)
+                out.setdefault("field", self.field)
+                out["step"] = step
+                out["value"] = round(v, 6)
+        self.window.append(v)
+        return out
+
+
+class _ZSpike(RollingDetector):
+    """value > mean + z*sigma AND > factor*mean: both a statistical outlier
+    and materially larger (sigma floors keep flat phases from firing)."""
+
+    z = 6.0
+    factor = 1.5
+
+    def check(self, v, rec):
+        vals = list(self.window)
+        n = len(vals)
+        mean = sum(vals) / n
+        var = sum((x - mean) ** 2 for x in vals) / n
+        sigma = max(var ** 0.5, abs(mean) * 0.02, 1e-12)
+        if v > mean + self.z * sigma and v > self.factor * abs(mean):
+            return {"mean": round(mean, 6), "sigma": round(sigma, 6),
+                    "zscore": round((v - mean) / sigma, 3)}
+        return None
+
+
+class LossSpike(_ZSpike):
+    kind = "loss_spike"
+    field = "loss"
+
+
+class GradNormSpike(_ZSpike):
+    kind = "grad_norm_spike"
+    field = "grad_norm"
+
+
+class _SustainedRatio(RollingDetector):
+    """value vs rolling-median ratio crossing a bound for `patience`
+    consecutive steps (single hiccups — a GC pause, one slow batch — are
+    not regressions)."""
+
+    ratio = 2.0
+    patience = 3
+    direction = "above"  # or "below"
+
+    def __init__(self, window: int = 32, min_points: int = 8,
+                 cooldown: int = 25, patience: Optional[int] = None):
+        super().__init__(window, min_points, cooldown)
+        if patience is not None:
+            self.patience = int(patience)
+        self._streak = 0
+
+    def _median(self) -> float:
+        s = sorted(self.window)
+        n = len(s)
+        return (s[n // 2] if n % 2 else (s[n // 2 - 1] + s[n // 2]) / 2.0)
+
+    def check(self, v, rec):
+        med = self._median()
+        if med <= 0:
+            return None
+        r = v / med
+        bad = r > self.ratio if self.direction == "above" \
+            else r < self.ratio
+        if not bad:
+            self._streak = 0
+            return None
+        self._streak += 1
+        if self._streak < self.patience:
+            return None
+        self._streak = 0
+        return {"median": round(med, 6), "ratio": round(r, 3),
+                "patience": self.patience}
+
+
+class StepTimeRegression(_SustainedRatio):
+    kind = "step_time_regression"
+    field = "step_wall_s"
+    ratio = 2.0
+    direction = "above"
+
+
+class ThroughputCollapse(_SustainedRatio):
+    kind = "throughput_collapse"
+    field = "tokens_per_s"
+    ratio = 0.5
+    direction = "below"
+
+    def value(self, rec):
+        v = rec.get("tokens_per_s", rec.get("samples_per_s"))
+        try:
+            return float(v) if v is not None else None
+        except (TypeError, ValueError):
+            return None
+
+
+class CompileCacheCollapse(RollingDetector):
+    """Compile-cache hit-rate collapse = a recompile storm: the cumulative
+    miss counter advancing on `patience` consecutive steps. In steady state
+    no step compiles at all, so ANY sustained miss motion is anomalous."""
+
+    kind = "compile_cache_collapse"
+    field = "compile_cache"
+    patience = 3
+
+    def __init__(self, window: int = 32, min_points: int = 2,
+                 cooldown: int = 25, patience: int = 3):
+        super().__init__(window, min_points, cooldown)
+        self.patience = int(patience)
+        self._last_misses: Optional[float] = None
+        self._streak = 0
+
+    def value(self, rec):
+        cc = rec.get("compile_cache")
+        if not isinstance(cc, dict):
+            return None
+        try:
+            return float(cc.get("misses", 0))
+        except (TypeError, ValueError):
+            return None
+
+    def check(self, v, rec):
+        last, self._last_misses = self._last_misses, v
+        if last is None or v <= last:
+            self._streak = 0
+            return None
+        self._streak += 1
+        if self._streak < self.patience:
+            return None
+        self._streak = 0
+        hits = 0.0
+        cc = rec.get("compile_cache") or {}
+        try:
+            hits = float(cc.get("hits", 0))
+        except (TypeError, ValueError):
+            pass
+        total = hits + v
+        return {"misses": v, "patience": self.patience,
+                "hit_rate": round(hits / total, 4) if total else 0.0}
+
+    def observe(self, rec):  # misses delta needs every step, warm or not
+        v = self.value(rec)
+        if v is None:
+            return None
+        step = int(rec.get("step", -1))
+        if self._last_misses is None:
+            self._last_misses = v
+            self.window.append(v)
+            return None
+        out = None
+        if step > self._cooldown_until:
+            out = self.check(v, rec)
+            if out is not None:
+                self._cooldown_until = step + self.cooldown
+                out.setdefault("kind", self.kind)
+                out["step"] = step
+                out["value"] = v
+        else:
+            self._last_misses = v
+        self.window.append(v)
+        return out
+
+
+def default_detectors(**kw) -> List[RollingDetector]:
+    return [LossSpike(**kw), GradNormSpike(**kw), StepTimeRegression(**kw),
+            ThroughputCollapse(**kw), CompileCacheCollapse()]
+
+
+class AnomalyEngine:
+    """Feeds step records through every detector; on a hit emits the
+    structured `anomaly` event (JSONL + Prometheus counter + flight-recorder
+    note) and — unless disarmed — dumps the flight recorder with the anomaly
+    attached. One engine per training loop; thread-safe for the
+    serve.py health endpoint reading `recent()`."""
+
+    def __init__(self, detectors: Optional[List[RollingDetector]] = None,
+                 *, dump: bool = True, dump_cooldown_steps: int = 50):
+        self.detectors = (default_detectors() if detectors is None
+                          else list(detectors))
+        self.dump = bool(dump)
+        self.dump_cooldown_steps = int(dump_cooldown_steps)
+        self._dump_armed_at = -1
+        self._lock = threading.Lock()
+        self._recent: deque = deque(maxlen=64)
+        self.dumps: List[str] = []
+
+    def observe(self, record: Dict[str, Any]) -> List[Dict[str, Any]]:
+        """Run every detector over one step record; returns anomalies."""
+        found = []
+        for d in self.detectors:
+            try:
+                ev = d.observe(record)
+            except Exception:  # noqa: BLE001 — detection never kills a run
+                continue
+            if ev is not None:
+                found.append(ev)
+        for ev in found:
+            self._emit(ev)
+        return found
+
+    def _emit(self, ev: Dict[str, Any]) -> None:
+        ev = dict(ev, ts=time.time())
+        with self._lock:
+            self._recent.append(ev)
+        _ANOMALIES.inc(kind=ev["kind"])
+        telemetry.get_telemetry().event(
+            "anomaly", anomaly_kind=ev["kind"],
+            **{k: v for k, v in ev.items() if k not in ("ts", "kind")})
+        flight_recorder.note_anomaly(ev)
+        step = int(ev.get("step", -1))
+        if self.dump and step > self._dump_armed_at:
+            self._dump_armed_at = step + self.dump_cooldown_steps
+            try:
+                path = flight_recorder.get_flight_recorder().dump(
+                    f"anomaly_{ev['kind']}", extra={"anomaly": ev})
+                self.dumps.append(path)
+            except OSError:
+                pass
+
+    def recent(self, n: int = 16) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._recent)[-int(n):]
+
+
+def from_flags(**kw) -> Optional[AnomalyEngine]:
+    """An engine when FLAGS_metrics=on and FLAGS_anomaly=on, else None —
+    the one-liner ResilientTrainer.run uses."""
+    return AnomalyEngine(**kw) if anomaly_enabled() else None

@@ -16,6 +16,7 @@ metrics dir is set). The whole module is inert while FLAGS_metrics is off.
 """
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import tempfile
@@ -42,6 +43,35 @@ _DUMPS = counter("flight_recorder_dumps_total",
 
 _EVENT_RING = 256
 _SPAN_TAIL = 200
+_ANOMALY_RING = 32
+
+# two triggers inside one second used to collide on the timestamped dump
+# filename (the later os.replace silently overwrote the earlier dump);
+# a process-wide monotonic sequence makes every dump name unique
+_DUMP_SEQ = itertools.count()
+
+# last cluster view published by observability/cluster.py (rank 0 only);
+# module-level so it survives FlightRecorder reset() between run()s
+_cluster_snapshot: Optional[Dict[str, Any]] = None
+_cluster_lock = threading.Lock()
+
+
+def set_cluster_snapshot(snapshot: Dict[str, Any]) -> None:
+    """Latest cluster aggregation/straggler view, embedded in every dump."""
+    global _cluster_snapshot
+    with _cluster_lock:
+        _cluster_snapshot = snapshot
+
+
+def cluster_snapshot() -> Optional[Dict[str, Any]]:
+    with _cluster_lock:
+        return _cluster_snapshot
+
+
+def note_anomaly(event: Dict[str, Any]) -> None:
+    """Record one anomaly event into the recorder's bounded anomaly ring
+    (anomaly.AnomalyEngine calls this on every detection, dump or not)."""
+    get_flight_recorder().record_anomaly(event)
 
 
 class FlightRecorder:
@@ -54,6 +84,7 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._steps: deque = deque(maxlen=capacity)
         self._events: deque = deque(maxlen=_EVENT_RING)
+        self._anomalies: deque = deque(maxlen=_ANOMALY_RING)
         self._dump_count = 0
 
     # -- feeding -----------------------------------------------------------
@@ -71,6 +102,12 @@ class FlightRecorder:
         with self._lock:
             self._events.append(ev)
 
+    def record_anomaly(self, event: Dict[str, Any]) -> None:
+        """Push one anomaly event into the bounded anomaly ring; the last
+        K of these ride along in every subsequent dump."""
+        with self._lock:
+            self._anomalies.append(dict(event))
+
     # -- reading -----------------------------------------------------------
     def steps(self) -> List[Dict[str, Any]]:
         with self._lock:
@@ -79,6 +116,10 @@ class FlightRecorder:
     def events(self) -> List[Dict[str, Any]]:
         with self._lock:
             return list(self._events)
+
+    def anomalies(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._anomalies)
 
     # -- dumping -----------------------------------------------------------
     def _dump_dir(self, directory: Optional[str]) -> str:
@@ -90,13 +131,17 @@ class FlightRecorder:
         return os.path.abspath("flight_recorder")
 
     def dump(self, reason: str, exc: Optional[BaseException] = None,
-             directory: Optional[str] = None) -> str:
-        """Write the black box to disk atomically; returns the dump path."""
+             directory: Optional[str] = None,
+             extra: Optional[Dict[str, Any]] = None) -> str:
+        """Write the black box to disk atomically; returns the dump path.
+        `extra` keys are merged into the payload (e.g. the anomaly engine
+        attaches the triggering anomaly under "anomaly")."""
         with self._lock:
             self._dump_count += 1
             n = self._dump_count
             steps = list(self._steps)
             events = list(self._events)
+            anomalies = list(self._anomalies)
         payload: Dict[str, Any] = {
             "kind": "flight_recorder_dump",
             "reason": str(reason),
@@ -106,9 +151,16 @@ class FlightRecorder:
             "capacity": self.capacity,
             "steps": steps,
             "events": events,
+            "anomalies": anomalies,
             "spans": spans.tail(_SPAN_TAIL),
             "metrics": default_registry().snapshot(),
         }
+        cluster = cluster_snapshot()
+        if cluster is not None:
+            payload["cluster"] = cluster
+        if extra:
+            for k, v in extra.items():
+                payload.setdefault(k, v)
         if exc is not None:
             payload["exception"] = {
                 "type": type(exc).__name__,
@@ -120,9 +172,13 @@ class FlightRecorder:
         os.makedirs(d, exist_ok=True)
         safe = "".join(c if c.isalnum() or c in "-_" else "_"
                        for c in str(reason))[:48]
+        # the per-instance count n resets with the recorder; the process-wide
+        # _DUMP_SEQ does not — two triggers in the same second (or across a
+        # recorder reset) can never collide on the name
+        seq = next(_DUMP_SEQ)
         path = os.path.join(
             d, f"flight_{time.strftime('%Y%m%d_%H%M%S')}_{os.getpid()}"
-               f"_{n:03d}_{safe}.json")
+               f"_{n:03d}_{seq:04d}_{safe}.json")
         fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
         try:
             with os.fdopen(fd, "w", encoding="utf-8") as f:
@@ -154,9 +210,11 @@ def get_flight_recorder() -> FlightRecorder:
 
 def reset() -> None:
     """Drop the singleton (tests; also re-reads FLAGS_flight_recorder_steps)."""
-    global _recorder
+    global _recorder, _cluster_snapshot
     with _recorder_lock:
         _recorder = None
+    with _cluster_lock:
+        _cluster_snapshot = None
 
 
 # -- runtime trigger hooks (called by jit/, resilience/) --------------------

@@ -64,6 +64,8 @@ _COMPILES = counter("training_compile_events_total",
 
 _PROM_EVERY = 50  # steps between Prometheus textfile rewrites (finalize()
                   # always writes one, so short runs still get a file)
+_MEM_EVERY = 20   # steps between device/host memory-gauge refreshes (one
+                  # C call per device + two procfs reads; see memory.py)
 
 
 def enabled() -> bool:
@@ -224,7 +226,21 @@ class StepTelemetry:
             self._staged = rec
             self._last = rec
         flight_recorder.get_flight_recorder().record_step(rec)
+        if rec["step"] % _MEM_EVERY == 0:
+            try:
+                from . import memory as _memory
+
+                _memory.update_memory_gauges()
+            except Exception:  # noqa: BLE001 — gauges must not break steps
+                pass
         return rec
+
+    def last_record(self) -> Optional[Dict[str, Any]]:
+        """The most recent staged step record (what the anomaly engine and
+        cluster publisher read right after TrainStep returns). Late phase
+        merges (save) mutate this dict in place."""
+        with self._lock:
+            return self._last or None
 
     def event(self, kind: str, **data) -> None:
         """Irregular event (compile, recompile, preemption...): written to

@@ -51,6 +51,31 @@ _SAVES = _obs_counter(
     "failed = the write raised before the commit point.",
     labelnames=("outcome",))
 
+_SYNC_COMMITS = _obs_counter(
+    "cluster_ckpt_commits_total",
+    "Multi-host synchronized checkpoint commits, by this rank's role "
+    "(leader = rank 0 performed the atomic rename after all ranks reported "
+    "ready; follower = waited for the leader's committed marker).",
+    labelnames=("role",))
+
+_CKPT_KEY_PREFIX = "/pt/ckpt"
+
+
+def _store_wait_ge(store, key: str, target: int, timeout_s: float) -> int:
+    """wait_ge across store flavors: InProcStore takes timeout_s, the native
+    TCPStore client carries its own socket timeout."""
+    try:
+        return store.wait_ge(key, target, timeout_s=timeout_s)
+    except TypeError:
+        return store.wait_ge(key, target)
+
+
+def _store_get(store, key: str, timeout_s: float):
+    try:
+        return store.get(key, blocking=True, timeout_s=timeout_s)
+    except TypeError:
+        return store.get(key, blocking=True)
+
 __all__ = ["CheckpointManager", "CheckpointCorrupt", "RestoredCheckpoint"]
 
 MANIFEST = "manifest.json"
@@ -165,10 +190,22 @@ class CheckpointManager:
         backend: "npy" (self-contained raw-array files + crc32 checksums) or
             "orbax" (sharded multi-host payload via distributed/checkpoint.py,
             wrapped in this manager's commit protocol).
+        store / rank / world_size: process-group KV store (distributed/env
+            get_store()) enabling the synchronized multi-host commit: every
+            rank reports ready for `step`, rank 0 performs the atomic rename
+            only once all ranks have, then publishes the committed marker the
+            followers wait on. With replicated params the followers write no
+            payload of their own — their save() IS the barrier — so no rank
+            can observe (or GC against) a checkpoint some other rank hasn't
+            finished with. Single-process default (world_size=1) bypasses
+            all of it.
+        sync_timeout_s: barrier wait bound; a rank missing past it raises
+            rather than committing a checkpoint the cluster disagrees on.
     """
 
     def __init__(self, root: str, keep_last_n: int = 3, backend: str = "npy",
-                 async_save: bool = False):
+                 async_save: bool = False, store=None, rank: int = 0,
+                 world_size: int = 1, sync_timeout_s: float = 60.0):
         if backend not in ("npy", "orbax"):
             raise ValueError(f"unknown checkpoint backend {backend!r}")
         self.root = os.path.abspath(root)
@@ -181,6 +218,10 @@ class CheckpointManager:
         # and re-raises any write error. Commit order is preserved: at most
         # one save is in flight.
         self.async_save = bool(async_save)
+        self.store = store
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        self.sync_timeout_s = float(sync_timeout_s)
         self._thread: Optional[Any] = None
         self._error: Optional[BaseException] = None
         self.last_scan_report: List[Tuple[str, str]] = []  # (path, reason)
@@ -225,6 +266,8 @@ class CheckpointManager:
         if asynchronous is None:
             asynchronous = self.async_save
         self.wait()  # one in-flight save at a time; ordered commits
+        if self._sync_enabled and self.rank != 0:
+            return self._follower_commit(step)
         if self.backend == "orbax" or not asynchronous:
             return self._save_now(step, state, meta)
         leaves: List[np.ndarray] = []
@@ -248,6 +291,48 @@ class CheckpointManager:
             target=_worker, name="ckpt-save", daemon=True)
         self._thread.start()
         return self._dir_for(step)
+
+    # -- synchronized multi-host commit -------------------------------------
+    @property
+    def _sync_enabled(self) -> bool:
+        return self.store is not None and self.world_size > 1
+
+    def _ckpt_key(self, step: int) -> str:
+        return f"{_CKPT_KEY_PREFIX}/{int(step)}"
+
+    def _follower_commit(self, step: int) -> str:
+        """Non-leader rank's save(): report ready, wait for rank 0's commit
+        marker. Returns the committed path rank 0 published."""
+        key = self._ckpt_key(step)
+        with _span("cluster.ckpt_commit", cat="cluster",
+                   args={"step": int(step), "role": "follower"}):
+            self.store.add(key + "/ready", 1)
+            committed = _store_get(self.store, key + "/committed",
+                                   self.sync_timeout_s)
+        if committed is None:
+            raise TimeoutError(
+                f"rank {self.rank}: no committed marker for step {step} "
+                f"within {self.sync_timeout_s}s")
+        _SYNC_COMMITS.inc(role="follower")
+        return committed.decode() if isinstance(committed, bytes) \
+            else str(committed)
+
+    def _leader_barrier(self, step: int) -> None:
+        """Rank 0, immediately before the commit rename: wait until every
+        rank (self included) has reported ready for `step`."""
+        key = self._ckpt_key(step)
+        self.store.add(key + "/ready", 1)
+        got = _store_wait_ge(self.store, key + "/ready", self.world_size,
+                             self.sync_timeout_s)
+        if got < self.world_size:
+            raise TimeoutError(
+                f"ckpt commit barrier for step {step}: only {got}/"
+                f"{self.world_size} ranks ready after {self.sync_timeout_s}s")
+
+    def _leader_publish(self, step: int, final: str) -> None:
+        """Rank 0, after the rename landed: release the followers."""
+        self.store.set(self._ckpt_key(step) + "/committed", final)
+        _SYNC_COMMITS.inc(role="leader")
 
     def wait(self):
         """Block until the in-flight async save (if any) commits; re-raise
@@ -337,6 +422,20 @@ class CheckpointManager:
         _fsync_dir(tmp)
 
         chaos.crash_point("ckpt.before_commit")
+        if self._sync_enabled:
+            with _span("cluster.ckpt_commit", cat="cluster",
+                       args={"step": int(step), "role": "leader"}):
+                self._leader_barrier(step)
+                self._commit_rename(step, tmp, final)
+                self._leader_publish(step, final)
+        else:
+            self._commit_rename(step, tmp, final)
+
+        chaos.crash_point("ckpt.before_gc")
+        self._gc()
+        return final
+
+    def _commit_rename(self, step: int, tmp: str, final: str) -> None:
         with _span("ckpt.commit", cat="io", args={"step": int(step)}):
             if os.path.exists(final):  # same-step re-save: replace atomically
                 old = final + ".replaced"
@@ -348,10 +447,6 @@ class CheckpointManager:
             else:
                 os.rename(tmp, final)  # <- the commit point
             _fsync_dir(self.root)
-
-        chaos.crash_point("ckpt.before_gc")
-        self._gc()
-        return final
 
     # -- GC ----------------------------------------------------------------
     def _gc(self):

@@ -20,7 +20,9 @@ import numpy as np
 from . import chaos
 from .checkpoint_manager import CheckpointManager
 from .preemption import PreemptionHandler
+from ..observability import anomaly as _anomaly
 from ..observability import flight_recorder as _flight
+from ..observability import serve as _serve
 from ..observability import telemetry as _telemetry
 
 __all__ = ["ResilientTrainer"]
@@ -73,6 +75,11 @@ class ResilientTrainer:
         nan_guard: compile the NaN/Inf step-guard into the train step.
         backoff: optional amp.LossScaleBackoff (or any object with
             on_step(skipped: bool)) fed the guard verdict every step.
+        anomaly_engine: observability.AnomalyEngine fed each completed step
+            record; built from flags (FLAGS_anomaly) when None.
+        cluster: observability.ClusterTelemetry — when set, every step
+            record is published through the process-group store for rank-0
+            aggregation + straggler detection.
         step_kwargs: extra TrainStep kwargs (shardings, mesh, donate).
     """
 
@@ -82,6 +89,8 @@ class ResilientTrainer:
                  preemption: Optional[PreemptionHandler] = None,
                  nan_guard: bool = True,
                  backoff=None,
+                 anomaly_engine=None,
+                 cluster=None,
                  **step_kwargs):
         from ..jit.trainer import TrainStep
 
@@ -95,6 +104,8 @@ class ResilientTrainer:
         self.save_every = int(save_every)
         self.preemption = preemption
         self.backoff = backoff
+        self.anomaly_engine = anomaly_engine
+        self.cluster = cluster
         self._epoch = 0
         self._offset = 0  # batches consumed in the current epoch
         self.resumed_from: Optional[int] = None
@@ -183,6 +194,12 @@ class ResilientTrainer:
         # compiled step can't see — host data wait before the step, blocking
         # checkpoint time after it
         tele = _telemetry.get_telemetry() if _telemetry.enabled() else None
+        if tele is not None:
+            if self.anomaly_engine is None:
+                self.anomaly_engine = _anomaly.from_flags()
+            if self.anomaly_engine is not None:
+                _serve.set_health_engine(self.anomaly_engine)
+            _serve.maybe_start_from_flags()
         try:
             while self._epoch < epochs:
                 it = iter(batches() if callable(batches) else batches)
@@ -212,6 +229,13 @@ class ResilientTrainer:
                     report["last_loss"] = float(np.asarray(loss.numpy()))
                     if self.backoff is not None:
                         self.backoff.on_step(self.step.last_skipped)
+                    if tele is not None:
+                        rec = tele.last_record()
+                        if rec is not None:
+                            if self.anomaly_engine is not None:
+                                self.anomaly_engine.observe(rec)
+                            if self.cluster is not None:
+                                self.cluster.publish(rec)
                     self._offset = i + 1
                     if self.save_every and \
                             self.step._step_i % self.save_every == 0:

@@ -189,7 +189,7 @@ class TestBucketedAllReduce:
         paddle.seed(0)
         model = nn.Linear(2, 2)
         opt = optimizer.SGD(0.1, parameters=model.parameters())
-        with pytest.raises(ValueError, match="mesh with that axis"):
+        with pytest.raises(ValueError, match="not an axis of the active mesh"):
             TrainStep(model, lambda a: model(a).sum(), opt, dp_axis="nope",
                       mesh=mesh8)
         with pytest.raises(ValueError, match="in_shardings"):

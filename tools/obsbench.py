@@ -1,6 +1,6 @@
-"""Observability benchmark + gate (ISSUE r9).
+"""Observability benchmark + gate (ISSUE r9, extended r10).
 
-Three checks, all CPU-safe:
+Five checks, all CPU-safe:
 
   * overhead — steps/s of an identical TrainStep loop with FLAGS_metrics on
                vs off; the acceptance bar is ON within OVERHEAD_TOLERANCE
@@ -14,11 +14,19 @@ Three checks, all CPU-safe:
                per-step phase timings, and the Prometheus textfile must
                round-trip through parse_prometheus_text with the autotune
                and compile-cache counters present.
+  * straggler — 4 simulated ranks (threads over an InProcStore) publish
+               through ClusterTelemetry; one rank's compute phase is delayed
+               3x mid-run and must be flagged within M+2 steps of the
+               injection — and never before it.
+  * anomaly  — steady synthetic telemetry through the AnomalyEngine must
+               stay silent; an injected loss spike must produce exactly one
+               anomaly-tagged flight dump that parses with the anomaly and
+               the step ring inside.
 
-Writes one JSON artifact (default OBSBENCH_r09.json at the repo root) and
+Writes one JSON artifact (default OBSBENCH_r10.json at the repo root) and
 exits nonzero when any check fails, so the verify pipeline can gate on it.
 
-Usage: python tools/obsbench.py [--steps N] [--out OBSBENCH_r09.json]
+Usage: python tools/obsbench.py [--steps N] [--out OBSBENCH_r10.json]
 """
 import argparse
 import json
@@ -81,7 +89,7 @@ def child_overhead(metrics_on: bool, steps: int) -> int:
     return 0
 
 
-def bench_overhead(steps: int, repeats: int = 2) -> dict:
+def bench_overhead(steps: int, repeats: int = 3) -> dict:
     """Best-of-`repeats` per mode, modes interleaved so slow host drift hits
     both equally; best-of is the standard noise-rejecting statistic for a
     fixed workload."""
@@ -214,10 +222,133 @@ def bench_flight_and_sinks(steps: int) -> dict:
         reset_all()
 
 
+# --------------------------------------------------------------------------
+# straggler half (r10): 4 thread-ranks over an InProcStore, one delayed
+# --------------------------------------------------------------------------
+
+def bench_straggler(world: int = 4, steps: int = 12, inject_at: int = 5,
+                    victim: int = 2) -> dict:
+    import threading
+
+    import tools.cpu_force  # noqa: F401
+
+    from paddle_tpu.core import flags
+    from paddle_tpu.distributed.env import InProcStore
+    from paddle_tpu.observability import reset_all
+    from paddle_tpu.observability.cluster import ClusterTelemetry
+
+    reset_all()
+    flags.set_flags({"metrics": "on"})
+    try:
+        store = InProcStore()
+        m = 3
+        cts = [ClusterTelemetry(store, r, world, k=2.0, m=m, timeout_s=30.0)
+               for r in range(world)]
+        base, slow = 0.01, 0.05
+
+        def run_rank(r):
+            for s in range(steps):
+                compute = slow if (r == victim and s >= inject_at) else base
+                cts[r].publish({
+                    "step": s, "loss": 1.0 + 0.01 * s,
+                    "step_wall_s": compute + 0.002,
+                    "phases": {"data": 0.001, "compute": compute,
+                               "reduce": 0.0, "save": 0.0},
+                })
+
+        threads = [threading.Thread(target=run_rank, args=(r,))
+                   for r in range(1, world)]
+        for t in threads:
+            t.start()
+        run_rank(0)  # rank 0 aggregates inline; blocking gets pace the run
+        for t in threads:
+            t.join(timeout=60)
+
+        events = cts[0].straggler_events
+        first_flag = min((e["step"] for e in events
+                          if e["rank"] == victim), default=None)
+        wrong = [e for e in events if e["rank"] != victim]
+        return {
+            "world": world, "steps": steps, "inject_at": inject_at,
+            "victim": victim, "m": m,
+            "aggregated": len(cts[0].aggregates),
+            "straggler_events": len(events),
+            "first_flag_step": first_flag,
+            "false_flags": len(wrong),
+            # gate: flagged within M+2 of injection (the detector needs M
+            # consecutive steps by construction), never before, no one else
+            "ok": (len(cts[0].aggregates) == steps
+                   and first_flag is not None
+                   and inject_at + m - 1 <= first_flag <= inject_at + m + 2
+                   and not wrong),
+        }
+    finally:
+        flags.set_flags({"metrics": "off"})
+        reset_all()
+
+
+# --------------------------------------------------------------------------
+# anomaly half (r10): steady telemetry silent; loss spike -> tagged dump
+# --------------------------------------------------------------------------
+
+def bench_anomaly_dump() -> dict:
+    import glob
+
+    import tools.cpu_force  # noqa: F401
+
+    from paddle_tpu.core import flags
+    from paddle_tpu.observability import reset_all
+    from paddle_tpu.observability.anomaly import AnomalyEngine
+
+    mdir = tempfile.mkdtemp(prefix="ob_anom_")
+    reset_all()
+    flags.set_flags({"metrics": "on", "metrics_dir": mdir,
+                     "anomaly": "on"})
+    try:
+        def rec(step, loss):
+            return {"step": step, "loss": loss, "grad_norm": 1.0,
+                    "step_wall_s": 0.01, "tokens_per_s": 1000.0,
+                    "phases": {"compute": 0.01}}
+
+        engine = AnomalyEngine()
+        steady = 0
+        for s in range(20):
+            steady += len(engine.observe(rec(s, 2.0 + 0.001 * s)))
+        spiked = engine.observe(rec(20, 50.0))  # 25x the steady loss
+
+        dumps = glob.glob(os.path.join(mdir, "flight", "*.json"))
+        result = {
+            "steady_anomalies": steady,
+            "spike_kinds": [e["kind"] for e in spiked],
+            "dumps": len(dumps),
+        }
+        dump_ok = False
+        if dumps:
+            with open(dumps[0]) as f:
+                payload = json.load(f)  # a torn file raises here
+            anomaly = payload.get("anomaly") or {}
+            result["dump_reason"] = payload.get("reason")
+            result["dump_anomaly_kind"] = anomaly.get("kind")
+            dump_ok = (anomaly.get("kind") == "loss_spike"
+                       and anomaly.get("step") == 20
+                       and payload.get("anomalies")
+                       and not glob.glob(
+                           os.path.join(mdir, "flight", "*.tmp")))
+        result["ok"] = bool(steady == 0
+                            and any(e["kind"] == "loss_spike"
+                                    for e in spiked)
+                            and len(dumps) == 1 and dump_ok)
+        return result
+    finally:
+        flags.set_flags({"metrics": "off", "metrics_dir": "",
+                         "anomaly": "off"})
+        reset_all()
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=30)
-    ap.add_argument("--out", default=os.path.join(_REPO, "OBSBENCH_r09.json"))
+    ap.add_argument("--out", default=os.path.join(_REPO, "OBSBENCH_r10.json"))
     args = ap.parse_args()
 
     result = {"tool": "obsbench",
@@ -235,9 +366,31 @@ def main() -> int:
         result["flight_sinks"] = {"ok": False,
                                   "error": f"{type(e).__name__}: {e}"}
     log(json.dumps(result["flight_sinks"]))
+    log("--- straggler injection (4 thread-ranks)")
+    try:
+        result["straggler"] = bench_straggler()
+    except Exception as e:
+        import traceback
+
+        traceback.print_exc()
+        result["straggler"] = {"ok": False,
+                               "error": f"{type(e).__name__}: {e}"}
+    log(json.dumps(result["straggler"]))
+    log("--- anomaly engine (steady silence + loss-spike dump)")
+    try:
+        result["anomaly"] = bench_anomaly_dump()
+    except Exception as e:
+        import traceback
+
+        traceback.print_exc()
+        result["anomaly"] = {"ok": False,
+                             "error": f"{type(e).__name__}: {e}"}
+    log(json.dumps(result["anomaly"]))
 
     result["ok"] = bool(result["overhead"].get("ok")
-                        and result["flight_sinks"].get("ok"))
+                        and result["flight_sinks"].get("ok")
+                        and result["straggler"].get("ok")
+                        and result["anomaly"].get("ok"))
     with open(args.out, "w") as f:
         json.dump(result, f, indent=2)
     print(json.dumps(result), flush=True)

@@ -104,6 +104,15 @@ class CausalSelfAttention(nn.Layer):
                     "KV-cache decoding under sequence_parallel is not "
                     "supported; gather the sequence (sequence_parallel=None) "
                     "for generation")
+            if hasattr(cache, "block_table"):
+                # paged decode (serving engine): one query token per slot,
+                # KV scattered across fixed-size blocks; ragged per-slot
+                # lengths live in the cache view (ops paged_cached_attention)
+                out, new_k, new_v = api.paged_cached_attention(
+                    q, k, v, cache.k_pages, cache.v_pages,
+                    cache.block_table, cache.seq_lens)
+                out = api.reshape(out, [b, s, h])
+                return self.resid_dropout(self.out_proj(out)), (new_k, new_v)
             # decode path: static-shape KV ring updated in place, causal
             # masking against the absolute position (models/generation.py)
             out, new_k, new_v = api.cached_multihead_attention(
@@ -217,6 +226,25 @@ class GPTModel(nn.Layer):
             import jax.numpy as jnp
             from jax import lax
 
+            if hasattr(caches[0], "block_table"):
+                # paged decode: PER-SLOT positions (each slot is mid-way
+                # through its own sequence) ride the packed-rope / gathered
+                # wpe form instead of a scalar offset
+                pos_v = caches[0].seq_lens
+                pos_v = (pos_v._value if isinstance(pos_v, Tensor)
+                         else jnp.asarray(pos_v)).astype(jnp.int32)
+                if self.config.use_rotary:
+                    cos, sin = self._rope(
+                        self.config.max_position_embeddings)
+                    rope = (cos, sin, Tensor(pos_v[:, None]))
+                else:
+                    h = h + self.wpe(Tensor(pos_v[:, None]))
+                h = self.drop(h)
+                new_caches = []
+                for block, cache in zip(self.blocks, caches):
+                    h, nc = block(h, rope=rope, cache=cache, pos=None)
+                    new_caches.append(nc)
+                return self.ln_f(h), new_caches
             pos_v = pos._value if isinstance(pos, Tensor) else jnp.asarray(pos)
             pos_v = pos_v.astype(jnp.int32).reshape(())
             if self.config.use_rotary:

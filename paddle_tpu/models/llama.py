@@ -100,6 +100,14 @@ class LlamaAttention(nn.Layer):
         else:
             q, k = api.rotary_position_embedding(q, k, rope[0], rope[1])
         if cache is not None:
+            if hasattr(cache, "block_table"):
+                # paged decode (serving engine): KV in fixed-size blocks,
+                # ragged per-slot lengths; GQA pages keep unrepeated kv heads
+                out, new_k, new_v = api.paged_cached_attention(
+                    q, k, v, cache.k_pages, cache.v_pages,
+                    cache.block_table, cache.seq_lens)
+                out = api.reshape(out, [b, s, self.num_heads * self.head_dim])
+                return self.o_proj(out), (new_k, new_v)
             # GQA caches keep the UNREPEATED kv heads (HBM = kv_heads/d of
             # MHA); the cached op broadcasts per q-head group at compute time
             out, new_k, new_v = api.cached_multihead_attention(
@@ -180,6 +188,18 @@ class LlamaModel(nn.Layer):
                     "KV-cache decoding")
             from jax import lax
 
+            if hasattr(caches[0], "block_table"):
+                # paged decode: per-slot positions via the packed-rope form
+                pos_v = caches[0].seq_lens
+                pos_v = (pos_v._value if isinstance(pos_v, Tensor)
+                         else jnp.asarray(pos_v)).astype(jnp.int32)
+                rope = (self._rope[0], self._rope[1], Tensor(pos_v[:, None]))
+                h = self.embed_tokens(input_ids)
+                new_caches = []
+                for layer, cache in zip(self.layers, caches):
+                    h, nc = layer(h, rope, cache=cache, pos=None)
+                    new_caches.append(nc)
+                return self.norm(h), new_caches
             pos_v = pos._value if isinstance(pos, Tensor) else jnp.asarray(pos)
             pos_v = pos_v.astype(jnp.int32).reshape(())
             d = self._rope[0].shape[-1]

@@ -881,6 +881,58 @@ def cached_multihead_attention(q, k, v, k_cache, v_cache, pos, scale=None):
     return out, k_cache, v_cache
 
 
+def paged_cached_attention(q, k, v, k_pages, v_pages, block_table, seq_lens,
+                           scale=None):
+    """One decode step of attention over a PAGED KV cache (the serving
+    engine's per-step op; see paddle_tpu/serving/ and
+    ops/pallas/paged_attention.py).
+
+    Each slot's KV lives in fixed-size token blocks scattered across a
+    preallocated pool; block_table names them. This op (1) writes the step's
+    new K/V at each slot's next position (seq_lens tokens already present),
+    then (2) attends each slot's single query over its own ragged context —
+    Pallas kernel on TPU / interpret mode, XLA gather composition otherwise.
+
+    q: [slots, 1, q_heads, d]; k, v: [slots, 1, kv_heads, d];
+    k_pages, v_pages: [num_blocks, block_size, kv_heads, d];
+    block_table: [slots, max_blocks] int32; seq_lens: [slots] int32.
+    Returns (out [slots, 1, q_heads, d], k_pages, v_pages). Idle slots
+    (block tables full of the null page 0) write and read garbage there
+    harmlessly — the engine masks their sampled tokens.
+    """
+    slots, sq, hq, d = q.shape
+    if sq != 1:
+        raise ValueError("paged_cached_attention is decode-only (sq == 1); "
+                         "prefill runs the contiguous cached path")
+    bs = k_pages.shape[1]
+    seq_lens = jnp.asarray(seq_lens, jnp.int32).reshape(slots)
+    # KV append: one token per slot at (block_table[seq//bs], seq%bs)
+    page = jnp.take_along_axis(
+        block_table.astype(jnp.int32), (seq_lens // bs)[:, None], axis=1)[:, 0]
+    off = seq_lens % bs
+    k_pages = k_pages.at[page, off].set(k[:, 0].astype(k_pages.dtype))
+    v_pages = v_pages.at[page, off].set(v[:, 0].astype(v_pages.dtype))
+    ctx = seq_lens + 1  # the token just written attends to itself
+
+    from .. import pallas as _pallas
+    from ..pallas.paged_attention import (
+        paged_attention_tuned as _paged_kernel,
+        paged_attention_xla as _paged_xla,
+        supports as _paged_supports,
+    )
+
+    q2 = q[:, 0]
+    kernel_ok = _paged_supports(q2.shape, k_pages.shape)
+    if kernel_ok and _pallas.interpret_mode():
+        out = _paged_kernel(q2, k_pages, v_pages, block_table, ctx, scale,
+                            interpret=True)
+    elif kernel_ok and jax.default_backend() == "tpu":
+        out = _paged_kernel(q2, k_pages, v_pages, block_table, ctx, scale)
+    else:
+        out = _paged_xla(q2, k_pages, v_pages, block_table, ctx, scale)
+    return out[:, None], k_pages, v_pages
+
+
 def softsign(x):
     return x / (1.0 + jnp.abs(x))
 

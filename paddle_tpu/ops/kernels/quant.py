@@ -22,10 +22,34 @@ def quantize_weight_absmax(w, axis=0):
     return q, jnp.squeeze(scale, axis)
 
 
-def weight_only_matmul(x, qweight, scales, bias=None):
-    """phi weight_only_linear: fp activations x int8 weights; dequantized
-    into the matmul epilogue. x: [..., in], qweight: [in, out] int8."""
-    out = jnp.matmul(x, qweight.astype(x.dtype)) * scales.astype(x.dtype)
+def dequantize_weight(qweight, scales, dtype=jnp.float32):
+    """Scale-folded dequantization: fp [in, out] table from the int8 weight +
+    per-output-channel scales. This is weight_only_matmul's epilogue hoisted
+    out of the hot path: on backends with no int8 GEMM (XLA:CPU) the per-call
+    convert MATERIALIZES a full fp copy of the weight every decode step, which
+    measured 1.6-1.7x slower than the fp GEMM it was supposed to beat
+    (DECODEBENCH_r05: int8 299 vs fp 416 tok/s). Dequantizing once and reusing
+    the fp table makes int8 decode run the identical GEMM as fp."""
+    return qweight.astype(dtype) * scales.astype(dtype)
+
+
+def weight_only_matmul(x, qweight, scales, bias=None, dequant=None):
+    """phi weight_only_linear: fp activations x int8 weights. x: [..., in],
+    qweight: [in, out] int8.
+
+    Two epilogue structures, chosen by the caller per backend:
+      * dequant=None — dequantize into the matmul epilogue (int8 stream from
+        HBM, convert fused into the MXU feed): the TPU path, where 4x less
+        weight traffic is the decode-phase win.
+      * dequant=<fp table> — the hoisted form (dequantize_weight, computed
+        ONCE): the CPU path, where XLA has no int8 GEMM and the per-call
+        convert is pure overhead. Scales are folded into the table, so the
+        hot loop is exactly the fp GEMM.
+    """
+    if dequant is not None:
+        out = jnp.matmul(x, dequant.astype(x.dtype))
+    else:
+        out = jnp.matmul(x, qweight.astype(x.dtype)) * scales.astype(x.dtype)
     if bias is not None:
         out = out + bias
     return out

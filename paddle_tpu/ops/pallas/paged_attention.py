@@ -1,0 +1,227 @@
+"""Ragged paged attention (decode) as a Pallas TPU kernel.
+
+Reference analog: the paged attention of vLLM-style serving stacks and the
+TPU ragged-paged-attention line of work (PAPERS.md: "Ragged Paged
+Attention: A High-Performance and Flexible LLM Inference Kernel for TPU").
+The serving engine (paddle_tpu/serving/) keeps every sequence's KV in
+fixed-size token blocks scattered across one preallocated pool; this kernel
+computes one decode step of attention STRAIGHT from
+
+    q            [slots, q_heads, d]        one query token per slot
+    k/v_pages    [num_blocks, block_size, kv_heads, d]
+    block_tables [slots, max_blocks]  int32 page ids per slot (0 = null)
+    context_lens [slots]              int32 valid tokens incl. current
+
+without materializing contiguous per-sequence caches — the "ragged" part:
+every slot attends over its own length, fully-masked pages are skipped.
+
+Kernel shape: grid (slots, kv_heads, kv_splits, pages_per_split) with the
+block table + context lens as SCALAR-PREFETCH operands, so each grid step's
+BlockSpec index_map picks the next physical page to DMA (data-dependent
+paging — the whole point of scalar prefetch). Online softmax (m, l, acc)
+carried in VMEM scratch across the page loop; the kv_splits dimension is
+flash-decoding-style split-K over the context: each split reduces its page
+range to a partial (acc, m, l) and an XLA epilogue combines splits by
+logsumexp weighting. kv_splits is the block-autotuned knob (core/autotune):
+1 split minimizes combine overhead, more splits expose parallelism when
+slots*kv_heads is small relative to the context length.
+
+GQA layout convention matches cached_multihead_attention's jnp.repeat: kv
+head h serves q heads [h*g, (h+1)*g), g = q_heads // kv_heads.
+
+Same portability contract as flash_attention.py: interpret=True runs the
+identical kernel on CPU (opt-in via FLAGS_pallas_interpret); the XLA
+gather composition (paged_attention_xla) is the default CPU fallback.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+# ------------------------------------------------------------------- kernel
+def _decode_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref,
+                   acc_ref, m_ref, l_ref,
+                   acc_s, m_s, l_s, *, block_size, pages_per_split, scale):
+    # scalar prefetch: bt_ref [slots, max_blocks], cl_ref [slots] (SMEM)
+    # blocks: q_ref [g, d]; k_ref/v_ref [block_size, d] (one physical page,
+    # this kv head); outputs are per-split partials.
+    i = pl.program_id(0)           # slot
+    s = pl.program_id(2)           # split
+    j = pl.program_id(3)           # page within split
+
+    @pl.when(j == 0)
+    def _init():
+        m_s[:] = jnp.full_like(m_s, NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    page_idx = s * pages_per_split + j
+    cl = cl_ref[i]
+
+    @pl.when(page_idx * block_size < cl)   # ragged skip: page has live tokens
+    def _compute():
+        g = q_ref.shape[0]
+        q = q_ref[:].astype(jnp.float32) * scale
+        k = k_ref[:].astype(jnp.float32)
+        v = v_ref[:].astype(jnp.float32)
+        sc = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [g, block_size]
+        pos = page_idx * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (g, block_size), 1)
+        live = pos < cl
+        sc = jnp.where(live, sc, NEG_INF)
+        m_prev = m_s[:]                       # [g, 1]
+        l_prev = l_s[:]
+        m_cur = jnp.max(sc, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.where(live, jnp.exp(sc - m_new), 0.0)
+        m_s[:] = m_new
+        l_s[:] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_s[:] = acc_s[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == pages_per_split - 1)
+    def _out():
+        acc_ref[:] = acc_s[:]
+        m_ref[:] = m_s[:]
+        l_ref[:] = l_s[:]
+
+
+def _paged_pallas(q, k_pages, v_pages, block_tables, context_lens, scale,
+                  kv_splits, interpret):
+    slots, hq, d = q.shape
+    bs = k_pages.shape[1]
+    hkv = k_pages.shape[2]
+    g = hq // hkv
+    max_bps = block_tables.shape[1]
+    pad = (-max_bps) % kv_splits
+    if pad:
+        # padded entries point at the null page; context_lens masks them
+        block_tables = jnp.pad(block_tables, ((0, 0), (0, pad)))
+    nps = (max_bps + pad) // kv_splits
+    qr = q.reshape(slots, hkv, g, d)
+    bt = block_tables.astype(jnp.int32)
+    cl = context_lens.astype(jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(slots, hkv, kv_splits, nps),
+        in_specs=[
+            pl.BlockSpec((None, None, g, d),
+                         lambda i, h, s, j, bt, cl: (i, h, 0, 0)),
+            pl.BlockSpec((None, bs, None, d),
+                         lambda i, h, s, j, bt, cl, nps=nps:
+                         (bt[i, s * nps + j], 0, h, 0)),
+            pl.BlockSpec((None, bs, None, d),
+                         lambda i, h, s, j, bt, cl, nps=nps:
+                         (bt[i, s * nps + j], 0, h, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, None, None, g, d),
+                         lambda i, h, s, j, bt, cl: (i, h, s, 0, 0)),
+            pl.BlockSpec((None, None, None, g, 1),
+                         lambda i, h, s, j, bt, cl: (i, h, s, 0, 0)),
+            pl.BlockSpec((None, None, None, g, 1),
+                         lambda i, h, s, j, bt, cl: (i, h, s, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((g, d), jnp.float32),
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, 1), jnp.float32),
+        ],
+    )
+    acc, m, l = pl.pallas_call(
+        functools.partial(_decode_kernel, block_size=bs,
+                          pages_per_split=nps, scale=scale),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((slots, hkv, kv_splits, g, d), jnp.float32),
+            jax.ShapeDtypeStruct((slots, hkv, kv_splits, g, 1), jnp.float32),
+            jax.ShapeDtypeStruct((slots, hkv, kv_splits, g, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(bt, cl, qr, k_pages, v_pages)
+
+    # flash-decoding combine: logsumexp-weight the per-split partials
+    m_g = jnp.max(m, axis=2, keepdims=True)
+    w = jnp.exp(m - m_g)                       # empty splits -> weight 0
+    num = jnp.sum(acc * w, axis=2)             # [slots, hkv, g, d]
+    den = jnp.maximum(jnp.sum(l * w, axis=2), 1e-30)
+    return (num / den).astype(q.dtype).reshape(slots, hq, d)
+
+
+# ------------------------------------------------------------- XLA fallback
+def paged_attention_xla(q, k_pages, v_pages, block_tables, context_lens,
+                        scale=None):
+    """Dense-gather reference: gather each slot's pages into a contiguous
+    [max_ctx] view, mask past context_lens, fp32 softmax. The default CPU
+    path and the numerics oracle for the kernel tests."""
+    slots, hq, d = q.shape
+    bs = k_pages.shape[1]
+    hkv = k_pages.shape[2]
+    g = hq // hkv
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    max_ctx = block_tables.shape[1] * bs
+    k = k_pages[block_tables].reshape(slots, max_ctx, hkv, d)
+    v = v_pages[block_tables].reshape(slots, max_ctx, hkv, d)
+    qg = q.reshape(slots, hkv, g, d).astype(jnp.float32)
+    sc = jnp.einsum("bhgd,bkhd->bhgk", qg,
+                    k.astype(jnp.float32)) * scale
+    live = (jnp.arange(max_ctx)[None, :]
+            < context_lens.astype(jnp.int32)[:, None])  # [slots, max_ctx]
+    sc = jnp.where(live[:, None, None, :], sc, NEG_INF)
+    p = jax.nn.softmax(sc, axis=-1)
+    out = jnp.einsum("bhgk,bkhd->bhgd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype).reshape(slots, hq, d)
+
+
+# ---------------------------------------------------------------- public API
+def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
+                    scale=None, kv_splits=1, interpret=False):
+    """One decode step of ragged paged attention (see module docstring).
+    q: [slots, q_heads, d]; returns [slots, q_heads, d]."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    return _paged_pallas(q, k_pages, v_pages, block_tables, context_lens,
+                         scale, kv_splits, interpret)
+
+
+def supports(q_shape, k_pages_shape) -> bool:
+    """Shape gate for the kernel path (XLA fallback otherwise)."""
+    slots, hq, d = q_shape
+    hkv = k_pages_shape[2]
+    return d <= 256 and hkv >= 1 and hq % hkv == 0
+
+
+# ---- autotuned entry (split-K over the context is the tunable block) ----
+from ...core.autotune import autotune as _autotune  # noqa: E402
+
+_SPLIT_CANDIDATES = [
+    {"kv_splits": 1},   # default 1st: no combine overhead
+    {"kv_splits": 2},
+    {"kv_splits": 4},
+    {"kv_splits": 8},
+]
+
+
+@_autotune(_SPLIT_CANDIDATES)
+def paged_attention_tuned(q, k_pages, v_pages, block_tables, context_lens,
+                          scale=None, interpret=False, *, kv_splits):
+    """paged_attention with the flash-decoding split count chosen by the
+    autotune cache when FLAGS_use_autotune is on; otherwise 1 split."""
+    if block_tables.shape[1] < kv_splits:
+        raise ValueError("more splits than pages")  # tuner skips
+    return paged_attention(q, k_pages, v_pages, block_tables, context_lens,
+                           scale, kv_splits, interpret)

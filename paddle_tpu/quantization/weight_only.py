@@ -15,17 +15,39 @@ from __future__ import annotations
 import types
 from typing import List
 
+from ..core import flags as _flags
 from ..core.tensor import Tensor
 from ..ops import api
+
+_flags.define_flag(
+    "weight_only_dequant_cache", "auto",
+    "Hoist int8 weight-only dequantization out of the decode hot loop by "
+    "caching a scale-folded fp table per quantized layer (registered buffer "
+    "'dequant_weight'). 'auto' enables it on backends with no int8 GEMM "
+    "(everything but TPU), where the per-call convert made int8 decode "
+    "SLOWER than fp (DECODEBENCH_r05); 'on'/'off' force it. The int8 tables "
+    "remain the storage/wire format either way.")
+
+
+def _dequant_cache_enabled() -> bool:
+    import jax
+
+    v = str(_flags.get_flag("weight_only_dequant_cache")).lower()
+    if v in ("on", "true", "1"):
+        return True
+    if v in ("off", "false", "0"):
+        return False
+    return jax.default_backend() != "tpu"
 
 
 def _quantize_linear_like(layer, kind: str) -> None:
     from ..distributed.fleet.mp_layers import all_gather_concat
     from ..distributed.collective import _bound_axis
-    from ..ops.kernels.quant import quantize_weight_absmax
+    from ..ops.kernels.quant import dequantize_weight, quantize_weight_absmax
 
     import jax.numpy as jnp
 
+    compute_dtype = layer.weight._value.dtype
     q, s = quantize_weight_absmax(layer.weight._value)
     # drop the fp parameter; register int8 + scales as buffers so the
     # generation/TrainStep functional swap carries them
@@ -33,6 +55,15 @@ def _quantize_linear_like(layer, kind: str) -> None:
     layer.weight = None
     layer.register_buffer("quant_weight", Tensor(q))
     layer.register_buffer("quant_scales", Tensor(s.astype(jnp.float32)))
+    use_cache = _dequant_cache_enabled()
+    if use_cache:
+        # CPU fast path: one scale-folded dequant pass now, so every decode
+        # step runs the identical fp GEMM the unquantized model runs (the
+        # per-call convert was the DECODEBENCH_r05 regression). Registered
+        # as a buffer so compiled decode programs stream it like any weight.
+        layer.register_buffer(
+            "dequant_weight",
+            Tensor(dequantize_weight(q, s, dtype=compute_dtype)))
     # the int8 tables inherit the fp weight's TP layout, or a TP serving
     # run would replicate every table and lose the sharded matmul
     from ..distributed.mesh import annotate_param
@@ -41,14 +72,22 @@ def _quantize_linear_like(layer, kind: str) -> None:
     if kind == "column":
         annotate_param(layer.quant_weight, P(None, "mp"))
         annotate_param(layer.quant_scales, P("mp"))
+        if use_cache:
+            annotate_param(layer.dequant_weight, P(None, "mp"))
     elif kind == "row":
         annotate_param(layer.quant_weight, P("mp", None))
         annotate_param(layer.quant_scales, P())
+        if use_cache:
+            annotate_param(layer.dequant_weight, P("mp", None))
+
+    def _wom(self, x, bias):
+        return api.weight_only_matmul(
+            x, self.quant_weight, self.quant_scales, bias,
+            dequant=getattr(self, "dequant_weight", None))
 
     if kind == "column":
         def fwd(self, x):
-            out = api.weight_only_matmul(x, self.quant_weight,
-                                         self.quant_scales, self.bias)
+            out = _wom(self, x, self.bias)
             if self.gather_output and (_bound_axis(self.group) is not None):
                 out = all_gather_concat(out, axis=-1, group=self.group)
             return out
@@ -58,21 +97,57 @@ def _quantize_linear_like(layer, kind: str) -> None:
 
             axis = _bound_axis(self.group) if self.group is not None else None
             if axis is None:
-                return api.weight_only_matmul(x, self.quant_weight,
-                                              self.quant_scales, self.bias)
-            out = api.weight_only_matmul(x, self.quant_weight,
-                                         self.quant_scales, None)
+                return _wom(self, x, self.bias)
+            out = _wom(self, x, None)
             out = all_reduce(out, group=self.group)
             if self.bias is not None:
                 out = out + self.bias
             return out
     else:  # plain linear
         def fwd(self, x):
-            return api.weight_only_matmul(x, self.quant_weight,
-                                          self.quant_scales, self.bias)
+            return _wom(self, x, self.bias)
 
     layer.forward = types.MethodType(fwd, layer)
     layer._weight_only_quantized = True
+
+
+def _quantize_tied_head(model, emb_weight) -> None:
+    """Weight-only int8 for the TIED LM head (GPT-style `h @ wte.weight^T`).
+
+    The head projection is the single biggest GEMM of a decode step
+    (hidden x vocab) and the tied form runs it TRANSPOSED — which XLA:CPU
+    executes ~5x slower than the straight [in, out] layout (measured at the
+    decodebench head shape). Quantizing the head stores the int8 table (and
+    its scale-folded dequant cache) PRE-TRANSPOSED as [hidden, vocab]: the
+    int8 model's head streams 4x fewer HBM bytes on TPU and runs the fast
+    GEMM layout everywhere. The embedding lookup keeps the fp table."""
+    import jax.numpy as jnp
+
+    from ..distributed.mesh import annotate_param
+    from ..ops.kernels.quant import dequantize_weight, quantize_weight_absmax
+    from jax.sharding import PartitionSpec as P
+
+    compute_dtype = emb_weight._value.dtype
+    wt = emb_weight._value.T  # [hidden, vocab] projection view
+    q, s = quantize_weight_absmax(wt)  # per-vocab-column scales
+    model.register_buffer("head_quant_weight", Tensor(q))
+    model.register_buffer("head_quant_scales", Tensor(s.astype(jnp.float32)))
+    # vocab is the output dim -> column-parallel layout over 'mp'
+    annotate_param(model.head_quant_weight, P(None, "mp"))
+    annotate_param(model.head_quant_scales, P("mp"))
+    if _dequant_cache_enabled():
+        model.register_buffer(
+            "head_dequant_weight",
+            Tensor(dequantize_weight(q, s, dtype=compute_dtype)))
+        annotate_param(model.head_dequant_weight, P(None, "mp"))
+
+    def _head(self, h):
+        return api.weight_only_matmul(
+            h, self.head_quant_weight, self.head_quant_scales,
+            dequant=getattr(self, "head_dequant_weight", None))
+
+    model._head = types.MethodType(_head, model)
+    model._head_weight_only = True
 
 
 def quantize_for_generation(model, algo: str = "weight_only_int8") -> List[str]:
@@ -101,6 +176,21 @@ def quantize_for_generation(model, algo: str = "weight_only_int8") -> List[str]:
         else:
             continue
         done.append(name)
+    # tied LM heads bypass the Linear sweep (`h @ wte.weight^T`): quantize
+    # the projection view too, or the biggest GEMM of every decode step
+    # stays fp (and in the slow transposed layout)
+    if not getattr(model, "_head_weight_only", False) \
+            and getattr(getattr(model, "config", None),
+                        "tie_word_embeddings", False) \
+            and hasattr(model, "_head"):
+        emb = None
+        if hasattr(model, "gpt"):  # GPTForCausalLM
+            emb = model.gpt.wte.weight
+        elif hasattr(model, "model"):  # LlamaForCausalLM (tied config)
+            emb = model.model.embed_tokens.weight
+        if emb is not None:
+            _quantize_tied_head(model, emb)
+            done.append("_head")
     # stale compiled decode programs captured the fp parameter list
     if hasattr(model, "_gen_exec_cache"):
         model._gen_exec_cache.clear()

@@ -99,6 +99,12 @@ class ResilientTrainer:
         self.manager = manager
         self.model = model
         self.optimizer = optimizer
+        # Donation is off by default here (callers can still opt back in via
+        # step_kwargs): a heap-layout-sensitive XLA:CPU bug (ROADMAP "Carried
+        # bugs") can leave the final written-back params aliasing freed donor
+        # memory, so a resilient run's whole point — params you can trust
+        # after run() returns — is worth the extra in-flight copy.
+        step_kwargs.setdefault("donate", False)
         self.step = TrainStep(model, loss_fn, optimizer,
                               nan_guard=nan_guard, **step_kwargs)
         self.save_every = int(save_every)
@@ -263,6 +269,17 @@ class ResilientTrainer:
     def _finish(self, report: Dict[str, Any]) -> Dict[str, Any]:
         self.manager.wait()  # run() must not return before the final commit
         self.step.sync_to_optimizer()
+        # Donation-UAF mitigation: the compiled train step donates its
+        # param/opt-state buffers, and on XLA:CPU a heap-layout-sensitive
+        # bug (see ROADMAP "Carried bugs") can leave the FINAL written-back
+        # param arrays aliasing freed donor memory — reads after run()
+        # return garbage without tripping jax's deleted-array guard. Settle
+        # every in-flight donation, then rematerialize each param as a
+        # fresh buffer so nothing returned from run() aliases donated HBM.
+        import jax
+        import jax.numpy as jnp
+        for p in self.step.params:
+            p._value = jnp.array(jax.block_until_ready(p._value))
         report["step"] = int(self.step._step_i)
         report["steps_skipped"] = (int(self.step.skipped_steps)
                                    - report.pop("steps_skipped_start"))

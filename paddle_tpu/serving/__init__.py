@@ -1,0 +1,47 @@
+"""Production serving runtime: paged KV cache + continuous batching.
+
+Reference analogs: the serving stack around fused_multi_transformer
+(PaddleNLP llm serving) and the TPU ragged-paged-attention line of work
+(PAPERS.md: "Ragged Paged Attention: A High-Performance and Flexible LLM
+Inference Kernel for TPU").
+
+The static-batch decode path (models/generation.py) allocates one
+[b, max_len] KV ring per generate() call: every sequence pays max_len of
+HBM whether it uses it or not, finished sequences keep decoding as padding
+until the whole batch drains, and a new request waits for the NEXT batch.
+This package replaces that with the vLLM/TPU-serving shape:
+
+  * blocks.py    — fixed-size token blocks carved from one preallocated
+                   pool; per-sequence block tables; O(1) alloc/append/free
+                   with immediate reuse; occupancy/fragmentation gauges in
+                   the observability metrics registry.
+  * paged.py     — the device-side paged KV pool ([num_blocks, block_size,
+                   kv_heads, head_dim] per layer) + the PagedLayerCache
+                   view the models' attention layers consume; prefill
+                   scatter of a contiguous prefix into pages.
+  * scheduler.py — continuous batching: admits queued requests into the
+                   running decode batch every step, interleaves bounded
+                   prefill chunks with decode steps, evicts finished
+                   sequences (and frees their blocks) immediately.
+  * engine.py    — ServingEngine: one compiled decode step over a fixed
+                   set of slots (paged ragged attention, sampling inside
+                   the program, page buffers donated), chunked prefill,
+                   works unchanged with the int8 weight-only swap.
+  * server.py    — stdlib HTTP front end (POST /generate) with
+                   per-request telemetry: queue time, TTFT, tokens/s.
+"""
+from .blocks import BlockAllocator  # noqa: F401
+from .paged import PagedKVPool, PagedLayerCache  # noqa: F401
+from .scheduler import Request, Scheduler  # noqa: F401
+from .engine import ServingEngine  # noqa: F401
+from .server import ServingServer  # noqa: F401
+
+__all__ = [
+    "BlockAllocator",
+    "PagedKVPool",
+    "PagedLayerCache",
+    "Request",
+    "Scheduler",
+    "ServingEngine",
+    "ServingServer",
+]

@@ -1,0 +1,184 @@
+"""Paged KV-cache block allocator (host-side bookkeeping).
+
+The device pool (paged.py) is a fixed array of NUM_BLOCKS fixed-size token
+blocks; this allocator owns which block belongs to which sequence. All
+operations are O(1) amortized: the free list is a stack (LIFO reuse keeps
+recently-touched blocks hot), a sequence's block table is an append-only
+list, and free() pushes the whole table back in one pass.
+
+Block 0 is reserved as the NULL block: inactive decode slots point their
+block tables at it so the compiled decode step can write the (masked,
+garbage) KV of idle slots somewhere harmless without branching.
+
+Occupancy/fragmentation are surfaced through the observability metrics
+registry (always-on gauges — serving runs don't require FLAGS_metrics):
+
+  serving_kv_blocks_total / _used / _free   pool shape
+  serving_kv_tokens                         live tokens across sequences
+  serving_kv_occupancy                      used blocks / allocatable blocks
+  serving_kv_fragmentation                  1 - tokens/(used * block_size)
+                                            (internal fragmentation: tail
+                                            waste of partially-filled last
+                                            blocks)
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..observability.registry import gauge as _gauge
+
+_BLOCKS_TOTAL = _gauge("serving_kv_blocks_total",
+                       "KV pool size in blocks (excl. the null block).",
+                       always=True)
+_BLOCKS_USED = _gauge("serving_kv_blocks_used",
+                      "KV blocks currently assigned to sequences.",
+                      always=True)
+_BLOCKS_FREE = _gauge("serving_kv_blocks_free",
+                      "KV blocks on the free list.", always=True)
+_TOKENS = _gauge("serving_kv_tokens",
+                 "Live KV tokens across all sequences.", always=True)
+_OCCUPANCY = _gauge("serving_kv_occupancy",
+                    "used / allocatable KV blocks.", always=True)
+_FRAG = _gauge("serving_kv_fragmentation",
+               "1 - tokens/(used*block_size): tail waste of partially "
+               "filled last blocks.", always=True)
+
+
+class BlockAllocator:
+    """Host-side allocator over a pool of `num_blocks` blocks of
+    `block_size` tokens each. Block ids index the device pool directly."""
+
+    NULL_BLOCK = 0
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError("need at least 2 blocks (one is the null block)")
+        if block_size < 1:
+            raise ValueError("block_size must be >= 1")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        # stack: LIFO reuse; block 0 reserved (never handed out)
+        self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
+        self._tables: Dict[object, List[int]] = {}
+        self._lens: Dict[object, int] = {}
+        self._publish()
+
+    # -- capacity ---------------------------------------------------------
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return (self.num_blocks - 1) - len(self._free)
+
+    def blocks_for(self, n_tokens: int) -> int:
+        return -(-int(n_tokens) // self.block_size)  # ceil div
+
+    def can_allocate(self, n_tokens: int) -> bool:
+        return self.blocks_for(n_tokens) <= len(self._free)
+
+    # -- lifecycle --------------------------------------------------------
+    def allocate(self, seq_id, n_tokens: int) -> List[int]:
+        """Claim blocks for a new sequence of `n_tokens` (prefill). Returns
+        the block table. Raises KeyError on duplicate id, MemoryError when
+        the pool can't hold it (callers queue the request instead)."""
+        if seq_id in self._tables:
+            raise KeyError(f"sequence {seq_id!r} already allocated")
+        need = self.blocks_for(max(int(n_tokens), 1))
+        if need > len(self._free):
+            raise MemoryError(
+                f"KV pool exhausted: need {need} blocks, {len(self._free)} "
+                f"free")
+        table = [self._free.pop() for _ in range(need)]
+        self._tables[seq_id] = table
+        self._lens[seq_id] = int(n_tokens)
+        self._publish()
+        return table
+
+    def reserve(self, seq_id, n_tokens: int, total_tokens: int) -> List[int]:
+        """allocate(), but claim blocks for `total_tokens` (worst case)
+        upfront while the live length starts at `n_tokens`. The table never
+        grows mid-decode, so the serving engine uploads it to the device
+        ONCE at admission and never touches it again — no per-step
+        allocator call, no per-step table scatter. Costs nothing in
+        capacity when admission already gates on the worst case."""
+        if seq_id in self._tables:
+            raise KeyError(f"sequence {seq_id!r} already allocated")
+        need = self.blocks_for(max(int(total_tokens), int(n_tokens), 1))
+        if need > len(self._free):
+            raise MemoryError(
+                f"KV pool exhausted: need {need} blocks, {len(self._free)} "
+                f"free")
+        table = [self._free.pop() for _ in range(need)]
+        self._tables[seq_id] = table
+        self._lens[seq_id] = int(n_tokens)
+        self._publish()
+        return table
+
+    def append_token(self, seq_id) -> List[int]:
+        """Account one decoded token; grows the block table by one block
+        when the sequence crosses a block boundary. Returns the (possibly
+        grown) table. Raises MemoryError when a needed block isn't there —
+        the scheduler preempts or queues in that case."""
+        table = self._tables[seq_id]
+        n = self._lens[seq_id] + 1
+        if self.blocks_for(n) > len(table):
+            if not self._free:
+                raise MemoryError("KV pool exhausted on append")
+            table.append(self._free.pop())
+        self._lens[seq_id] = n
+        self._publish()
+        return table
+
+    def free(self, seq_id) -> int:
+        """Release a sequence's blocks back to the pool (immediate reuse).
+        Returns how many blocks were released."""
+        table = self._tables.pop(seq_id)
+        self._lens.pop(seq_id)
+        self._free.extend(reversed(table))  # LIFO: reuse hottest first
+        self._publish()
+        return len(table)
+
+    # -- introspection ----------------------------------------------------
+    def table(self, seq_id) -> List[int]:
+        return list(self._tables[seq_id])
+
+    def seq_len(self, seq_id) -> int:
+        return self._lens[seq_id]
+
+    def sequences(self):
+        return list(self._tables)
+
+    def occupancy_report(self) -> dict:
+        """Pool shape + occupancy/fragmentation, the dict the metrics
+        gauges mirror (and servebench embeds in its report)."""
+        allocatable = self.num_blocks - 1
+        used = self.used_blocks
+        tokens = sum(self._lens.values())
+        cap = used * self.block_size
+        return {
+            "num_blocks": allocatable,
+            "block_size": self.block_size,
+            "used_blocks": used,
+            "free_blocks": len(self._free),
+            "sequences": len(self._tables),
+            "tokens": tokens,
+            "occupancy": used / allocatable if allocatable else 0.0,
+            "fragmentation": 1.0 - tokens / cap if cap else 0.0,
+        }
+
+    def _publish(self):
+        r = self.occupancy_report()
+        _BLOCKS_TOTAL.set(r["num_blocks"])
+        _BLOCKS_USED.set(r["used_blocks"])
+        _BLOCKS_FREE.set(r["free_blocks"])
+        _TOKENS.set(r["tokens"])
+        _OCCUPANCY.set(r["occupancy"])
+        _FRAG.set(r["fragmentation"])
+
+    def __repr__(self):  # pragma: no cover
+        r = self.occupancy_report()
+        return (f"BlockAllocator(blocks={r['used_blocks']}/"
+                f"{r['num_blocks']}, seqs={r['sequences']}, "
+                f"occ={r['occupancy']:.2f})")

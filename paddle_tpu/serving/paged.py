@@ -1,0 +1,101 @@
+"""Device-side paged KV pool + the cache view the models consume.
+
+The pool is ONE preallocated array pair per layer:
+
+    k_pages, v_pages : [num_blocks, block_size, kv_heads, head_dim]
+
+Block ids from blocks.BlockAllocator index the leading dim directly. A
+sequence's KV lives in the (non-contiguous) blocks its table names; the
+ragged paged attention op (ops/pallas/paged_attention.py) computes straight
+from (pages, block_table, context_lens) without ever materializing a
+contiguous per-sequence cache.
+
+PagedLayerCache is the per-layer view threaded through the models' existing
+`caches=` plumbing: gpt/llama attention layers duck-type on `.block_table`
+to pick the paged decode path over the static-ring path. It is constructed
+inside the compiled decode step (engine.py), so its fields are Tensors of
+traced values.
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class PagedLayerCache:
+    """Per-layer paged-KV view: pages + the batch's block tables/lengths.
+
+    seq_lens counts tokens ALREADY in the cache for each slot (the new
+    token of the current decode step is written at position seq_lens and
+    included in attention by the op)."""
+
+    __slots__ = ("k_pages", "v_pages", "block_table", "seq_lens")
+
+    def __init__(self, k_pages, v_pages, block_table, seq_lens):
+        self.k_pages = k_pages
+        self.v_pages = v_pages
+        self.block_table = block_table
+        self.seq_lens = seq_lens
+
+
+class PagedKVPool:
+    """Owns the per-layer page arrays. Holds plain jax arrays (not Tensors):
+    the compiled decode step takes and returns them as donated buffers."""
+
+    def __init__(self, num_blocks: int, block_size: int, num_layers: int,
+                 num_kv_heads: int, head_dim: int, dtype=jnp.float32):
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.num_layers = int(num_layers)
+        self.num_kv_heads = int(num_kv_heads)
+        self.head_dim = int(head_dim)
+        self.dtype = dtype
+        shape = (self.num_blocks, self.block_size, self.num_kv_heads,
+                 self.head_dim)
+        self.layers: List[Tuple[jax.Array, jax.Array]] = [
+            (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+            for _ in range(self.num_layers)
+        ]
+
+    def nbytes(self) -> int:
+        k, _ = self.layers[0]
+        return 2 * self.num_layers * k.size * k.dtype.itemsize
+
+    def replace(self, new_layers) -> None:
+        """Swap in the page arrays a compiled step returned (the old ones
+        were donated into it)."""
+        self.layers = [(k, v) for k, v in new_layers]
+
+
+def write_prefix(k_pages, v_pages, k, v, table, *, block_size):
+    """Scatter a contiguous KV prefix into its pages.
+
+    k, v: [plen_padded, kv_heads, d] with plen_padded a multiple of
+    block_size; table: [plen_padded // block_size] int32 block ids.
+    Garbage rows past the real prompt length land in the tail of the last
+    block — they are masked by context_lens until the decode steps that
+    overwrite them. Used by the engine after chunked prefill (which runs in
+    a contiguous workspace); jit-compiled per padded length."""
+    nb = table.shape[0]
+    kb = k.reshape(nb, block_size, k.shape[1], k.shape[2])
+    vb = v.reshape(nb, block_size, v.shape[1], v.shape[2])
+    return (k_pages.at[table].set(kb.astype(k_pages.dtype)),
+            v_pages.at[table].set(vb.astype(v_pages.dtype)))
+
+
+def append_token_kv(k_pages, v_pages, k_new, v_new, block_table, seq_lens,
+                    *, block_size):
+    """Write one new token's K/V per slot at its current position.
+
+    k_new, v_new: [slots, kv_heads, d]; block_table: [slots, max_blocks];
+    seq_lens: [slots] tokens already present (write position). Idle slots
+    point at the null block and write garbage there harmlessly."""
+    slots = seq_lens.shape[0]
+    page = jnp.take_along_axis(
+        block_table, (seq_lens // block_size)[:, None], axis=1)[:, 0]
+    off = seq_lens % block_size
+    k_pages = k_pages.at[page, off].set(k_new.astype(k_pages.dtype))
+    v_pages = v_pages.at[page, off].set(v_new.astype(v_pages.dtype))
+    return k_pages, v_pages

@@ -1,0 +1,154 @@
+"""Stdlib HTTP front end for the serving engine (POST /generate).
+
+Same shape as observability/serve.py's MetricsServer: one
+ThreadingHTTPServer + daemon threads, no third-party web stack. The server
+owns the engine loop thread — handler threads only submit requests and
+block on the request's completion event, so concurrent clients are batched
+CONTINUOUSLY by the single engine loop rather than serialized.
+
+  POST /generate   {"prompt": [int, ...], "max_new_tokens": 16,
+                    "temperature": 0.0, "eos_token_id": null}
+               ->  {"request_id", "output_tokens", "finish_reason",
+                    "telemetry": {queue_s, ttft_s, decode_tok_s, ...}}
+  GET  /stats      engine + KV-pool occupancy snapshot (JSON)
+  GET  /healthz    {"ok": true, ...} liveness of the engine loop
+
+Every response carries the request's own telemetry (queue time, TTFT,
+steady-state decode tokens/s); the aggregate gauges/histograms live in the
+observability metrics registry (serving_* metrics, always on).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..core.flags import define_flag, get_flag
+
+define_flag("serving_port", 0,
+            "Port for the serving HTTP front end (POST /generate); 0 binds "
+            "an ephemeral port.")
+define_flag("serving_request_timeout_s", 300.0,
+            "Per-request wall-clock cap for POST /generate before the "
+            "server answers 504.")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "paddle_tpu_serving/1.0"
+
+    @property
+    def _srv(self):
+        return self.server._serving_server  # type: ignore[attr-defined]
+
+    def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        path = self.path.split("?", 1)[0]
+        if path != "/generate":
+            self._reply(404, {"error": "not found"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            prompt = body.get("prompt")
+            if (not isinstance(prompt, list) or not prompt
+                    or not all(isinstance(t, int) for t in prompt)):
+                self._reply(400, {"error": "prompt must be a non-empty "
+                                           "list of token ids"})
+                return
+            req = self._srv.engine.submit(
+                prompt,
+                max_new_tokens=int(body.get("max_new_tokens", 16)),
+                temperature=float(body.get("temperature", 0.0)),
+                eos_token_id=body.get("eos_token_id"))
+        except ValueError as e:
+            self._reply(400, {"error": str(e)})
+            return
+        except Exception as e:  # noqa: BLE001 — malformed JSON etc.
+            self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+            return
+        timeout = float(get_flag("serving_request_timeout_s"))
+        if not req.wait(timeout):
+            self._reply(504, {"error": "generation timed out",
+                              "request_id": req.request_id})
+            return
+        self._reply(200, {
+            "request_id": req.request_id,
+            "output_tokens": req.output_tokens,
+            "finish_reason": req.finish_reason,
+            "telemetry": req.telemetry(),
+        })
+
+    def do_GET(self):  # noqa: N802
+        path = self.path.split("?", 1)[0]
+        if path == "/stats":
+            self._reply(200, self._srv.engine.stats())
+        elif path in ("/healthz", "/health"):
+            alive = self._srv.loop_alive()
+            self._reply(200 if alive else 503,
+                        {"ok": alive, "steps": self._srv.engine.steps})
+        else:
+            self._reply(404, {"error": "not found"})
+
+    def _reply(self, code: int, obj) -> None:
+        try:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    def log_message(self, fmt, *args):  # requests must not spam stderr
+        pass
+
+
+class ServingServer:
+    """HTTP server + the engine loop thread. The loop runs engine ticks
+    while there is work and idles (short sleep) otherwise; handler threads
+    never touch the device."""
+
+    def __init__(self, engine, port: Optional[int] = None,
+                 host: str = "127.0.0.1", idle_sleep_s: float = 0.002):
+        self.engine = engine
+        if port is None:
+            port = int(get_flag("serving_port"))
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd._serving_server = self  # type: ignore[attr-defined]
+        self.port = int(self._httpd.server_address[1])
+        self.host = host
+        self._idle_sleep_s = float(idle_sleep_s)
+        self._stop = threading.Event()
+        self._loop = threading.Thread(target=self._run_loop,
+                                      name="serving-engine", daemon=True)
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.25},
+            name="serving-http", daemon=True)
+        self._loop.start()
+        self._http_thread.start()
+
+    def _run_loop(self) -> None:
+        while not self._stop.is_set():
+            if self.engine.sched.has_work():
+                self.engine.step()
+            else:
+                time.sleep(self._idle_sleep_s)
+
+    def loop_alive(self) -> bool:
+        return self._loop.is_alive()
+
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._loop.join(timeout=10)
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._http_thread.join(timeout=5)
+
+    def __repr__(self):  # pragma: no cover
+        return f"ServingServer(port={self.port})"

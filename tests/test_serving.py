@@ -1,0 +1,395 @@
+"""Serving-stack tests (ISSUE r11): paged KV allocator invariants, ragged
+paged-attention numerics vs a dense oracle, continuous-batching scheduler
+admission/eviction, engine decode parity with model.generate(), and an HTTP
+round-trip smoke over the stdlib front end.
+"""
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import (
+    GPTConfig,
+    GPTForCausalLM,
+    LlamaConfig,
+    LlamaForCausalLM,
+)
+from paddle_tpu.serving import (
+    BlockAllocator,
+    Request,
+    Scheduler,
+    ServingEngine,
+    ServingServer,
+)
+
+
+# ------------------------------------------------------------- allocator
+class TestBlockAllocator:
+    def test_null_block_never_handed_out(self):
+        a = BlockAllocator(num_blocks=8, block_size=4)
+        handed = a.allocate("s0", 4 * 7)  # drain the whole pool
+        assert sorted(handed) == list(range(1, 8))
+        assert BlockAllocator.NULL_BLOCK not in handed
+        assert a.free_blocks == 0
+
+    def test_alloc_append_free_conservation(self):
+        a = BlockAllocator(num_blocks=10, block_size=4)
+        t0 = a.allocate("s0", 5)          # 2 blocks (ceil 5/4)
+        t1 = a.allocate("s1", 4)          # exactly 1 block
+        assert len(t0) == 2 and len(t1) == 1
+        assert a.used_blocks == 3 and a.free_blocks == 6
+        # appends within the last block don't grow the table...
+        for _ in range(3):                # 5 -> 8 tokens, still 2 blocks
+            assert len(a.append_token("s0")) == 2
+        # ...and the boundary-crossing append grows it by exactly one
+        assert len(a.append_token("s0")) == 3
+        assert a.seq_len("s0") == 9
+        # free returns every block; the pool is conserved
+        assert a.free("s0") == 3
+        assert a.free("s1") == 1
+        assert a.used_blocks == 0 and a.free_blocks == 9
+        assert a.sequences() == []
+
+    def test_exhaustion_and_duplicates_raise(self):
+        a = BlockAllocator(num_blocks=3, block_size=2)
+        a.allocate("s0", 4)               # both allocatable blocks
+        with pytest.raises(MemoryError):
+            a.allocate("s1", 1)
+        with pytest.raises(KeyError):
+            a.allocate("s0", 1)
+        with pytest.raises(MemoryError):
+            a.append_token("s0")          # 4 -> 5 needs a 3rd block
+        a.free("s0")
+        assert a.can_allocate(4) and not a.can_allocate(5)
+
+    def test_reserve_claims_worst_case_upfront(self):
+        a = BlockAllocator(num_blocks=10, block_size=4)
+        t = a.reserve("s0", 5, 12)        # live len 5, worst case 12 tokens
+        assert len(t) == 3                # ceil(12/4) blocks immediately
+        assert a.seq_len("s0") == 5
+        # appends never grow a reserved table (the whole point: the table
+        # can be uploaded to the device once and never touched again)
+        for _ in range(7):                # 5 -> 12 tokens
+            assert len(a.append_token("s0")) == 3
+        assert a.free("s0") == 3
+        assert a.used_blocks == 0
+        with pytest.raises(MemoryError):
+            a.reserve("big", 1, 100)
+
+    def test_occupancy_report_math(self):
+        a = BlockAllocator(num_blocks=9, block_size=4)
+        a.allocate("s0", 6)               # 2 blocks, 6 of 8 token slots
+        r = a.occupancy_report()
+        assert r["num_blocks"] == 8 and r["block_size"] == 4
+        assert r["used_blocks"] == 2 and r["tokens"] == 6
+        assert r["occupancy"] == pytest.approx(2 / 8)
+        assert r["fragmentation"] == pytest.approx(1 - 6 / 8)
+
+    def test_lifo_reuse(self):
+        a = BlockAllocator(num_blocks=6, block_size=2)
+        t = a.allocate("s0", 6)
+        a.free("s0")
+        assert a.allocate("s1", 6) == t   # hottest blocks come back first
+
+
+# ------------------------------------------------- paged attention numerics
+def _dense_oracle(q, k_pages, v_pages, tables, lens, scale):
+    """Hand-built numpy reference: per-slot gather + masked softmax."""
+    slots, hq, d = q.shape
+    bs, hkv = k_pages.shape[1], k_pages.shape[2]
+    g = hq // hkv
+    out = np.zeros_like(q, dtype=np.float32)
+    for s in range(slots):
+        ctx = int(lens[s])
+        k = k_pages[tables[s]].reshape(-1, hkv, d)[:ctx]   # [ctx, hkv, d]
+        v = v_pages[tables[s]].reshape(-1, hkv, d)[:ctx]
+        for h in range(hq):
+            kv_h = h // g
+            sc = (k[:, kv_h] @ q[s, h]).astype(np.float64) * scale
+            sc -= sc.max()
+            p = np.exp(sc)
+            p /= p.sum()
+            out[s, h] = p @ v[:, kv_h]
+    return out
+
+
+def _make_case(slots=3, hq=4, hkv=2, d=8, bs=4, blocks_per_seq=3, seed=0):
+    rng = np.random.default_rng(seed)
+    num_blocks = 1 + slots * blocks_per_seq
+    q = rng.standard_normal((slots, hq, d)).astype(np.float32)
+    k_pages = rng.standard_normal((num_blocks, bs, hkv, d)).astype(np.float32)
+    v_pages = rng.standard_normal((num_blocks, bs, hkv, d)).astype(np.float32)
+    tables = np.arange(1, num_blocks, dtype=np.int32)
+    tables = tables.reshape(slots, blocks_per_seq)
+    max_ctx = blocks_per_seq * bs
+    # ragged: one full, one one-token, one mid-block context
+    lens = np.array([max_ctx, 1, bs + 2], np.int32)[:slots]
+    return q, k_pages, v_pages, tables, lens
+
+
+class TestPagedAttentionNumerics:
+    def test_xla_fallback_matches_oracle(self):
+        from paddle_tpu.ops.pallas.paged_attention import paged_attention_xla
+
+        q, kp, vp, bt, cl = _make_case()
+        scale = 1.0 / np.sqrt(q.shape[-1])
+        got = np.asarray(paged_attention_xla(q, kp, vp, bt, cl))
+        want = _dense_oracle(q, kp, vp, bt, cl, scale)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("kv_splits", [1, 3])
+    def test_kernel_interpret_matches_oracle(self, kv_splits):
+        from paddle_tpu.ops.pallas.paged_attention import paged_attention
+
+        q, kp, vp, bt, cl = _make_case(seed=kv_splits)
+        scale = 1.0 / np.sqrt(q.shape[-1])
+        got = np.asarray(paged_attention(q, kp, vp, bt, cl,
+                                         kv_splits=kv_splits, interpret=True))
+        want = _dense_oracle(q, kp, vp, bt, cl, scale)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_gqa_head_mapping(self):
+        # hq=6 over hkv=3: kv head h must serve exactly q heads [2h, 2h+1]
+        from paddle_tpu.ops.pallas.paged_attention import (
+            paged_attention,
+            supports,
+        )
+
+        q, kp, vp, bt, cl = _make_case(slots=2, hq=6, hkv=3, d=4,
+                                       blocks_per_seq=2, seed=7)
+        assert supports(q.shape, kp.shape)
+        scale = 1.0 / np.sqrt(q.shape[-1])
+        got = np.asarray(paged_attention(q, kp, vp, bt, cl, interpret=True))
+        want = _dense_oracle(q, kp, vp, bt, cl, scale)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_paged_cached_attention_appends_then_attends(self):
+        # the engine's per-step op: write this step's K/V at each slot's
+        # next position, then attend over the now ctx+1 ragged context
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops import api
+
+        q, kp, vp, bt, cl = _make_case(seed=11)
+        bs = kp.shape[1]
+        # every slot needs a free next position inside its table
+        cl = np.minimum(cl, bt.shape[1] * bs - 1).astype(np.int32)
+        rng = np.random.default_rng(11)
+        slots, hq, d = q.shape
+        hkv = kp.shape[2]
+        k_new = rng.standard_normal((slots, 1, hkv, d)).astype(np.float32)
+        v_new = rng.standard_normal((slots, 1, hkv, d)).astype(np.float32)
+        out, kp2, vp2 = api.paged_cached_attention(
+            jnp.asarray(q)[:, None], jnp.asarray(k_new), jnp.asarray(v_new),
+            jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(bt),
+            jnp.asarray(cl))
+        # reference: scatter the new token into a copy, then dense oracle
+        kp_ref, vp_ref = kp.copy(), vp.copy()
+        for s in range(slots):
+            pg = bt[s, cl[s] // bs]
+            kp_ref[pg, cl[s] % bs] = k_new[s, 0]
+            vp_ref[pg, cl[s] % bs] = v_new[s, 0]
+        want = _dense_oracle(q, kp_ref, vp_ref, bt, cl + 1,
+                             1.0 / np.sqrt(d))
+        np.testing.assert_allclose(np.asarray(out)[:, 0], want,
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(kp2), kp_ref)
+        np.testing.assert_array_equal(np.asarray(vp2), vp_ref)
+
+    def test_null_block_rows_are_ignored(self):
+        # poison the null block: masked idle context must not leak into out
+        from paddle_tpu.ops.pallas.paged_attention import paged_attention_xla
+
+        q, kp, vp, bt, cl = _make_case(seed=3)
+        out_clean = np.asarray(paged_attention_xla(q, kp, vp, bt, cl))
+        kp2, vp2 = kp.copy(), vp.copy()
+        kp2[0] = 1e6
+        vp2[0] = -1e6
+        # point the dead tail of slot 1 (ctx=1) at the poisoned null block
+        bt2 = bt.copy()
+        bt2[1, 1:] = 0
+        out_poison = np.asarray(paged_attention_xla(q, kp2, vp2, bt2, cl))
+        np.testing.assert_allclose(out_poison[1], out_clean[1],
+                                   rtol=1e-5, atol=1e-5)
+
+
+# ------------------------------------------------------------- scheduler
+def _req(plen, max_new=4, **kw):
+    return Request(list(range(1, plen + 1)), max_new_tokens=max_new, **kw)
+
+
+class TestScheduler:
+    def test_admission_respects_kv_reservation(self):
+        # 4 allocatable blocks of 4 tokens; each request reserves
+        # ceil((6+6)/4)=3 worst-case blocks -> only one fits at a time
+        a = BlockAllocator(num_blocks=5, block_size=4)
+        s = Scheduler(a, max_slots=4, max_model_len=16)
+        r0, r1 = _req(6, 6), _req(6, 6)
+        s.submit(r0)
+        s.submit(r1)
+        assert [r.request_id for r in s.admit()] == [r0.request_id]
+        assert r0.state == "prefill" and r1.state == "queued"
+        assert s.admit() == []            # reservation blocks r1
+        s.finish(r0, "stop")              # eviction frees blocks + slot...
+        assert a.used_blocks == 0 and r0.wait(0)
+        assert [r.request_id for r in s.admit()] == [r1.request_id]
+
+    def test_admission_respects_slots(self):
+        a = BlockAllocator(num_blocks=64, block_size=4)
+        s = Scheduler(a, max_slots=2, max_model_len=32)
+        reqs = [_req(4) for _ in range(3)]
+        for r in reqs:
+            s.submit(r)
+        admitted = s.admit()
+        assert len(admitted) == 2 and len(s.waiting) == 1
+        slots = {r.slot for r in admitted}
+        assert len(slots) == 2            # distinct slots
+        s.finish(admitted[0], "length")
+        again = s.admit()
+        assert len(again) == 1 and again[0].slot in slots  # slot reused
+
+    def test_finish_from_prefill_state(self):
+        a = BlockAllocator(num_blocks=16, block_size=4)
+        s = Scheduler(a, max_slots=2, max_model_len=32)
+        r = _req(4)
+        s.submit(r)
+        s.admit()
+        s.finish(r, "stop")               # evict mid-prefill
+        assert r.state == "finished" and not s.has_work()
+        assert s.counts()["reserved_blocks"] == 0
+        assert a.used_blocks == 0
+
+    def test_submit_validation(self):
+        a = BlockAllocator(num_blocks=16, block_size=4)
+        s = Scheduler(a, max_slots=2, max_model_len=8)
+        with pytest.raises(ValueError):
+            s.submit(_req(8))             # 8 + 1 > max_model_len
+        with pytest.raises(ValueError):
+            s.submit(Request([]))
+
+
+# ------------------------------------------------------------- engine
+def _tiny_gpt():
+    cfg = GPTConfig.tiny()
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return cfg, m
+
+
+class TestServingEngine:
+    @pytest.mark.slow
+    def test_gpt_greedy_parity_with_static_generate(self):
+        cfg, m = _tiny_gpt()
+        rng = np.random.default_rng(0)
+        prompts = [list(rng.integers(0, cfg.vocab_size, n))
+                   for n in (5, 19, 33, 7)]
+        n_new = 6
+        eng = ServingEngine(m, max_slots=3, block_size=16, prefill_chunk=16)
+        got = eng.generate(prompts, max_new_tokens=n_new)
+        for p, full in zip(prompts, got):
+            ids = np.asarray([p], np.int32)
+            want = m.generate(paddle.to_tensor(ids),
+                              max_new_tokens=n_new).numpy()[0]
+            assert full == [int(t) for t in want]
+        # clean drain: no leaked blocks or reservations
+        st = eng.stats()
+        assert st["kv"]["used_blocks"] == 0
+        assert st["reserved_blocks"] == 0 and st["running"] == 0
+
+    @pytest.mark.slow
+    def test_llama_gqa_greedy_parity(self):
+        cfg = LlamaConfig.tiny()
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        rng = np.random.default_rng(1)
+        prompts = [list(rng.integers(0, cfg.vocab_size, n)) for n in (9, 4)]
+        eng = ServingEngine(m, max_slots=2, block_size=8, prefill_chunk=8)
+        got = eng.generate(prompts, max_new_tokens=5)
+        for p, full in zip(prompts, got):
+            ids = np.asarray([p], np.int32)
+            want = m.generate(paddle.to_tensor(ids),
+                              max_new_tokens=5).numpy()[0]
+            assert full == [int(t) for t in want]
+
+    def test_prefill_chunk_must_align_to_block_size(self):
+        _, m = _tiny_gpt()
+        with pytest.raises(ValueError):
+            ServingEngine(m, block_size=16, prefill_chunk=8)
+
+    def test_fused_decode_matches_unfused(self):
+        cfg, m = _tiny_gpt()
+        rng = np.random.default_rng(5)
+        prompts = [list(rng.integers(0, cfg.vocab_size, n)) for n in (5, 9)]
+        eng1 = ServingEngine(m, max_slots=2, block_size=16, prefill_chunk=16)
+        eng4 = ServingEngine(m, max_slots=2, block_size=16, prefill_chunk=16)
+        eng4.fuse_steps = 4               # FLAGS_serving_fuse_steps analog
+        # 6 tokens with k=4 forces a mid-chunk budget overshoot: the extra
+        # fused steps must be dropped at flush, not returned
+        out1 = eng1.generate(prompts, max_new_tokens=6)
+        out4 = eng4.generate(prompts, max_new_tokens=6)
+        assert out1 == out4
+        assert all(len(o) == len(p) + 6 for o, p in zip(out4, prompts))
+
+    def test_eos_stops_early_and_reports_reason(self):
+        cfg, m = _tiny_gpt()
+        rng = np.random.default_rng(2)
+        prompt = list(rng.integers(0, cfg.vocab_size, 6))
+        # learn what greedy emits first, then declare it the eos token
+        ids = np.asarray([prompt], np.int32)
+        first = int(m.generate(paddle.to_tensor(ids),
+                               max_new_tokens=1).numpy()[0, -1])
+        eng = ServingEngine(m, max_slots=2, block_size=16, prefill_chunk=16)
+        req = eng.submit(prompt, max_new_tokens=8, eos_token_id=first)
+        eng.run_until_idle()
+        assert req.finish_reason == "stop"
+        assert req.output_tokens == [first]
+        t = req.telemetry()
+        assert t["queue_s"] is not None and t["ttft_s"] is not None
+
+
+# ------------------------------------------------------------- HTTP smoke
+class TestServingHTTP:
+    def test_generate_roundtrip_and_stats(self):
+        cfg, m = _tiny_gpt()
+        eng = ServingEngine(m, max_slots=2, block_size=16, prefill_chunk=16)
+        srv = ServingServer(eng, port=0)
+        try:
+            prompt = list(np.random.default_rng(3).integers(
+                0, cfg.vocab_size, 5))
+            body = json.dumps({"prompt": [int(t) for t in prompt],
+                               "max_new_tokens": 4}).encode()
+            req = urllib.request.Request(
+                srv.url() + "/generate", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                assert resp.status == 200
+                out = json.loads(resp.read())
+            assert len(out["output_tokens"]) == 4
+            assert out["finish_reason"] == "length"
+            assert out["telemetry"]["ttft_s"] is not None
+            # static greedy agrees with what came over the wire
+            ids = np.asarray([prompt], np.int32)
+            want = m.generate(paddle.to_tensor(ids),
+                              max_new_tokens=4).numpy()[0, -4:]
+            assert out["output_tokens"] == [int(t) for t in want]
+
+            with urllib.request.urlopen(srv.url() + "/stats",
+                                        timeout=30) as resp:
+                st = json.loads(resp.read())
+            assert st["kv"]["used_blocks"] == 0
+            with urllib.request.urlopen(srv.url() + "/healthz",
+                                        timeout=30) as resp:
+                assert json.loads(resp.read())["ok"] is True
+
+            bad = urllib.request.Request(
+                srv.url() + "/generate",
+                data=json.dumps({"prompt": "not-a-list"}).encode(),
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(bad, timeout=30)
+            assert ei.value.code == 400
+        finally:
+            srv.stop()

@@ -19,7 +19,9 @@ def test_gpt_int8_decode_matches_fp_tokens():
         0, cfg.vocab_size, (2, 6)).astype(np.int32)
     ref = m.generate(paddle.to_tensor(ids), max_new_tokens=5).numpy()
     done = quantize_for_generation(m)
-    assert len(done) == cfg.num_layers * 4  # qkv/out_proj/fc_in/fc_out
+    # qkv/out_proj/fc_in/fc_out per layer + the tied LM head projection
+    assert len(done) == cfg.num_layers * 4 + 1
+    assert "_head" in done
     out = m.generate(paddle.to_tensor(ids), max_new_tokens=5).numpy()
     # int8 rounding can flip an occasional argmax; most tokens agree
     assert (out[:, 6:] == ref[:, 6:]).mean() >= 0.6
@@ -44,6 +46,21 @@ def test_llama_int8_logits_close():
     # per-channel absmax int8: logits stay close in relative terms
     denom = np.abs(ref).max()
     assert np.abs(got - ref).max() / denom < 0.1
+
+
+def test_dequantize_weight_roundtrip():
+    # the hoisted CPU epilogue: one fp table from (int8 weight, scales),
+    # accurate to half a quantization step per output channel
+    from paddle_tpu.ops import api
+    from paddle_tpu.ops.kernels.quant import quantize_weight_absmax
+
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((32, 16)).astype(np.float32)
+    q, s = quantize_weight_absmax(jnp.asarray(w))
+    assert q.dtype == jnp.int8 and s.shape == (16,)
+    table = np.asarray(api.dequantize_weight(q, s))
+    step = np.abs(w).max(axis=0) / 127.0
+    assert np.all(np.abs(table - w) <= step[None, :] * 0.5 + 1e-6)
 
 
 def test_quantize_twice_is_idempotent():

@@ -34,6 +34,13 @@ PRESETS = {
 }
 
 
+def _timed_generate(model, ids, new):
+    t0 = time.time()
+    out = model.generate(ids, max_new_tokens=new)
+    _ = int(np.asarray(out._value)[0, -1])
+    return time.time() - t0
+
+
 def measure(name, quant, hidden, layers, heads, vocab, batch, prompt, new,
             max_pos, out_path):
     import jax
@@ -59,11 +66,10 @@ def measure(name, quant, hidden, layers, heads, vocab, batch, prompt, new,
     # value fetch = real sync (tunnel transports lie to block_until_ready)
     _ = int(np.asarray(out._value)[0, -1])
     first = time.time() - t0
-    # second run reuses every compiled program: pure decode throughput
-    t0 = time.time()
-    out = model.generate(ids, max_new_tokens=new)
-    _ = int(np.asarray(out._value)[0, -1])
-    dt = time.time() - t0
+    # warm runs reuse every compiled program: pure decode throughput.
+    # best-of-3 — same noise discipline as obsbench (host-load spikes on a
+    # shared CPU box flip 1-2% deltas, and fp-vs-int8 is gated on the sign)
+    dt = min(_timed_generate(model, ids, new) for _ in range(3))
     tps = batch * new / dt
     row = {
         "config": name, "quant": "int8" if quant else "fp",

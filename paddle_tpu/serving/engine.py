@@ -141,6 +141,7 @@ class ServingEngine:
         self._fns = None
         self._lock = threading.RLock()
         self._step_seed = 0
+        self._sample_nonce = 0   # per-admission entropy for _sample_host
         self.steps = 0
 
     # ------------------------------------------------------- compiled fns
@@ -238,6 +239,27 @@ class ServingEngine:
             self._jit[key] = jax.jit(step, donate_argnums=(3, 5, 7))
         return self._jit[key]
 
+    def _clear_slot_jit(self):
+        """Fused device-side slot clear for _finish: zero the slot's token,
+        block-table row, length and temperature in ONE dispatch. The decode
+        program keeps running over EVERY slot after a finish, so leaving
+        the device copies stale would keep writing the dead sequence's K/V
+        at advancing positions into its freed blocks — which the allocator
+        may have already handed to a newly admitted request in a DIFFERENT
+        slot (slot-LIFO and block-LIFO reuse can misalign). An all-zero
+        table row points the idle slot at the null block, where its writes
+        are harmless and its (len 0) context is never read."""
+        key = ("clear_slot", self.max_slots, self.max_blocks_per_seq)
+        if key not in self._jit:
+            def clear(toks, bt, sl, temps, slot):
+                return (toks.at[slot].set(0),
+                        bt.at[slot].set(jnp.zeros((bt.shape[1],), bt.dtype)),
+                        sl.at[slot].set(0),
+                        temps.at[slot].set(0.0))
+
+            self._jit[key] = jax.jit(clear)
+        return self._jit[key]
+
     def _admit_jit(self, chunk):
         """Fused admission for greedy requests: the first token (argmax of
         the prefill logits, ON device — no host sync per admitted prompt)
@@ -302,6 +324,18 @@ class ServingEngine:
         with self._lock:
             self.sched.submit(req)
         return req
+
+    def cancel(self, req: Request, reason: str = "cancelled") -> bool:
+        """Evict a request in any pre-finished state — queued, prefilling,
+        or running — releasing its slot and worst-case KV reservation
+        immediately. Used by the HTTP front end when a client times out or
+        disconnects, so abandoned requests stop consuming serving capacity.
+        Returns False if the request had already finished."""
+        with self._lock:
+            if req.state == "finished":
+                return False
+            self._finish(req, reason)
+            return True
 
     # ------------------------------------------------------------ tick
     def step(self) -> dict:
@@ -425,14 +459,18 @@ class ServingEngine:
                 self._finish(req, "length")
 
     def _sample_host(self, logits: np.ndarray, req: Request) -> int:
+        """First-token sampling for non-deferred admissions: same
+        fold_in(PRNGKey(0), seed) threefry scheme as the compiled decode
+        step, plus a per-admission nonce — two sampled requests admitted in
+        the SAME tick must draw from distinct streams, and the first token
+        must not replay what a decode tick at the same seed would emit."""
         if req.temperature <= 0.0:
             return int(logits.argmax())
-        lg = logits.astype(np.float64) / req.temperature
-        lg -= lg.max()
-        p = np.exp(lg)
-        p /= p.sum()
-        rng = np.random.default_rng(self._step_seed * 0x9E3779B1 + 7)
-        return int(rng.choice(len(p), p=p))
+        self._sample_nonce += 1
+        key = jax.random.fold_in(jax.random.PRNGKey(0), self._step_seed)
+        key = jax.random.fold_in(key, self._sample_nonce)
+        lg = jnp.asarray(logits, jnp.float32) / max(req.temperature, 1e-6)
+        return int(jax.random.categorical(key, lg, axis=-1))
 
     # ------------------------------------------------------------ decode
     def _dev_init(self):
@@ -508,6 +546,10 @@ class ServingEngine:
         for arr, (_, items) in zip(vals, pending):
             a = np.asarray(arr)
             for idx, slot, req in items:
+                # cancelled mid-flight: its slot may already belong to a
+                # NEW request — don't touch output_tokens or _toks[slot]
+                if req.state == "finished":
+                    continue
                 req._pending_n -= 1
                 # fused-step overshoot past the token budget: drop
                 if len(req.output_tokens) >= req.max_new_tokens:
@@ -536,6 +578,14 @@ class ServingEngine:
             self._lens[slot] = 0
             self._toks[slot] = 0
             self._temps[slot] = 0.0
+            if self._dev is not None:
+                # the blocks just freed can be reallocated to a request in
+                # another slot before this slot is refilled — clear the
+                # DEVICE copies too, or the next decode ticks keep writing
+                # this dead sequence's K/V into someone else's pages
+                d_toks, d_tables, d_lens, d_temps, d_seed = self._dev
+                self._dev = (*self._clear_slot_jit()(
+                    d_toks, d_tables, d_lens, d_temps, slot), d_seed)
         _GEN_TOKENS.inc(len(req.output_tokens))
         rate = req.decode_tokens_per_s()
         if rate is not None:

@@ -186,7 +186,14 @@ class Scheduler:
     def finish(self, req: Request, reason: str) -> None:
         """Evict: free blocks + slot immediately (the next admit() sees
         them), whatever state the request was in."""
-        if req.state == "prefill":
+        if req.state == "queued":
+            # cancel/timeout of a never-admitted request: drop it from the
+            # queue, or admit() would later re-admit a finished request
+            try:
+                self.waiting.remove(req)
+            except ValueError:
+                pass
+        elif req.state == "prefill":
             self.prefilling.remove(req)
         elif req.state == "running":
             self.running.pop(req.slot, None)

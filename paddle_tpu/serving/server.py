@@ -69,8 +69,13 @@ class _Handler(BaseHTTPRequestHandler):
             return
         timeout = float(get_flag("serving_request_timeout_s"))
         if not req.wait(timeout):
+            # evict the abandoned request so its slot and worst-case KV
+            # reservation go back to the pool instead of decoding for a
+            # client that already gave up
+            cancelled = self._srv.engine.cancel(req, reason="timeout")
             self._reply(504, {"error": "generation timed out",
-                              "request_id": req.request_id})
+                              "request_id": req.request_id,
+                              "cancelled": cancelled})
             return
         self._reply(200, {
             "request_id": req.request_id,

@@ -4,6 +4,7 @@ admission/eviction, engine decode parity with model.generate(), and an HTTP
 round-trip smoke over the stdlib front end.
 """
 import json
+import time
 import urllib.error
 import urllib.request
 
@@ -270,6 +271,24 @@ class TestScheduler:
         with pytest.raises(ValueError):
             s.submit(Request([]))
 
+    def test_finish_queued_request_is_dequeued(self):
+        # cancel/timeout of a never-admitted request: finish() must drop it
+        # from the waiting deque, or admit() later re-admits a finished
+        # request and overwrites its state
+        a = BlockAllocator(num_blocks=16, block_size=4)
+        s = Scheduler(a, max_slots=1, max_model_len=32)
+        r0, r1 = _req(4), _req(4)
+        s.submit(r0)
+        s.submit(r1)
+        s.admit()                         # r0 takes the only slot; r1 waits
+        s.finish(r1, "cancelled")
+        assert r1.state == "finished" and r1.wait(0)
+        assert not s.waiting
+        assert s.admit() == []            # r1 must NOT come back
+        assert r1.state == "finished"
+        s.finish(r0, "stop")
+        assert not s.has_work() and a.used_blocks == 0
+
 
 # ------------------------------------------------------------- engine
 def _tiny_gpt():
@@ -349,6 +368,116 @@ class TestServingEngine:
         t = req.telemetry()
         assert t["queue_s"] is not None and t["ttft_s"] is not None
 
+    @pytest.mark.slow
+    def test_finish_clears_device_slot_no_cross_request_corruption(self):
+        """Regression (r11 review, high): after a finish, the slot's DEVICE
+        block table / seq_len must be cleared, not just the host mirrors —
+        the compiled decode step keeps running over EVERY slot, and the
+        stale slot's K/V writes at advancing positions land in its freed
+        blocks, which the allocator hands to a newly admitted request in a
+        DIFFERENT slot.
+
+        Construction: A (3 blocks, finishes by eos MID-reservation, so its
+        frozen write pointer sits behind its reservation's end) and B (1
+        block, finishes by length) end in the SAME flush, A first — so C
+        is admitted into B's slot while A's slot stays stale, and C's
+        LIFO-popped table is [B's block, A's blocks...]. C's 24-token
+        prompt therefore extends into A's old blocks BEHIND A's frozen
+        pointer (len 11 -> C position 19): as C decodes, the stale slot
+        sprays garbage over C's already-scattered, always-attended prompt
+        tail and then trails two positions behind C's own write head —
+        unless _finish cleared the device-side slot. D is a long-lived
+        deferred request: its fused admission makes the device state a
+        genuine jit output (on CPU, jnp.asarray(host_mirror) can ALIAS the
+        numpy buffer, so _finish's host-mirror zeroing would mask the
+        stale-slot bug), and it keeps the decode loop ticking while C
+        prefills."""
+        cfg, m = _tiny_gpt()
+        rng = np.random.default_rng(4)
+        # A must finish by eos in DECODE (not at prefill): pick a prompt
+        # whose first two greedy continuations differ, eos = the second
+        for _ in range(32):
+            prompt_a = [int(t) for t in rng.integers(0, cfg.vocab_size, 10)]
+            ids = np.asarray([prompt_a], np.int32)
+            pair = m.generate(paddle.to_tensor(ids),
+                              max_new_tokens=2).numpy()[0, -2:]
+            if pair[0] != pair[1]:
+                break
+        else:
+            pytest.fail("no prompt with two distinct greedy tokens found")
+        eos_a = int(pair[1])
+        prompt_b = [int(t) for t in rng.integers(0, cfg.vocab_size, 5)]
+        prompt_d = [int(t) for t in rng.integers(0, cfg.vocab_size, 4)]
+        prompt_c = [int(t) for t in rng.integers(0, cfg.vocab_size, 24)]
+        # B gets an eos it never emits: keeps B on the non-deferred
+        # admission path, so the flush's finish order is A (slot 0) then B
+        # (slot 1) and C deterministically inherits B's slot
+        want_b = m.generate(paddle.to_tensor(np.asarray([prompt_b],
+                                                        np.int32)),
+                            max_new_tokens=2).numpy()[0]
+        eos_b = next(t for t in range(cfg.vocab_size)
+                     if t not in [int(x) for x in want_b[-2:]])
+        # 7 allocatable blocks of 8: A reserves 3 (10+8), B 1 (5+2), D 3
+        # (4+20) -> C (24+8 tokens, 4 blocks) must wait for A's AND B's
+        # frees, and pops exactly [B's block, A's three blocks]
+        eng = ServingEngine(m, max_slots=3, block_size=8, num_blocks=8,
+                            prefill_chunk=8)
+        ra = eng.submit(prompt_a, max_new_tokens=8, eos_token_id=eos_a)
+        rb = eng.submit(prompt_b, max_new_tokens=2, eos_token_id=eos_b)
+        rd = eng.submit(prompt_d, max_new_tokens=20)
+        rc = eng.submit(prompt_c, max_new_tokens=8)
+        eng.run_until_idle()
+        assert ra.finish_reason == "stop"
+        assert ra.output_tokens == [int(pair[0]), eos_a]
+        assert rb.finish_reason == "length"
+        for prompt, req, n_new in ((prompt_b, rb, 2), (prompt_d, rd, 20),
+                                   (prompt_c, rc, 8)):
+            ids = np.asarray([prompt], np.int32)
+            want = m.generate(paddle.to_tensor(ids),
+                              max_new_tokens=n_new).numpy()[0]
+            assert prompt + req.output_tokens == [int(t) for t in want]
+        st = eng.stats()
+        assert st["kv"]["used_blocks"] == 0 and st["running"] == 0
+
+    def test_cancel_running_request_frees_capacity(self):
+        cfg, m = _tiny_gpt()
+        rng = np.random.default_rng(6)
+        p0 = [int(t) for t in rng.integers(0, cfg.vocab_size, 5)]
+        p1 = [int(t) for t in rng.integers(0, cfg.vocab_size, 7)]
+        eng = ServingEngine(m, max_slots=2, block_size=16, prefill_chunk=16)
+        victim = eng.submit(p0, max_new_tokens=64)
+        for _ in range(4):                # running, deferred fetches queued
+            eng.step()
+        assert victim.state == "running"
+        assert eng.cancel(victim, reason="timeout")
+        assert victim.state == "finished"
+        assert victim.finish_reason == "timeout" and victim.wait(0)
+        assert not eng.cancel(victim)     # already finished: no-op
+        st = eng.stats()
+        assert st["kv"]["used_blocks"] == 0 and st["running"] == 0
+        # the recycled slot + blocks still serve correctly (and the stale
+        # deferred tokens of the cancelled request are dropped at flush)
+        out = eng.generate([p1], max_new_tokens=5)[0]
+        want = m.generate(paddle.to_tensor(np.asarray([p1], np.int32)),
+                          max_new_tokens=5).numpy()[0]
+        assert out == [int(t) for t in want]
+        assert victim.output_tokens == []  # flush must not resurrect it
+
+    def test_same_tick_sampled_admissions_draw_distinct_streams(self):
+        # r11 review: two temperature>0 requests admitted in one tick must
+        # not sample from identical RNG streams (_step_seed alone doesn't
+        # advance between same-tick admissions)
+        _, m = _tiny_gpt()
+        eng = ServingEngine(m, max_slots=2, block_size=16, prefill_chunk=16)
+        logits = np.zeros(64, np.float32)   # flat: the draw IS the stream
+        reqs = [Request([1], temperature=0.7) for _ in range(8)]
+        draws = [eng._sample_host(logits, r) for r in reqs]
+        assert len(set(draws)) > 1
+        # same engine history -> same stream (threefry fold_in, like the
+        # compiled decode path; not wall-clock or os entropy)
+        eng2 = ServingEngine(m, max_slots=2, block_size=16, prefill_chunk=16)
+        assert [eng2._sample_host(logits, r) for r in reqs] == draws
+
 
 # ------------------------------------------------------------- HTTP smoke
 class TestServingHTTP:
@@ -392,4 +521,40 @@ class TestServingHTTP:
                 urllib.request.urlopen(bad, timeout=30)
             assert ei.value.code == 400
         finally:
+            srv.stop()
+
+    def test_timeout_cancels_request_and_frees_capacity(self):
+        # r11 review: a 504 must evict the abandoned request — its slot and
+        # worst-case KV reservation go back to the pool instead of decoding
+        # to completion for a client that already gave up
+        from paddle_tpu.core import flags as _flags
+
+        cfg, m = _tiny_gpt()
+        eng = ServingEngine(m, max_slots=2, block_size=16, prefill_chunk=16)
+        srv = ServingServer(eng, port=0)
+        old = _flags.get_flag("serving_request_timeout_s")
+        _flags.set_flags({"serving_request_timeout_s": 0.05})
+        try:
+            prompt = [int(t) for t in np.random.default_rng(8).integers(
+                0, cfg.vocab_size, 5)]
+            body = json.dumps({"prompt": prompt,
+                               "max_new_tokens": 5000}).encode()
+            req = urllib.request.Request(
+                srv.url() + "/generate", data=body,
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=120)
+            assert ei.value.code == 504
+            assert json.loads(ei.value.read())["cancelled"] is True
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                st = eng.stats()
+                if (st["kv"]["used_blocks"] == 0 and st["running"] == 0
+                        and st["waiting"] == 0 and st["prefilling"] == 0):
+                    break
+                time.sleep(0.01)
+            else:
+                pytest.fail(f"capacity not released after timeout: {st}")
+        finally:
+            _flags.set_flags({"serving_request_timeout_s": old})
             srv.stop()

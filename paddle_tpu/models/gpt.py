@@ -246,7 +246,27 @@ class GPTModel(nn.Layer):
                     new_caches.append(nc)
                 return self.ln_f(h), new_caches
             pos_v = pos._value if isinstance(pos, Tensor) else jnp.asarray(pos)
-            pos_v = pos_v.astype(jnp.int32).reshape(())
+            pos_v = pos_v.astype(jnp.int32)
+            if pos_v.ndim == 1 and pos_v.shape[0] == b:
+                # ragged batched prefill (serving engine): each row starts
+                # at its OWN offset — per-token positions ride the packed
+                # rope / gathered wpe form, and the cached attention op
+                # takes the per-row offset vector
+                pos2d = pos_v[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
+                if self.config.use_rotary:
+                    cos, sin = self._rope(
+                        self.config.max_position_embeddings)
+                    rope = (cos, sin, Tensor(pos2d))
+                else:
+                    h = h + self.wpe(Tensor(pos2d))
+                h = self.drop(h)
+                new_caches = []
+                for block, cache in zip(self.blocks, caches):
+                    h, nc = block(h, rope=rope, cache=cache,
+                                  pos=Tensor(pos_v))
+                    new_caches.append(nc)
+                return self.ln_f(h), new_caches
+            pos_v = pos_v.reshape(())
             if self.config.use_rotary:
                 cos, sin = self._rope(self.config.max_position_embeddings)
                 rope = (Tensor(lax.dynamic_slice(

@@ -201,7 +201,20 @@ class LlamaModel(nn.Layer):
                     new_caches.append(nc)
                 return self.norm(h), new_caches
             pos_v = pos._value if isinstance(pos, Tensor) else jnp.asarray(pos)
-            pos_v = pos_v.astype(jnp.int32).reshape(())
+            pos_v = pos_v.astype(jnp.int32)
+            if pos_v.ndim == 1 and pos_v.shape[0] == input_ids.shape[0]:
+                # ragged batched prefill (serving engine): per-row offsets
+                # via the packed-rope form; cached attention takes the
+                # offset vector
+                pos2d = pos_v[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
+                rope = (self._rope[0], self._rope[1], Tensor(pos2d))
+                h = self.embed_tokens(input_ids)
+                new_caches = []
+                for layer, cache in zip(self.layers, caches):
+                    h, nc = layer(h, rope, cache=cache, pos=Tensor(pos_v))
+                    new_caches.append(nc)
+                return self.norm(h), new_caches
+            pos_v = pos_v.reshape(())
             d = self._rope[0].shape[-1]
             cos = Tensor(lax.dynamic_slice(self._rope[0]._value,
                                            (pos_v, 0), (s, d)))

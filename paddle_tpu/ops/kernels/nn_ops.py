@@ -855,28 +855,45 @@ def cached_multihead_attention(q, k, v, k_cache, v_cache, pos, scale=None):
     store unrepeated KV heads and broadcast at compute time.
 
     q: [b, sq, hq, d]; k,v: [b, sq, hkv, d]; pos: scalar int32 (tokens
-    already in the cache). Returns (out [b, sq, hq, d], k_cache, v_cache).
+    already in the cache) — or a PER-ROW int32 vector [b] for ragged
+    batched prefill (each row's new tokens land at its own offset; writes
+    past max_len are dropped, and each row masks to its own prefix).
+    Returns (out [b, sq, hq, d], k_cache, v_cache).
     """
     b, sq, hq, d = q.shape
     max_len = k_cache.shape[1]
     hkv = k_cache.shape[2]
-    pos = jnp.asarray(pos, jnp.int32).reshape(())
-    k_cache = jax.lax.dynamic_update_slice(
-        k_cache, k.astype(k_cache.dtype), (0, pos, 0, 0))
-    v_cache = jax.lax.dynamic_update_slice(
-        v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0))
+    pos = jnp.asarray(pos, jnp.int32)
+    if pos.ndim == 1 and pos.shape[0] == b:
+        pos = pos.reshape(b)
+        idx = pos[:, None] + jnp.arange(sq, dtype=jnp.int32)[None, :]
+        bidx = jnp.arange(b)[:, None]
+        # per-row scatter (out-of-bounds rows/positions drop harmlessly)
+        k_cache = k_cache.at[bidx, idx].set(k.astype(k_cache.dtype))
+        v_cache = v_cache.at[bidx, idx].set(v.astype(v_cache.dtype))
+        # [b, sq, max_len]: row r's query i sees keys <= pos[r] + i
+        mask = (jnp.arange(max_len)[None, None, :]
+                <= idx[:, :, None])
+        attn_mask = mask[:, None]        # broadcast over heads
+    else:
+        pos = pos.reshape(())
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, pos, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0))
+        # rows: new queries at absolute positions pos..pos+sq-1; each sees
+        # keys at absolute positions <= its own (causal over the prefix)
+        mask = (jnp.arange(max_len)[None, :]
+                <= pos + jnp.arange(sq)[:, None])  # [sq, max_len]
+        attn_mask = mask[None, None]
     k_all, v_all = k_cache, v_cache
     if hkv != hq:
         rep = hq // hkv
         k_all = jnp.repeat(k_all, rep, axis=2)
         v_all = jnp.repeat(v_all, rep, axis=2)
-    # rows: new queries at absolute positions pos..pos+sq-1; each sees keys
-    # at absolute positions <= its own (causal over the valid prefix)
-    mask = (jnp.arange(max_len)[None, :]
-            <= pos + jnp.arange(sq)[:, None])  # [sq, max_len]
     out = scaled_dot_product_attention(
         q, k_all.astype(q.dtype), v_all.astype(q.dtype),
-        attn_mask=mask[None, None], is_causal=False, training=False,
+        attn_mask=attn_mask, is_causal=False, training=False,
         scale=scale)
     return out, k_cache, v_cache
 

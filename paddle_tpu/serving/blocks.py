@@ -1,31 +1,60 @@
-"""Paged KV-cache block allocator (host-side bookkeeping).
+"""Paged KV-cache block allocator (host-side bookkeeping) with automatic
+prefix caching.
 
 The device pool (paged.py) is a fixed array of NUM_BLOCKS fixed-size token
-blocks; this allocator owns which block belongs to which sequence. All
-operations are O(1) amortized: the free list is a stack (LIFO reuse keeps
-recently-touched blocks hot), a sequence's block table is an append-only
-list, and free() pushes the whole table back in one pass.
+blocks; this allocator owns which block belongs to which sequence. The free
+list is a stack (LIFO reuse keeps recently-touched blocks hot), a sequence's
+block table is an append-only list, and free() releases the whole table in
+one pass.
 
 Block 0 is reserved as the NULL block: inactive decode slots point their
 block tables at it so the compiled decode step can write the (masked,
-garbage) KV of idle slots somewhere harmless without branching.
+garbage) KV of idle slots somewhere harmless without branching. The null
+block is never handed out and never cached.
+
+Prefix caching (vLLM-style, over FULL blocks only):
+
+  * every block is refcounted; a block may appear in several sequences'
+    tables at once (shared prompt prefix) — refcount == number of tables
+    (plus copy-on-write pins) holding it;
+  * a sequence's prompt is chain-hashed per full block (blake2b over the
+    previous block's digest + this block's token ids), so a block's key
+    identifies the whole prefix up to and including it;
+  * `register_prefix` publishes a finished prefill's full prompt blocks
+    into the hash index; `reserve_prefix` looks new prompts up and returns
+    a table whose head is the shared cached blocks — the engine prefils
+    only the unmatched suffix;
+  * when a sequence's refcount on a hashed block drops to zero the block is
+    NOT returned to the free list: it parks in an LRU pool of evictable
+    cached blocks, still indexed, still matchable. Capacity pressure
+    reclaims from the LRU tail only after the free list is empty;
+  * a write may never land in a block another reader can see: full blocks
+    are immutable by construction (only partial tail blocks are written,
+    and those are never hashed/shared), and the one exception — a prompt
+    that is ENTIRELY cached, whose re-decoded last token would land in the
+    final shared block — is handled by copy-on-write: `reserve_prefix`
+    forks that block (fresh private block in the table, the shared source
+    pinned until the sequence finishes so the engine can copy its device
+    contents before any eviction).
 
 Occupancy/fragmentation are surfaced through the observability metrics
 registry (always-on gauges — serving runs don't require FLAGS_metrics):
 
   serving_kv_blocks_total / _used / _free   pool shape
+  serving_kv_cached_blocks                  evictable cached (refcount-0)
   serving_kv_tokens                         live tokens across sequences
   serving_kv_occupancy                      used blocks / allocatable blocks
   serving_kv_fragmentation                  1 - tokens/(used * block_size)
-                                            (internal fragmentation: tail
-                                            waste of partially-filled last
-                                            blocks)
+
+Gauge publication is O(1): running counters, never a sum over sequences.
 """
 from __future__ import annotations
 
-from typing import Dict, List
+import hashlib
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
 
-from ..observability.registry import gauge as _gauge
+from ..observability.registry import counter as _counter, gauge as _gauge
 
 _BLOCKS_TOTAL = _gauge("serving_kv_blocks_total",
                        "KV pool size in blocks (excl. the null block).",
@@ -35,6 +64,9 @@ _BLOCKS_USED = _gauge("serving_kv_blocks_used",
                       always=True)
 _BLOCKS_FREE = _gauge("serving_kv_blocks_free",
                       "KV blocks on the free list.", always=True)
+_BLOCKS_CACHED = _gauge("serving_kv_cached_blocks",
+                        "Evictable prefix-cache blocks (hashed, refcount 0).",
+                        always=True)
 _TOKENS = _gauge("serving_kv_tokens",
                  "Live KV tokens across all sequences.", always=True)
 _OCCUPANCY = _gauge("serving_kv_occupancy",
@@ -42,6 +74,18 @@ _OCCUPANCY = _gauge("serving_kv_occupancy",
 _FRAG = _gauge("serving_kv_fragmentation",
                "1 - tokens/(used*block_size): tail waste of partially "
                "filled last blocks.", always=True)
+_PREFIX_HITS = _counter("serving_prefix_cache_hits_total",
+                        "Admissions that matched >=1 cached prefix block.",
+                        always=True)
+_PREFIX_MISSES = _counter("serving_prefix_cache_misses_total",
+                          "Admissions that matched no cached block.",
+                          always=True)
+_PREFIX_HIT_TOKENS = _counter("serving_prefix_hit_tokens_total",
+                              "Prompt tokens served from the prefix cache "
+                              "(prefill skipped).", always=True)
+_PREFIX_EVICTIONS = _counter("serving_prefix_evictions_total",
+                             "Cached blocks reclaimed under capacity "
+                             "pressure.", always=True)
 
 
 class BlockAllocator:
@@ -50,17 +94,33 @@ class BlockAllocator:
 
     NULL_BLOCK = 0
 
-    def __init__(self, num_blocks: int, block_size: int):
+    def __init__(self, num_blocks: int, block_size: int,
+                 prefix_cache: bool = True):
         if num_blocks < 2:
             raise ValueError("need at least 2 blocks (one is the null block)")
         if block_size < 1:
             raise ValueError("block_size must be >= 1")
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
+        self.prefix_cache = bool(prefix_cache)
         # stack: LIFO reuse; block 0 reserved (never handed out)
         self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
         self._tables: Dict[object, List[int]] = {}
         self._lens: Dict[object, int] = {}
+        # refcounts for LIVE blocks only (block in >=1 table or pinned)
+        self._ref: Dict[int, int] = {}
+        # content addressing: block -> chain digest, digest -> block. A
+        # hashed block keeps its digest while live AND while evictable;
+        # both maps drop the entry together on eviction.
+        self._digest: Dict[int, bytes] = {}
+        self._index: Dict[bytes, int] = {}
+        # refcount-0 hashed blocks, LRU order (oldest first = evict first)
+        self._evictable: "OrderedDict[int, None]" = OrderedDict()
+        # copy-on-write source pins: seq_id -> blocks held alive beyond the
+        # table so the engine can device-copy them before any eviction
+        self._extra: Dict[object, List[int]] = {}
+        self._tokens = 0            # running sum of _lens (O(1) publish)
+        self.last_fork: Optional[Tuple[int, int]] = None
         self._publish()
 
     # -- capacity ---------------------------------------------------------
@@ -70,13 +130,128 @@ class BlockAllocator:
 
     @property
     def used_blocks(self) -> int:
-        return (self.num_blocks - 1) - len(self._free)
+        return len(self._ref)
+
+    @property
+    def cached_blocks(self) -> int:
+        return len(self._evictable)
+
+    @property
+    def available_blocks(self) -> int:
+        """Blocks a new reservation can claim: free + evictable cached."""
+        return len(self._free) + len(self._evictable)
 
     def blocks_for(self, n_tokens: int) -> int:
         return -(-int(n_tokens) // self.block_size)  # ceil div
 
     def can_allocate(self, n_tokens: int) -> bool:
-        return self.blocks_for(n_tokens) <= len(self._free)
+        return self.blocks_for(n_tokens) <= self.available_blocks
+
+    # -- content addressing -----------------------------------------------
+    def block_hashes(self, tokens) -> List[bytes]:
+        """Chain digests for every FULL block of `tokens`: digest i commits
+        to tokens[0 : (i+1)*block_size], so equal digests imply equal whole
+        prefixes (not just equal blocks)."""
+        out: List[bytes] = []
+        prev = b""
+        bs = self.block_size
+        for i in range(len(tokens) // bs):
+            h = hashlib.blake2b(prev, digest_size=16)
+            for t in tokens[i * bs:(i + 1) * bs]:
+                h.update(int(t).to_bytes(8, "little", signed=True))
+            prev = h.digest()
+            out.append(prev)
+        return out
+
+    def _match(self, tokens) -> List[int]:
+        """Longest run of cached blocks covering a prefix of `tokens`."""
+        if not self.prefix_cache:
+            return []
+        matched: List[int] = []
+        for key in self.block_hashes(tokens):
+            blk = self._index.get(key)
+            if blk is None:
+                break
+            matched.append(blk)
+        return matched
+
+    def peek_match(self, tokens) -> int:
+        """Prompt tokens a reservation would serve from cache (no side
+        effects; scheduler admission gating)."""
+        m = len(self._match(tokens))
+        return min(m * self.block_size, len(tokens))
+
+    def blocks_needed(self, tokens, total_tokens: int) -> int:
+        """NEW blocks a reserve_prefix() would claim from the pool (the
+        suffix worst case, +1 when a full-prompt match forks its last
+        block). Excludes revived cached blocks — those were already
+        resident."""
+        plen = len(tokens)
+        matched = self._match(tokens)
+        m = len(matched)
+        need = self.blocks_for(max(int(total_tokens), plen, 1)) - m
+        if m and m * self.block_size >= plen:
+            need += 1   # copy-on-write fork of the last shared block
+        return need
+
+    def can_reserve_prefix(self, tokens, total_tokens: int) -> bool:
+        """Admission gate: do the suffix's new blocks fit beside the
+        matched blocks that must be revived out of the evictable pool?"""
+        matched = self._match(tokens)
+        revive = sum(1 for b in matched if b in self._evictable)
+        plen = len(tokens)
+        m = len(matched)
+        need = self.blocks_for(max(int(total_tokens), plen, 1)) - m
+        if m and m * self.block_size >= plen:
+            need += 1
+        return need + revive <= self.available_blocks
+
+    # -- block pool internals ---------------------------------------------
+    def _pop_block(self) -> int:
+        """A blank block: the free stack first, then evict the LRU cached
+        block (dropping its index entry — the prefix is gone)."""
+        if self._free:
+            return self._free.pop()
+        if self._evictable:
+            blk, _ = self._evictable.popitem(last=False)   # oldest first
+            key = self._digest.pop(blk)
+            del self._index[key]
+            _PREFIX_EVICTIONS.inc()
+            return blk
+        raise MemoryError("KV pool exhausted")
+
+    def _claim(self, need: int) -> List[int]:
+        if need > self.available_blocks:
+            raise MemoryError(
+                f"KV pool exhausted: need {need} blocks, "
+                f"{self.available_blocks} available")
+        out = []
+        for _ in range(need):
+            blk = self._pop_block()
+            self._ref[blk] = 1
+            out.append(blk)
+        return out
+
+    def _decref(self, blk: int) -> bool:
+        """Drop one reference; True when the block left the live set."""
+        n = self._ref[blk] - 1
+        if n > 0:
+            self._ref[blk] = n
+            return False
+        del self._ref[blk]
+        if blk in self._digest and self.prefix_cache:
+            self._evictable[blk] = None          # newest at the LRU tail
+        else:
+            self._free.append(blk)
+        return True
+
+    def _revive(self, blk: int) -> None:
+        """Take a matched block live (cached -> referenced, or +1 ref)."""
+        if blk in self._ref:
+            self._ref[blk] += 1
+        else:
+            del self._evictable[blk]
+            self._ref[blk] = 1
 
     # -- lifecycle --------------------------------------------------------
     def allocate(self, seq_id, n_tokens: int) -> List[int]:
@@ -86,13 +261,10 @@ class BlockAllocator:
         if seq_id in self._tables:
             raise KeyError(f"sequence {seq_id!r} already allocated")
         need = self.blocks_for(max(int(n_tokens), 1))
-        if need > len(self._free):
-            raise MemoryError(
-                f"KV pool exhausted: need {need} blocks, {len(self._free)} "
-                f"free")
-        table = [self._free.pop() for _ in range(need)]
+        table = self._claim(need)
         self._tables[seq_id] = table
         self._lens[seq_id] = int(n_tokens)
+        self._tokens += int(n_tokens)
         self._publish()
         return table
 
@@ -106,39 +278,137 @@ class BlockAllocator:
         if seq_id in self._tables:
             raise KeyError(f"sequence {seq_id!r} already allocated")
         need = self.blocks_for(max(int(total_tokens), int(n_tokens), 1))
-        if need > len(self._free):
-            raise MemoryError(
-                f"KV pool exhausted: need {need} blocks, {len(self._free)} "
-                f"free")
-        table = [self._free.pop() for _ in range(need)]
+        table = self._claim(need)
         self._tables[seq_id] = table
         self._lens[seq_id] = int(n_tokens)
+        self._tokens += int(n_tokens)
         self._publish()
         return table
 
+    def reserve_prefix(self, seq_id, tokens,
+                       total_tokens: int) -> Tuple[List[int], int,
+                                                   Optional[int], int]:
+        """reserve(), but the table's head reuses cached blocks matching
+        the prompt's full-block prefix. Returns
+        `(table, matched_tokens, cow_src, new_blocks)`:
+
+          * `matched_tokens` — prompt tokens whose KV is already resident;
+            the engine prefils only `tokens[matched_tokens:]`;
+          * `cow_src` — when the ENTIRE prompt matched, the engine enters
+            decode directly and its first write would land in the last
+            shared block: that table entry is a fresh private fork and
+            `cow_src` is the shared source to device-copy from (pinned
+            until free(seq_id) so concurrent admissions can't evict it);
+          * `new_blocks` — blocks claimed from the pool (suffix worst case
+            + fork), the number capacity actually shrank by.
+        """
+        if seq_id in self._tables:
+            raise KeyError(f"sequence {seq_id!r} already allocated")
+        plen = len(tokens)
+        matched = self._match(tokens)
+        m = len(matched)
+        total = self.blocks_for(max(int(total_tokens), plen, 1))
+        full_match = bool(m) and m * self.block_size >= plen
+        need = total - m + (1 if full_match else 0)
+        revive = sum(1 for b in matched if b in self._evictable)
+        if need + revive > self.available_blocks:
+            raise MemoryError(
+                f"KV pool exhausted: need {need} blocks beside {revive} "
+                f"revivals, {self.available_blocks} available")
+        # revive FIRST: _pop_block must never evict a block we matched
+        for blk in matched:
+            self._revive(blk)
+        fresh = self._claim(need)
+        cow_src: Optional[int] = None
+        if full_match:
+            # fork the last shared block: the fresh block takes its table
+            # slot, the source stays referenced (pinned outside the table)
+            # until this sequence finishes so the engine can copy its
+            # device contents without racing an eviction
+            cow_src = matched[-1]
+            table = matched[:-1] + [fresh[0]] + fresh[1:]
+            self._extra.setdefault(seq_id, []).append(cow_src)
+        else:
+            table = matched + fresh
+        self._tables[seq_id] = table
+        self._lens[seq_id] = plen
+        self._tokens += plen
+        matched_tokens = min(m * self.block_size, plen)
+        if m:
+            _PREFIX_HITS.inc()
+            _PREFIX_HIT_TOKENS.inc(matched_tokens)
+        elif self.prefix_cache:
+            _PREFIX_MISSES.inc()
+        self._publish()
+        return table, matched_tokens, cow_src, need
+
+    def register_prefix(self, seq_id, tokens) -> int:
+        """Publish a prefilled prompt's full blocks into the hash index so
+        later prompts can share them. Call AFTER the prefix KV has been
+        scattered into the pool pages. Idempotent; blocks whose content key
+        already maps to a DIFFERENT block stay private (no live dedup).
+        Returns how many blocks were newly indexed."""
+        if not self.prefix_cache:
+            return 0
+        table = self._tables[seq_id]
+        added = 0
+        for i, key in enumerate(self.block_hashes(tokens)):
+            blk = table[i]
+            if blk == self.NULL_BLOCK or blk in self._digest:
+                continue
+            if key in self._index:
+                continue
+            self._digest[blk] = key
+            self._index[key] = blk
+            added += 1
+        return added
+
     def append_token(self, seq_id) -> List[int]:
         """Account one decoded token; grows the block table by one block
-        when the sequence crosses a block boundary. Returns the (possibly
-        grown) table. Raises MemoryError when a needed block isn't there —
-        the scheduler preempts or queues in that case."""
+        when the sequence crosses a block boundary, and copy-on-write forks
+        the destination block if it is shared (refcount > 1) or published
+        in the prefix index — a write must never be visible to another
+        reader. The fork is recorded in `self.last_fork = (src, dst)` so a
+        caller that owns device state can copy the contents. Raises
+        MemoryError when a needed block isn't there — the scheduler
+        preempts or queues in that case."""
         table = self._tables[seq_id]
         n = self._lens[seq_id] + 1
+        self.last_fork = None
         if self.blocks_for(n) > len(table):
-            if not self._free:
+            if not self.available_blocks:
                 raise MemoryError("KV pool exhausted on append")
-            table.append(self._free.pop())
+            blk = self._pop_block()
+            self._ref[blk] = 1
+            table.append(blk)
+        else:
+            bi = (n - 1) // self.block_size   # block receiving this token
+            blk = table[bi]
+            if self._ref.get(blk, 0) > 1 or blk in self._digest:
+                dst = self._pop_block()
+                self._ref[dst] = 1
+                table[bi] = dst
+                self._decref(blk)
+                self.last_fork = (blk, dst)
         self._lens[seq_id] = n
+        self._tokens += 1
         self._publish()
         return table
 
     def free(self, seq_id) -> int:
-        """Release a sequence's blocks back to the pool (immediate reuse).
-        Returns how many blocks were released."""
+        """Release a sequence's references. Unhashed blocks whose refcount
+        hits zero go straight back to the free stack (immediate LIFO
+        reuse); hashed blocks park in the evictable LRU pool, still
+        matchable. Returns how many blocks left the live set."""
         table = self._tables.pop(seq_id)
-        self._lens.pop(seq_id)
-        self._free.extend(reversed(table))  # LIFO: reuse hottest first
+        self._tokens -= self._lens.pop(seq_id)
+        released = 0
+        for blk in reversed(table):      # LIFO: reuse hottest first
+            released += self._decref(blk)
+        for blk in self._extra.pop(seq_id, ()):
+            released += self._decref(blk)
         self._publish()
-        return len(table)
+        return released
 
     # -- introspection ----------------------------------------------------
     def table(self, seq_id) -> List[int]:
@@ -150,35 +420,76 @@ class BlockAllocator:
     def sequences(self):
         return list(self._tables)
 
+    def refcount(self, blk: int) -> int:
+        return self._ref.get(blk, 0)
+
+    def check_invariants(self) -> None:
+        """Conservation + sharing invariants (tests call this after every
+        mutation sequence; cheap enough for production asserts too)."""
+        allocatable = self.num_blocks - 1
+        live = set(self._ref)
+        ev = set(self._evictable)
+        free = set(self._free)
+        assert not (live & ev) and not (live & free) and not (ev & free), \
+            "a block is in two pools at once"
+        assert len(live) + len(ev) + len(free) == allocatable, \
+            f"conservation violated: {len(live)}+{len(ev)}+{len(free)} " \
+            f"!= {allocatable}"
+        assert self.NULL_BLOCK not in live | ev | free
+        assert self.NULL_BLOCK not in self._digest
+        # refcount >= number of live readers
+        readers: Dict[int, int] = {}
+        for t in self._tables.values():
+            for b in t:
+                readers[b] = readers.get(b, 0) + 1
+        for pins in self._extra.values():
+            for b in pins:
+                readers[b] = readers.get(b, 0) + 1
+        for b, r in readers.items():
+            assert self._ref.get(b, 0) == r, \
+                f"block {b}: refcount {self._ref.get(b, 0)} != {r} readers"
+        assert set(readers) == live
+        # index <-> digest are inverse bijections over hashed blocks
+        assert {v: k for k, v in self._index.items()} == self._digest
+        assert ev <= set(self._digest)
+        assert self._tokens == sum(self._lens.values())
+
     def occupancy_report(self) -> dict:
         """Pool shape + occupancy/fragmentation, the dict the metrics
         gauges mirror (and servebench embeds in its report)."""
         allocatable = self.num_blocks - 1
         used = self.used_blocks
-        tokens = sum(self._lens.values())
+        tokens = self._tokens
         cap = used * self.block_size
         return {
             "num_blocks": allocatable,
             "block_size": self.block_size,
             "used_blocks": used,
             "free_blocks": len(self._free),
+            "cached_blocks": len(self._evictable),
             "sequences": len(self._tables),
             "tokens": tokens,
             "occupancy": used / allocatable if allocatable else 0.0,
-            "fragmentation": 1.0 - tokens / cap if cap else 0.0,
+            # shared blocks can make per-sequence token sums exceed the
+            # unique-block capacity; clamp at 0 (no tail waste)
+            "fragmentation": max(0.0, 1.0 - tokens / cap) if cap else 0.0,
         }
 
     def _publish(self):
-        r = self.occupancy_report()
-        _BLOCKS_TOTAL.set(r["num_blocks"])
-        _BLOCKS_USED.set(r["used_blocks"])
-        _BLOCKS_FREE.set(r["free_blocks"])
-        _TOKENS.set(r["tokens"])
-        _OCCUPANCY.set(r["occupancy"])
-        _FRAG.set(r["fragmentation"])
+        # O(1): running counters only — never a sum over sequences
+        allocatable = self.num_blocks - 1
+        used = len(self._ref)
+        cap = used * self.block_size
+        _BLOCKS_TOTAL.set(allocatable)
+        _BLOCKS_USED.set(used)
+        _BLOCKS_FREE.set(len(self._free))
+        _BLOCKS_CACHED.set(len(self._evictable))
+        _TOKENS.set(self._tokens)
+        _OCCUPANCY.set(used / allocatable if allocatable else 0.0)
+        _FRAG.set(max(0.0, 1.0 - self._tokens / cap) if cap else 0.0)
 
     def __repr__(self):  # pragma: no cover
         r = self.occupancy_report()
         return (f"BlockAllocator(blocks={r['used_blocks']}/"
-                f"{r['num_blocks']}, seqs={r['sequences']}, "
-                f"occ={r['occupancy']:.2f})")
+                f"{r['num_blocks']}, cached={r['cached_blocks']}, "
+                f"seqs={r['sequences']}, occ={r['occupancy']:.2f})")

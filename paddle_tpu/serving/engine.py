@@ -71,6 +71,17 @@ _flags.define_flag("serving_fuse_steps", 1,
 _flags.define_flag("serving_max_model_len", 0,
                    "Serving context cap (prompt + generated). 0 = the "
                    "model's max_position_embeddings.")
+_flags.define_flag("serving_prefix_cache", True,
+                   "Automatic prefix caching: content-address full KV "
+                   "blocks so prompts sharing a prefix skip its prefill "
+                   "and share the blocks (copy-on-write on full-prompt "
+                   "hits).")
+_flags.define_flag("serving_prefill_bucket", 16,
+                   "Length bucket (tokens) for the batched multi-prompt "
+                   "prefill program: a burst's unmatched suffixes pad to "
+                   "one bucketed [n_prompts, max_suffix] dispatch instead "
+                   "of one program per prompt. 0 disables batching "
+                   "(per-prompt chunked prefill only).")
 
 _TTFT_H = _histogram("serving_ttft_seconds",
                      "Arrival -> first token, per request.", always=True)
@@ -80,6 +91,9 @@ _TOKRATE_H = _histogram("serving_decode_tokens_per_s",
                         "Per-request steady-state decode rate.", always=True)
 _GEN_TOKENS = _counter("serving_generated_tokens_total",
                        "Tokens generated across all requests.", always=True)
+_PREFILL_TOKENS = _counter("serving_prefill_tokens_total",
+                           "Prompt tokens actually computed by prefill "
+                           "(cache hits skip theirs).", always=True)
 
 
 class ServingEngine:
@@ -93,7 +107,9 @@ class ServingEngine:
                  block_size: Optional[int] = None,
                  num_blocks: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
-                 max_model_len: Optional[int] = None):
+                 max_model_len: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None,
+                 prefill_bucket: Optional[int] = None):
         self.model = model
         model.eval()
         n_layers, n_kv, head_dim, max_pos = model._decode_geometry()
@@ -115,9 +131,15 @@ class ServingEngine:
                               auto_blocks)
         self._dtype = model._cache_dtype()
         self._geometry = (n_layers, n_kv, head_dim)
+        self.prefix_cache = (bool(_flags.get_flag("serving_prefix_cache"))
+                             if prefix_cache is None else bool(prefix_cache))
+        self.prefill_bucket = int(
+            _flags.get_flag("serving_prefill_bucket")
+            if prefill_bucket is None else prefill_bucket)
         self.pool = PagedKVPool(self.num_blocks, self.block_size, n_layers,
                                 n_kv, head_dim, self._dtype)
-        self.allocator = BlockAllocator(self.num_blocks, self.block_size)
+        self.allocator = BlockAllocator(self.num_blocks, self.block_size,
+                                        prefix_cache=self.prefix_cache)
         self.sched = Scheduler(self.allocator, self.max_slots,
                                self.max_model_len)
         # host mirror of per-slot decode state; the authoritative copies
@@ -143,6 +165,11 @@ class ServingEngine:
         self._step_seed = 0
         self._sample_nonce = 0   # per-admission entropy for _sample_host
         self.steps = 0
+        # prefill accounting (servebench + the batched-dispatch test)
+        self.prefill_programs = 0    # prefill dispatches, chunked + batched
+        self.batched_prefills = 0    # batched multi-prompt dispatches
+        self.prefill_tokens = 0      # prompt tokens actually computed
+        self.cow_admissions = 0      # full-prompt hits (zero prefill)
 
     # ------------------------------------------------------- compiled fns
     def _functional(self):
@@ -295,6 +322,100 @@ class ServingEngine:
             self._jit[key] = jax.jit(pf, donate_argnums=(3,))
         return self._jit[key]
 
+    def _gather_jit(self, padded, mb):
+        """Materialize a prefill workspace whose head is a cached prefix
+        gathered from the pool pages (prefix-cache partial hit: the suffix
+        chunks run the contiguous cached path on top of it). Pages are NOT
+        donated — they stay the live pool."""
+        key = ("gather", padded, mb)
+        if key not in self._jit:
+            bs = self.block_size
+            n = mb * bs
+
+            def g(pages, table):
+                out = []
+                for kp, vp in pages:
+                    hkv, d = kp.shape[2], kp.shape[3]
+                    k = jnp.zeros((1, padded, hkv, d), kp.dtype)
+                    v = jnp.zeros((1, padded, hkv, d), vp.dtype)
+                    k = k.at[0, :n].set(kp[table].reshape(n, hkv, d))
+                    v = v.at[0, :n].set(vp[table].reshape(n, hkv, d))
+                    out.append((k, v))
+                return out
+
+            self._jit[key] = jax.jit(g)
+        return self._jit[key]
+
+    def _admit_cow_jit(self):
+        """Full-prompt cache hit: fork the last shared block (device copy
+        src -> dst across every layer — the only block the re-decoded last
+        prompt token will write) and scatter the slot's decode state, one
+        dispatch. Pages are donated (in-place pool update); the decode
+        state tensors are not (the token vector may be referenced by the
+        deferred-flush queue)."""
+        key = ("admit_cow", self.max_slots, self.max_blocks_per_seq)
+        if key not in self._jit:
+            def f(pages, toks, bt, sl, temps, src, dst, slot, table, plen,
+                  tok, temp):
+                new = [(kp.at[dst].set(kp[src]), vp.at[dst].set(vp[src]))
+                       for kp, vp in pages]
+                return (new,
+                        toks.at[slot].set(tok),
+                        bt.at[slot].set(table),
+                        sl.at[slot].set(plen),
+                        temps.at[slot].set(temp))
+
+            self._jit[key] = jax.jit(f, donate_argnums=(0,))
+        return self._jit[key]
+
+    def _batched_prefill_jit(self, S, P):
+        """ONE compiled program admitting up to max_slots prompts: gather
+        each row's cached prefix into a contiguous [n, P] workspace, run
+        the model over the padded [n, S] suffixes with PER-ROW position
+        offsets, argmax each row's first token at its own last real index,
+        scatter the workspaces back to the pool pages and the rows' decode
+        state into the live slots — so a burst of N admissions costs one
+        dispatch instead of N.
+
+        Padding rows are inert by construction: their block tables are all
+        null (write-back garbage lands in block 0, the idle-slot dumping
+        ground) and their slot index is max_slots, which jax's scatter
+        drops as out-of-bounds. Shared prefix blocks appear in several
+        rows' tables; every row scatters back the IDENTICAL bytes it
+        gathered, so duplicate-index writes are deterministic."""
+        n = self.max_slots
+        key = ("batched_prefill", n, S, P)
+        if key not in self._jit:
+            static_fn = self._functional()[1]
+            bs = self.block_size
+            nb = P // bs
+
+            def bp(pv, bv, pages, ids, pos, tP, last, slots, bt_rows,
+                   plens, temps, d_toks, d_bt, d_sl, d_temps):
+                caches = []
+                for kp, vp in pages:
+                    hkv, d = kp.shape[2], kp.shape[3]
+                    caches.append((kp[tP].reshape(n, P, hkv, d),
+                                   vp[tP].reshape(n, P, hkv, d)))
+                logits, ncs = static_fn(pv, bv, ids, caches, pos)
+                lg = logits[jnp.arange(n), last].astype(jnp.float32)
+                first = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                flat = tP.reshape(-1)
+                new_pages = []
+                for (kp, vp), (k, v) in zip(pages, ncs):
+                    hkv, d = kp.shape[2], kp.shape[3]
+                    new_pages.append(
+                        (kp.at[flat].set(k.reshape(n * nb, bs, hkv, d)),
+                         vp.at[flat].set(v.reshape(n * nb, bs, hkv, d))))
+                return (first, new_pages,
+                        d_toks.at[slots].set(first),
+                        d_bt.at[slots].set(bt_rows),
+                        d_sl.at[slots].set(plens),
+                        d_temps.at[slots].set(temps))
+
+            self._jit[key] = jax.jit(bp, donate_argnums=(2,))
+        return self._jit[key]
+
     def _scatter_jit(self, padded, nb):
         """Scatter a prefilled workspace prefix into the pool pages. The
         workspace slicing happens INSIDE the program (an eager slice per
@@ -343,6 +464,20 @@ class ServingEngine:
         over the running batch. Returns per-tick stats."""
         with self._lock:
             admitted = self.sched.admit()
+            # full-prompt cache hits never prefill: copy-on-write the last
+            # shared block and drop straight into the decode batch
+            for req in [r for r in self.sched.prefilling
+                        if r._cow_src is not None]:
+                self._admit_cached(req)
+            # batched multi-prompt prefill: a burst of short unmatched
+            # suffixes admits in ONE dispatch instead of one per prompt
+            if self.prefill_bucket > 0:
+                batch = [r for r in self.sched.prefilling
+                         if r._ws_caches is None and r.temperature <= 0.0
+                         and 0 < (len(r.prompt) - r.prefill_pos)
+                         <= self.prefill_chunk]
+                if len(batch) >= 2:
+                    self._batched_prefill(batch[:self.max_slots])
             # one prefill chunk per tick bounds how long a prompt can stall
             # the running batch — but a slot with NOTHING to decode isn't
             # stalled, so after a burst (many admissions, few running) keep
@@ -383,15 +518,138 @@ class ServingEngine:
         return [r.prompt + r.output_tokens for r in reqs]
 
     # ----------------------------------------------------------- prefill
+    def _admit_cached(self, req: Request) -> None:
+        """Full-prompt prefix-cache hit: every prompt block is already in
+        the pool, so the request enters decode DIRECTLY — zero prefill
+        dispatches. The decode program recomputes the last prompt token's
+        step (token = prompt[-1] at seq_len = plen - 1): its K/V write
+        lands in the copy-on-write fork of the final shared block, and its
+        logits yield the first generated token on the next decode tick."""
+        plen = len(req.prompt)
+        slot = req.slot
+        table = np.asarray(self.allocator.table(req.request_id), np.int32)
+        dst = int(table[plen // self.block_size - 1])
+        src = int(req._cow_src)
+        self._tables[slot] = 0
+        self._tables[slot, :len(table)] = table
+        self._lens[slot] = plen - 1
+        self._toks[slot] = req.prompt[-1]
+        self._temps[slot] = req.temperature
+        if self._dev is None:
+            self._dev_init()
+        d_toks, d_tables, d_lens, d_temps, d_seed = self._dev
+        new_layers, n_toks, n_bt, n_sl, n_temps = self._admit_cow_jit()(
+            self.pool.layers, d_toks, d_tables, d_lens, d_temps,
+            src, dst, slot, self._tables[slot], plen - 1,
+            int(req.prompt[-1]), req.temperature)
+        self.pool.replace(new_layers)
+        self._dev = (n_toks, n_bt, n_sl, n_temps, d_seed)
+        self.cow_admissions += 1
+        self.sched.start_running(req)
+        _QUEUE_H.observe(req.queue_seconds())
+        _TTFT_H.observe(req.ttft_seconds())
+
+    def _batched_prefill(self, reqs: List[Request]) -> None:
+        """Admit a burst of prompts in ONE dispatch (see
+        _batched_prefill_jit). Rows are the burst's unmatched suffixes,
+        padded to a bucketed [n, S]; the workspace holds each row's full
+        context (cached prefix + suffix) padded to P tokens. Greedy-only:
+        each row's first token is argmaxed on device and its fetch
+        deferred like any decode token."""
+        _, _, pv, bv = self._functional()
+        n = self.max_slots
+        bs = self.block_size
+        bucket = max(self.prefill_bucket, 1)
+        suffixes = [len(r.prompt) - r.prefill_pos for r in reqs]
+        S = -(-max(suffixes) // bucket) * bucket
+        ctx = max(r.prefill_pos + S for r in reqs)
+        # quantize the workspace length to the CHUNK grid, not the bucket
+        # grid: P drives the compiled shape, and a fine grid means a fresh
+        # XLA compile per burst composition (prefill_pos varies with cache
+        # hits) — a compile storm costs far more than the extra padding
+        P = -(-ctx // self.prefill_chunk) * self.prefill_chunk
+        nb = P // bs
+        ids = np.zeros((n, S), np.int32)
+        pos = np.zeros(n, np.int32)
+        tP = np.zeros((n, nb), np.int32)
+        last = np.zeros(n, np.int32)
+        slots = np.full(n, self.max_slots, np.int32)   # OOB -> dropped
+        bt_rows = np.zeros((n, self.max_blocks_per_seq), np.int32)
+        plens = np.zeros(n, np.int32)
+        temps = np.zeros(n, np.float32)
+        for r, req in enumerate(reqs):
+            plen = len(req.prompt)
+            start = req.prefill_pos
+            take = plen - start
+            ids[r, :take] = req.prompt[start:]
+            pos[r] = start
+            table = self.allocator.table(req.request_id)
+            tP[r, :min(nb, len(table))] = table[:nb]
+            last[r] = take - 1
+            slots[r] = req.slot
+            bt_rows[r, :len(table)] = table
+            plens[r] = plen
+            temps[r] = req.temperature
+        if self._dev is None:
+            self._dev_init()
+        d_toks, d_tables, d_lens, d_temps, d_seed = self._dev
+        first_dev, new_layers, n_toks, n_bt, n_sl, n_temps = \
+            self._batched_prefill_jit(S, P)(
+                pv, bv, self.pool.layers, jnp.asarray(ids),
+                jnp.asarray(pos), jnp.asarray(tP), jnp.asarray(last),
+                jnp.asarray(slots), jnp.asarray(bt_rows),
+                jnp.asarray(plens), jnp.asarray(temps),
+                d_toks, d_tables, d_lens, d_temps)
+        self.pool.replace(new_layers)
+        self._dev = (n_toks, n_bt, n_sl, n_temps, d_seed)
+        self.batched_prefills += 1
+        self.prefill_programs += 1
+        computed = sum(suffixes)
+        self.prefill_tokens += computed
+        _PREFILL_TOKENS.inc(computed)
+        self._pending.append(
+            (first_dev, [(r, req.slot, req) for r, req in enumerate(reqs)]))
+        flush = False
+        for r, req in enumerate(reqs):
+            slot = req.slot
+            self._tables[slot] = bt_rows[r]
+            self._lens[slot] = plens[r]
+            self._toks[slot] = 0          # fetched at the next flush
+            self._temps[slot] = req.temperature
+            req.prefill_pos = len(req.prompt)
+            req._pending_n += 1
+            if self.prefix_cache:
+                self.allocator.register_prefix(req.request_id, req.prompt)
+            self.sched.start_running(req)
+            _QUEUE_H.observe(req.queue_seconds())
+            _TTFT_H.observe(req.ttft_seconds())
+            if req.eos_token_id is not None or req.max_new_tokens <= 1:
+                flush = True
+        if flush:
+            self._flush_pending()
+
     def _prefill_one_chunk(self, req: Request) -> None:
         _, _, pv, bv = self._functional()
         n_layers, n_kv, head_dim = self._geometry
         plen = len(req.prompt)
         chunk = self.prefill_chunk
-        padded = -(-plen // chunk) * chunk
+        # chunk writes start at prefix_matched (a block multiple, not
+        # necessarily a chunk multiple): the workspace must cover the LAST
+        # chunk window, or dynamic_update_slice would clamp it backwards
+        padded = (req.prefix_matched
+                  + -(-(plen - req.prefix_matched) // chunk) * chunk)
         if req._ws_caches is None:
-            req._ws_caches = init_kv_cache(1, padded, n_layers, n_kv,
-                                           head_dim, self._dtype)
+            if req.prefix_matched:
+                # partial prefix hit: seed the workspace with the cached
+                # blocks so the suffix chunks run on top of real context
+                mb = req.prefix_matched // self.block_size
+                head = np.asarray(
+                    self.allocator.table(req.request_id)[:mb], np.int32)
+                req._ws_caches = self._gather_jit(padded, mb)(
+                    self.pool.layers, head)
+            else:
+                req._ws_caches = init_kv_cache(1, padded, n_layers, n_kv,
+                                               head_dim, self._dtype)
         start = req.prefill_pos
         ids = np.zeros((1, chunk), np.int32)
         take = min(chunk, plen - start)
@@ -400,6 +658,9 @@ class ServingEngine:
             pv, bv, jnp.asarray(ids), req._ws_caches,
             jnp.asarray(start, jnp.int32))
         req.prefill_pos = start + take
+        self.prefill_programs += 1
+        self.prefill_tokens += take
+        _PREFILL_TOKENS.inc(take)
         if req.prefill_pos < plen:
             return
         # prompt fully prefilled: sample the first token from the last REAL
@@ -413,6 +674,10 @@ class ServingEngine:
             self.pool.layers, req._ws_caches, table[:nb])
         self.pool.replace(new_layers)
         req._ws_caches = None
+        if self.prefix_cache:
+            # the prompt's full blocks are now resident in the pool: index
+            # them so later prompts sharing the prefix skip its prefill
+            self.allocator.register_prefix(req.request_id, req.prompt)
         slot = req.slot
         self._tables[slot] = 0
         self._tables[slot, :len(table)] = table
@@ -449,6 +714,7 @@ class ServingEngine:
                              d_temps.at[slot].set(req.temperature),
                              d_seed)
             req.output_tokens.append(first)
+            req._progress.set()
         self.sched.start_running(req)
         _QUEUE_H.observe(req.queue_seconds())
         _TTFT_H.observe(req.ttft_seconds())
@@ -568,6 +834,10 @@ class ServingEngine:
                 self._finish(req, "length")
             elif int(self._lens[slot]) >= self.max_model_len:
                 self._finish(req, "length")
+        for _, req in touched.values():
+            # wake streaming readers AFTER the finish checks so a reader
+            # never observes tokens past an eos truncation
+            req._progress.set()
 
     def _finish(self, req: Request, reason: str) -> None:
         slot = req.slot
@@ -592,9 +862,21 @@ class ServingEngine:
             _TOKRATE_H.observe(rate)
 
     # ------------------------------------------------------------ status
+    def snapshot_output(self, req: Request):
+        """Consistent (tokens, state, finish_reason) for streaming
+        handlers: taken under the engine lock so a reader never races the
+        flush's eos truncation."""
+        with self._lock:
+            return list(req.output_tokens), req.state, req.finish_reason
+
     def stats(self) -> dict:
         return {
             "steps": self.steps,
             "kv": self.allocator.occupancy_report(),
+            "prefix_cache": self.prefix_cache,
+            "prefill_programs": self.prefill_programs,
+            "batched_prefills": self.batched_prefills,
+            "prefill_tokens": self.prefill_tokens,
+            "cow_admissions": self.cow_admissions,
             **self.sched.counts(),
         }

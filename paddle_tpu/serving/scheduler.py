@@ -71,13 +71,21 @@ class Request:
         self.finish_time: Optional[float] = None
         # engine-owned prefill progress (tokens of prompt already run)
         self.prefill_pos = 0
+        self.prefix_matched = 0       # prompt tokens served from the cache
+        self._cow_src = None          # shared block forked at admission
         self._ws_caches = None        # contiguous prefill workspace
         self._pending_n = 0           # sampled tokens not yet fetched
         self._reserved_blocks = 0
         self._done = threading.Event()  # set at finish (HTTP waiters)
+        self._progress = threading.Event()  # pulsed per output flush
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self._done.wait(timeout)
+
+    def wait_progress(self, timeout: Optional[float] = None) -> bool:
+        """Block until more output tokens were flushed (or the request
+        finished). Streaming handlers clear + re-wait in a loop."""
+        return self._progress.wait(timeout)
 
     # -- telemetry --------------------------------------------------------
     def queue_seconds(self) -> Optional[float]:
@@ -103,6 +111,7 @@ class Request:
             "state": self.state,
             "finish_reason": self.finish_reason,
             "prompt_tokens": len(self.prompt),
+            "prefix_matched_tokens": self.prefix_matched,
             "output_tokens": len(self.output_tokens),
             "queue_s": self.queue_seconds(),
             "ttft_s": self.ttft_seconds(),
@@ -143,25 +152,31 @@ class Scheduler:
     # -- per-tick transitions ---------------------------------------------
     def admit(self) -> List[Request]:
         """Move waiting requests into prefill while a slot AND a worst-case
-        KV reservation fit (FCFS — no request starves)."""
+        KV reservation fit (FCFS — no request starves). The gate is on the
+        SUFFIX worst case: blocks whose prefix already sits in the cache
+        cost nothing, which raises effective capacity under shared-prefix
+        load."""
         admitted = []
-        allocatable = self.allocator.num_blocks - 1
         while self.waiting and self._free_slots:
             req = self.waiting[0]
-            worst = self._worst_case_blocks(req)
-            if self._reserved_blocks + worst > allocatable:
+            total = min(len(req.prompt) + req.max_new_tokens,
+                        self.max_model_len)
+            if not self.allocator.can_reserve_prefix(req.prompt, total):
                 break
             self.waiting.popleft()
             req.slot = self._free_slots.pop()
             # materialize the whole worst-case reservation as the block
             # table NOW: decode-time appends never allocate, so the engine
-            # can upload each sequence's table once and leave it alone
-            self.allocator.reserve(
-                req.request_id, len(req.prompt),
-                min(len(req.prompt) + req.max_new_tokens,
-                    self.max_model_len))
-            req._reserved_blocks = worst
-            self._reserved_blocks += worst
+            # can upload each sequence's table once and leave it alone.
+            # The table's head is any cached shared prefix; the engine
+            # prefils only from req.prefill_pos (= matched tokens).
+            _, matched, cow_src, new_blocks = self.allocator.reserve_prefix(
+                req.request_id, req.prompt, total)
+            req.prefix_matched = matched
+            req.prefill_pos = matched
+            req._cow_src = cow_src
+            req._reserved_blocks = new_blocks
+            self._reserved_blocks += new_blocks
             req.state = "prefill"
             req.prefill_start = time.monotonic()
             self.prefilling.append(req)
@@ -205,10 +220,12 @@ class Scheduler:
         self._reserved_blocks -= req._reserved_blocks
         req._reserved_blocks = 0
         req._ws_caches = None
+        req._cow_src = None
         req.state = "finished"
         req.finish_reason = reason
         req.finish_time = time.monotonic()
         req._done.set()
+        req._progress.set()   # wake streaming readers for the final drain
         _FINISHED.inc(reason=reason)
         self._publish()
 

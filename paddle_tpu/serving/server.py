@@ -10,6 +10,13 @@ CONTINUOUSLY by the single engine loop rather than serialized.
                     "temperature": 0.0, "eos_token_id": null}
                ->  {"request_id", "output_tokens", "finish_reason",
                     "telemetry": {queue_s, ttft_s, decode_tok_s, ...}}
+                   With "stream": true the response is chunked
+                   transfer-encoding NDJSON: one {"request_id", "tokens",
+                   "done": false} line per flushed token batch (the
+                   engine's deferred-fetch flush points), then a final
+                   {"done": true, "finish_reason", "telemetry"} line.
+                   A client disconnect cancels the request (its slot and
+                   KV reservation return to the pool immediately).
   GET  /stats      engine + KV-pool occupancy snapshot (JSON)
   GET  /healthz    {"ok": true, ...} liveness of the engine loop
 
@@ -37,6 +44,9 @@ define_flag("serving_request_timeout_s", 300.0,
 
 class _Handler(BaseHTTPRequestHandler):
     server_version = "paddle_tpu_serving/1.0"
+    # chunked transfer-encoding (streaming) requires HTTP/1.1; every
+    # non-stream reply carries Content-Length so keep-alive stays valid
+    protocol_version = "HTTP/1.1"
 
     @property
     def _srv(self):
@@ -56,6 +66,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(400, {"error": "prompt must be a non-empty "
                                            "list of token ids"})
                 return
+            stream = bool(body.get("stream", False))
             req = self._srv.engine.submit(
                 prompt,
                 max_new_tokens=int(body.get("max_new_tokens", 16)),
@@ -68,6 +79,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(400, {"error": f"{type(e).__name__}: {e}"})
             return
         timeout = float(get_flag("serving_request_timeout_s"))
+        if stream:
+            self._stream(req, timeout)
+            return
         if not req.wait(timeout):
             # evict the abandoned request so its slot and worst-case KV
             # reservation go back to the pool instead of decoding for a
@@ -83,6 +97,50 @@ class _Handler(BaseHTTPRequestHandler):
             "finish_reason": req.finish_reason,
             "telemetry": req.telemetry(),
         })
+
+    def _stream(self, req, timeout: float) -> None:
+        """Chunked NDJSON: one line per engine flush with the newly
+        materialized tokens, a final line with the finish reason and
+        telemetry. The engine pulses req's progress event at every
+        deferred-fetch flush; snapshots are taken under the engine lock so
+        a line never shows tokens past an eos truncation. A broken pipe
+        (client gone) cancels the request so it stops consuming slots."""
+        import time as _time
+
+        engine = self._srv.engine
+        deadline = _time.monotonic() + timeout
+        sent = 0
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            while True:
+                req._progress.clear()
+                toks, state, reason = engine.snapshot_output(req)
+                if len(toks) > sent:
+                    self._chunk({"request_id": req.request_id,
+                                 "tokens": toks[sent:], "done": False})
+                    sent = len(toks)
+                if state == "finished":
+                    self._chunk({"request_id": req.request_id,
+                                 "done": True, "finish_reason": reason,
+                                 "telemetry": req.telemetry()})
+                    break
+                if _time.monotonic() > deadline:
+                    engine.cancel(req, reason="timeout")
+                    self._chunk({"request_id": req.request_id,
+                                 "done": True, "finish_reason": "timeout"})
+                    break
+                req.wait_progress(timeout=0.25)
+            self.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError):
+            engine.cancel(req, reason="disconnect")
+
+    def _chunk(self, obj) -> None:
+        line = json.dumps(obj).encode() + b"\n"
+        self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
+        self.wfile.flush()
 
     def do_GET(self):  # noqa: N802
         path = self.path.split("?", 1)[0]

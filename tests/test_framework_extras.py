@@ -324,6 +324,16 @@ class TestDistFleetExecutor:
         assert results[1] == "rank1-ok", results[1]
 
 
+def _has_cryptography() -> bool:
+    try:
+        import cryptography  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+@pytest.mark.skipif(not _has_cryptography(),
+                    reason="optional 'cryptography' package not installed")
 class TestCrypto:
     def test_roundtrip_bytes_and_files(self, tmp_path):
         from paddle_tpu.crypto import Cipher, CipherFactory, CipherUtils

@@ -13,9 +13,17 @@ the backlog.
 
 Per load point it reports aggregate generated tokens/s and request-latency
 p50/p99 (arrival -> finish) for both schedulers, and writes the whole run
-to SERVEBENCH_r11.json (--out). Exit is non-zero when either scheduler
+to SERVEBENCH_r12.json (--out). Exit is non-zero when either scheduler
 completes zero requests, or when continuous batching fails --min-speedup
 (default 1.5x) over static at the HIGHEST load point.
+
+A second workload measures PREFIX CACHING: a shared system prompt of
+PREFIX_LEN tokens carried by PREFIX_SHARE of requests, replayed through
+two identical engines — prefix cache on vs off — after one unmeasured
+warm pass (compiles every program and brings the cache to steady state).
+It reports cache hit rate, prefill tokens actually computed, and TTFT
+p50/p99 for both, and gates on: greedy outputs bitwise-identical, >= 2x
+prefill-token reduction, and a TTFT p50 improvement.
 """
 from __future__ import annotations
 
@@ -41,6 +49,17 @@ NEW_SHORT = (4, 16)         # 75% of requests
 NEW_LONG = (48, 64)         # 25% tail
 BUCKET = 16                 # static baseline pads plen and max_new to this
 LOADS_RPS = (4.0, 16.0, 256.0)
+
+# shared-system-prompt workload (prefix caching): PREFIX_SHARE of requests
+# carry the same PREFIX_LEN-token system prompt plus a short user turn;
+# the rest are unrelated prompts from PROMPT_RANGE
+PREFIX_LEN = 96             # 6 full blocks of 16
+PREFIX_SHARE = 0.7
+PREFIX_SUFFIX = (4, 32)     # user-turn tokens appended to the prefix
+PREFIX_NEW = (8, 24)
+# high enough that prefill work produces real queueing: the TTFT gap
+# between cache on and off is the point of the workload
+PREFIX_RPS = 64.0
 
 
 def _build_model():
@@ -78,7 +97,9 @@ def _percentiles(lat):
             round(float(np.percentile(lat, 99)), 4))
 
 
-def _run_continuous(eng, trace):
+def _replay(eng, trace):
+    """Real-time replay of an arrival trace against the engine loop run
+    inline; returns the Request objects in submission order."""
     pending = list(trace)
     reqs = []
     t0 = time.monotonic()
@@ -91,6 +112,11 @@ def _run_continuous(eng, trace):
             eng.step()
         elif pending:
             time.sleep(min(0.001, max(0.0, pending[0][0] - now)))
+    return reqs, t0
+
+
+def _run_continuous(eng, trace):
+    reqs, t0 = _replay(eng, trace)
     done = [r for r in reqs if r.finish_reason is not None]
     if not done:
         return {"completed": 0}
@@ -145,10 +171,131 @@ def _run_static(model, trace, slots):
             "latency_p50_s": p50, "latency_p99_s": p99}
 
 
+def _shared_prefix(seed):
+    rng = np.random.default_rng(10_000 + seed)
+    return [int(x) for x in rng.integers(0, MODEL["vocab"], PREFIX_LEN)]
+
+
+def _prefix_trace(n, rate_rps, seed):
+    """Shared-system-prompt arrivals: PREFIX_SHARE of requests are the
+    same PREFIX_LEN-token prefix + a short random user turn, the rest
+    unrelated prompts. Greedy throughout (parity must be checkable). The
+    prefix is the same for every seed — only arrivals and user turns
+    vary — so a trace with a different seed exercises the cache seeded
+    by an earlier one."""
+    prefix = _shared_prefix(0)
+    rng = np.random.default_rng(20_000 + seed)
+    gaps = rng.exponential(1.0 / rate_rps, size=n)
+    t = np.cumsum(gaps)
+    out = []
+    for i in range(n):
+        new = int(rng.integers(PREFIX_NEW[0], PREFIX_NEW[1] + 1))
+        if rng.random() < PREFIX_SHARE:
+            s = int(rng.integers(PREFIX_SUFFIX[0], PREFIX_SUFFIX[1] + 1))
+            prompt = prefix + [int(x)
+                               for x in rng.integers(0, MODEL["vocab"], s)]
+        else:
+            plen = int(rng.integers(PROMPT_RANGE[0], PROMPT_RANGE[1] + 1))
+            prompt = [int(x) for x in rng.integers(0, MODEL["vocab"], plen)]
+        out.append((float(t[i]), prompt, new))
+    return out
+
+
+def _warm_prefix_shapes(eng, prefix):
+    """Compile every hit-path program shape the prefix workload can hit:
+    a cache-hit request prefilling ALONE (prefix gather + one suffix
+    chunk) and each batched-prefill (S, P) bucket combo — shared-only,
+    mixed, and unshared-only bursts. Constant-token prompts (distinct
+    value per prompt) can't collide with the random measured trace."""
+    def toks(v, k):
+        return [int(v)] * k
+
+    smax = -(-PREFIX_SUFFIX[1] // 16) * 16
+    # singles, far enough apart that they never batch
+    _replay(eng, [(0.0, prefix + toks(3, 5), 2)])
+    _replay(eng, [(0.0, prefix + toks(5, PREFIX_SUFFIX[1]), 2)])
+    bursts = (
+        [prefix + toks(7, 4), prefix + toks(9, 4)],                # small S
+        [prefix + toks(11, smax), prefix + toks(13, smax - 12)],   # big S
+        [prefix + toks(15, 4), toks(17, PROMPT_RANGE[1])],         # mixed
+        [toks(19, 4), toks(21, 16)],
+        [toks(23, PREFIX_SUFFIX[1]), toks(25, PREFIX_SUFFIX[1] - 12)],
+        [toks(27, PROMPT_RANGE[1]), toks(29, PROMPT_RANGE[1] - 8)],
+    )
+    for burst in bursts:
+        _replay(eng, [(0.0, p, 2) for p in burst])
+
+
+def _run_prefix_workload(model, n, slots, rps):
+    """Two identical engines — prefix cache on vs off. Each engine runs
+    one unmeasured warm trace (compiles the cold-path programs and seeds
+    the cache with the shared prefix), then a deterministic hit-shape
+    warm, then the MEASURED trace: fresh arrivals and fresh user turns
+    over the SAME system prompt. Measuring a fresh trace keeps the hit
+    set honest (a request matches exactly the shared prefix, never its
+    own earlier full prompt) and keeps the program-shape set closed —
+    every (S, P) / gather combo the measurement can touch was compiled
+    during warm, so TTFT reflects scheduling, not XLA compiles. Reports
+    hit rate, prefill tokens computed, and TTFT; returns (row, ok)."""
+    from paddle_tpu.serving import ServingEngine
+
+    mml = PREFIX_LEN + PREFIX_SUFFIX[1] + PREFIX_NEW[1]
+    kw = dict(max_slots=slots, block_size=16, prefill_chunk=64,
+              max_model_len=mml)
+    engines = (("cache_on", ServingEngine(model, **kw)),
+               ("cache_off", ServingEngine(model, prefix_cache=False,
+                                           prefill_bucket=0, **kw)))
+    warm_trace = _prefix_trace(n, rps, seed=0)
+    trace = _prefix_trace(n, rps, seed=1)
+    results = {}
+    outs = {}
+    prefix = _shared_prefix(0)
+    for name, eng in engines:
+        _replay(eng, warm_trace)
+        _warm_prefix_shapes(eng, prefix)
+        base_tok = eng.prefill_tokens
+        base_prog = eng.prefill_programs
+        base_batched = eng.batched_prefills
+        reqs, _ = _replay(eng, trace)
+        done = [r for r in reqs if r.finish_reason is not None]
+        ttft = [r.ttft_seconds() for r in done
+                if r.ttft_seconds() is not None]
+        p50, p99 = _percentiles(ttft) if ttft else (None, None)
+        hits = sum(1 for r in done if r.prefix_matched > 0)
+        results[name] = {
+            "completed": len(done),
+            "prefill_tokens": eng.prefill_tokens - base_tok,
+            "prefill_programs": eng.prefill_programs - base_prog,
+            "batched_prefills": eng.batched_prefills - base_batched,
+            "hit_rate": round(hits / len(done), 3) if done else 0.0,
+            "hit_tokens": sum(r.prefix_matched for r in done),
+            "ttft_p50_s": p50, "ttft_p99_s": p99,
+        }
+        outs[name] = [r.prompt + r.output_tokens for r in reqs]
+        if name == "cache_on":
+            results[name]["kv"] = eng.stats()["kv"]
+    on, off = results["cache_on"], results["cache_off"]
+    identical = outs["cache_on"] == outs["cache_off"]
+    reduction = (round(off["prefill_tokens"] / on["prefill_tokens"], 2)
+                 if on["prefill_tokens"] else None)
+    ok = (bool(identical) and reduction is not None and reduction >= 2.0
+          and on["ttft_p50_s"] is not None and off["ttft_p50_s"] is not None
+          and on["ttft_p50_s"] < off["ttft_p50_s"])
+    row = {"workload": "shared_system_prompt",
+           "prefix_len": PREFIX_LEN, "share": PREFIX_SHARE,
+           "suffix_range": list(PREFIX_SUFFIX),
+           "new_range": list(PREFIX_NEW),
+           "load_rps": rps, "requests": n,
+           "cache_on": on, "cache_off": off,
+           "prefill_token_reduction": reduction,
+           "outputs_identical": bool(identical), "ok": ok}
+    return row, ok
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=os.path.join(_REPO,
-                                                  "SERVEBENCH_r11.json"))
+                                                  "SERVEBENCH_r12.json"))
     ap.add_argument("--requests", type=int, default=48)
     ap.add_argument("--slots", type=int, default=8)
     ap.add_argument("--min-speedup", type=float, default=1.5,
@@ -186,6 +333,12 @@ def main():
     warm = [(0.0, [1] * plen, 2)
             for plen in range(BUCKET, pmax + 1, BUCKET)]
     _run_continuous(eng, warm)
+    # batched-prefill programs are keyed by (bucketed suffix S, chunked
+    # workspace P): warm every S the traces can produce (distinct token
+    # values per burst so the prefix cache can't shrink a warm suffix)
+    for i, s_len in enumerate(range(BUCKET, eng.prefill_chunk + 1, BUCKET)):
+        _run_continuous(eng, [(0.0, [10 + 2 * i] * s_len, 2),
+                              (0.0, [11 + 2 * i] * s_len, 2)])
 
     points = []
     ok = True
@@ -214,6 +367,18 @@ def main():
               f"{args.min_speedup}x")
         ok = False
 
+    prefix_row, prefix_ok = _run_prefix_workload(
+        model, args.requests, args.slots, PREFIX_RPS)
+    print(json.dumps(prefix_row), flush=True)
+    if not prefix_ok:
+        print("FAIL: prefix-caching workload — need outputs identical, "
+              ">=2x prefill-token reduction, and TTFT p50 improvement; got "
+              f"identical={prefix_row['outputs_identical']} "
+              f"reduction={prefix_row['prefill_token_reduction']} "
+              f"ttft_p50 on/off={prefix_row['cache_on']['ttft_p50_s']}/"
+              f"{prefix_row['cache_off']['ttft_p50_s']}")
+        ok = False
+
     report = {
         "bench": "servebench", "backend": jax.default_backend(),
         "model": MODEL, "slots": args.slots, "requests": args.requests,
@@ -221,7 +386,9 @@ def main():
         "new_short": list(NEW_SHORT), "new_long": list(NEW_LONG),
         "bucket": BUCKET,
         "min_speedup": args.min_speedup,
-        "points": points, "ok": ok,
+        "points": points,
+        "prefix_caching": prefix_row,
+        "ok": ok,
         "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
     }
     with open(args.out, "w") as f:

@@ -229,16 +229,18 @@ class GPTModel(nn.Layer):
             if hasattr(caches[0], "block_table"):
                 # paged decode: PER-SLOT positions (each slot is mid-way
                 # through its own sequence) ride the packed-rope / gathered
-                # wpe form instead of a scalar offset
+                # wpe form instead of a scalar offset; s > 1 is the
+                # speculative verify window at positions seq_lens..+s-1
                 pos_v = caches[0].seq_lens
                 pos_v = (pos_v._value if isinstance(pos_v, Tensor)
                          else jnp.asarray(pos_v)).astype(jnp.int32)
+                pos2d = pos_v[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
                 if self.config.use_rotary:
                     cos, sin = self._rope(
                         self.config.max_position_embeddings)
-                    rope = (cos, sin, Tensor(pos_v[:, None]))
+                    rope = (cos, sin, Tensor(pos2d))
                 else:
-                    h = h + self.wpe(Tensor(pos_v[:, None]))
+                    h = h + self.wpe(Tensor(pos2d))
                 h = self.drop(h)
                 new_caches = []
                 for block, cache in zip(self.blocks, caches):

@@ -189,11 +189,14 @@ class LlamaModel(nn.Layer):
             from jax import lax
 
             if hasattr(caches[0], "block_table"):
-                # paged decode: per-slot positions via the packed-rope form
+                # paged decode: per-slot positions via the packed-rope form;
+                # s > 1 is the speculative verify window at seq_lens..+s-1
                 pos_v = caches[0].seq_lens
                 pos_v = (pos_v._value if isinstance(pos_v, Tensor)
                          else jnp.asarray(pos_v)).astype(jnp.int32)
-                rope = (self._rope[0], self._rope[1], Tensor(pos_v[:, None]))
+                s = input_ids.shape[1]
+                pos2d = pos_v[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
+                rope = (self._rope[0], self._rope[1], Tensor(pos2d))
                 h = self.embed_tokens(input_ids)
                 new_caches = []
                 for layer, cache in zip(self.layers, caches):

@@ -910,44 +910,79 @@ def paged_cached_attention(q, k, v, k_pages, v_pages, block_table, seq_lens,
     then (2) attends each slot's single query over its own ragged context —
     Pallas kernel on TPU / interpret mode, XLA gather composition otherwise.
 
-    q: [slots, 1, q_heads, d]; k, v: [slots, 1, kv_heads, d];
+    q: [slots, sq, q_heads, d]; k, v: [slots, sq, kv_heads, d];
     k_pages, v_pages: [num_blocks, block_size, kv_heads, d];
     block_table: [slots, max_blocks] int32; seq_lens: [slots] int32.
-    Returns (out [slots, 1, q_heads, d], k_pages, v_pages). Idle slots
+    Returns (out [slots, sq, q_heads, d], k_pages, v_pages). Idle slots
     (block tables full of the null page 0) write and read garbage there
     harmlessly — the engine masks their sampled tokens.
+
+    sq > 1 is the speculative-verification window: the sq tokens are
+    written at positions seq_lens..seq_lens+sq-1 and each query attends
+    causally within the window (query i sees pos < seq_lens + i + 1).
+    Window positions that would fall past a slot's block table land in the
+    null page 0 instead of clamping onto the table's last real block —
+    the engine rolls rejected tokens back by length, so those writes are
+    never read.
     """
     slots, sq, hq, d = q.shape
-    if sq != 1:
-        raise ValueError("paged_cached_attention is decode-only (sq == 1); "
-                         "prefill runs the contiguous cached path")
     bs = k_pages.shape[1]
     seq_lens = jnp.asarray(seq_lens, jnp.int32).reshape(slots)
-    # KV append: one token per slot at (block_table[seq//bs], seq%bs)
-    page = jnp.take_along_axis(
-        block_table.astype(jnp.int32), (seq_lens // bs)[:, None], axis=1)[:, 0]
-    off = seq_lens % bs
-    k_pages = k_pages.at[page, off].set(k[:, 0].astype(k_pages.dtype))
-    v_pages = v_pages.at[page, off].set(v[:, 0].astype(v_pages.dtype))
-    ctx = seq_lens + 1  # the token just written attends to itself
 
     from .. import pallas as _pallas
     from ..pallas.paged_attention import (
+        paged_attention_multi as _paged_multi,
         paged_attention_tuned as _paged_kernel,
         paged_attention_xla as _paged_xla,
+        paged_attention_xla_multi as _paged_xla_multi,
         supports as _paged_supports,
     )
 
-    q2 = q[:, 0]
-    kernel_ok = _paged_supports(q2.shape, k_pages.shape)
+    if sq == 1:
+        # KV append: one token per slot at (block_table[seq//bs], seq%bs)
+        page = jnp.take_along_axis(
+            block_table.astype(jnp.int32),
+            (seq_lens // bs)[:, None], axis=1)[:, 0]
+        off = seq_lens % bs
+        k_pages = k_pages.at[page, off].set(k[:, 0].astype(k_pages.dtype))
+        v_pages = v_pages.at[page, off].set(v[:, 0].astype(v_pages.dtype))
+        ctx = seq_lens + 1  # the token just written attends to itself
+
+        q2 = q[:, 0]
+        kernel_ok = _paged_supports(q2.shape, k_pages.shape)
+        if kernel_ok and _pallas.interpret_mode():
+            out = _paged_kernel(q2, k_pages, v_pages, block_table, ctx,
+                                scale, interpret=True)
+        elif kernel_ok and jax.default_backend() == "tpu":
+            out = _paged_kernel(q2, k_pages, v_pages, block_table, ctx,
+                                scale)
+        else:
+            out = _paged_xla(q2, k_pages, v_pages, block_table, ctx, scale)
+        return out[:, None], k_pages, v_pages
+
+    # ---- multi-token verify window ----
+    bt = block_table.astype(jnp.int32)
+    pos = seq_lens[:, None] + jnp.arange(sq, dtype=jnp.int32)[None, :]
+    page_idx = pos // bs                                     # [slots, sq]
+    in_table = page_idx < bt.shape[1]
+    gathered = jnp.take_along_axis(
+        bt, jnp.minimum(page_idx, bt.shape[1] - 1), axis=1)
+    page = jnp.where(in_table, gathered, 0)    # overflow -> null page
+    off = pos % bs
+    k_pages = k_pages.at[page, off].set(k.astype(k_pages.dtype))
+    v_pages = v_pages.at[page, off].set(v.astype(v_pages.dtype))
+
+    kernel_ok = _paged_supports((slots, hq, d), k_pages.shape)
     if kernel_ok and _pallas.interpret_mode():
-        out = _paged_kernel(q2, k_pages, v_pages, block_table, ctx, scale,
-                            interpret=True)
+        out = _paged_multi(q, k_pages, v_pages, block_table, seq_lens,
+                           scale, interpret=True)
     elif kernel_ok and jax.default_backend() == "tpu":
-        out = _paged_kernel(q2, k_pages, v_pages, block_table, ctx, scale)
+        out = _paged_multi(q, k_pages, v_pages, block_table, seq_lens,
+                           scale)
     else:
-        out = _paged_xla(q2, k_pages, v_pages, block_table, ctx, scale)
-    return out[:, None], k_pages, v_pages
+        out = _paged_xla_multi(q, k_pages, v_pages, block_table, seq_lens,
+                               scale)
+    return out, k_pages, v_pages
 
 
 def softsign(x):

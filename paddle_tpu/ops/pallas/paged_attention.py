@@ -161,6 +161,171 @@ def _paged_pallas(q, k_pages, v_pages, block_tables, context_lens, scale,
     return (num / den).astype(q.dtype).reshape(slots, hq, d)
 
 
+# -------------------------------------------------- multi-query (verify)
+def _verify_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref,
+                   acc_ref, m_ref, l_ref,
+                   acc_s, m_s, l_s, *, block_size, pages_per_split, scale,
+                   sq, g):
+    # Speculative-verification variant of _decode_kernel: the q block holds
+    # sq query tokens folded into rows ([sq*g, d], row r = query r // g,
+    # head r % g) and cl_ref[i] is the BASE context (tokens cached before
+    # this window), so query qi attends over pos < cl + qi + 1 — causal
+    # within the window, full context before it.
+    i = pl.program_id(0)           # slot
+    s = pl.program_id(2)           # split
+    j = pl.program_id(3)           # page within split
+
+    @pl.when(j == 0)
+    def _init():
+        m_s[:] = jnp.full_like(m_s, NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    page_idx = s * pages_per_split + j
+    cl = cl_ref[i]
+
+    @pl.when(page_idx * block_size < cl + sq)   # window tokens count too
+    def _compute():
+        rows = sq * g
+        q = q_ref[:].astype(jnp.float32) * scale
+        k = k_ref[:].astype(jnp.float32)
+        v = v_ref[:].astype(jnp.float32)
+        sc = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [rows, block_size]
+        pos = page_idx * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, block_size), 1)
+        qi = jax.lax.broadcasted_iota(jnp.int32, (rows, block_size), 0) // g
+        live = pos < cl + qi + 1
+        sc = jnp.where(live, sc, NEG_INF)
+        m_prev = m_s[:]                       # [rows, 1]
+        l_prev = l_s[:]
+        m_cur = jnp.max(sc, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.where(live, jnp.exp(sc - m_new), 0.0)
+        m_s[:] = m_new
+        l_s[:] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_s[:] = acc_s[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == pages_per_split - 1)
+    def _out():
+        acc_ref[:] = acc_s[:]
+        m_ref[:] = m_s[:]
+        l_ref[:] = l_s[:]
+
+
+def _paged_pallas_multi(q, k_pages, v_pages, block_tables, context_lens,
+                        scale, kv_splits, interpret):
+    slots, sq, hq, d = q.shape
+    bs = k_pages.shape[1]
+    hkv = k_pages.shape[2]
+    g = hq // hkv
+    max_bps = block_tables.shape[1]
+    pad = (-max_bps) % kv_splits
+    if pad:
+        block_tables = jnp.pad(block_tables, ((0, 0), (0, pad)))
+    nps = (max_bps + pad) // kv_splits
+    rows = sq * g
+    # fold queries into rows: [slots, hkv, sq*g, d], row r = (qi=r//g, r%g)
+    qr = (q.reshape(slots, sq, hkv, g, d)
+          .transpose(0, 2, 1, 3, 4).reshape(slots, hkv, rows, d))
+    bt = block_tables.astype(jnp.int32)
+    cl = context_lens.astype(jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(slots, hkv, kv_splits, nps),
+        in_specs=[
+            pl.BlockSpec((None, None, rows, d),
+                         lambda i, h, s, j, bt, cl: (i, h, 0, 0)),
+            pl.BlockSpec((None, bs, None, d),
+                         lambda i, h, s, j, bt, cl, nps=nps:
+                         (bt[i, s * nps + j], 0, h, 0)),
+            pl.BlockSpec((None, bs, None, d),
+                         lambda i, h, s, j, bt, cl, nps=nps:
+                         (bt[i, s * nps + j], 0, h, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, None, None, rows, d),
+                         lambda i, h, s, j, bt, cl: (i, h, s, 0, 0)),
+            pl.BlockSpec((None, None, None, rows, 1),
+                         lambda i, h, s, j, bt, cl: (i, h, s, 0, 0)),
+            pl.BlockSpec((None, None, None, rows, 1),
+                         lambda i, h, s, j, bt, cl: (i, h, s, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((rows, d), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
+        ],
+    )
+    acc, m, l = pl.pallas_call(
+        functools.partial(_verify_kernel, block_size=bs,
+                          pages_per_split=nps, scale=scale, sq=sq, g=g),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((slots, hkv, kv_splits, rows, d),
+                                 jnp.float32),
+            jax.ShapeDtypeStruct((slots, hkv, kv_splits, rows, 1),
+                                 jnp.float32),
+            jax.ShapeDtypeStruct((slots, hkv, kv_splits, rows, 1),
+                                 jnp.float32),
+        ],
+        interpret=interpret,
+    )(bt, cl, qr, k_pages, v_pages)
+
+    m_g = jnp.max(m, axis=2, keepdims=True)
+    w = jnp.exp(m - m_g)
+    num = jnp.sum(acc * w, axis=2)             # [slots, hkv, rows, d]
+    den = jnp.maximum(jnp.sum(l * w, axis=2), 1e-30)
+    out = (num / den).astype(q.dtype)
+    return (out.reshape(slots, hkv, sq, g, d)
+            .transpose(0, 2, 1, 3, 4).reshape(slots, sq, hq, d))
+
+
+def paged_attention_xla_multi(q, k_pages, v_pages, block_tables,
+                              context_lens, scale=None):
+    """Dense-gather reference for the multi-query verify window.
+    q: [slots, sq, q_heads, d]; context_lens is the BASE context (tokens
+    cached before the window) — query i sees pos < context_lens + i + 1."""
+    slots, sq, hq, d = q.shape
+    bs = k_pages.shape[1]
+    hkv = k_pages.shape[2]
+    g = hq // hkv
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    max_ctx = block_tables.shape[1] * bs
+    k = k_pages[block_tables].reshape(slots, max_ctx, hkv, d)
+    v = v_pages[block_tables].reshape(slots, max_ctx, hkv, d)
+    qg = (q.reshape(slots, sq, hkv, g, d)
+          .transpose(0, 2, 1, 3, 4).astype(jnp.float32))  # [b,h,sq,g,d]
+    sc = jnp.einsum("bhsgd,bkhd->bhsgk", qg,
+                    k.astype(jnp.float32)) * scale
+    live = (jnp.arange(max_ctx)[None, None, :]
+            < (context_lens.astype(jnp.int32)[:, None, None]
+               + jnp.arange(sq)[None, :, None] + 1))  # [slots, sq, max_ctx]
+    sc = jnp.where(live[:, None, :, None, :], sc, NEG_INF)
+    p = jax.nn.softmax(sc, axis=-1)
+    out = jnp.einsum("bhsgk,bkhd->bhsgd", p, v.astype(jnp.float32))
+    return (out.astype(q.dtype)
+            .transpose(0, 2, 1, 3, 4).reshape(slots, sq, hq, d))
+
+
+def paged_attention_multi(q, k_pages, v_pages, block_tables, context_lens,
+                          scale=None, kv_splits=1, interpret=False):
+    """Speculative-verification attention: sq query tokens per slot against
+    the paged KV pool, causal within the window. q: [slots, sq, q_heads, d];
+    context_lens = tokens cached BEFORE the window. Returns the same shape
+    as q."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    return _paged_pallas_multi(q, k_pages, v_pages, block_tables,
+                               context_lens, scale, kv_splits, interpret)
+
+
 # ------------------------------------------------------------- XLA fallback
 def paged_attention_xla(q, k_pages, v_pages, block_tables, context_lens,
                         scale=None):

@@ -29,19 +29,27 @@ This package replaces that with the vLLM/TPU-serving shape:
                    works unchanged with the int8 weight-only swap.
   * server.py    — stdlib HTTP front end (POST /generate) with
                    per-request telemetry: queue time, TTFT, tokens/s.
+  * speculative.py — draft-model-free self-speculation: n-gram prompt-
+                   lookup drafting from each request's own history plus
+                   the per-request adaptive-k throttle; the engine
+                   verifies drafts in ONE multi-token dispatch and rolls
+                   rejected positions back exactly.
 """
 from .blocks import BlockAllocator  # noqa: F401
 from .paged import PagedKVPool, PagedLayerCache  # noqa: F401
 from .scheduler import Request, Scheduler  # noqa: F401
+from .speculative import NgramDrafter, SpecState  # noqa: F401
 from .engine import ServingEngine  # noqa: F401
 from .server import ServingServer  # noqa: F401
 
 __all__ = [
     "BlockAllocator",
+    "NgramDrafter",
     "PagedKVPool",
     "PagedLayerCache",
     "Request",
     "Scheduler",
     "ServingEngine",
     "ServingServer",
+    "SpecState",
 ]

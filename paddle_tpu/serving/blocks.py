@@ -86,6 +86,10 @@ _PREFIX_HIT_TOKENS = _counter("serving_prefix_hit_tokens_total",
 _PREFIX_EVICTIONS = _counter("serving_prefix_evictions_total",
                              "Cached blocks reclaimed under capacity "
                              "pressure.", always=True)
+_PREFIX_DEDUPS = _counter("serving_prefix_dedup_blocks_total",
+                          "Private prefilled blocks swapped for an "
+                          "already-indexed twin at register time.",
+                          always=True)
 
 
 class BlockAllocator:
@@ -120,7 +124,12 @@ class BlockAllocator:
         # table so the engine can device-copy them before any eviction
         self._extra: Dict[object, List[int]] = {}
         self._tokens = 0            # running sum of _lens (O(1) publish)
+        # table size at reservation: rollback never truncates below it (a
+        # worst-case reservation must survive speculation intact)
+        self._base: Dict[object, int] = {}
         self.last_fork: Optional[Tuple[int, int]] = None
+        # register_prefix dedup swaps: [(table_index, private, canonical)]
+        self.last_dedup: List[Tuple[int, int, int]] = []
         self._publish()
 
     # -- capacity ---------------------------------------------------------
@@ -265,6 +274,7 @@ class BlockAllocator:
         self._tables[seq_id] = table
         self._lens[seq_id] = int(n_tokens)
         self._tokens += int(n_tokens)
+        self._base[seq_id] = len(table)
         self._publish()
         return table
 
@@ -282,6 +292,7 @@ class BlockAllocator:
         self._tables[seq_id] = table
         self._lens[seq_id] = int(n_tokens)
         self._tokens += int(n_tokens)
+        self._base[seq_id] = len(table)
         self._publish()
         return table
 
@@ -333,6 +344,7 @@ class BlockAllocator:
         self._tables[seq_id] = table
         self._lens[seq_id] = plen
         self._tokens += plen
+        self._base[seq_id] = len(table)
         matched_tokens = min(m * self.block_size, plen)
         if m:
             _PREFIX_HITS.inc()
@@ -345,23 +357,71 @@ class BlockAllocator:
     def register_prefix(self, seq_id, tokens) -> int:
         """Publish a prefilled prompt's full blocks into the hash index so
         later prompts can share them. Call AFTER the prefix KV has been
-        scattered into the pool pages. Idempotent; blocks whose content key
-        already maps to a DIFFERENT block stay private (no live dedup).
-        Returns how many blocks were newly indexed."""
+        scattered into the pool pages. Idempotent. When a block's content
+        key is ALREADY indexed under a different block (two identical
+        prompts prefilled concurrently), the private duplicate is swapped
+        for the canonical block — live dedup: the table adopts the
+        canonical block, the duplicate returns to the free list, and the
+        swap is recorded in `self.last_dedup` as
+        `(table_index, private_blk, canonical_blk)` so a caller that owns
+        device state can redirect its block-table row. Returns how many
+        blocks were newly indexed."""
         if not self.prefix_cache:
             return 0
         table = self._tables[seq_id]
         added = 0
+        self.last_dedup = []
         for i, key in enumerate(self.block_hashes(tokens)):
             blk = table[i]
             if blk == self.NULL_BLOCK or blk in self._digest:
                 continue
-            if key in self._index:
+            canon = self._index.get(key)
+            if canon is not None and canon != blk:
+                # identical content prefilled twice: share from now on.
+                # The private block was claimed fresh (refcount 1, never
+                # hashed), so the decref sends it straight to the free
+                # stack. The canonical block may be parked evictable.
+                self._revive(canon)
+                table[i] = canon
+                self._decref(blk)
+                self.last_dedup.append((i, blk, canon))
+                _PREFIX_DEDUPS.inc()
                 continue
             self._digest[blk] = key
             self._index[key] = blk
             added += 1
+        if self.last_dedup:
+            self._publish()
         return added
+
+    def rollback(self, seq_id, n_tokens: int) -> List[int]:
+        """Rewind a sequence by `n_tokens` (speculative-decode rejection).
+        The live length shrinks and any blocks appended PAST the original
+        reservation that the shorter length no longer needs are released —
+        the reservation itself (`reserve*`'s worst case) is never
+        truncated, so a mid-flight sequence keeps its admission guarantee.
+        Returns the (possibly trimmed) block table. The rejected tail's
+        device KV is left in place as garbage masked by the length — full
+        blocks are immutable/shared by construction, so rejected writes
+        only ever landed in this sequence's private blocks."""
+        n = int(n_tokens)
+        if n < 0:
+            raise ValueError("rollback count must be >= 0")
+        if n == 0:
+            return self._tables[seq_id]
+        if n > self._lens[seq_id]:
+            raise ValueError(
+                f"rollback of {n} exceeds live length {self._lens[seq_id]}")
+        table = self._tables[seq_id]
+        new_len = self._lens[seq_id] - n
+        keep = max(self.blocks_for(max(new_len, 1)),
+                   self._base.get(seq_id, 0))
+        while len(table) > keep:
+            self._decref(table.pop())
+        self._lens[seq_id] = new_len
+        self._tokens -= n
+        self._publish()
+        return table
 
     def append_token(self, seq_id) -> List[int]:
         """Account one decoded token; grows the block table by one block
@@ -402,6 +462,7 @@ class BlockAllocator:
         matchable. Returns how many blocks left the live set."""
         table = self._tables.pop(seq_id)
         self._tokens -= self._lens.pop(seq_id)
+        self._base.pop(seq_id, None)
         released = 0
         for blk in reversed(table):      # LIFO: reuse hottest first
             released += self._decref(blk)

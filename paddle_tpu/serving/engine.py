@@ -49,6 +49,7 @@ from ..observability.registry import (
 from .blocks import BlockAllocator
 from .paged import PagedKVPool, PagedLayerCache, write_prefix
 from .scheduler import Request, Scheduler
+from .speculative import NgramDrafter, SpecState
 
 _flags.define_flag("serving_block_size", 16,
                    "KV-cache block size (tokens per page) for the serving "
@@ -76,6 +77,26 @@ _flags.define_flag("serving_prefix_cache", True,
                    "blocks so prompts sharing a prefix skip its prefill "
                    "and share the blocks (copy-on-write on full-prompt "
                    "hits).")
+_flags.define_flag("serving_spec_k", 0,
+                   "Self-speculative decoding: max draft tokens verified "
+                   "per tick. Drafts are n-gram / prompt-lookup matches "
+                   "from the request's OWN token history; ONE multi-token "
+                   "dispatch scores draft + bonus positions and the "
+                   "longest matching prefix commits. 0 (default) disables "
+                   "speculation. Greedy requests only (temperature > 0 "
+                   "rows fall back to single-token decode in the same "
+                   "batch); mutually exclusive with serving_fuse_steps > "
+                   "1.")
+_flags.define_flag("serving_spec_ngram", 3,
+                   "Longest n-gram the self-speculation drafter matches "
+                   "against the request's history (tries n down to 2).")
+_flags.define_flag("serving_spec_pause", 32,
+                   "Adaptive-k throttle: after 4 consecutive fruitless "
+                   "speculation ticks a request pauses drafting for this "
+                   "many engine ticks before probing again, so "
+                   "non-repetitive traffic degrades to plain one-token "
+                   "decode instead of paying verify windows that never "
+                   "accept.")
 _flags.define_flag("serving_prefill_bucket", 16,
                    "Length bucket (tokens) for the batched multi-prompt "
                    "prefill program: a burst's unmatched suffixes pad to "
@@ -94,6 +115,15 @@ _GEN_TOKENS = _counter("serving_generated_tokens_total",
 _PREFILL_TOKENS = _counter("serving_prefill_tokens_total",
                            "Prompt tokens actually computed by prefill "
                            "(cache hits skip theirs).", always=True)
+_SPEC_PROPOSED = _counter("serving_spec_proposed_total",
+                          "Draft tokens offered to speculative "
+                          "verification.", always=True)
+_SPEC_ACCEPTED = _counter("serving_spec_accepted_total",
+                          "Draft tokens accepted by speculative "
+                          "verification.", always=True)
+_SPEC_ROLLBACKS = _counter("serving_spec_rollbacks_total",
+                           "Speculative ticks that rejected >= 1 draft "
+                           "token (exact KV rollback).", always=True)
 
 
 class ServingEngine:
@@ -109,7 +139,10 @@ class ServingEngine:
                  prefill_chunk: Optional[int] = None,
                  max_model_len: Optional[int] = None,
                  prefix_cache: Optional[bool] = None,
-                 prefill_bucket: Optional[int] = None):
+                 prefill_bucket: Optional[int] = None,
+                 spec_k: Optional[int] = None,
+                 spec_ngram: Optional[int] = None,
+                 spec_pause: Optional[int] = None):
         self.model = model
         model.eval()
         n_layers, n_kv, head_dim, max_pos = model._decode_geometry()
@@ -157,6 +190,21 @@ class ServingEngine:
         # greedy decode steps fused per dispatch (1 = no fusion); sampled
         # batches always run unfused so every token sees a fresh seed tick
         self.fuse_steps = int(_flags.get_flag("serving_fuse_steps"))
+        # self-speculative decoding (speculative.py): drafts verified in
+        # one multi-token dispatch; 0 = off
+        self.spec_k = int(_flags.get_flag("serving_spec_k")
+                          if spec_k is None else spec_k)
+        self.spec_ngram = int(_flags.get_flag("serving_spec_ngram")
+                              if spec_ngram is None else spec_ngram)
+        self.spec_pause = int(_flags.get_flag("serving_spec_pause")
+                              if spec_pause is None else spec_pause)
+        if self.spec_k > 0 and self.fuse_steps > 1:
+            raise ValueError(
+                "FLAGS_serving_fuse_steps > 1 and speculative decoding "
+                "(serving_spec_k > 0) are mutually exclusive decode "
+                "shapes: the fused loop carries a fixed one-token-per-"
+                "step schedule that a variable-width verify window would "
+                "miscompile. Disable one of them.")
         self._dev = None        # (toks, tables, lens, temps, seed) on device
         self._pending = []      # [(tokens_dev, [(idx, slot, req), ...])]
         self._jit = {}
@@ -170,6 +218,12 @@ class ServingEngine:
         self.batched_prefills = 0    # batched multi-prompt dispatches
         self.prefill_tokens = 0      # prompt tokens actually computed
         self.cow_admissions = 0      # full-prompt hits (zero prefill)
+        self.dedup_admissions = 0    # register-time block dedups applied
+        # speculation accounting (stats() + servebench JSON)
+        self.spec_ticks = 0          # ticks that ran a verify window
+        self.spec_proposed = 0       # draft tokens offered
+        self.spec_accepted = 0       # draft tokens accepted
+        self.spec_rollbacks = 0      # ticks that rolled back >= 1 token
 
     # ------------------------------------------------------- compiled fns
     def _functional(self):
@@ -264,6 +318,47 @@ class ServingEngine:
                 return tok, pages, sl, seed + k, out.reshape(-1)
 
             self._jit[key] = jax.jit(step, donate_argnums=(3, 5, 7))
+        return self._jit[key]
+
+    def _spec_jit(self, W: int, sampled: bool):
+        """Speculative verify: score a W-token window (current token +
+        W-1 drafts, zero-padded past each slot's own draft length) in ONE
+        dispatch through the multi-query paged attention path, and accept
+        the longest draft prefix that matches the greedy targets — all on
+        device. Returns per-slot greedy targets [slots, W] (targets 0..acc
+        are this tick's emitted tokens), the accepted count, the
+        fed-back next token, and lengths advanced by acc+1 — an EXACT
+        rollback of every rejected position, whose garbage KV stays
+        masked behind the length in the slot's own private blocks.
+        Sampled slots (temperature > 0) ride with a zero draft length:
+        their column-0 logits are the same distribution the plain step
+        would compute, and their next token is the categorical draw."""
+        key = ("spec", self.max_slots, self.max_blocks_per_seq, W, sampled)
+        if key not in self._jit:
+            paged_fn = self._functional()[0]
+
+            def step(pv, bv, win, pages, bt, sl, dls, temps, seed):
+                logits, new_pages = paged_fn(pv, bv, win, pages, bt, sl)
+                lg = logits.astype(jnp.float32)       # [slots, W, vocab]
+                greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                # accepted = longest prefix where draft i+1 equals the
+                # greedy target after window position i
+                ok = ((win[:, 1:] == greedy[:, :-1])
+                      & (jnp.arange(W - 1, dtype=jnp.int32)[None, :]
+                         < dls[:, None]))
+                acc = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1),
+                              axis=1)
+                nxt = jnp.take_along_axis(greedy, acc[:, None],
+                                          axis=1)[:, 0]
+                if sampled:
+                    key_ = jax.random.fold_in(jax.random.PRNGKey(0), seed)
+                    t = jnp.maximum(temps, 1e-6)[:, None]
+                    draw = jax.random.categorical(
+                        key_, lg[:, 0, :] / t, axis=-1).astype(jnp.int32)
+                    nxt = jnp.where(temps > 0.0, draw, nxt)
+                return greedy, acc, nxt, new_pages, sl + acc + 1, seed + 1
+
+            self._jit[key] = jax.jit(step, donate_argnums=(3, 5, 8))
         return self._jit[key]
 
     def _clear_slot_jit(self):
@@ -620,6 +715,21 @@ class ServingEngine:
             req._pending_n += 1
             if self.prefix_cache:
                 self.allocator.register_prefix(req.request_id, req.prompt)
+                if self.allocator.last_dedup:
+                    # live dedup: identical blocks prefilled concurrently
+                    # in this burst now share storage — adopt the swapped
+                    # table on host AND in the already-uploaded device row
+                    table = np.asarray(
+                        self.allocator.table(req.request_id), np.int32)
+                    self._tables[slot] = 0
+                    self._tables[slot, :len(table)] = table
+                    d_toks, d_tables, d_lens, d_temps, d_seed = self._dev
+                    self._dev = (
+                        d_toks,
+                        d_tables.at[slot].set(
+                            jnp.asarray(self._tables[slot])),
+                        d_lens, d_temps, d_seed)
+                    self.dedup_admissions += 1
             self.sched.start_running(req)
             _QUEUE_H.observe(req.queue_seconds())
             _TTFT_H.observe(req.ttft_seconds())
@@ -678,6 +788,13 @@ class ServingEngine:
             # the prompt's full blocks are now resident in the pool: index
             # them so later prompts sharing the prefix skip its prefill
             self.allocator.register_prefix(req.request_id, req.prompt)
+            if self.allocator.last_dedup:
+                # live dedup (a twin registered first while this prompt
+                # prefilled): adopt the swapped table before the slot's
+                # device row is uploaded below
+                table = np.asarray(self.allocator.table(req.request_id),
+                                   np.int32)
+                self.dedup_admissions += 1
         slot = req.slot
         self._tables[slot] = 0
         self._tables[slot, :len(table)] = table
@@ -745,6 +862,10 @@ class ServingEngine:
                      jnp.asarray(self._step_seed, jnp.int32))
 
     def _decode_step(self) -> int:
+        if self.spec_k > 0:
+            decoded = self._spec_step()
+            if decoded is not None:
+                return decoded
         _, _, pv, bv = self._functional()
         running = list(self.sched.running.items())
         if self._dev is None:
@@ -798,6 +919,140 @@ class ServingEngine:
         if flush:
             self._flush_pending()
         return len(running) * k
+
+    def _spec_step(self) -> Optional[int]:
+        """One speculative tick, or None to fall through to the plain
+        deferred-fetch decode path (no request may draft right now — all
+        paused by the adaptive throttle, sampled, or out of budget).
+
+        Speculation is inherently synchronous on the host side: drafting
+        needs every emitted token's VALUE, so the tick flushes the
+        deferred queue first and fetches its own (targets, accepted)
+        results eagerly. The adaptive pause keeps that cost off
+        non-repetitive traffic — when nothing drafts, the plain
+        pipelined path runs untouched."""
+        # cheap pre-check before paying the flush: is anyone allowed to
+        # draft this tick? (draft_k needs no token values)
+        active = False
+        for slot, req in self.sched.running.items():
+            if req.temperature > 0.0:
+                continue
+            if req._spec is None:
+                req._drafter = NgramDrafter(max_n=self.spec_ngram)
+                req._spec = SpecState(self.spec_k,
+                                      pause_ticks=self.spec_pause)
+            if req._spec.draft_k(self.steps) > 0:
+                active = True
+        if not active:
+            return None
+        self._flush_pending()
+        running = list(self.sched.running.items())
+        if not running:
+            return 0
+        # draft per slot, capped so a fully-accepted window can never
+        # overrun the token budget, the context cap, or the worst-case
+        # block reservation (rollback never needs to grow a table)
+        drafts = {}
+        for slot, req in running:
+            if req.temperature > 0.0 or req._spec is None:
+                continue
+            rid = req.request_id
+            room = (self.block_size * len(self.allocator.table(rid))
+                    - self.allocator.seq_len(rid) - 1)
+            k_r = min(req._spec.draft_k(self.steps),
+                      req.max_new_tokens - len(req.output_tokens) - 1,
+                      self.max_model_len - 1 - int(self._lens[slot]),
+                      room)
+            if k_r <= 0:
+                continue
+            d = req._drafter.propose(req.prompt + req.output_tokens, k_r)
+            drafts[slot] = d
+            if not d:
+                req._spec.record(0, 0, self.steps)
+        if not any(drafts.values()):
+            return None     # nobody produced a draft: plain path
+        # FIXED window width: the verify program is compiled once for
+        # W = spec_k + 1 and shorter (or absent) drafts are masked by
+        # dls — a varying per-tick max draft length would recompile the
+        # step every time the adaptive throttle moved k
+        W = 1 + self.spec_k
+        _, _, pv, bv = self._functional()
+        if self._dev is None:
+            self._dev_init()
+        d_toks, d_tables, d_lens, d_temps, d_seed = self._dev
+        win = np.zeros((self.max_slots, W), np.int32)
+        dls = np.zeros(self.max_slots, np.int32)
+        for slot, req in running:
+            win[slot, 0] = self._toks[slot]
+            d = drafts.get(slot, ())
+            win[slot, 1:1 + len(d)] = d
+            dls[slot] = len(d)
+        needs_sampling = any(req.temperature > 0.0 for _, req in running)
+        greedy, acc, nxt, new_layers, new_sl, new_seed = self._spec_jit(
+            W, needs_sampling)(
+            pv, bv, jnp.asarray(win), self.pool.layers, d_tables, d_lens,
+            jnp.asarray(dls), d_temps, d_seed)
+        self.pool.replace(new_layers)
+        self._dev = (nxt, d_tables, new_sl, d_temps, new_seed)
+        self._step_seed += 1
+        self.spec_ticks += 1
+        greedy_h, acc_h, nxt_h = jax.device_get((greedy, acc, nxt))
+        decoded = 0
+        touched = []
+        for slot, req in running:
+            dl = int(dls[slot])
+            if req.temperature > 0.0:
+                # single-token fallback in the mixed batch: the sampled
+                # draw fed back by the program
+                t = int(nxt_h[slot])
+                req.output_tokens.append(t)
+                self._toks[slot] = t
+                self._lens[slot] += 1
+                decoded += 1
+                touched.append((slot, req))
+                continue
+            a = int(acc_h[slot])
+            emitted = [int(x) for x in greedy_h[slot, :a + 1]]
+            if dl:
+                # allocator commit of the whole window via the existing
+                # append path, then EXACT rollback of the rejected tail
+                # (length rewind + table trim down to the reservation)
+                rid = req.request_id
+                for _ in range(dl + 1):
+                    self.allocator.append_token(rid)
+                    if self.allocator.last_fork is not None:
+                        raise RuntimeError(
+                            "speculative append forked a shared block — "
+                            "decode writes must only land in private "
+                            "blocks")
+                if a < dl:
+                    self.allocator.rollback(rid, dl - a)
+                    self.spec_rollbacks += 1
+                    _SPEC_ROLLBACKS.inc()
+                req._spec.record(dl, a, self.steps)
+                self.spec_proposed += dl
+                self.spec_accepted += a
+                _SPEC_PROPOSED.inc(dl)
+                _SPEC_ACCEPTED.inc(a)
+            req.output_tokens.extend(emitted)
+            self._toks[slot] = emitted[-1]
+            self._lens[slot] += a + 1
+            decoded += len(emitted)
+            touched.append((slot, req))
+        for slot, req in touched:
+            if req.eos_token_id is not None and \
+                    req.eos_token_id in req.output_tokens:
+                cut = req.output_tokens.index(req.eos_token_id) + 1
+                del req.output_tokens[cut:]
+                self._finish(req, "stop")
+            elif len(req.output_tokens) >= req.max_new_tokens:
+                del req.output_tokens[req.max_new_tokens:]
+                self._finish(req, "length")
+            elif int(self._lens[slot]) >= self.max_model_len:
+                self._finish(req, "length")
+        for _, req in touched:
+            req._progress.set()
+        return decoded
 
     def _flush_pending(self) -> None:
         """Materialize every deferred sampled token (one host transfer for
@@ -878,5 +1133,16 @@ class ServingEngine:
             "batched_prefills": self.batched_prefills,
             "prefill_tokens": self.prefill_tokens,
             "cow_admissions": self.cow_admissions,
+            "dedup_admissions": self.dedup_admissions,
+            "speculative": {
+                "enabled": self.spec_k > 0,
+                "k": self.spec_k,
+                "ticks": self.spec_ticks,
+                "proposed": self.spec_proposed,
+                "accepted": self.spec_accepted,
+                "rollbacks": self.spec_rollbacks,
+                "acceptance": (self.spec_accepted / self.spec_proposed
+                               if self.spec_proposed else 0.0),
+            },
             **self.sched.counts(),
         }

@@ -21,6 +21,13 @@ Admission uses worst-case KV reservation: a request is admitted only when
 every admitted request's reservation. Decode-time block appends therefore
 NEVER fail mid-flight — no preemption/swap machinery is needed (the trade
 is admission conservatism, i.e. occupancy, not correctness).
+
+The same reservation covers SPECULATIVE (up-to-k-token) ticks: the engine
+caps every draft at the remaining `max_new_tokens` budget and at
+`max_model_len - 1 - current_len`, so a verify window can never commit a
+token past the reserved worst case, and rollback only ever shrinks usage
+back toward it (BlockAllocator.rollback never trims below the
+reservation).
 """
 from __future__ import annotations
 
@@ -76,6 +83,10 @@ class Request:
         self._ws_caches = None        # contiguous prefill workspace
         self._pending_n = 0           # sampled tokens not yet fetched
         self._reserved_blocks = 0
+        # self-speculation state, attached by the engine when spec is on
+        # (greedy requests only); kept after finish for telemetry
+        self._drafter = None          # speculative.NgramDrafter
+        self._spec = None             # speculative.SpecState
         self._done = threading.Event()  # set at finish (HTTP waiters)
         self._progress = threading.Event()  # pulsed per output flush
 
@@ -106,7 +117,7 @@ class Request:
         return (n - 1) / dt if n > 1 and dt > 0 else None
 
     def telemetry(self) -> dict:
-        return {
+        t = {
             "request_id": self.request_id,
             "state": self.state,
             "finish_reason": self.finish_reason,
@@ -117,6 +128,11 @@ class Request:
             "ttft_s": self.ttft_seconds(),
             "decode_tok_s": self.decode_tokens_per_s(),
         }
+        if self._spec is not None:
+            t["spec_proposed"] = self._spec.proposed
+            t["spec_accepted"] = self._spec.accepted
+            t["spec_acceptance"] = self._spec.acceptance
+        return t
 
 
 class Scheduler:

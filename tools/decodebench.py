@@ -1,5 +1,6 @@
 """Autoregressive decode throughput: tokens/s for the compiled KV-cache
-single-token step, fp vs int8 weight-only.
+single-token step, fp vs int8 weight-only, plus the serving engine's
+self-speculative decode on a repetitive workload (spec on vs off).
 
 Usage: python tools/decodebench.py [--preset small|large] [--out FILE]
 
@@ -86,16 +87,66 @@ def measure(name, quant, hidden, layers, heads, vocab, batch, prompt, new,
     return row
 
 
+def measure_spec(out_path, min_speedup=1.3):
+    """Self-speculative decode tokens/s on the repetitive workload, spec on
+    vs off — same overfit-cyclic-model recipe and warm protocol as the
+    servebench speculation arm (imported, not duplicated)."""
+    import jax
+
+    from tools.servebench import (SPEC_CYCLE, SPEC_K, SPEC_MODEL, SPEC_NEW,
+                                  SPEC_PROMPTS, _spec_arm,
+                                  _train_cyclic_model)
+
+    model, loss = _train_cyclic_model()
+    period = len(SPEC_CYCLE)
+    prompts = [list(SPEC_CYCLE[i % period:]) + list(SPEC_CYCLE) * 2
+               for i in range(0, SPEC_PROMPTS * 2, 2)]
+    tokens = SPEC_PROMPTS * SPEC_NEW
+    out_on, dt_on, st_on = _spec_arm(model, prompts, SPEC_NEW, SPEC_K)
+    out_off, dt_off, _ = _spec_arm(model, prompts, SPEC_NEW, 0)
+    speedup = round(dt_off / dt_on, 2)
+    ok = out_on == out_off and speedup >= min_speedup
+    row = {
+        "config": "spec_repetitive", "quant": "fp",
+        "backend": jax.default_backend(),
+        "batch": SPEC_PROMPTS, "prompt": len(prompts[0]),
+        "new_tokens": SPEC_NEW, "spec_k": SPEC_K,
+        "train_loss": round(loss, 4),
+        "spec_on_tokens_per_sec": round(tokens / dt_on, 1),
+        "spec_off_tokens_per_sec": round(tokens / dt_off, 1),
+        "speedup": speedup,
+        "outputs_identical": bool(out_on == out_off),
+        "acceptance": st_on["speculative"]["acceptance"],
+        "min_speedup": min_speedup, "ok": bool(ok),
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    print(json.dumps(row), flush=True)
+    with open(out_path, "a") as f:
+        f.write(json.dumps(row) + "\n")
+    if not ok:
+        print(f"FAIL: speculation gate — wanted identical greedy outputs "
+              f"and >= {min_speedup}x decode tokens/s, got "
+              f"identical={row['outputs_identical']} "
+              f"speedup={speedup}", flush=True)
+    return row, ok
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--preset", default="small", choices=sorted(PRESETS))
     ap.add_argument("--out", default=os.path.join(_REPO, "DECODEBENCH.jsonl"))
     ap.add_argument("--skip-int8", action="store_true")
+    ap.add_argument("--skip-spec", action="store_true")
+    ap.add_argument("--min-spec-speedup", type=float, default=1.3)
     args = ap.parse_args()
     p = PRESETS[args.preset]
     measure(args.preset, False, out_path=args.out, **p)
     if not args.skip_int8:
         measure(args.preset, True, out_path=args.out, **p)
+    if not args.skip_spec:
+        _, ok = measure_spec(args.out, min_speedup=args.min_spec_speedup)
+        if not ok:
+            sys.exit(1)
 
 
 if __name__ == "__main__":

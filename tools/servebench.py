@@ -13,7 +13,7 @@ the backlog.
 
 Per load point it reports aggregate generated tokens/s and request-latency
 p50/p99 (arrival -> finish) for both schedulers, and writes the whole run
-to SERVEBENCH_r12.json (--out). Exit is non-zero when either scheduler
+to SERVEBENCH_r13.json (--out). Exit is non-zero when either scheduler
 completes zero requests, or when continuous batching fails --min-speedup
 (default 1.5x) over static at the HIGHEST load point.
 
@@ -24,6 +24,21 @@ warm pass (compiles every program and brings the cache to steady state).
 It reports cache hit rate, prefill tokens actually computed, and TTFT
 p50/p99 for both, and gates on: greedy outputs bitwise-identical, >= 2x
 prefill-token reduction, and a TTFT p50 improvement.
+
+A third workload measures SELF-SPECULATIVE DECODING (n-gram prompt-lookup
+drafting + one multi-token verify dispatch per tick). Two arms:
+
+  * repetitive — a tiny GPT overfit on a short cyclic stream (the
+    high-acceptance regime prompt-lookup exists for: templated/extractive
+    continuations); gates on greedy outputs bitwise-identical spec-on vs
+    spec-off AND >= --min-spec-speedup (default 1.3x) wall-clock speedup.
+  * adversarial_random — an UNTRAINED model on random prompts: drafts
+    never verify, the adaptive throttle must pause drafting and degrade
+    to the plain path within 3% (ratio >= 0.97).
+
+Timing protocol: two unmeasured passes per engine (the first compiles the
+prefill/decode/verify programs, the second the cache-hit admission path),
+then the measured pass — same discipline as the prefix workload.
 """
 from __future__ import annotations
 
@@ -60,6 +75,23 @@ PREFIX_NEW = (8, 24)
 # high enough that prefill work produces real queueing: the TTFT gap
 # between cache on and off is the point of the workload
 PREFIX_RPS = 64.0
+
+# speculative-decoding workload: a dedicated tiny model (vocab 64) overfit
+# on SPEC_CYCLE so its greedy continuation IS the cycle — prompt-lookup
+# drafts then verify at ~100% acceptance. Period 8 with distinct tokens is
+# bigram-determined (converges in ~300 steps) and long enough that a k=8
+# draft pays a full window per verify dispatch.
+SPEC_MODEL = dict(vocab=64, hidden=64, layers=2, heads=4, max_pos=512)
+SPEC_CYCLE = (3, 9, 17, 42, 5, 28, 51, 60)
+SPEC_TRAIN_STEPS = 300
+SPEC_LR = 1e-3
+SPEC_K = 8
+SPEC_NEW = 96
+# the adversarial arm decodes longer: the throttle's cost is a FIXED few
+# probe ticks per request (then exponential-backoff pause), so the honest
+# number is the amortized ratio, not one dominated by the probes
+SPEC_ADV_NEW = 256
+SPEC_PROMPTS = 4
 
 
 def _build_model():
@@ -292,15 +324,124 @@ def _run_prefix_workload(model, n, slots, rps):
     return row, ok
 
 
+def _train_cyclic_model():
+    """Overfit a tiny GPT on the repeating SPEC_CYCLE stream (128 tokens,
+    covering every decode position the workload reaches — positions past
+    the training length have unlearned embeddings and derail the cycle)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=SPEC_MODEL["vocab"],
+                    hidden_size=SPEC_MODEL["hidden"],
+                    num_layers=SPEC_MODEL["layers"],
+                    num_heads=SPEC_MODEL["heads"],
+                    max_position_embeddings=SPEC_MODEL["max_pos"],
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    model = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(SPEC_LR, parameters=model.parameters())
+    stream = np.array(list(SPEC_CYCLE) * 16, dtype=np.int32)
+    ids = paddle.to_tensor(stream[None, :])
+    model.train()
+    loss = None
+    for _ in range(SPEC_TRAIN_STEPS):
+        loss = model(ids, labels=ids)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    model.eval()
+    return model, float(loss.item())
+
+
+def _spec_arm(model, prompts, new_tokens, spec_k, repeats=3):
+    """Best-of-`repeats` measured pass after two warm passes (compiles +
+    cache-hit admission); returns (outputs, seconds, engine stats)."""
+    from paddle_tpu.serving import ServingEngine
+
+    eng = ServingEngine(model, spec_k=spec_k)
+    eng.generate(prompts, max_new_tokens=new_tokens)
+    eng.generate(prompts, max_new_tokens=new_tokens)
+    best = float("inf")
+    out = None
+    for _ in range(repeats):
+        t0 = time.time()
+        out = eng.generate(prompts, max_new_tokens=new_tokens)
+        best = min(best, time.time() - t0)
+    return out, best, eng.stats()
+
+
+def _run_spec_workload(min_speedup):
+    """Self-speculation bench: repetitive arm (overfit cyclic model; gate
+    parity + speedup) and adversarial-random arm (untrained model, random
+    prompts; gate <= 3% regression). Returns (row, ok)."""
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    model, loss = _train_cyclic_model()
+    period = len(SPEC_CYCLE)
+    prompts = [list(SPEC_CYCLE[i % period:]) + list(SPEC_CYCLE) * 2
+               for i in range(0, SPEC_PROMPTS * 2, 2)]
+    tokens = SPEC_PROMPTS * SPEC_NEW
+    out_on, dt_on, st_on = _spec_arm(model, prompts, SPEC_NEW, SPEC_K)
+    out_off, dt_off, _ = _spec_arm(model, prompts, SPEC_NEW, 0)
+    rep_identical = out_on == out_off
+    rep_speedup = round(dt_off / dt_on, 2)
+    rep = {"outputs_identical": bool(rep_identical),
+           "train_loss": round(loss, 4),
+           "spec_on_tokens_per_s": round(tokens / dt_on, 1),
+           "spec_off_tokens_per_s": round(tokens / dt_off, 1),
+           "speedup": rep_speedup,
+           "speculative": st_on["speculative"]}
+
+    import paddle_tpu as paddle
+
+    paddle.seed(7)
+    cfg = GPTConfig(vocab_size=SPEC_MODEL["vocab"],
+                    hidden_size=SPEC_MODEL["hidden"],
+                    num_layers=SPEC_MODEL["layers"],
+                    num_heads=SPEC_MODEL["heads"],
+                    max_position_embeddings=SPEC_MODEL["max_pos"],
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    raw = GPTForCausalLM(cfg)
+    raw.eval()
+    rng = np.random.default_rng(42)
+    rand_prompts = [[int(x) for x in
+                     rng.integers(0, SPEC_MODEL["vocab"], 16)]
+                    for _ in range(SPEC_PROMPTS)]
+    # best-of-5: the adversarial runs are short (~0.1s) so host noise on a
+    # single pass can swing the ratio past the 3% budget either way
+    aout_on, adt_on, ast_on = _spec_arm(raw, rand_prompts, SPEC_ADV_NEW,
+                                        SPEC_K, repeats=5)
+    aout_off, adt_off, _ = _spec_arm(raw, rand_prompts, SPEC_ADV_NEW, 0,
+                                     repeats=5)
+    adv_identical = aout_on == aout_off
+    adv_ratio = round(adt_off / adt_on, 2)
+    adv = {"outputs_identical": bool(adv_identical),
+           "ratio": adv_ratio,
+           "speculative": ast_on["speculative"]}
+
+    ok = (bool(rep_identical) and rep_speedup >= min_speedup
+          and bool(adv_identical) and adv_ratio >= 0.97)
+    row = {"workload": "self_speculation", "model": SPEC_MODEL,
+           "cycle": list(SPEC_CYCLE), "spec_k": SPEC_K,
+           "new_tokens": SPEC_NEW, "adv_new_tokens": SPEC_ADV_NEW,
+           "prompts": SPEC_PROMPTS,
+           "min_speedup": min_speedup,
+           "repetitive": rep, "adversarial_random": adv, "ok": ok}
+    return row, ok
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=os.path.join(_REPO,
-                                                  "SERVEBENCH_r12.json"))
+                                                  "SERVEBENCH_r13.json"))
     ap.add_argument("--requests", type=int, default=48)
     ap.add_argument("--slots", type=int, default=8)
     ap.add_argument("--min-speedup", type=float, default=1.5,
                     help="required continuous/static tokens/s ratio at the "
                          "highest load point")
+    ap.add_argument("--min-spec-speedup", type=float, default=1.3,
+                    help="required spec-on/spec-off wall-clock ratio on "
+                         "the repetitive arm")
     args = ap.parse_args()
 
     import jax
@@ -379,6 +520,18 @@ def main():
               f"{prefix_row['cache_off']['ttft_p50_s']}")
         ok = False
 
+    spec_row, spec_ok = _run_spec_workload(args.min_spec_speedup)
+    print(json.dumps(spec_row), flush=True)
+    if not spec_ok:
+        rep, adv = spec_row["repetitive"], spec_row["adversarial_random"]
+        print("FAIL: speculation workload — need identical outputs, "
+              f">={args.min_spec_speedup}x on the repetitive arm and "
+              ">=0.97x on the adversarial arm; got "
+              f"identical={rep['outputs_identical']}/"
+              f"{adv['outputs_identical']} "
+              f"speedup={rep['speedup']} adv_ratio={adv['ratio']}")
+        ok = False
+
     report = {
         "bench": "servebench", "backend": jax.default_backend(),
         "model": MODEL, "slots": args.slots, "requests": args.requests,
@@ -388,6 +541,7 @@ def main():
         "min_speedup": args.min_speedup,
         "points": points,
         "prefix_caching": prefix_row,
+        "speculation": spec_row,
         "ok": ok,
         "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
     }

@@ -10,6 +10,9 @@ Entry points:
   - analyze(fn, *args, mesh=..., donate_argnums=..., ...) -> Report
   - analyze_jaxpr(closed_jaxpr, ...) -> Report
   - lint_train_step(train_step, batch) -> Report   (what FLAGS_jit_lint uses)
+  - output_ready_indices / schedule_report / verify_overlap_schedule
+    (readiness.py) — reusable queries for the fine-grained overlap
+    scheduler (distributed/overlap.py), not lint rules
   - python -m paddle_tpu.analysis                   (lint model-zoo presets)
 
 Rules (ids): collective-axis, dtype-promotion, recompile-hazard, donation,
@@ -27,5 +30,12 @@ from .analyzer import (  # noqa: F401
     trace_program,
 )
 from .findings import Finding, LintError, Report, Severity  # noqa: F401
+from .readiness import (  # noqa: F401
+    bucket_ready_indices,
+    output_ready_indices,
+    producer_indices,
+    schedule_report,
+    verify_overlap_schedule,
+)
 from .registry import Rule, all_rules, get_rule, register_rule  # noqa: F401
 from .rules.pallas_tiling import lint_block_shape  # noqa: F401

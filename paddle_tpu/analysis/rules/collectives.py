@@ -50,6 +50,30 @@ def _param_meshes(eqn):
     return out
 
 
+def _ppermute_perm(eqn):
+    """The (src, dst) pairs of a ppermute eqn, or None."""
+    perm = eqn.params.get("perm")
+    if perm is None:
+        return None
+    try:
+        return tuple((int(s), int(d)) for s, d in perm)
+    except (TypeError, ValueError):
+        return None
+
+
+def _is_full_cycle(perm, size) -> bool:
+    """True when `perm` is a bijection over all `size` participants — the
+    shape of a decomposed-collective step (ring reduce-scatter/all-gather,
+    distributed/overlap.py): every device sends and receives exactly once,
+    so nothing is zero-filled and the op is real communication."""
+    if not perm or size is None or size <= 0:
+        return False
+    srcs = [s for s, _ in perm]
+    dsts = [d for _, d in perm]
+    full = set(range(size))
+    return (len(perm) == size and set(srcs) == full and set(dsts) == full)
+
+
 @register_rule(
     "collective-axis", "Collective over a nonexistent or size-1 mesh axis",
     Severity.ERROR,
@@ -73,10 +97,19 @@ def check(program: ProgramInfo):
     for idx, eqn in iter_eqns(program.closed_jaxpr):
         for m in _param_meshes(eqn):
             allowed.update(str(a) for a in m.axis_names)
+    # ppermute chains: a decomposed collective (ring reduce-scatter /
+    # all-gather, distributed/overlap.py) legitimately emits 2*(world-1)
+    # ppermutes over the same axis with full-cycle rotation perms — often
+    # interleaved with compute. Per-eqn findings on such a chain are pure
+    # noise, so ppermute findings are grouped per (axis, perm) chain and
+    # emitted once, and full-cycle perms are never flagged as zero-filling.
+    chains: dict = {}  # (axis, perm) -> [first_idx, count, eqn]
     for idx, eqn in iter_eqns(program.closed_jaxpr):
         local = set()
         for m in _param_meshes(eqn):
             local.update(str(a) for a in m.axis_names)
+        is_ppermute = eqn.primitive.name == "ppermute"
+        perm = _ppermute_perm(eqn) if is_ppermute else None
         for ax in _axis_names(eqn):
             if ax in unbound:
                 continue  # already an ERROR above
@@ -91,6 +124,11 @@ def check(program: ProgramInfo):
                              "with this axis (distributed.build_mesh)")
                 continue
             size = program.axis_size(ax)
+            if is_ppermute:
+                key = (ax, perm)
+                ent = chains.setdefault(key, [idx, 0, eqn, size])
+                ent[1] += 1
+                continue
             if size == 1:
                 yield Finding(
                     rule="collective-axis", severity=Severity.WARNING,
@@ -101,3 +139,31 @@ def check(program: ProgramInfo):
                     source=eqn_source(eqn),
                     fix_hint="size the mesh axis >1 or drop the collective "
                              "on single-device configs")
+    for (ax, perm), (idx, count, eqn, size) in chains.items():
+        chain = f" ({count}-step chain)" if count > 1 else ""
+        if size == 1:
+            yield Finding(
+                rule="collective-axis", severity=Severity.WARNING,
+                message=f"ppermute over axis {ax!r} of size 1 — a no-op "
+                        f"collective{chain} (wrong mesh shape, or dead "
+                        "code on single-device runs?)",
+                primitive="ppermute", eqn_index=idx,
+                source=eqn_source(eqn),
+                fix_hint="size the mesh axis >1 or drop the collective "
+                         "on single-device configs")
+        elif perm is not None and size is not None and \
+                not _is_full_cycle(perm, size):
+            # partial perms zero-fill every device missing as a source —
+            # legal (halo masking) but a classic silent-wrong-result shape;
+            # full-cycle rotations (decomposed reduce steps) never fire this
+            missing = size - len({d for _, d in perm})
+            yield Finding(
+                rule="collective-axis", severity=Severity.WARNING,
+                message=f"ppermute over axis {ax!r} covers "
+                        f"{len(perm)}/{size} participants{chain} — devices "
+                        f"missing as destinations ({missing}) receive "
+                        "zeros, which silently drops data if unintended",
+                primitive="ppermute", eqn_index=idx,
+                source=eqn_source(eqn),
+                fix_hint="make the perm a bijection over the axis (full "
+                         "rotation) or confirm the zero-fill is intended")

@@ -117,3 +117,10 @@ from .ps import (  # noqa: F401,E402
     ShowClickEntry,
 )
 from . import passes  # noqa: F401,E402
+from .overlap import (  # noqa: F401,E402  (fine-grained reduce schedules)
+    choose_schedule,
+    last_schedule,
+    overlap_grad_reduce,
+    reduce_flush,
+    ring_all_reduce,
+)

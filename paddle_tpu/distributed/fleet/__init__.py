@@ -53,6 +53,11 @@ class DistributedStrategy:
         self.dp_comm_configs = {
             "bucketed_allreduce": False,
             "grad_bucket_mb": 4,
+            # reduction schedule: 'bucketed' (one pmean per bucket) or
+            # 'fine' (analyzer-driven decomposed ring reduce interleaved
+            # with the backward — distributed/overlap.py); None follows
+            # FLAGS_dp_overlap
+            "overlap": None,
         }
 
 
@@ -138,6 +143,9 @@ def dp_train_step(model, loss_fn, optimizer, strategy=None, mesh=None,
     (distributed/grad_buckets.py); otherwise one coalesced all-reduce runs
     after the full backward (still the explicit shard_map path, so the two
     are directly comparable — tools/stepbench.py does exactly that).
+    ``dp_comm_configs['overlap']`` picks the reduction schedule: 'bucketed'
+    (per-bucket pmean) or 'fine' (decomposed ring reduce interleaved with
+    the backward, distributed/overlap.py); None follows FLAGS_dp_overlap.
     """
     from ...jit.trainer import TrainStep
 
@@ -145,6 +153,7 @@ def dp_train_step(model, loss_fn, optimizer, strategy=None, mesh=None,
            else DistributedStrategy().dp_comm_configs)
     bucket_mb = (cfg.get("grad_bucket_mb", 4)
                  if cfg.get("bucketed_allreduce", True) else -1)
+    kwargs.setdefault("dp_overlap", cfg.get("overlap"))
     return TrainStep(model, loss_fn, optimizer, mesh=mesh, dp_axis=dp_axis,
                      grad_bucket_mb=bucket_mb, **kwargs)
 

@@ -88,6 +88,24 @@ def partition_buckets(shapes: Sequence[tuple], dtypes: Sequence,
     return buckets
 
 
+def coalesce(g_vals, idxs: Sequence[int]):
+    """Flatten+concat the bucket members `idxs` of `g_vals` (dtype-uniform
+    by construction of partition_buckets)."""
+    if len(idxs) == 1:
+        return g_vals[idxs[0]].ravel()
+    return jnp.concatenate([g_vals[i].ravel() for i in idxs])
+
+
+def uncoalesce(red, idxs: Sequence[int], shapes, out: list) -> None:
+    """Scatter a reduced coalesced vector back to `out` at the bucket's
+    member positions, restoring each member's shape."""
+    off = 0
+    for i in idxs:
+        n = int(np.prod(shapes[i], dtype=np.int64) or 1)
+        out[i] = red[off:off + n].reshape(shapes[i])
+        off += n
+
+
 def bucket_reduce(g_vals, axis_name: str, bucket_bytes: int = None,
                   mean: bool = True):
     """Reduce per-shard gradients over `axis_name` in coalesced buckets.
@@ -111,11 +129,6 @@ def bucket_reduce(g_vals, axis_name: str, bucket_bytes: int = None,
                 i = idxs[0]
                 out[i] = reduce_(g_vals[i], axis_name)
                 continue
-            flat = jnp.concatenate([g_vals[i].ravel() for i in idxs])
-            red = reduce_(flat, axis_name)
-            off = 0
-            for i in idxs:
-                n = int(np.prod(shapes[i], dtype=np.int64) or 1)
-                out[i] = red[off:off + n].reshape(shapes[i])
-                off += n
+            red = reduce_(coalesce(g_vals, idxs), axis_name)
+            uncoalesce(red, idxs, shapes, out)
     return out

@@ -65,6 +65,7 @@ class TrainStep:
         nan_guard: bool = False,
         dp_axis: Optional[str] = None,
         grad_bucket_mb: Optional[int] = None,
+        dp_overlap: Optional[str] = None,
         telemetry: Optional[bool] = None,
     ):
         self.model = model
@@ -110,6 +111,18 @@ class TrainStep:
         else:
             self._bucket_bytes = (int(grad_bucket_mb) << 20
                                   if grad_bucket_mb >= 0 else 1 << 62)
+        # Reduction schedule on the explicit-DP path: 'bucketed' keeps one
+        # pmean per bucket (bitwise vs single all-reduce); 'fine' lowers each
+        # bucket to a decomposed ring reduce-scatter/all-gather interleaved
+        # with the backward (distributed/overlap.py; allclose parity). None
+        # follows FLAGS_dp_overlap at trace time.
+        if dp_overlap is not None:
+            dp_overlap = str(dp_overlap).lower()
+            if dp_overlap not in ("bucketed", "fine"):
+                raise ValueError(
+                    f"dp_overlap={dp_overlap!r}: expected 'bucketed' or "
+                    "'fine'")
+        self._dp_overlap = dp_overlap
 
         # ZeRO stage placements (distributed/sharding.py): optimizer state is
         # sharded in all stages; grads carry a reduce-scatter constraint in
@@ -134,10 +147,15 @@ class TrainStep:
             [p._value.sharding for p in self.params]
             if getattr(optimizer, "_zero_level", None) else None)
 
-        def step(param_vals, buffer_vals, opt_state, lr, seed, batch):
-            saved = [(p._value, p._grad_node, p._grad, p.stop_gradient) for p in self.params]
+        def fwd_bwd(param_vals, buffer_vals, batch):
+            """Pure forward+backward: swap the traced values into the live
+            layer tree, differentiate, restore. Returns (loss, per-param
+            grads, updated buffer values). Deliberately collective-free so
+            the fine overlap scheduler can make_jaxpr it and hand the
+            readiness analysis a pure backward."""
+            saved = [(p._value, p._grad_node, p._grad, p.stop_gradient)
+                     for p in self.params]
             saved_buf = [(b._value,) for b in self.buffers]
-            prev_seed = _random.default_generator.push_trace_seed(seed)
             try:
                 for p, v in zip(self.params, param_vals):
                     p._value = v
@@ -153,15 +171,49 @@ class TrainStep:
                     (g._value if g is not None else jnp.zeros_like(p._value))
                     for g, p in zip(grads, self.params)
                 ]
-                loss_val = loss._value
-                if self._dp_axis is not None:
-                    # explicit DP: bucketed all-reduce BEFORE clipping so the
-                    # clip sees globally-reduced grads (GSPMD-path parity)
-                    from ..distributed.grad_buckets import bucket_reduce
+                new_buffer_vals = [b._value for b in self.buffers]  # BN stats updated in-place
+                return loss._value, g_vals, new_buffer_vals
+            finally:
+                for p, (v, gn, g, sg) in zip(self.params, saved):
+                    p._value, p._grad_node, p._grad, p.stop_gradient = \
+                        v, gn, g, sg
+                for b, (v,) in zip(self.buffers, saved_buf):
+                    b._value = v
 
-                    g_vals = bucket_reduce(g_vals, self._dp_axis,
-                                           self._bucket_bytes)
+        self._fwd_bwd_fn = fwd_bwd  # overlap tests trace this directly
+
+        def step(param_vals, buffer_vals, opt_state, lr, seed, batch):
+            saved = [(p._value,) for p in self.params]
+            prev_seed = _random.default_generator.push_trace_seed(seed)
+            try:
+                if self._dp_axis is not None and \
+                        self._overlap_mode() == "fine":
+                    # fine-grained overlap: trace the pure backward, replay
+                    # it with each bucket's decomposed ring all-reduce
+                    # interleaved at its readiness point
+                    # (distributed/overlap.py)
+                    from ..distributed import overlap as _overlap
+
+                    loss_val, g_vals, new_buffer_vals = \
+                        _overlap.overlap_grad_reduce(
+                            fwd_bwd, (param_vals, buffer_vals, batch),
+                            self._dp_axis, self._bucket_bytes)
                     loss_val = jax.lax.pmean(loss_val, self._dp_axis)
+                else:
+                    loss_val, g_vals, new_buffer_vals = fwd_bwd(
+                        param_vals, buffer_vals, batch)
+                    if self._dp_axis is not None:
+                        # explicit DP: bucketed all-reduce BEFORE clipping so
+                        # the clip sees globally-reduced grads (GSPMD parity)
+                        from ..distributed.grad_buckets import bucket_reduce
+
+                        g_vals = bucket_reduce(g_vals, self._dp_axis,
+                                               self._bucket_bytes)
+                        loss_val = jax.lax.pmean(loss_val, self._dp_axis)
+                # clip/update section: hybrid clips read param identities AND
+                # their current (traced) values, so swap those back in
+                for p, v in zip(self.params, param_vals):
+                    p._value = v
                 if self._grad_shardings is not None:  # ZeRO-2/3 reduce-scatter
                     g_vals = [
                         jax.lax.with_sharding_constraint(g, sh)
@@ -198,7 +250,6 @@ class TrainStep:
                         jax.lax.with_sharding_constraint(v, sh)
                         for v, sh in zip(new_p, self._param_shardings)
                     ]
-                new_buffer_vals = [b._value for b in self.buffers]  # BN stats updated in-place
                 out = [loss_val, new_p, new_buffer_vals, new_s]
                 if self._nan_guard:
                     # finite check; overflow of the square-sum to inf is
@@ -217,10 +268,8 @@ class TrainStep:
                 return tuple(out)
             finally:
                 _random.default_generator.pop_trace_seed(prev_seed)
-                for p, (v, gn, g, sg) in zip(self.params, saved):
-                    p._value, p._grad_node, p._grad, p.stop_gradient = v, gn, g, sg
-                for b, (v,) in zip(self.buffers, saved_buf):
-                    b._value = v
+                for p, (v,) in zip(self.params, saved):
+                    p._value = v
 
         self._step_fn = step  # analysis.lint_train_step traces this
         self._donate = bool(donate)
@@ -265,20 +314,81 @@ class TrainStep:
                 in_specs=(_P(), _P(), _P(), _P(), _P(), _P(dp_axis)),
                 out_specs=_P(),
                 axis_names=frozenset({dp_axis}), check_vma=False)
+            self._base_callable = smapped
             self._jitted = jax.jit(smapped, donate_argnums=donate_argnums)
         else:
+            self._base_callable = step
             self._jitted = jax.jit(
                 step,
                 donate_argnums=donate_argnums,
                 in_shardings=in_shardings,
                 out_shardings=out_shardings,
             )
+        self._donate_argnums = donate_argnums
         # AOT fast dispatch (jit/compile_cache.py): the lowered+compiled
         # executable for the (single) input signature, built lazily
         self._aot = None
         self._aot_sig = None
         self._n_params = None  # resolved lazily for the telemetry MFU
         self._batch_dims = None  # (samples, tokens) cached per signature
+        # overlap schedule config baked into the traced program (mode,
+        # bucket bytes, ring floor): tracked so a FLAGS flip between calls
+        # rebuilds the jit cache instead of dispatching the stale trace
+        self._overlap_cfg_used = None
+        # attributed reduce time (telemetry): the fused program hides the
+        # collective wait inside compute_s, so a standalone comm-only probe
+        # is compiled lazily and re-timed every ~50 steps
+        self._reduce_probe = None
+        self._probe_zeros = None
+        self._reduce_s = None
+        self._probe_step = -(1 << 30)
+
+    def _overlap_mode(self) -> str:
+        """Resolved reduction schedule for the dp path: the explicit
+        constructor arg wins, else FLAGS_dp_overlap (read at trace time)."""
+        mode = self._dp_overlap if self._dp_overlap is not None else \
+            str(get_flag("dp_overlap")).lower()
+        if mode not in ("bucketed", "fine"):
+            raise ValueError(
+                f"FLAGS_dp_overlap={mode!r}: expected 'bucketed' or 'fine'")
+        return mode
+
+    def _overlap_cfg(self):
+        """The schedule-shaping knobs the traced program closed over."""
+        from ..distributed.grad_buckets import default_bucket_bytes
+        from ..distributed.overlap import min_ring_bytes
+
+        return (self._overlap_mode(),
+                self._bucket_bytes if self._bucket_bytes is not None
+                else default_bucket_bytes(),
+                min_ring_bytes())
+
+    def _refresh_overlap_cfg(self) -> None:
+        """jax caches traces on arg signatures only — the overlap flags are
+        read at trace time, so a change between calls must drop the cached
+        trace (and the AOT executable) to take effect."""
+        if self._dp_axis is None:
+            return
+        cfg = self._overlap_cfg()
+        if self._overlap_cfg_used is None:
+            self._overlap_cfg_used = cfg
+            return
+        if cfg != self._overlap_cfg_used:
+            self._overlap_cfg_used = cfg
+            # jax's trace cache is shared across jit wrappers and keyed on
+            # the underlying callable's identity — a fresh closure forces
+            # the body (and the flags it reads) to actually re-trace
+            base = self._base_callable
+
+            def retraced(*a):
+                return base(*a)
+
+            self._jitted = jax.jit(retraced,
+                                   donate_argnums=self._donate_argnums)
+            self._aot = None
+            self._aot_sig = None
+            self._reduce_probe = None  # schedule changed: re-probe
+            self._reduce_s = None
 
     @staticmethod
     def _arg_signature(args):
@@ -290,6 +400,7 @@ class TrainStep:
     def _dispatch(self, *args):
         from ..core.flags import get_flag
 
+        self._refresh_overlap_cfg()
         if not get_flag("jit_fast_dispatch"):
             if not self._telemetry:
                 return self._jitted(*args)
@@ -307,6 +418,10 @@ class TrainStep:
                     what="train_step", aot=False)
             return out
         sig = self._arg_signature(args)
+        if self._dp_axis is not None:
+            # the overlap schedule is part of the compiled program, so it is
+            # part of the executable's identity too
+            sig = (sig, self._overlap_cfg_used)
         if self._aot is None or sig != self._aot_sig:
             # new shape/dtype signature: AOT-compile for it (first time), or
             # fall through jit for a shape-polymorphic caller
@@ -403,6 +518,55 @@ class TrainStep:
             self._emit_step(loss, gnorm, float(lr), t0, batch_vals)
         return Tensor(loss)
 
+    _REDUCE_PROBE_EVERY = 50  # steps between reduce-probe re-measurements
+
+    def _probe_reduce_s(self) -> Optional[float]:
+        """Attributed reduce time for telemetry on the explicit-DP path.
+
+        The gradient all-reduce is fused into the one step executable, so no
+        host-observable reduce wait exists and `reduce_ms` would read 0.0
+        forever. Instead, a standalone program containing ONLY this step's
+        gradient reduction (same shapes/dtypes/schedule — overlap.reduce_flush
+        over zeros) is compiled once and re-timed every ~50 steps; its wall
+        time is reported as the step's reduce phase and subtracted from
+        compute so phases still sum to the measured step time."""
+        if self._dp_size is None or self._dp_size <= 1:
+            return None
+        if self._step_i - self._probe_step < self._REDUCE_PROBE_EVERY:
+            return self._reduce_s  # cached (or throttled after a failure)
+        try:
+            if self._reduce_probe is None:
+                from jax.sharding import PartitionSpec as _P
+
+                from ..distributed import overlap as _overlap
+                from ..distributed._compat import shard_map as _shard_map
+
+                axis, mode = self._dp_axis, self._overlap_mode()
+                bucket_bytes = self._bucket_bytes
+
+                def reduce_only(*g_vals):
+                    return tuple(_overlap.reduce_flush(
+                        list(g_vals), axis, bucket_bytes, mode=mode))
+
+                n = len(self.params)
+                self._reduce_probe = jax.jit(_shard_map(
+                    reduce_only, mesh=self._mesh,
+                    in_specs=(_P(),) * n, out_specs=(_P(),) * n,
+                    axis_names=frozenset({axis}), check_vma=False))
+                self._probe_zeros = [jnp.zeros_like(np.asarray(p._value))
+                                     for p in self.params]
+                # warm call so the timed one below never measures a compile
+                jax.block_until_ready(self._reduce_probe(*self._probe_zeros))
+            t0 = time.perf_counter()
+            jax.block_until_ready(self._reduce_probe(*self._probe_zeros))
+            self._reduce_s = time.perf_counter() - t0
+            self._probe_step = self._step_i
+        except Exception:  # the probe must never take down training
+            self._reduce_probe = None
+            self._reduce_s = None
+            self._probe_step = self._step_i  # throttles the retry
+        return self._reduce_s
+
     def _emit_step(self, loss, gnorm, lr_f, t0, batch_vals):
         """Build and stage this step's telemetry record (telemetry path only).
         Reading loss/gnorm to host scalars is the step's natural sync point,
@@ -441,9 +605,14 @@ class TrainStep:
             "compute_s": compute_s,
             "skipped": self.last_skipped if self._nan_guard else False,
             # on the fused single-program path the all-reduce overlaps the
-            # backward inside XLA; no host-observable reduce wait exists
+            # backward inside XLA; no host-observable reduce wait exists —
+            # reduce_s below is the PROBED comm cost attributed out of
+            # compute_s, not a wait the host saw
             "reduce_overlapped": True,
         }
+        reduce_s = self._probe_reduce_s()
+        if reduce_s:
+            core["reduce_s"] = round(min(reduce_s, compute_s), 6)
         if samples:
             core["samples"] = samples
         if tokens:

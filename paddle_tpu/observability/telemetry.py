@@ -21,9 +21,12 @@ Assembly protocol (who knows what, when):
 
 On the single-compiled-program path the gradient all-reduce is fused into
 the step executable (XLA overlaps it with the backward — see
-distributed/grad_buckets.py), so `reduce` reports host-observable collective
-wait only, which is 0.0 there by construction; the record says so honestly
-via `reduce_overlapped`.
+distributed/grad_buckets.py and distributed/overlap.py), so no
+host-observable reduce wait exists. The `reduce` phase is instead the comm
+cost jit.TrainStep ATTRIBUTES from inside the step: a standalone probe of
+the step's own reduction schedule, carved out of `compute` so the phases
+still sum to the measured step time; `reduce_overlapped` stays True to say
+the time was attributed, not waited on.
 
 Everything is inert while FLAGS_metrics is off: `enabled()` is one flag
 read, and TrainStep checks it before building any record.
@@ -166,6 +169,14 @@ class StepTelemetry:
             self._write(prev)
 
         compute_s = float(core.get("compute_s", 0.0))
+        # `reduce_s` is the comm time the step ATTRIBUTES out of its own
+        # measured wall (jit.TrainStep's reduce probe): the collective is
+        # fused into the step program, so it is carved out of compute rather
+        # than added on top — phases keep summing to the measured step time
+        reduce_s = min(float(core.get("reduce_s", 0.0) or 0.0), compute_s)
+        if reduce_s > 0.0:
+            phases["reduce"] = phases.get("reduce", 0.0) + reduce_s
+            compute_s -= reduce_s
         phases["compute"] = phases.get("compute", 0.0) + compute_s
         # wall time step->step covers data+compute+save of the interleave;
         # throughput/MFU use it when available (first step: compute only)

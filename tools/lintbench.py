@@ -97,22 +97,50 @@ def _bad_corpus():
         return analysis.analyze(bad, np.ones((4,), np.float32),
                                 context={"prefetch_active": True})
 
+    def ppermute_partial():
+        # a perm that is NOT a bijection over the axis: missing devices
+        # receive zeros — the silent-wrong-result shape the rule warns on
+        return analysis.analyze(
+            lambda x: jax.lax.ppermute(x, "dp", [(0, 1)]),
+            np.ones((4,), np.float32), axis_env={"dp": 8})
+
     return [
-        ("collective-axis", collective),
-        ("dtype-promotion", dtype),
-        ("recompile-hazard", recompile),
-        ("donation", donation),
-        ("dead-output", deadcode),
-        ("host-sync", syncpoint),
-        ("pallas-tiling", pallas),
-        ("prefetch-effects", prefetch),
+        ("collective-axis", "collective-axis", collective),
+        ("collective-axis", "ppermute-partial-perm", ppermute_partial),
+        ("dtype-promotion", "dtype-promotion", dtype),
+        ("recompile-hazard", "recompile-hazard", recompile),
+        ("donation", "donation", donation),
+        ("dead-output", "dead-output", deadcode),
+        ("host-sync", "host-sync", syncpoint),
+        ("pallas-tiling", "pallas-tiling", pallas),
+        ("prefetch-effects", "prefetch-effects", prefetch),
+    ]
+
+
+def _good_corpus():
+    """[(rule_id, label, thunk -> Report)] — false-positive guards: programs
+    that must lint CLEAN for the given rule."""
+    from paddle_tpu import analysis
+
+    def ppermute_ring():
+        # a decomposed ring all-reduce is 2*(world-1) full-cycle ppermutes
+        # over a bound axis (distributed/overlap.py): real communication,
+        # zero findings expected — neither no-op nor zero-fill warnings
+        from paddle_tpu.distributed import overlap
+
+        return analysis.analyze(
+            lambda x: overlap.ring_all_reduce(x, "dp", world=8),
+            np.ones((64,), np.float32), axis_env={"dp": 8})
+
+    return [
+        ("collective-axis", "ppermute-ring-chain", ppermute_ring),
     ]
 
 
 def run_detect():
     rows = []
     ok = True
-    for rule_id, thunk in _bad_corpus():
+    for rule_id, label, thunk in _bad_corpus():
         try:
             report = thunk()
             hits = [f for f in report.findings if f.rule == rule_id]
@@ -121,8 +149,27 @@ def run_detect():
         except Exception as e:  # a crashing positive is also a regression
             detected, msg = False, f"{type(e).__name__}: {e}"
         ok &= detected
-        rows.append({"rule": rule_id, "detected": detected, "detail": msg})
-        print(f"  detect {rule_id:18s} {'OK' if detected else 'MISSED'}")
+        rows.append({"rule": rule_id, "label": label, "detected": detected,
+                     "detail": msg})
+        print(f"  detect {label:22s} {'OK' if detected else 'MISSED'}")
+    return ok, rows
+
+
+def run_negatives():
+    rows = []
+    ok = True
+    for rule_id, label, thunk in _good_corpus():
+        try:
+            report = thunk()
+            hits = [f for f in report.findings if f.rule == rule_id]
+            clean = not hits
+            msg = hits[0].message if hits else ""
+        except Exception as e:  # a crashing negative is also a failure
+            clean, msg = False, f"{type(e).__name__}: {e}"
+        ok &= clean
+        rows.append({"rule": rule_id, "label": label, "clean": clean,
+                     "detail": msg})
+        print(f"  negative {label:20s} {'OK' if clean else 'FALSE POSITIVE'}")
     return ok, rows
 
 
@@ -167,6 +214,9 @@ def main(argv=None):
     print("== detect: every rule fires on its synthetic positive ==")
     detect_ok, detect_rows = run_detect()
 
+    print("== negatives: known-good shapes must lint clean ==")
+    negative_ok, negative_rows = run_negatives()
+
     print("== presets: model zoo must lint clean ==")
     preset_rows, error_keys, total = run_presets()
 
@@ -182,11 +232,12 @@ def main(argv=None):
         baseline = set()
 
     new_errors = sorted(set(error_keys) - baseline)
-    ok = detect_ok and not new_errors
+    ok = detect_ok and negative_ok and not new_errors
 
     result = {
         "bench": "lintbench", "issue": "r08",
         "detect": detect_rows,
+        "negatives": negative_rows,
         "presets": preset_rows,
         "preset_findings_total": total,
         "new_error_findings": new_errors,
@@ -204,6 +255,8 @@ def main(argv=None):
             print(f"  NEW ERROR: {k}")
     if not detect_ok:
         print("  DETECTION REGRESSION: a rule missed its synthetic positive")
+    if not negative_ok:
+        print("  FALSE POSITIVE: a rule fired on a known-good program")
     print(f"wrote {args.out}  ok={ok}")
     return 0 if ok else 1
 

@@ -260,6 +260,75 @@ def default_detectors(**kw) -> List[RollingDetector]:
             ThroughputCollapse(**kw), CompileCacheCollapse()]
 
 
+# -- serving detectors (r16) -------------------------------------------------
+# Same rolling-window machinery over the serving engine's per-tick records
+# (serving/observability.py assembles them): latency/goodput/cache-hit
+# regressions relative to the run's own recent history, plus a hard
+# invariant check on the block allocator. Record fields are only present
+# when the tick had the signal (no TTFT field on a tick that admitted
+# nothing), which RollingDetector already tolerates (value() -> None).
+
+class TTFTRegression(_SustainedRatio):
+    """Mean TTFT of the tick's admissions > ratio x rolling median for
+    `patience` consecutive ticks-with-admissions: the latency-collapse
+    signal an SLO-aware router sheds on."""
+
+    kind = "ttft_regression"
+    field = "ttft_s"
+    ratio = 3.0
+    direction = "above"
+
+
+class GoodputCollapse(_SustainedRatio):
+    """Windowed decoded tokens/s < ratio x rolling median while work is
+    queued or running — the serving analog of ThroughputCollapse."""
+
+    kind = "goodput_collapse"
+    field = "goodput_tokens_per_s"
+    ratio = 0.5
+    direction = "below"
+
+    def value(self, rec):
+        v = super().value(rec)
+        if v is None:
+            return None
+        # idle engine (nothing to decode) is not a collapse
+        if not (rec.get("running") or rec.get("waiting")):
+            return None
+        return v
+
+
+class CacheHitCollapse(_SustainedRatio):
+    """Rolling prefix-cache hit rate < ratio x its own median: the cache
+    stopped matching (eviction storm, workload shift, or a chain-hash
+    regression) on a workload that used to hit."""
+
+    kind = "cache_hit_collapse"
+    field = "prefix_hit_rate"
+    ratio = 0.5
+    direction = "below"
+
+
+class KVConservationBreach(RollingDetector):
+    """Block-allocator conservation law (ref + evictable + free ==
+    num_blocks - 1) violated: not statistical — fires on the first breached
+    tick (leak or double-free; KV corruption follows)."""
+
+    kind = "kv_conservation_breach"
+    field = "kv_conservation_breach"
+
+    def __init__(self, window: int = 32, cooldown: int = 25):
+        super().__init__(window, min_points=0, cooldown=cooldown)
+
+    def check(self, v, rec):
+        return {} if v > 0 else None
+
+
+def serving_default_detectors(**kw) -> List[RollingDetector]:
+    return [TTFTRegression(**kw), GoodputCollapse(**kw),
+            CacheHitCollapse(**kw), KVConservationBreach()]
+
+
 class AnomalyEngine:
     """Feeds step records through every detector; on a hit emits the
     structured `anomaly` event (JSONL + Prometheus counter + flight-recorder

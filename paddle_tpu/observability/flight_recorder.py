@@ -50,6 +50,24 @@ _ANOMALY_RING = 32
 # a process-wide monotonic sequence makes every dump name unique
 _DUMP_SEQ = itertools.count()
 
+
+def safe_reason(reason: str) -> str:
+    """Filesystem-safe dump-name suffix from a trigger reason. The ONE
+    sanitizer for every dump path — training triggers and the serving
+    flight arm share it, so dumps from both sort and grep uniformly."""
+    return "".join(c if c.isalnum() or c in "-_" else "_"
+                   for c in str(reason))[:48]
+
+
+def dump_filename(reason: str, n: int) -> str:
+    """Shared dump naming scheme: flight_<wallclock>_<pid>_<instance
+    count>_<process seq>_<reason>.json. The per-instance count n resets
+    with its recorder; the process-wide _DUMP_SEQ does not — two triggers
+    in the same second (or across a recorder reset) can never collide."""
+    seq = next(_DUMP_SEQ)
+    return (f"flight_{time.strftime('%Y%m%d_%H%M%S')}_{os.getpid()}"
+            f"_{int(n):03d}_{seq:04d}_{safe_reason(reason)}.json")
+
 # last cluster view published by observability/cluster.py (rank 0 only);
 # module-level so it survives FlightRecorder reset() between run()s
 _cluster_snapshot: Optional[Dict[str, Any]] = None
@@ -170,15 +188,7 @@ class FlightRecorder:
             }
         d = self._dump_dir(directory)
         os.makedirs(d, exist_ok=True)
-        safe = "".join(c if c.isalnum() or c in "-_" else "_"
-                       for c in str(reason))[:48]
-        # the per-instance count n resets with the recorder; the process-wide
-        # _DUMP_SEQ does not — two triggers in the same second (or across a
-        # recorder reset) can never collide on the name
-        seq = next(_DUMP_SEQ)
-        path = os.path.join(
-            d, f"flight_{time.strftime('%Y%m%d_%H%M%S')}_{os.getpid()}"
-               f"_{n:03d}_{seq:04d}_{safe}.json")
+        path = os.path.join(d, dump_filename(reason, n))
         fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
         try:
             with os.fdopen(fd, "w", encoding="utf-8") as f:
@@ -192,7 +202,7 @@ class FlightRecorder:
             except OSError:
                 pass
             raise
-        _DUMPS.inc(reason=safe or "manual")
+        _DUMPS.inc(reason=safe_reason(reason) or "manual")
         return path
 
 

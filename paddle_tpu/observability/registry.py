@@ -191,6 +191,31 @@ class Histogram(_Metric):
                 return {"count": 0, "sum": 0.0}
             return {"count": row[-2], "sum": row[-1]}
 
+    def quantile(self, q: float, **labels) -> Optional[float]:
+        """Approximate quantile from the cumulative buckets (linear
+        interpolation inside the bucket, Prometheus histogram_quantile
+        semantics). None when nothing was observed; q clamps to [0, 1].
+        Observations past the last finite bound report that bound."""
+        q = min(max(float(q), 0.0), 1.0)
+        key = self._key(labels)
+        with self._lock:
+            row = self._hist.get(key)
+            if row is None or row[-2] <= 0:
+                return None
+            row = list(row)
+        rank = q * row[-2]
+        lo = 0.0
+        prev_count = 0.0
+        for i, b in enumerate(self.buckets):
+            if row[i] >= rank:
+                width = b - lo
+                in_bucket = row[i] - prev_count
+                if in_bucket <= 0:
+                    return b
+                return lo + width * (rank - prev_count) / in_bucket
+            lo, prev_count = b, row[i]
+        return self.buckets[-1] if self.buckets else None
+
     def samples(self):  # prometheus expansion handled by the text writer
         with self._lock:
             items = list(self._hist.items())

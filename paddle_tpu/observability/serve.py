@@ -39,6 +39,18 @@ STALE_AFTER_S = 300.0  # healthz: no step for this long => status "stale"
 ANOMALY_RECENT_S = 300.0  # healthz: anomalies within this window count
 
 
+def metrics_body() -> bytes:
+    """The GET /metrics response body: the whole registry as Prometheus
+    text, memory gauges refreshed per scrape. Shared by this server and
+    the serving front end (serving/server.py) so both scrape surfaces
+    render identically."""
+    try:
+        memory.update_memory_gauges()  # fresh HBM per scrape
+    except Exception:  # noqa: BLE001
+        pass
+    return sinks.prometheus_text(default_registry()).encode()
+
+
 def health_snapshot(stale_after_s: float = STALE_AFTER_S) -> Dict[str, Any]:
     """The /healthz body, also usable directly (obsbench, tests)."""
     now = time.time()
@@ -96,12 +108,7 @@ class _Handler(BaseHTTPRequestHandler):
         path = self.path.split("?", 1)[0]
         try:
             if path == "/metrics":
-                try:
-                    memory.update_memory_gauges()  # fresh HBM per scrape
-                except Exception:  # noqa: BLE001
-                    pass
-                body = sinks.prometheus_text(default_registry()).encode()
-                self._reply(200, body,
+                self._reply(200, metrics_body(),
                             "text/plain; version=0.0.4; charset=utf-8")
             elif path in ("/healthz", "/health"):
                 snap = health_snapshot()

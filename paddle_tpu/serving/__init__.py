@@ -34,8 +34,18 @@ This package replaces that with the vLLM/TPU-serving shape:
                    the per-request adaptive-k throttle; the engine
                    verifies drafts in ONE multi-token dispatch and rolls
                    rejected positions back exactly.
+  * observability.py — per-request lifecycle traces (chrome-trace
+                   exportable), tier-labeled SLO histograms (TTFT, TPOT,
+                   queue, e2e), goodput/shed counters, per-tick engine
+                   gauges, serving anomaly detectors + the flight-
+                   recorder arm that auto-dumps on regression.
 """
 from .blocks import BlockAllocator  # noqa: F401
+from .observability import (  # noqa: F401
+    RequestTrace,
+    ServingObservability,
+    export_request_trace,
+)
 from .paged import PagedKVPool, PagedLayerCache  # noqa: F401
 from .scheduler import Request, Scheduler  # noqa: F401
 from .speculative import NgramDrafter, SpecState  # noqa: F401
@@ -48,8 +58,11 @@ __all__ = [
     "PagedKVPool",
     "PagedLayerCache",
     "Request",
+    "RequestTrace",
     "Scheduler",
     "ServingEngine",
+    "ServingObservability",
     "ServingServer",
     "SpecState",
+    "export_request_trace",
 ]

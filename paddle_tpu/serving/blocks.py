@@ -515,6 +515,14 @@ class BlockAllocator:
         assert ev <= set(self._digest)
         assert self._tokens == sum(self._lens.values())
 
+    def conservation_ok(self) -> bool:
+        """O(1) conservation law: every allocatable block is in exactly one
+        of live / evictable / free. False means a leak or double-free (KV
+        corruption follows) — the serving anomaly engine samples this per
+        tick; check_invariants() is the O(n) forensic version."""
+        return (len(self._ref) + len(self._evictable) + len(self._free)
+                == self.num_blocks - 1)
+
     def occupancy_report(self) -> dict:
         """Pool shape + occupancy/fragmentation, the dict the metrics
         gauges mirror (and servebench embeds in its report)."""
@@ -523,6 +531,7 @@ class BlockAllocator:
         tokens = self._tokens
         cap = used * self.block_size
         return {
+            "conservation_ok": self.conservation_ok(),
             "num_blocks": allocatable,
             "block_size": self.block_size,
             "used_blocks": used,

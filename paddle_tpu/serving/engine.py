@@ -42,11 +42,13 @@ import numpy as np
 from ..core import flags as _flags
 from ..core.tensor import Tensor
 from ..models.generation import init_kv_cache
-from ..observability.registry import (
-    counter as _counter,
-    histogram as _histogram,
-)
 from .blocks import BlockAllocator
+from .observability import (
+    _PREFILL_TOKENS,
+    EngineStats,
+    ServingObservability,
+    new_engine_id,
+)
 from .paged import PagedKVPool, PagedLayerCache, write_prefix
 from .scheduler import Request, Scheduler
 from .speculative import NgramDrafter, SpecState
@@ -104,26 +106,10 @@ _flags.define_flag("serving_prefill_bucket", 16,
                    "of one program per prompt. 0 disables batching "
                    "(per-prompt chunked prefill only).")
 
-_TTFT_H = _histogram("serving_ttft_seconds",
-                     "Arrival -> first token, per request.", always=True)
-_QUEUE_H = _histogram("serving_queue_seconds",
-                      "Arrival -> prefill start, per request.", always=True)
-_TOKRATE_H = _histogram("serving_decode_tokens_per_s",
-                        "Per-request steady-state decode rate.", always=True)
-_GEN_TOKENS = _counter("serving_generated_tokens_total",
-                       "Tokens generated across all requests.", always=True)
-_PREFILL_TOKENS = _counter("serving_prefill_tokens_total",
-                           "Prompt tokens actually computed by prefill "
-                           "(cache hits skip theirs).", always=True)
-_SPEC_PROPOSED = _counter("serving_spec_proposed_total",
-                          "Draft tokens offered to speculative "
-                          "verification.", always=True)
-_SPEC_ACCEPTED = _counter("serving_spec_accepted_total",
-                          "Draft tokens accepted by speculative "
-                          "verification.", always=True)
-_SPEC_ROLLBACKS = _counter("serving_spec_rollbacks_total",
-                           "Speculative ticks that rejected >= 1 draft "
-                           "token (exact KV rollback).", always=True)
+# SLO histograms (TTFT/queue/TPOT/e2e/tokrate, tier-labeled) and the
+# per-request lifecycle trace live in serving/observability.py; the engine
+# reports transitions through self.obs. The per-tick speculation counters
+# moved into SpecState.record (speculative.py).
 
 
 class ServingEngine:
@@ -213,17 +199,61 @@ class ServingEngine:
         self._step_seed = 0
         self._sample_nonce = 0   # per-admission entropy for _sample_host
         self.steps = 0
-        # prefill accounting (servebench + the batched-dispatch test)
-        self.prefill_programs = 0    # prefill dispatches, chunked + batched
-        self.batched_prefills = 0    # batched multi-prompt dispatches
-        self.prefill_tokens = 0      # prompt tokens actually computed
-        self.cow_admissions = 0      # full-prompt hits (zero prefill)
-        self.dedup_admissions = 0    # register-time block dedups applied
-        # speculation accounting (stats() + servebench JSON)
-        self.spec_ticks = 0          # ticks that ran a verify window
-        self.spec_proposed = 0       # draft tokens offered
-        self.spec_accepted = 0       # draft tokens accepted
-        self.spec_rollbacks = 0      # ticks that rolled back >= 1 token
+        # prefill + speculation accounting now lives on the metrics
+        # registry (serving_engine_events_total, labeled per engine
+        # instance — see observability.EngineStats); the properties below
+        # keep the historical int-attribute reads (servebench deltas,
+        # tests) and stats() keeps its JSON shape
+        self._stats = EngineStats(new_engine_id())
+        # lifecycle hooks: request traces, SLO histograms, per-tick
+        # gauges, serving anomaly detectors + flight arm
+        self.obs = ServingObservability(self)
+
+    # -- registry-backed counter views (historical int attributes) --------
+    @property
+    def prefill_programs(self) -> int:
+        """Prefill dispatches, chunked + batched."""
+        return self._stats["prefill_programs"]
+
+    @property
+    def batched_prefills(self) -> int:
+        """Batched multi-prompt dispatches."""
+        return self._stats["batched_prefills"]
+
+    @property
+    def prefill_tokens(self) -> int:
+        """Prompt tokens actually computed (cache hits skip theirs)."""
+        return self._stats["prefill_tokens"]
+
+    @property
+    def cow_admissions(self) -> int:
+        """Full-prompt cache hits (zero prefill)."""
+        return self._stats["cow_admissions"]
+
+    @property
+    def dedup_admissions(self) -> int:
+        """Register-time block dedups applied."""
+        return self._stats["dedup_admissions"]
+
+    @property
+    def spec_ticks(self) -> int:
+        """Ticks that ran a verify window."""
+        return self._stats["spec_ticks"]
+
+    @property
+    def spec_proposed(self) -> int:
+        """Draft tokens offered."""
+        return self._stats["spec_proposed"]
+
+    @property
+    def spec_accepted(self) -> int:
+        """Draft tokens accepted."""
+        return self._stats["spec_accepted"]
+
+    @property
+    def spec_rollbacks(self) -> int:
+        """Ticks that rolled back >= 1 token."""
+        return self._stats["spec_rollbacks"]
 
     # ------------------------------------------------------- compiled fns
     def _functional(self):
@@ -533,11 +563,13 @@ class ServingEngine:
     def submit(self, prompt: List[int], max_new_tokens: int = 16,
                temperature: float = 0.0,
                eos_token_id: Optional[int] = None,
-               request_id: Optional[str] = None) -> Request:
+               request_id: Optional[str] = None,
+               tier: str = "default") -> Request:
         req = Request(prompt, max_new_tokens=max_new_tokens,
                       temperature=temperature, eos_token_id=eos_token_id,
-                      request_id=request_id)
+                      request_id=request_id, tier=tier)
         with self._lock:
+            self.obs.on_submit(req)
             self.sched.submit(req)
         return req
 
@@ -558,7 +590,10 @@ class ServingEngine:
         """One engine tick: admissions, one prefill chunk, one decode step
         over the running batch. Returns per-tick stats."""
         with self._lock:
+            t0 = self.obs.tick_begin()
             admitted = self.sched.admit()
+            for req in admitted:
+                self.obs.on_admitted(req)
             # full-prompt cache hits never prefill: copy-on-write the last
             # shared block and drop straight into the decode batch
             for req in [r for r in self.sched.prefilling
@@ -588,8 +623,10 @@ class ServingEngine:
                     break   # long prompt mid-prefill: one chunk per tick
             decoded = self._decode_step() if self.sched.running else 0
             self.steps += 1
-            return {"admitted": len(admitted), "decoded_tokens": decoded,
-                    **self.sched.counts()}
+            out = {"admitted": len(admitted), "decoded_tokens": decoded,
+                   **self.sched.counts()}
+            self.obs.on_tick(t0, out)
+            return out
 
     def run_until_idle(self, max_steps: int = 1_000_000) -> int:
         steps = 0
@@ -639,10 +676,9 @@ class ServingEngine:
             int(req.prompt[-1]), req.temperature)
         self.pool.replace(new_layers)
         self._dev = (n_toks, n_bt, n_sl, n_temps, d_seed)
-        self.cow_admissions += 1
+        self._stats.inc("cow_admissions")
         self.sched.start_running(req)
-        _QUEUE_H.observe(req.queue_seconds())
-        _TTFT_H.observe(req.ttft_seconds())
+        self.obs.on_first_token(req)
 
     def _batched_prefill(self, reqs: List[Request]) -> None:
         """Admit a burst of prompts in ONE dispatch (see
@@ -651,6 +687,7 @@ class ServingEngine:
         context (cached prefix + suffix) padded to P tokens. Greedy-only:
         each row's first token is argmaxed on device and its fetch
         deferred like any decode token."""
+        t0 = self.obs.now()
         _, _, pv, bv = self._functional()
         n = self.max_slots
         bs = self.block_size
@@ -697,10 +734,10 @@ class ServingEngine:
                 d_toks, d_tables, d_lens, d_temps)
         self.pool.replace(new_layers)
         self._dev = (n_toks, n_bt, n_sl, n_temps, d_seed)
-        self.batched_prefills += 1
-        self.prefill_programs += 1
+        self._stats.inc("batched_prefills")
+        self._stats.inc("prefill_programs")
         computed = sum(suffixes)
-        self.prefill_tokens += computed
+        self._stats.inc("prefill_tokens", computed)
         _PREFILL_TOKENS.inc(computed)
         self._pending.append(
             (first_dev, [(r, req.slot, req) for r, req in enumerate(reqs)]))
@@ -729,16 +766,17 @@ class ServingEngine:
                         d_tables.at[slot].set(
                             jnp.asarray(self._tables[slot])),
                         d_lens, d_temps, d_seed)
-                    self.dedup_admissions += 1
+                    self._stats.inc("dedup_admissions")
+            self.obs.on_prefill_chunk(req, t0, suffixes[r], batched=True)
             self.sched.start_running(req)
-            _QUEUE_H.observe(req.queue_seconds())
-            _TTFT_H.observe(req.ttft_seconds())
+            self.obs.on_first_token(req)
             if req.eos_token_id is not None or req.max_new_tokens <= 1:
                 flush = True
         if flush:
             self._flush_pending()
 
     def _prefill_one_chunk(self, req: Request) -> None:
+        t0 = self.obs.now()
         _, _, pv, bv = self._functional()
         n_layers, n_kv, head_dim = self._geometry
         plen = len(req.prompt)
@@ -768,9 +806,10 @@ class ServingEngine:
             pv, bv, jnp.asarray(ids), req._ws_caches,
             jnp.asarray(start, jnp.int32))
         req.prefill_pos = start + take
-        self.prefill_programs += 1
-        self.prefill_tokens += take
+        self._stats.inc("prefill_programs")
+        self._stats.inc("prefill_tokens", take)
         _PREFILL_TOKENS.inc(take)
+        self.obs.on_prefill_chunk(req, t0, take)
         if req.prefill_pos < plen:
             return
         # prompt fully prefilled: sample the first token from the last REAL
@@ -794,7 +833,7 @@ class ServingEngine:
                 # device row is uploaded below
                 table = np.asarray(self.allocator.table(req.request_id),
                                    np.int32)
-                self.dedup_admissions += 1
+                self._stats.inc("dedup_admissions")
         slot = req.slot
         self._tables[slot] = 0
         self._tables[slot, :len(table)] = table
@@ -833,8 +872,7 @@ class ServingEngine:
             req.output_tokens.append(first)
             req._progress.set()
         self.sched.start_running(req)
-        _QUEUE_H.observe(req.queue_seconds())
-        _TTFT_H.observe(req.ttft_seconds())
+        self.obs.on_first_token(req)
         if not defer:
             if req.eos_token_id is not None and first == req.eos_token_id:
                 self._finish(req, "stop")
@@ -866,6 +904,7 @@ class ServingEngine:
             decoded = self._spec_step()
             if decoded is not None:
                 return decoded
+        t0 = self.obs.now()
         _, _, pv, bv = self._functional()
         running = list(self.sched.running.items())
         if self._dev is None:
@@ -907,6 +946,7 @@ class ServingEngine:
         # request with an eos_token_id (checked every token), or one whose
         # count reached its length cap this tick.
         self._pending.append((toks, items))
+        self.obs.on_decode(t0, running, k)
         flush = False
         for slot, req in running:
             req._pending_n += k
@@ -988,6 +1028,7 @@ class ServingEngine:
             win[slot, 1:1 + len(d)] = d
             dls[slot] = len(d)
         needs_sampling = any(req.temperature > 0.0 for _, req in running)
+        t0 = self.obs.now()
         greedy, acc, nxt, new_layers, new_sl, new_seed = self._spec_jit(
             W, needs_sampling)(
             pv, bv, jnp.asarray(win), self.pool.layers, d_tables, d_lens,
@@ -995,7 +1036,9 @@ class ServingEngine:
         self.pool.replace(new_layers)
         self._dev = (nxt, d_tables, new_sl, d_temps, new_seed)
         self._step_seed += 1
-        self.spec_ticks += 1
+        self._stats.inc("spec_ticks")
+        self.obs.on_decode(t0, running, 1, kind="spec_verify",
+                           window=W)
         greedy_h, acc_h, nxt_h = jax.device_get((greedy, acc, nxt))
         decoded = 0
         touched = []
@@ -1027,13 +1070,12 @@ class ServingEngine:
                             "blocks")
                 if a < dl:
                     self.allocator.rollback(rid, dl - a)
-                    self.spec_rollbacks += 1
-                    _SPEC_ROLLBACKS.inc()
+                    self._stats.inc("spec_rollbacks")
+                    self.obs.on_rollback(req, dl - a)
+                # record() also advances the global serving_spec_* counters
                 req._spec.record(dl, a, self.steps)
-                self.spec_proposed += dl
-                self.spec_accepted += a
-                _SPEC_PROPOSED.inc(dl)
-                _SPEC_ACCEPTED.inc(a)
+                self._stats.inc("spec_proposed", dl)
+                self._stats.inc("spec_accepted", a)
             req.output_tokens.extend(emitted)
             self._toks[slot] = emitted[-1]
             self._lens[slot] += a + 1
@@ -1111,10 +1153,7 @@ class ServingEngine:
                 d_toks, d_tables, d_lens, d_temps, d_seed = self._dev
                 self._dev = (*self._clear_slot_jit()(
                     d_toks, d_tables, d_lens, d_temps, slot), d_seed)
-        _GEN_TOKENS.inc(len(req.output_tokens))
-        rate = req.decode_tokens_per_s()
-        if rate is not None:
-            _TOKRATE_H.observe(rate)
+        self.obs.on_finish(req, reason)
 
     # ------------------------------------------------------------ status
     def snapshot_output(self, req: Request):
@@ -1125,6 +1164,13 @@ class ServingEngine:
             return list(req.output_tokens), req.state, req.finish_reason
 
     def stats(self) -> dict:
+        """Legacy JSON snapshot (shape unchanged since r11), now taken
+        under the engine lock so a /stats scrape during concurrent
+        streaming sees one consistent tick, not a field-by-field race."""
+        with self._lock:
+            return self._stats_locked()
+
+    def _stats_locked(self) -> dict:
         return {
             "steps": self.steps,
             "kv": self.allocator.occupancy_report(),

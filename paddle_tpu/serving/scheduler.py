@@ -61,13 +61,19 @@ class Request:
 
     def __init__(self, prompt: List[int], max_new_tokens: int = 16,
                  temperature: float = 0.0, eos_token_id: Optional[int] = None,
-                 request_id: Optional[str] = None):
+                 request_id: Optional[str] = None, tier: str = "default"):
         self.request_id = (request_id if request_id is not None
                            else f"req-{next(_req_counter)}")
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.eos_token_id = eos_token_id
+        # admission tier: the SLO-metric label (one class today; the
+        # fleet router's priority tiers plug in here)
+        self.tier = str(tier) if tier else "default"
+        # per-request lifecycle trace, attached by the engine at submit
+        # when span recording is on (serving/observability.RequestTrace)
+        self.trace = None
         self.output_tokens: List[int] = []
         self.state = "queued"
         self.finish_reason: Optional[str] = None
@@ -119,6 +125,7 @@ class Request:
     def telemetry(self) -> dict:
         t = {
             "request_id": self.request_id,
+            "tier": self.tier,
             "state": self.state,
             "finish_reason": self.finish_reason,
             "prompt_tokens": len(self.prompt),

@@ -17,12 +17,23 @@ CONTINUOUSLY by the single engine loop rather than serialized.
                    {"done": true, "finish_reason", "telemetry"} line.
                    A client disconnect cancels the request (its slot and
                    KV reservation return to the pool immediately).
-  GET  /stats      engine + KV-pool occupancy snapshot (JSON)
-  GET  /healthz    {"ok": true, ...} liveness of the engine loop
+  GET  /stats      engine + KV-pool occupancy snapshot (JSON), taken in
+                   ONE engine-lock acquisition so concurrent streaming
+                   never yields a torn scrape
+  GET  /metrics    the process-wide metrics registry as Prometheus text
+                   (observability/serve.py renders it) — TTFT/TPOT/queue
+                   histograms, goodput/shed counters, KV-pool gauges
+  GET  /healthz    engine health snapshot: 200 {"ok": true, status,
+                   steps, last_tick_age_s, ...} / 503 when the engine
+                   loop is dead, a serving anomaly fired recently, or
+                   the engine has work but hasn't ticked (stale) —
+                   load-balancer semantics, body says why
 
 Every response carries the request's own telemetry (queue time, TTFT,
 steady-state decode tokens/s); the aggregate gauges/histograms live in the
-observability metrics registry (serving_* metrics, always on).
+observability metrics registry (serving_* metrics, always on). With
+FLAGS_serving_metrics_port > 0 the same /metrics + training-side /healthz
+are ALSO served on a dedicated port (one scrape target per concern).
 """
 from __future__ import annotations
 
@@ -33,6 +44,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from ..core.flags import define_flag, get_flag
+from ..observability import serve as _obs_serve
+from . import observability as _sobs  # noqa: F401 — defines the flags
 
 define_flag("serving_port", 0,
             "Port for the serving HTTP front end (POST /generate); 0 binds "
@@ -71,7 +84,8 @@ class _Handler(BaseHTTPRequestHandler):
                 prompt,
                 max_new_tokens=int(body.get("max_new_tokens", 16)),
                 temperature=float(body.get("temperature", 0.0)),
-                eos_token_id=body.get("eos_token_id"))
+                eos_token_id=body.get("eos_token_id"),
+                tier=str(body.get("tier", "default")))
         except ValueError as e:
             self._reply(400, {"error": str(e)})
             return
@@ -145,19 +159,27 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802
         path = self.path.split("?", 1)[0]
         if path == "/stats":
+            # one lock acquisition inside stats(): the whole snapshot is
+            # consistent even while streaming requests mutate the
+            # scheduler between ticks
             self._reply(200, self._srv.engine.stats())
+        elif path == "/metrics":
+            self._reply_raw(200, _obs_serve.metrics_body(),
+                            "text/plain; version=0.0.4; charset=utf-8")
         elif path in ("/healthz", "/health"):
-            alive = self._srv.loop_alive()
-            self._reply(200 if alive else 503,
-                        {"ok": alive, "steps": self._srv.engine.steps})
+            snap = self._srv.engine.obs.health_snapshot(
+                loop_alive=self._srv.loop_alive())
+            self._reply(200 if snap["ok"] else 503, snap)
         else:
             self._reply(404, {"error": "not found"})
 
     def _reply(self, code: int, obj) -> None:
+        self._reply_raw(code, json.dumps(obj).encode(), "application/json")
+
+    def _reply_raw(self, code: int, body: bytes, ctype: str) -> None:
         try:
-            body = json.dumps(obj).encode()
             self.send_response(code)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -184,6 +206,17 @@ class ServingServer:
         self.port = int(self._httpd.server_address[1])
         self.host = host
         self._idle_sleep_s = float(idle_sleep_s)
+        # optional dedicated observability port (FLAGS_serving_metrics_
+        # port, defined in serving/observability.py): the process-wide
+        # /metrics + training-style /healthz via observability/serve.py.
+        # Bind failure degrades to None — never a dead serving process.
+        self.metrics_server = None
+        mp = int(get_flag("serving_metrics_port"))
+        if mp > 0:
+            try:
+                self.metrics_server = _obs_serve.MetricsServer(mp)
+            except OSError:
+                pass
         self._stop = threading.Event()
         self._loop = threading.Thread(target=self._run_loop,
                                       name="serving-engine", daemon=True)
@@ -212,6 +245,12 @@ class ServingServer:
         self._httpd.shutdown()
         self._httpd.server_close()
         self._http_thread.join(timeout=5)
+        if self.metrics_server is not None:
+            try:
+                self.metrics_server.stop()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+            self.metrics_server = None
 
     def __repr__(self):  # pragma: no cover
         return f"ServingServer(port={self.port})"

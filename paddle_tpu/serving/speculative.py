@@ -28,6 +28,22 @@ from __future__ import annotations
 
 from typing import Dict, List, Sequence, Tuple
 
+from ..observability.registry import counter as _counter
+
+# process-wide speculation counters (always on, like every serving_*
+# metric): SpecState.record is the single choke point every verify tick
+# passes through, so the global accounting lives here rather than being
+# re-derived in the engine
+_SPEC_PROPOSED = _counter("serving_spec_proposed_total",
+                          "Draft tokens offered to speculative "
+                          "verification.", always=True)
+_SPEC_ACCEPTED = _counter("serving_spec_accepted_total",
+                          "Draft tokens accepted by speculative "
+                          "verification.", always=True)
+_SPEC_ROLLBACKS = _counter("serving_spec_rollbacks_total",
+                           "Speculative ticks that rejected >= 1 draft "
+                           "token (exact KV rollback).", always=True)
+
 
 class NgramDrafter:
     """Incremental n-gram lookup over one request's token history.
@@ -107,8 +123,13 @@ class SpecState:
     def record(self, proposed: int, accepted: int, tick: int) -> None:
         self.proposed += proposed
         self.accepted += accepted
+        if proposed:
+            _SPEC_PROPOSED.inc(proposed)
+        if accepted:
+            _SPEC_ACCEPTED.inc(accepted)
         if proposed and accepted < proposed:
             self.rollbacks += 1
+            _SPEC_ROLLBACKS.inc()
         if accepted == 0:
             self._miss += 1
             if proposed:

@@ -39,6 +39,16 @@ drafting + one multi-token verify dispatch per tick). Two arms:
 Timing protocol: two unmeasured passes per engine (the first compiles the
 prefill/decode/verify programs, the second the cache-hit admission path),
 then the measured pass — same discipline as the prefix workload.
+
+A fourth workload measures OBSERVABILITY (r16): one engine runs the same
+saturated workload with FLAGS_metrics off and on, interleaved best-of-3
+per arm, and gates metrics-on throughput within 3% of metrics-off. The
+metrics-on pass must also produce per-request chrome-trace spans covering
+the full lifecycle, a Prometheus scrape that parses back with the
+TTFT/TPOT/queue histograms and cache/occupancy gauges populated, and —
+via an injected goodput collapse fed through the anomaly seam — a serving
+flight dump containing the offending requests' traces. SLO p50/p95/p99
+(TTFT, TPOT, queue) land in the report row.
 """
 from __future__ import annotations
 
@@ -430,10 +440,158 @@ def _run_spec_workload(min_speedup):
     return row, ok
 
 
+# observability workload: saturated batches (overhead is engine-tick host
+# work, so measure with every slot busy, not a paced trace) + one paced
+# trace with metrics on for honest queue/TTFT quantiles
+OBS_RPS = 64.0
+# decode long enough that a measured pass is a few hundred ms: the 3%
+# overhead budget is inside host noise on a ~0.1s pass (same reasoning as
+# the adversarial speculation arm's best-of-5); a marginal miss
+# re-measures once
+OBS_NEW = 64
+OBS_REPEATS = 5
+
+
+def _run_obs_workload(model, n, slots, min_ratio=0.97):
+    """Metrics-on vs metrics-off on ONE engine (the flags are re-read at
+    every tick, so arms interleave without rebuilding compiled programs):
+    best-of-OBS_REPEATS per arm over the same saturated prompt set gates
+    the <=3% overhead; a paced metrics-on replay then supplies the SLO
+    quantiles, the sampled request trace, the Prometheus scrape, and the
+    records behind the injected-anomaly flight dump. Returns (row, ok)."""
+    import tempfile
+
+    from paddle_tpu.core import flags as _flags
+    from paddle_tpu.observability import registry as _registry
+    from paddle_tpu.observability import sinks as _sinks
+    from paddle_tpu.serving import ServingEngine, export_request_trace
+
+    mdir = tempfile.mkdtemp(prefix="servebench_obs_")
+    eng = ServingEngine(model, max_slots=slots, block_size=16,
+                        prefill_chunk=PROMPT_RANGE[1],
+                        max_model_len=PROMPT_RANGE[1] + NEW_LONG[1])
+    rng = np.random.default_rng(61)
+    gen_prompts = [[int(x) for x in rng.integers(0, MODEL["vocab"],
+                                                 int(rng.integers(8, 40)))]
+                   for _ in range(2 * slots)]
+    arm_flags = {
+        "off": {"metrics": "off", "serving_anomaly": "off"},
+        "on": {"metrics": "on", "metrics_dir": mdir,
+               "serving_anomaly": "off"},
+    }
+    try:
+        # two unmeasured passes: compiles, then the cache-hit admission path
+        _flags.set_flags(arm_flags["off"])
+        eng.generate(gen_prompts, max_new_tokens=OBS_NEW)
+        eng.generate(gen_prompts, max_new_tokens=OBS_NEW)
+        outs = {}
+
+        def _measure():
+            best = {"off": float("inf"), "on": float("inf")}
+            for _ in range(OBS_REPEATS):
+                for arm in ("off", "on"):
+                    _flags.set_flags(arm_flags[arm])
+                    t0 = time.monotonic()
+                    out = eng.generate(gen_prompts, max_new_tokens=OBS_NEW)
+                    best[arm] = min(best[arm], time.monotonic() - t0)
+                    outs[arm] = out
+            # same tokens both arms: throughput_on/off == dt_off/dt_on
+            return round(best["off"] / best["on"], 3)
+
+        ratio = _measure()
+        if ratio < min_ratio:          # marginal miss: re-measure once
+            ratio = max(ratio, _measure())
+        tokens = sum(len(o) - len(p) for o, p in zip(outs["on"],
+                                                     gen_prompts))
+
+        # --- paced metrics-on replay: traces, SLO quantiles, scrape ---
+        _flags.set_flags({"metrics": "on", "metrics_dir": mdir,
+                          "serving_anomaly": "on"})
+        reqs, _ = _replay(eng, _trace(n, OBS_RPS, seed=8))
+        traced = [r for r in reqs if r.trace is not None
+                  and r.finish_reason is not None]
+        need = {"serving.queue", "serving.admit", "serving.finish"}
+        all_names = set()
+        spans_ok = bool(traced)
+        for r in traced:
+            names = set(r.trace.names())
+            all_names |= names
+            spans_ok = spans_ok and need <= names
+        spans_ok = (spans_ok and "serving.prefill_chunk" in all_names
+                    and "serving.decode" in all_names
+                    and "serving.tick" not in all_names)
+        trace_path = os.path.join(mdir, "request_trace.json")
+        n_events = 0
+        if traced:
+            export_request_trace(traced[0], trace_path)
+            with open(trace_path) as f:
+                n_events = len(json.load(f)["traceEvents"])
+
+        reg = _registry.default_registry()
+        slo = {}
+        for metric, key in (("serving_ttft_seconds", "ttft"),
+                            ("serving_tpot_seconds", "tpot"),
+                            ("serving_queue_seconds", "queue")):
+            h = reg.get(metric)
+            slo[key] = {
+                f"p{int(q * 100)}": (round(v, 5) if (v := h.quantile(
+                    q, tier="default")) is not None else None)
+                for q in (0.50, 0.95, 0.99)}
+        parsed = _sinks.parse_prometheus_text(_sinks.prometheus_text(reg))
+        series = {name for name, _ in parsed}
+        scrape_ok = {"serving_ttft_seconds_bucket",
+                     "serving_tpot_seconds_bucket",
+                     "serving_queue_seconds_bucket",
+                     "serving_slot_occupancy", "serving_prefix_hit_rate",
+                     "serving_kv_occupancy"} <= series
+
+        # --- injected goodput collapse -> flight dump with the traces ---
+        obs = eng.obs
+        obs._anomaly = None          # fresh detector windows
+        obs._dump_armed_at = -1      # disarm the cooldown
+        base = len(obs.dumps)
+        for i in range(12):
+            obs.observe_record({"kind": "serving_tick", "step": i,
+                                "ts": time.time(), "running": 1,
+                                "waiting": 0, "kv_conservation_breach": 0.0,
+                                "goodput_tokens_per_s": 100.0})
+        for i in range(12, 18):
+            obs.observe_record({"kind": "serving_tick", "step": i,
+                                "ts": time.time(), "running": 1,
+                                "waiting": 0, "kv_conservation_breach": 0.0,
+                                "goodput_tokens_per_s": 4.0})
+        dump_ok = False
+        dump_path = None
+        for dump_path in obs.dumps[base:]:
+            with open(dump_path) as f:
+                payload = json.load(f)
+            dump_ok = (payload["anomaly"]["kind"] == "goodput_collapse"
+                       and any(r.get("trace")
+                               for r in payload["serving_requests"]))
+            if dump_ok:
+                break
+    finally:
+        _flags.set_flags({"metrics": "off", "metrics_dir": "",
+                          "serving_anomaly": "auto"})
+
+    ok = (bool(outs["on"] == outs["off"]) and ratio >= min_ratio
+          and spans_ok and scrape_ok and dump_ok)
+    row = {"workload": "observability", "requests": n,
+           "saturated_tokens": tokens,
+           "overhead_ratio": ratio, "min_ratio": min_ratio,
+           "outputs_identical": bool(outs["on"] == outs["off"]),
+           "slo": slo,
+           "trace_events": n_events, "spans_ok": bool(spans_ok),
+           "scrape_ok": bool(scrape_ok),
+           "anomaly_dump": dump_path, "dump_ok": bool(dump_ok),
+           "ok": ok}
+    return row, ok
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=os.path.join(_REPO,
-                                                  "SERVEBENCH_r13.json"))
+                                                  "SERVEBENCH_r16.json"))
     ap.add_argument("--requests", type=int, default=48)
     ap.add_argument("--slots", type=int, default=8)
     ap.add_argument("--min-speedup", type=float, default=1.5,
@@ -532,6 +690,20 @@ def main():
               f"speedup={rep['speedup']} adv_ratio={adv['ratio']}")
         ok = False
 
+    obs_row, obs_ok = _run_obs_workload(model, args.requests, args.slots)
+    print(json.dumps(obs_row), flush=True)
+    if not obs_ok:
+        print("FAIL: observability workload — need metrics-on throughput "
+              ">=0.97x metrics-off with identical outputs, lifecycle spans "
+              "on every traced request, a parsable Prometheus scrape, and "
+              "an injected-anomaly flight dump carrying request traces; "
+              f"got ratio={obs_row['overhead_ratio']} "
+              f"identical={obs_row['outputs_identical']} "
+              f"spans_ok={obs_row['spans_ok']} "
+              f"scrape_ok={obs_row['scrape_ok']} "
+              f"dump_ok={obs_row['dump_ok']}")
+        ok = False
+
     report = {
         "bench": "servebench", "backend": jax.default_backend(),
         "model": MODEL, "slots": args.slots, "requests": args.requests,
@@ -542,6 +714,7 @@ def main():
         "points": points,
         "prefix_caching": prefix_row,
         "speculation": spec_row,
+        "observability": obs_row,
         "ok": ok,
         "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
     }

@@ -22,6 +22,13 @@ from .checkpoint import (  # noqa: F401
     load_sharded,
     save_model_sharded,
     save_sharded,
+    split_bounds,
+)
+from .elastic import (  # noqa: F401
+    ElasticMembership,
+    MembershipView,
+    PeerLostError,
+    StoreReducer,
 )
 from .sharding import (  # noqa: F401
     group_sharded_parallel,

@@ -15,9 +15,11 @@ implements with its converter tool.
 """
 from __future__ import annotations
 
+import json
 import os
 import shutil
-from typing import Any, Optional
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -25,7 +27,9 @@ import numpy as np
 from ..core.tensor import Tensor
 
 __all__ = ["save_sharded", "load_sharded", "save_model_sharded",
-           "load_model_sharded", "wait_all", "CheckpointSaveError"]
+           "load_model_sharded", "wait_all", "CheckpointSaveError",
+           "split_bounds", "write_rank_shard", "write_shard_index",
+           "validate_rank_sharded", "is_rank_sharded"]
 
 
 def _to_arrays(obj):
@@ -160,15 +164,38 @@ def _abstract_like(obj):
     return obj
 
 
-def load_sharded(path: str, template: Optional[Any] = None):
+def load_sharded(path: str, template: Optional[Any] = None, *,
+                 target_world_size: Optional[int] = None,
+                 target_rank: int = 0):
     """Restore a sharded checkpoint. `template` (nested Tensors /
     ShapeDtypeStructs with shardings) directs placement — passing a model's
     current state_dict loads each array straight into that model's (possibly
     different-mesh) shardings. Without a template arrays restore replicated
-    on the default devices."""
+    on the default devices.
+
+    For RANK-SHARDED checkpoints (write_rank_shard layout — what the
+    elastic trainer commits), `target_world_size=` re-slices on load
+    across a DIFFERENT rank count than the one that saved: this call
+    returns target rank `target_rank`'s slice of every leaf at world size
+    `target_world_size`, reading only the source shards that overlap it,
+    bitwise-identical to gathering the full arrays and re-slicing.
+    `target_world_size=1` gathers the full state. Defaults to the saved
+    world size. Orbax checkpoints reshard via `template` shardings
+    instead; passing `target_world_size=` for one is an error.
+    """
+    path = os.path.abspath(path)
+    if is_rank_sharded(path):
+        return _load_rank_sharded(path, template,
+                                  target_world_size=target_world_size,
+                                  target_rank=target_rank)
+    if target_world_size is not None:
+        raise ValueError(
+            f"{path} is not a rank-sharded checkpoint; "
+            f"target_world_size= resharding only applies to the "
+            f"write_rank_shard layout (orbax checkpoints reshard via the "
+            f"`template` shardings)")
     import orbax.checkpoint as ocp
 
-    path = os.path.abspath(path)
     ckptr = ocp.StandardCheckpointer()
     try:
         if template is None:
@@ -185,6 +212,214 @@ def save_model_sharded(model, path: str, optimizer=None, async_save=False):
     if optimizer is not None:
         state["optimizer"] = _to_arrays(dict(optimizer.state_dict()))
     save_sharded(state, path, async_save=async_save)
+
+
+# -- rank-sharded layout (elastic resharding) --------------------------------
+#
+# The orbax path above shards BY DEVICE under one writer. Elastic training
+# needs the complement: N independent writer RANKS, each durably committing
+# its own slice, readable later at a different N. Layout under `path`:
+#
+#     shards.json               index: world size, pytree skeleton, global
+#                               leaf shapes/dtypes, commit nonce
+#     shard_00000/
+#         shard.json            per-array {file, rows, dtype, crc32} + nonce
+#         arr_0.bin ...         this rank's rows of each leaf, raw bytes
+#
+# Leaves are split along axis 0 with numpy.array_split bounds (first
+# n % world shards get one extra row) — the same rule the elastic trainer
+# uses to slice batches, so shard r is exactly dp-rank r's state. Scalars
+# (ndim 0) live in shard 0 only. Every shard embeds the index's nonce:
+# a half-written retry mixing shards from two different save attempts can
+# never validate.
+
+_SHARD_INDEX = "shards.json"
+_SHARD_JSON = "shard.json"
+
+
+def split_bounds(n: int, world_size: int) -> List[Tuple[int, int]]:
+    """[start, stop) row bounds per rank, numpy.array_split semantics."""
+    n, world_size = int(n), int(world_size)
+    if world_size < 1:
+        raise ValueError(f"world_size must be >= 1, got {world_size}")
+    base, extra = divmod(n, world_size)
+    bounds, start = [], 0
+    for r in range(world_size):
+        stop = start + base + (1 if r < extra else 0)
+        bounds.append((start, stop))
+        start = stop
+    return bounds
+
+
+def _shard_dir(path: str, rank: int) -> str:
+    return os.path.join(path, f"shard_{int(rank):05d}")
+
+
+def is_rank_sharded(path: str) -> bool:
+    return os.path.isfile(os.path.join(path, _SHARD_INDEX))
+
+
+def _fsync_write(fpath: str, data: bytes) -> None:
+    with open(fpath, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def write_rank_shard(path: str, rank: int, world_size: int, state: Any,
+                     nonce: str) -> Dict[str, Any]:
+    """Write rank `rank`'s slice of `state` under `path`. Returns the
+    index payload (skeleton + global leaf specs) — every rank computes
+    the identical one from its full-state view; rank 0 passes it to
+    write_shard_index. Crash-safe: lands in a `.tmp` dir renamed into
+    place, so a torn shard is never picked up by validation."""
+    from ..resilience import chaos
+    from ..resilience.checkpoint_manager import _encode
+
+    rank, world_size = int(rank), int(world_size)
+    leaves: List[np.ndarray] = []
+    skeleton = _encode(state, leaves)
+    sdir = _shard_dir(path, rank)
+    tmp = sdir + ".tmp"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    specs, arrays = [], []
+    for i, arr in enumerate(leaves):
+        specs.append({"shape": list(arr.shape), "dtype": arr.dtype.name,
+                      "scalar": arr.ndim == 0})
+        if arr.ndim == 0:
+            if rank != 0:  # scalars: shard 0 only
+                continue
+            piece, rows = arr, None
+        else:
+            a, b = split_bounds(arr.shape[0], world_size)[rank]
+            piece, rows = arr[a:b], [int(a), int(b)]
+        buf = np.ascontiguousarray(piece).tobytes()
+        fname = f"arr_{i}.bin"
+        _fsync_write(os.path.join(tmp, fname), buf)
+        arrays.append({"i": i, "file": fname, "rows": rows,
+                       "crc32": zlib.crc32(buf) & 0xFFFFFFFF})
+    shard_meta = {"nonce": str(nonce), "rank": rank,
+                  "world_size": world_size, "arrays": arrays}
+    mpath = os.path.join(tmp, _SHARD_JSON)
+    _fsync_write(mpath, json.dumps(shard_meta).encode())
+    chaos.crash_point("ckpt.shard")
+    if os.path.exists(sdir):
+        shutil.rmtree(sdir)
+    os.rename(tmp, sdir)
+    return {"version": 1, "world_size": world_size, "nonce": str(nonce),
+            "skeleton": skeleton, "leaves": specs}
+
+
+def write_shard_index(path: str, index: Dict[str, Any]) -> None:
+    """Commit the index (rank 0, after its own shard): tmp + os.replace so
+    `is_rank_sharded` only ever sees a complete index."""
+    ipath = os.path.join(path, _SHARD_INDEX)
+    _fsync_write(ipath + ".tmp", json.dumps(index).encode())
+    os.replace(ipath + ".tmp", ipath)
+
+
+def validate_rank_sharded(path: str) -> Optional[str]:
+    """None if every shard of the checkpoint at `path` is present, nonce-
+    consistent, and checksum-valid; else a human-readable reason."""
+    try:
+        with open(os.path.join(path, _SHARD_INDEX)) as f:
+            index = json.load(f)
+    except FileNotFoundError:
+        return "missing shard index"
+    except (OSError, json.JSONDecodeError) as e:
+        return f"unreadable shard index: {e}"
+    world = int(index.get("world_size", 0))
+    if world < 1:
+        return f"bad world_size {index.get('world_size')!r}"
+    for r in range(world):
+        sdir = _shard_dir(path, r)
+        try:
+            with open(os.path.join(sdir, _SHARD_JSON)) as f:
+                smeta = json.load(f)
+        except FileNotFoundError:
+            return f"missing shard {r}/{world}"
+        except (OSError, json.JSONDecodeError) as e:
+            return f"unreadable shard {r} metadata: {e}"
+        if smeta.get("nonce") != index.get("nonce"):
+            return (f"shard {r} nonce {smeta.get('nonce')!r} does not "
+                    f"match index nonce {index.get('nonce')!r} "
+                    f"(mixed save attempts)")
+        for entry in smeta.get("arrays", ()):
+            fpath = os.path.join(sdir, entry["file"])
+            try:
+                with open(fpath, "rb") as f:
+                    buf = f.read()
+            except OSError:
+                return f"missing array file shard {r}/{entry['file']}"
+            if (zlib.crc32(buf) & 0xFFFFFFFF) != entry["crc32"]:
+                return f"checksum mismatch in shard {r}/{entry['file']}"
+    return None
+
+
+def _read_shard_leaf(path: str, rank: int, leaf_i: int,
+                     dtype, tail_shape) -> np.ndarray:
+    with open(os.path.join(_shard_dir(path, rank),
+                           f"arr_{leaf_i}.bin"), "rb") as f:
+        buf = f.read()
+    arr = np.frombuffer(buf, dtype=dtype)
+    return arr.reshape((-1, *tail_shape))
+
+
+def _load_rank_sharded(path: str, template, *,
+                       target_world_size: Optional[int],
+                       target_rank: int):
+    from ..resilience.checkpoint_manager import _decode, _place_like
+
+    with open(os.path.join(path, _SHARD_INDEX)) as f:
+        index = json.load(f)
+    src_world = int(index["world_size"])
+    T = int(target_world_size if target_world_size is not None else src_world)
+    t = int(target_rank)
+    if not (0 <= t < T):
+        raise ValueError(f"target_rank {t} out of range for "
+                         f"target_world_size {T}")
+    src_bounds_cache: Dict[int, List[Tuple[int, int]]] = {}
+    leaves: List[np.ndarray] = []
+    for i, spec in enumerate(index["leaves"]):
+        dtype = _shard_dtype(spec["dtype"])
+        shape = tuple(spec["shape"])
+        if spec.get("scalar"):
+            with open(os.path.join(_shard_dir(path, 0),
+                                   f"arr_{i}.bin"), "rb") as f:
+                arr = np.frombuffer(f.read(), dtype=dtype).reshape(())
+            leaves.append(arr)
+            continue
+        n, tail = shape[0], shape[1:]
+        if n not in src_bounds_cache:
+            src_bounds_cache[n] = split_bounds(n, src_world)
+        a, b = split_bounds(n, T)[t]
+        pieces = []
+        for r, (sa, sb) in enumerate(src_bounds_cache[n]):
+            lo, hi = max(a, sa), min(b, sb)
+            if lo >= hi:
+                continue
+            src = _read_shard_leaf(path, r, i, dtype, tail)
+            pieces.append(src[lo - sa:hi - sa])
+        if pieces:
+            arr = pieces[0] if len(pieces) == 1 else np.concatenate(pieces)
+        else:
+            arr = np.empty((0, *tail), dtype=dtype)
+        leaves.append(arr.reshape((b - a, *tail)))
+    state = _decode(index["skeleton"], leaves)
+    if template is not None:
+        state = _place_like(state, template)
+    return state
+
+
+def _shard_dtype(name: str):
+    try:
+        return np.dtype(name)
+    except TypeError:  # ml_dtypes names (bfloat16) live on jax.numpy
+        import jax.numpy as jnp
+
+        return np.dtype(getattr(jnp, name))
 
 
 def load_model_sharded(model, path: str, optimizer=None):

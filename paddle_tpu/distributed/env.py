@@ -78,9 +78,11 @@ class InProcStore:
             while self._counters.get(key, 0) < int(target):
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
+                    cur = self._counters.get(key, 0)
                     raise TimeoutError(
-                        f"InProcStore.wait_ge({key!r}, {target}) timed out at "
-                        f"{self._counters.get(key, 0)}")
+                        f"InProcStore.wait_ge({key!r}, {target}) timed out "
+                        f"after {float(timeout_s):g}s: counter at {cur}, "
+                        f"{int(target) - cur} arrival(s) never happened")
                 self._cv.wait(remaining)
             return self._counters[key]
 
@@ -94,15 +96,40 @@ class InProcStore:
             return len(self._kv)
 
     def barrier(self, name: str = "default",
-                world_size: Optional[int] = None) -> None:
+                world_size: Optional[int] = None, *,
+                rank: Optional[int] = None,
+                timeout_s: float = 60.0) -> None:
         """Rendezvous of `world_size` callers. Client-stateless generation
         tracking: the n-th arrival belongs to wave ceil(n/world) and waits
         for that wave to fill, so a reused name re-rendezvouses correctly
-        no matter which thread calls through which reference."""
+        no matter which thread calls through which reference.
+
+        When callers pass their `rank`, a timeout names the ranks whose
+        arrival key never appeared for this wave instead of just "timed
+        out" — the difference between restarting a job and restarting the
+        one dead host."""
         world = int(world_size or self.world_size)
         n = self.add(f"/barrier/{name}", 1)
         wave = (n + world - 1) // world
-        self.wait_ge(f"/barrier/{name}", world * wave)
+        if rank is not None:
+            self.set(f"/barrier/{name}/w{wave}/r{int(rank)}", b"1")
+        try:
+            self.wait_ge(f"/barrier/{name}", world * wave,
+                         timeout_s=timeout_s)
+        except TimeoutError:
+            arrived = self._counters.get(f"/barrier/{name}", 0) \
+                - world * (wave - 1)
+            msg = (f"InProcStore.barrier({name!r}) timed out after "
+                   f"{float(timeout_s):g}s: {arrived}/{world} callers "
+                   f"arrived in wave {wave}")
+            if rank is not None:
+                missing = [r for r in range(world)
+                           if self.get(f"/barrier/{name}/w{wave}/r{r}",
+                                       blocking=False) is None]
+                if missing:
+                    msg += (f"; ranks whose arrival key never appeared: "
+                            f"{missing}")
+            raise TimeoutError(msg) from None
 
     def close(self) -> None:  # API parity with native.TCPStore
         pass
@@ -224,4 +251,22 @@ def init_parallel_env(strategy=None):
     _obs_counter("distributed_init_total",
                  "init_parallel_env completions.").inc()
     _initialized = True
+    return ParallelEnv()
+
+
+def reform_parallel_env(rank: int, world_size: int, *,
+                        drop_store: bool = False) -> ParallelEnv:
+    """Re-point this process's rank/world identity after an elastic
+    membership change (resilience/elastic.py reformed the mesh at a new
+    N). Rewrites the launcher env vars that ParallelEnv / get_rank /
+    get_world_size read lazily, so every later consumer sees the post-
+    reform topology. `drop_store=True` additionally drops the cached
+    process-group store singleton — wanted on a real multi-host reform
+    where the TCPStore endpoint set changed, NOT in thread-rank
+    simulations where many "ranks" share one InProcStore and one
+    process env (those pass their view explicitly instead)."""
+    os.environ["PADDLE_TRAINER_ID"] = str(int(rank))
+    os.environ["PADDLE_TRAINERS_NUM"] = str(int(world_size))
+    if drop_store:
+        reset_store()
     return ParallelEnv()

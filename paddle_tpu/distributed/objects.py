@@ -40,7 +40,7 @@ def _get_store():
     with _lock:
         if _store is None:
             from ..native import TCPStore
-            from ..resilience.retry import RetryPolicy
+            from ..resilience.retry import RetryError, RetryPolicy
 
             master = os.environ.get("PADDLE_MASTER") \
                 or os.environ.get("COORDINATOR_ADDRESS") or "127.0.0.1:0"
@@ -53,9 +53,18 @@ def _get_store():
                                  max_delay=2.0, deadline=120.0,
                                  retry_on=(RuntimeError, ConnectionError),
                                  name="collective.store_init")
-            _store = policy.call(
-                TCPStore, host, port, is_master=get_rank() == 0,
-                world_size=get_world_size(), timeout_s=120.0)
+            try:
+                _store = policy.call(
+                    TCPStore, host, port, is_master=get_rank() == 0,
+                    world_size=get_world_size(), timeout_s=120.0)
+            except RetryError as e:
+                raise RuntimeError(
+                    f"collective init failed: rank {get_rank()} of "
+                    f"{get_world_size()} could not reach the object store "
+                    f"at {host}:{port} (master rank 0 "
+                    f"{'is this rank' if get_rank() == 0 else 'never bound'}"
+                    f") — {e}. Check that rank 0 is up and PADDLE_MASTER/"
+                    f"PADDLE_OBJECT_STORE_PORT agree across ranks.") from e
         return _store
 
 
@@ -135,7 +144,11 @@ def gloo_init_parallel_env(rank_id: int, rank_num: int,
 def gloo_barrier():
     store = _get_store()
     if store is not None:
-        store.barrier()
+        try:
+            # InProcStore names the missing ranks on timeout when given ours
+            store.barrier("gloo", get_world_size(), rank=get_rank())
+        except TypeError:  # native TCPStore: positional-only, no rank kwarg
+            store.barrier()
 
 
 def gloo_release():

@@ -315,9 +315,11 @@ class TrainStep:
                 out_specs=_P(),
                 axis_names=frozenset({dp_axis}), check_vma=False)
             self._base_callable = smapped
+            self._io_shardings = (None, None)
             self._jitted = jax.jit(smapped, donate_argnums=donate_argnums)
         else:
             self._base_callable = step
+            self._io_shardings = (in_shardings, out_shardings)
             self._jitted = jax.jit(
                 step,
                 donate_argnums=donate_argnums,
@@ -389,6 +391,38 @@ class TrainStep:
             self._aot_sig = None
             self._reduce_probe = None  # schedule changed: re-probe
             self._reduce_s = None
+
+    def invalidate_executables(self) -> None:
+        """Drop every compiled artifact keyed on the current topology: the
+        cached trace, the AOT executable + its signature, and the reduce
+        probe. Elastic reformation calls this when the world size changes —
+        an executable traced (or AOT-compiled) for the old N would either
+        silently compute with stale mesh constants or fail on the new
+        shard shapes. The next call re-traces against whatever mesh/flags
+        are then in effect."""
+        base = self._base_callable
+
+        def retraced(*a):
+            return base(*a)
+
+        # same fresh-closure trick as _refresh_overlap_cfg: jax's trace
+        # cache keys on callable identity, so a new wrapper object is what
+        # actually forces the re-trace
+        ins, outs = self._io_shardings
+        kwargs = {}
+        if ins is not None:
+            kwargs["in_shardings"] = ins
+        if outs is not None:
+            kwargs["out_shardings"] = outs
+        self._jitted = jax.jit(retraced,
+                               donate_argnums=self._donate_argnums,
+                               **kwargs)
+        self._aot = None
+        self._aot_sig = None
+        self._reduce_probe = None
+        self._probe_zeros = None
+        self._reduce_s = None
+        self._batch_dims = None
 
     @staticmethod
     def _arg_signature(args):

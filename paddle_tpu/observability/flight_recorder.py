@@ -247,6 +247,19 @@ def on_preemption(reason: str) -> Optional[str]:
     return rec.dump(f"preemption_{reason}")
 
 
+def on_membership_change(info: Dict[str, Any]) -> Optional[str]:
+    """Elastic membership view adopted (rank lost/ejected/joined). The
+    dump carries the generation transition so a post-mortem can line the
+    loss trajectory up against exactly when the mesh reformed. No-op
+    while metrics are off."""
+    if not metrics_enabled():
+        return None
+    rec = get_flight_recorder()
+    rec.note("membership_change", **{k: info[k] for k in sorted(info)})
+    return rec.dump(f"membership_gen{info.get('gen', '?')}",
+                    extra={"membership": dict(info)})
+
+
 def on_exception(exc: BaseException) -> Optional[str]:
     """Uncaught exception escaping ResilientTrainer.run."""
     if not metrics_enabled():

@@ -27,16 +27,21 @@ from .retry import RetryError, RetryPolicy, retrying  # noqa: F401
 __all__ = [
     "CheckpointManager", "CheckpointCorrupt", "RestoredCheckpoint",
     "PreemptionHandler", "RetryPolicy", "RetryError", "retrying",
-    "ResilientTrainer", "chaos",
+    "ResilientTrainer", "ElasticTrainer", "MicroBatchRebalancer", "chaos",
 ]
 
 
 def __getattr__(name):
-    # ResilientTrainer pulls in jit.trainer (and with it the whole nn/opt
-    # stack); resolve it lazily so `from paddle_tpu.resilience import chaos`
-    # stays import-light for forked dataloader workers.
+    # ResilientTrainer / ElasticTrainer pull in jit.trainer (and with it
+    # the whole nn/opt stack); resolve them lazily so
+    # `from paddle_tpu.resilience import chaos` stays import-light for
+    # forked dataloader workers.
     if name == "ResilientTrainer":
         from .trainer import ResilientTrainer
 
         return ResilientTrainer
+    if name in ("ElasticTrainer", "MicroBatchRebalancer"):
+        from . import elastic
+
+        return getattr(elastic, name)
     raise AttributeError(name)

@@ -22,6 +22,8 @@ __all__ = [
     "InjectedCrash", "inject_crash", "crash_point", "clear", "armed",
     "poison_steps", "should_poison", "note_poisoned", "kill_worker",
     "fake_preemption", "stats", "reset_stats", "scope",
+    "kill_rank", "should_kill_rank", "note_rank_killed",
+    "slow_rank", "rank_delay",
 ]
 
 
@@ -36,12 +38,15 @@ class InjectedCrash(RuntimeError):
 _lock = threading.Lock()
 _crash_points: Dict[str, dict] = {}   # name -> {"after": int, "mode": str}
 _poison_steps: set = set()
+_rank_kills: Dict[int, int] = {}      # member id -> kill at global step
+_rank_delays: Dict[int, float] = {}   # member id -> extra seconds per step
 
 stats = {
     "crashes_injected": 0,
     "steps_poisoned": 0,
     "workers_killed": 0,
     "signals_sent": 0,
+    "ranks_killed": 0,
 }
 
 
@@ -55,6 +60,8 @@ def clear():
     with _lock:
         _crash_points.clear()
         _poison_steps.clear()
+        _rank_kills.clear()
+        _rank_delays.clear()
 
 
 def armed(point: Optional[str] = None) -> bool:
@@ -110,6 +117,46 @@ def note_poisoned(step: int):
     with _lock:
         _poison_steps.discard(int(step))
         stats["steps_poisoned"] += 1
+
+
+# -- elastic rank faults ----------------------------------------------------
+
+def kill_rank(member: int, at_step: int):
+    """Arm a rank kill: the elastic trainer checks should_kill_rank() at
+    the top of each global step and, once armed-and-reached, the member
+    stops heartbeating and exits its loop WITHOUT a left marker — from the
+    survivors' perspective an unannounced crash whose lease expires."""
+    with _lock:
+        _rank_kills[int(member)] = int(at_step)
+
+
+def should_kill_rank(member: int, step: int) -> bool:
+    with _lock:
+        at = _rank_kills.get(int(member))
+        return at is not None and int(step) >= at
+
+
+def note_rank_killed(member: int):
+    """The member died; disarm its kill (one-shot) and count it."""
+    with _lock:
+        _rank_kills.pop(int(member), None)
+        stats["ranks_killed"] += 1
+
+
+def slow_rank(member: int, delay_s: float):
+    """Arm a per-step straggler delay for one member (rank_delay() is
+    added to its step wall time by the elastic trainer) — exercises the
+    micro-batch rebalancer without ejecting anyone. delay_s <= 0 disarms."""
+    with _lock:
+        if float(delay_s) <= 0:
+            _rank_delays.pop(int(member), None)
+        else:
+            _rank_delays[int(member)] = float(delay_s)
+
+
+def rank_delay(member: int) -> float:
+    with _lock:
+        return _rank_delays.get(int(member), 0.0)
 
 
 # -- process-level faults ---------------------------------------------------

@@ -205,12 +205,19 @@ class CheckpointManager:
 
     def __init__(self, root: str, keep_last_n: int = 3, backend: str = "npy",
                  async_save: bool = False, store=None, rank: int = 0,
-                 world_size: int = 1, sync_timeout_s: float = 60.0):
-        if backend not in ("npy", "orbax"):
+                 world_size: int = 1, sync_timeout_s: float = 60.0,
+                 commit_namespace: str = ""):
+        if backend not in ("npy", "orbax", "sharded"):
             raise ValueError(f"unknown checkpoint backend {backend!r}")
         self.root = os.path.abspath(root)
         self.keep_last_n = max(int(keep_last_n), 1)
         self.backend = backend
+        # namespace mixed into every commit-coordination store key: the
+        # elastic trainer passes the membership generation, so ready
+        # counters / nonces left by a save that died mid-commit in an OLD
+        # generation can never satisfy (or poison) the reformed world's
+        # barrier for the same step number
+        self.commit_namespace = str(commit_namespace)
         # async: the host snapshot is taken on the caller thread (so donated
         # device buffers are never read after the step that invalidates
         # them), then file writes + the commit rename happen on a background
@@ -267,8 +274,10 @@ class CheckpointManager:
             asynchronous = self.async_save
         self.wait()  # one in-flight save at a time; ordered commits
         if self._sync_enabled and self.rank != 0:
+            if self.backend == "sharded":
+                return self._follower_write_shard(step, state)
             return self._follower_commit(step)
-        if self.backend == "orbax" or not asynchronous:
+        if self.backend in ("orbax", "sharded") or not asynchronous:
             return self._save_now(step, state, meta)
         leaves: List[np.ndarray] = []
         skeleton = _encode(state, leaves)  # device->host copies happen HERE
@@ -298,7 +307,26 @@ class CheckpointManager:
         return self.store is not None and self.world_size > 1
 
     def _ckpt_key(self, step: int) -> str:
-        return f"{_CKPT_KEY_PREFIX}/{int(step)}"
+        ns = f"/{self.commit_namespace}" if self.commit_namespace else ""
+        return f"{_CKPT_KEY_PREFIX}{ns}/{int(step)}"
+
+    def _follower_write_shard(self, step: int, state: Any) -> str:
+        """Sharded backend, non-leader rank: wait for the leader's nonce
+        (it creates the tmp dir before publishing), durably write THIS
+        rank's shard into it, then join the ready/committed handshake."""
+        from ..distributed import checkpoint as _dck
+
+        key = self._ckpt_key(step)
+        nonce = _store_get(self.store, key + "/nonce", self.sync_timeout_s)
+        if nonce is None:
+            raise TimeoutError(
+                f"rank {self.rank}: leader never published a shard nonce "
+                f"for step {step} within {self.sync_timeout_s}s")
+        nonce = nonce.decode() if isinstance(nonce, bytes) else str(nonce)
+        payload = os.path.join(self._dir_for(step) + ".tmp", "shards")
+        _dck.write_rank_shard(payload, self.rank, self.world_size, state,
+                              nonce)
+        return self._follower_commit(step)
 
     def _follower_commit(self, step: int) -> str:
         """Non-leader rank's save(): report ready, wait for rank 0's commit
@@ -306,28 +334,68 @@ class CheckpointManager:
         key = self._ckpt_key(step)
         with _span("cluster.ckpt_commit", cat="cluster",
                    args={"step": int(step), "role": "follower"}):
+            self.store.set(f"{key}/ready_r{self.rank}", b"1")
             self.store.add(key + "/ready", 1)
-            committed = _store_get(self.store, key + "/committed",
-                                   self.sync_timeout_s)
+            try:
+                committed = _store_get(self.store, key + "/committed",
+                                       self.sync_timeout_s)
+            except TimeoutError:
+                committed = None
         if committed is None:
+            # name who never reported ready — that's where the commit died
+            missing = []
+            try:
+                for r in range(self.world_size):
+                    if self.store.get(f"{key}/ready_r{r}",
+                                      blocking=False) is None:
+                        missing.append(r)
+            except TypeError:  # native store: no non-blocking get
+                missing = None
+            detail = (f"; ranks that never reported ready: {missing}"
+                      if missing else
+                      f"; every rank reported ready but rank 0 never "
+                      f"published the commit marker — it likely died "
+                      f"between the barrier and the rename"
+                      if missing == [] else "")
             raise TimeoutError(
                 f"rank {self.rank}: no committed marker for step {step} "
-                f"within {self.sync_timeout_s}s")
+                f"(key {key + '/committed'!r}) within "
+                f"{self.sync_timeout_s}s{detail}")
         _SYNC_COMMITS.inc(role="follower")
         return committed.decode() if isinstance(committed, bytes) \
             else str(committed)
 
     def _leader_barrier(self, step: int) -> None:
         """Rank 0, immediately before the commit rename: wait until every
-        rank (self included) has reported ready for `step`."""
+        rank (self included) has reported ready for `step`. A timeout
+        names the ranks whose ready marker never appeared."""
         key = self._ckpt_key(step)
+        self.store.set(f"{key}/ready_r{self.rank}", b"1")
         self.store.add(key + "/ready", 1)
-        got = _store_wait_ge(self.store, key + "/ready", self.world_size,
-                             self.sync_timeout_s)
-        if got < self.world_size:
+        try:
+            got = _store_wait_ge(self.store, key + "/ready",
+                                 self.world_size, self.sync_timeout_s)
+        except TimeoutError:
+            missing = []
+            for r in range(self.world_size):
+                try:
+                    arrived = self.store.get(f"{key}/ready_r{r}",
+                                             blocking=False)
+                except TypeError:  # native store: no non-blocking get
+                    missing = None
+                    break
+                if arrived is None:
+                    missing.append(r)
+            raise TimeoutError(
+                f"ckpt commit barrier for step {step}: not all "
+                f"{self.world_size} ranks ready after "
+                f"{self.sync_timeout_s}s"
+                + (f"; ranks that never reported ready: {missing}"
+                   if missing else "")) from None
+        if got < self.world_size:  # pragma: no cover — wait_ge guarantees ge
             raise TimeoutError(
                 f"ckpt commit barrier for step {step}: only {got}/"
-                f"{self.world_size} ranks ready after {self.sync_timeout_s}s")
+                f"{self.world_size} ranks ready")
 
     def _leader_publish(self, step: int, final: str) -> None:
         """Rank 0, after the rename landed: release the followers."""
@@ -349,9 +417,39 @@ class CheckpointManager:
     def _save_now(self, step: int, state: Any, meta: Optional[Dict]):
         if self.backend == "orbax":
             return self._write_orbax(step, state, meta)
+        if self.backend == "sharded":
+            return self._write_sharded(step, state, meta)
         leaves: List[np.ndarray] = []
         skeleton = _encode(state, leaves)
         return self._write_npy(step, skeleton, leaves, meta)
+
+    def _write_sharded(self, step: int, state: Any, meta: Optional[Dict]):
+        """Rank-sharded payload (distributed/checkpoint.write_rank_shard),
+        leader side (or the whole job at world 1). Order matters: the tmp
+        dir exists and the per-save nonce is published BEFORE the
+        followers are released to write their shards into it, every shard
+        is durable before the ready barrier passes, and only then does the
+        commit rename land."""
+        import uuid
+
+        from ..distributed import checkpoint as _dck
+
+        final = self._dir_for(step)
+        tmp = final + ".tmp"
+        if os.path.exists(tmp):  # stale debris from a previous crash
+            shutil.rmtree(tmp)
+        payload = os.path.join(tmp, "shards")
+        os.makedirs(payload)
+        chaos.crash_point("ckpt.begin")
+        nonce = uuid.uuid4().hex
+        if self._sync_enabled:
+            self.store.set(self._ckpt_key(step) + "/nonce", nonce)
+        index = _dck.write_rank_shard(payload, 0, self.world_size, state,
+                                      nonce)
+        _dck.write_shard_index(payload, index)
+        chaos.crash_point("ckpt.array")
+        return self._finalize(step, tmp, final, skeleton=None, arrays=[],
+                              meta=meta)
 
     def _write_orbax(self, step: int, state: Any, meta: Optional[Dict]):
         final = self._dir_for(step)
@@ -490,6 +588,10 @@ class CheckpointManager:
             if not os.path.isdir(os.path.join(path, "arrays")):
                 return "missing orbax payload"
             return None  # orbax validates its own array metadata on load
+        if manifest.get("backend") == "sharded":
+            from ..distributed.checkpoint import validate_rank_sharded
+
+            return validate_rank_sharded(os.path.join(path, "shards"))
         for entry in manifest.get("arrays", ()):
             fpath = os.path.join(path, entry["file"])
             try:
@@ -501,7 +603,9 @@ class CheckpointManager:
                 return f"checksum mismatch in {entry['file']}"
         return None
 
-    def _load(self, path: str, template: Optional[Any]) -> Tuple[Any, Dict]:
+    def _load(self, path: str, template: Optional[Any],
+              target_world_size: Optional[int] = None,
+              target_rank: Optional[int] = None) -> Tuple[Any, Dict]:
         with open(os.path.join(path, MANIFEST)) as f:
             manifest = json.load(f)
         if manifest.get("backend") == "orbax":
@@ -509,6 +613,20 @@ class CheckpointManager:
 
             state = load_sharded(os.path.join(path, "arrays"),
                                  template=template)
+            return state, manifest.get("meta", {})
+        if manifest.get("backend") == "sharded":
+            from ..distributed.checkpoint import load_sharded
+
+            # default to THIS manager's topology: rank r of W reads back
+            # exactly its slice; the elastic trainer passes
+            # target_world_size=1 to gather the full state for a reform
+            tws = self.world_size if target_world_size is None \
+                else int(target_world_size)
+            tr = self.rank if target_rank is None else int(target_rank)
+            state = load_sharded(os.path.join(path, "shards"),
+                                 template=template,
+                                 target_world_size=tws,
+                                 target_rank=min(tr, tws - 1))
             return state, manifest.get("meta", {})
         leaves = []
         for entry in manifest["arrays"]:
@@ -521,12 +639,20 @@ class CheckpointManager:
             state = _place_like(state, template)
         return state, manifest.get("meta", {})
 
-    def restore_latest(self, template: Optional[Any] = None
+    def restore_latest(self, template: Optional[Any] = None, *,
+                       target_world_size: Optional[int] = None,
+                       target_rank: Optional[int] = None
                        ) -> Optional[RestoredCheckpoint]:
         """Newest valid checkpoint (validating manifest + checksums), falling
         back to older ones on corruption; None when nothing valid exists.
         `template` (a pytree of Tensors/arrays matching the saved structure)
-        places restored arrays onto the template leaves' shardings."""
+        places restored arrays onto the template leaves' shardings.
+
+        For the "sharded" backend, `target_world_size=`/`target_rank=`
+        reshard on load across a different rank count (default: this
+        manager's own rank/world — each rank reads back its slice);
+        `target_world_size=1` gathers the full state, which is how the
+        elastic trainer re-seeds a reformed, smaller world."""
         self.wait()  # a just-issued async save must be visible (or raise)
         self.last_scan_report = []
         for step in reversed(self.all_steps()):
@@ -536,7 +662,8 @@ class CheckpointManager:
                 self.last_scan_report.append((path, reason))
                 continue
             try:
-                state, meta = self._load(path, template)
+                state, meta = self._load(path, template,
+                                         target_world_size, target_rank)
             except Exception as e:  # torn beyond what validate caught
                 self.last_scan_report.append((path, f"load failed: {e}"))
                 continue

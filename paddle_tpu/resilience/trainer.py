@@ -135,6 +135,9 @@ class ResilientTrainer:
             "epoch": int(self._epoch),
             "offset": int(self._offset),
             "skipped_steps": int(self.step.skipped_steps),
+            # recorded so restore() can refuse a world-size mismatch loudly
+            # instead of silently loading misshaped sharded state
+            "world_size": int(self.manager.world_size),
         }
 
     def save(self):
@@ -153,6 +156,19 @@ class ResilientTrainer:
         if restored is None:
             return None
         state, meta = restored.state, restored.meta
+        saved_world = meta.get("world_size")
+        cur_world = int(self.manager.world_size)
+        if saved_world is not None and int(saved_world) != cur_world:
+            raise RuntimeError(
+                f"checkpoint {restored.path} (step {restored.step}) was "
+                f"saved at world size {int(saved_world)} but this run has "
+                f"world size {cur_world} — refusing to load misshaped "
+                f"sharded state. Reshard it explicitly with "
+                f"distributed.checkpoint.load_sharded(path, "
+                f"target_world_size={cur_world}, target_rank=<rank>), or "
+                f"use resilience.elastic.ElasticTrainer, which reforms "
+                f"the mesh and reshards automatically on membership "
+                f"change.")
         for p, v in zip(self.step.params, state["params"]):
             p._value = jnp.asarray(v)
         for b, v in zip(self.step.buffers, state["buffers"]):

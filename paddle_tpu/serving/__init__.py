@@ -49,7 +49,7 @@ from .observability import (  # noqa: F401
 from .paged import PagedKVPool, PagedLayerCache  # noqa: F401
 from .scheduler import Request, Scheduler  # noqa: F401
 from .speculative import NgramDrafter, SpecState  # noqa: F401
-from .engine import ServingEngine  # noqa: F401
+from .engine import QueueFullError, ServingEngine  # noqa: F401
 from .server import ServingServer  # noqa: F401
 
 __all__ = [
@@ -57,6 +57,7 @@ __all__ = [
     "NgramDrafter",
     "PagedKVPool",
     "PagedLayerCache",
+    "QueueFullError",
     "Request",
     "RequestTrace",
     "Scheduler",

@@ -99,12 +99,39 @@ _flags.define_flag("serving_spec_pause", 32,
                    "non-repetitive traffic degrades to plain one-token "
                    "decode instead of paying verify windows that never "
                    "accept.")
+_flags.define_flag("serving_max_queue", 0,
+                   "Admission control: maximum requests waiting in the "
+                   "scheduler queue. A submit() past this depth raises "
+                   "QueueFullError (HTTP 503 + Retry-After at the server) "
+                   "instead of growing the queue without bound. 0 = "
+                   "unbounded (default).")
+_flags.define_flag("serving_retry_after_s", 1.0,
+                   "Retry-After hint (seconds) returned with 503 "
+                   "queue-full responses.")
 _flags.define_flag("serving_prefill_bucket", 16,
                    "Length bucket (tokens) for the batched multi-prompt "
                    "prefill program: a burst's unmatched suffixes pad to "
                    "one bucketed [n_prompts, max_suffix] dispatch instead "
                    "of one program per prompt. 0 disables batching "
                    "(per-prompt chunked prefill only).")
+
+
+class QueueFullError(RuntimeError):
+    """submit() rejected: the scheduler queue is at FLAGS_serving_max_queue.
+    Carries the depth/limit and a Retry-After hint so the HTTP layer can
+    answer 503 with an honest backoff instead of a generic error."""
+
+    def __init__(self, depth: int, limit: int,
+                 retry_after_s: Optional[float] = None):
+        self.depth = int(depth)
+        self.limit = int(limit)
+        self.retry_after_s = float(
+            _flags.get_flag("serving_retry_after_s")
+            if retry_after_s is None else retry_after_s)
+        super().__init__(
+            f"serving queue full: {self.depth} requests waiting >= "
+            f"FLAGS_serving_max_queue={self.limit}; retry after "
+            f"{self.retry_after_s:g}s")
 
 # SLO histograms (TTFT/queue/TPOT/e2e/tokrate, tier-labeled) and the
 # per-request lifecycle trace live in serving/observability.py; the engine
@@ -568,7 +595,12 @@ class ServingEngine:
         req = Request(prompt, max_new_tokens=max_new_tokens,
                       temperature=temperature, eos_token_id=eos_token_id,
                       request_id=request_id, tier=tier)
+        max_queue = int(_flags.get_flag("serving_max_queue"))
         with self._lock:
+            depth = len(self.sched.waiting)
+            if max_queue > 0 and depth >= max_queue:
+                self.obs.on_shed(req, "queue_full")
+                raise QueueFullError(depth, max_queue)
             self.obs.on_submit(req)
             self.sched.submit(req)
         return req

@@ -328,6 +328,11 @@ class ServingObservability:
         if _spans.enabled():
             req.trace = RequestTrace(req.request_id, req.tier)
 
+    def on_shed(self, req, reason: str) -> None:
+        """Request rejected at admission (never entered the queue): shed
+        accounting only — no trace, no SLO samples, it did no work."""
+        _SHED.inc(tier=req.tier, reason=str(reason))
+
     def on_admitted(self, req) -> None:
         """Queued -> prefill: close the queue-wait span, feed the rolling
         prefix-hit window (running sums — the tick path must not re-sum

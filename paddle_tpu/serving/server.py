@@ -46,6 +46,7 @@ from typing import Optional
 from ..core.flags import define_flag, get_flag
 from ..observability import serve as _obs_serve
 from . import observability as _sobs  # noqa: F401 — defines the flags
+from .engine import QueueFullError
 
 define_flag("serving_port", 0,
             "Port for the serving HTTP front end (POST /generate); 0 binds "
@@ -86,6 +87,16 @@ class _Handler(BaseHTTPRequestHandler):
                 temperature=float(body.get("temperature", 0.0)),
                 eos_token_id=body.get("eos_token_id"),
                 tier=str(body.get("tier", "default")))
+        except QueueFullError as e:
+            # honest load shedding: tell the client WHEN to come back
+            # instead of queueing without bound or failing opaquely
+            self._reply(503, {"error": str(e),
+                              "queue_depth": e.depth,
+                              "queue_limit": e.limit,
+                              "retry_after_s": e.retry_after_s},
+                        headers={"Retry-After":
+                                 str(max(1, int(round(e.retry_after_s))))})
+            return
         except ValueError as e:
             self._reply(400, {"error": str(e)})
             return
@@ -173,14 +184,18 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._reply(404, {"error": "not found"})
 
-    def _reply(self, code: int, obj) -> None:
-        self._reply_raw(code, json.dumps(obj).encode(), "application/json")
+    def _reply(self, code: int, obj, headers=None) -> None:
+        self._reply_raw(code, json.dumps(obj).encode(), "application/json",
+                        headers=headers)
 
-    def _reply_raw(self, code: int, body: bytes, ctype: str) -> None:
+    def _reply_raw(self, code: int, body: bytes, ctype: str,
+                   headers=None) -> None:
         try:
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
         except (BrokenPipeError, ConnectionResetError):

@@ -558,3 +558,68 @@ class TestServingHTTP:
         finally:
             _flags.set_flags({"serving_request_timeout_s": old})
             srv.stop()
+
+
+# ------------------------------------------------------------ queue limits
+class TestQueueFull:
+    def test_engine_submit_sheds_past_max_queue(self):
+        from paddle_tpu.core import flags as _flags
+        from paddle_tpu.observability import registry
+        from paddle_tpu.serving import QueueFullError
+
+        cfg, m = _tiny_gpt()
+        eng = ServingEngine(m, max_slots=2, block_size=16, prefill_chunk=16)
+        old = _flags.get_flag("serving_max_queue")
+        _flags.set_flags({"serving_max_queue": 2})
+        try:
+            shed = registry.REGISTRY.get("serving_shed_requests_total")
+            before = shed.value(tier="default", reason="queue_full")
+            eng.submit([1, 2, 3])          # no engine loop: both wait
+            eng.submit([1, 2, 3])
+            with pytest.raises(QueueFullError) as ei:
+                eng.submit([1, 2, 3])
+            assert ei.value.depth == 2 and ei.value.limit == 2
+            assert ei.value.retry_after_s > 0
+            assert "FLAGS_serving_max_queue" in str(ei.value)
+            assert shed.value(tier="default",
+                              reason="queue_full") == before + 1
+            assert len(eng.sched.waiting) == 2  # rejected one never queued
+        finally:
+            _flags.set_flags({"serving_max_queue": old})
+
+    def test_http_503_with_retry_after(self):
+        from paddle_tpu.core import flags as _flags
+
+        cfg, m = _tiny_gpt()
+        eng = ServingEngine(m, max_slots=1, block_size=16, prefill_chunk=16)
+        srv = ServingServer(eng, port=0)
+        old = _flags.get_flag("serving_max_queue")
+        _flags.set_flags({"serving_max_queue": 1})
+        try:
+            # occupy the only slot so queued requests cannot drain
+            hog = eng.submit([1, 2, 3], max_new_tokens=5000)
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                st = eng.stats()
+                if st["waiting"] == 0 and st["running"] + st["prefilling"]:
+                    break
+                time.sleep(0.01)
+            filler = eng.submit([4, 5, 6], max_new_tokens=8)  # fills queue
+            body = json.dumps({"prompt": [7, 8, 9],
+                               "max_new_tokens": 4}).encode()
+            req = urllib.request.Request(
+                srv.url() + "/generate", data=body,
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=30)
+            assert ei.value.code == 503
+            assert int(ei.value.headers["Retry-After"]) >= 1
+            payload = json.loads(ei.value.read())
+            assert payload["queue_depth"] == 1
+            assert payload["queue_limit"] == 1
+            assert payload["retry_after_s"] > 0
+            eng.cancel(hog, reason="cancelled")
+            eng.cancel(filler, reason="cancelled")
+        finally:
+            _flags.set_flags({"serving_max_queue": old})
+            srv.stop()

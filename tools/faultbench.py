@@ -1,10 +1,11 @@
-"""Fault-injection benchmark for the resilience runtime (ISSUE r6).
+"""Fault-injection benchmark for the resilience runtime (ISSUE r6 + r17).
 
 Scripted chaos run over paddle_tpu/resilience/: kills checkpoint saves at
 every instrumented crash point, corrupts committed checkpoints on disk,
-poisons gradients with NaNs, and delivers fake preemption signals — then
-verifies the runtime recovers exactly as the crash-consistency design
-promises, and writes one JSON artifact summarizing the outcome.
+poisons gradients with NaNs, delivers fake preemption signals, and kills a
+live data-parallel rank mid-run — then verifies the runtime recovers
+exactly as the crash-consistency and elastic-training designs promise, and
+writes one JSON artifact summarizing the outcome.
 
 Scenarios (all CPU, deterministic, a few seconds total):
   * crash_sweep     — inject a crash at each of the four checkpoint-commit
@@ -20,8 +21,20 @@ Scenarios (all CPU, deterministic, a few seconds total):
   * preemption      — deliver SIGTERM mid-epoch; the run must commit a final
                       checkpoint, report "preempted", and a restarted
                       trainer must finish the epoch from where it left off.
+  * elastic         — four thread-ranks train data-parallel over one
+                      InProcStore; one rank is killed mid-run (heartbeat
+                      stops, no goodbye). HARD GATES: the survivors must
+                      complete every step at N-1, the per-step loss
+                      trajectory must stay within tolerance of the
+                      no-failure run (fp reassociation only), recovery
+                      must replay at most save_every steps, post-reform
+                      step time must settle near the pre-kill baseline,
+                      and survivor params must be bitwise identical.
+                      A second pass slows (not kills) a rank and requires
+                      the straggler-aware rebalancer to shrink its batch
+                      share within the configured bound.
 
-Usage: python tools/faultbench.py [--out FAULTBENCH_r06.json]
+Usage: python tools/faultbench.py [--out FAULTBENCH_r17.json]
 """
 import argparse
 import json
@@ -203,10 +216,146 @@ def bench_preemption(tmp):
             "preemption_resumes": int(ok)}
 
 
+def _elastic_world(root, members, batches, nsteps, kill=None, slow=None,
+                   rebalance_skew=0.0):
+    """Run one thread-per-member elastic world to completion; returns
+    (trainers, reports, wall_s)."""
+    import threading
+
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed.env import InProcStore
+    from paddle_tpu.resilience import chaos
+    from paddle_tpu.resilience.elastic import ElasticTrainer
+
+    store = InProcStore()
+    trainers = []
+    for mid in members:
+        m = _build()
+        opt = optimizer.SGD(0.1, parameters=m.parameters())
+        loss_fn = nn.MSELoss()
+        trainers.append(ElasticTrainer(
+            m, (lambda mm: lambda a, b: loss_fn(mm(a), b))(m), opt, root,
+            store=store, member_id=mid, members=members, save_every=3,
+            lease_ttl_s=1.0, heartbeat_s=0.2, allreduce_timeout_s=6.0,
+            rebalance_skew=rebalance_skew))
+    if kill:
+        chaos.kill_rank(*kill)
+    if slow:
+        chaos.slow_rank(*slow)
+    reports = [None] * len(members)
+
+    def go(i):
+        reports[i] = trainers[i].run(batches, total_steps=nsteps)
+
+    threads = [threading.Thread(target=go, args=(i,))
+               for i in range(len(members))]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    wall = time.perf_counter() - t0
+    chaos.clear()
+    return trainers, reports, wall
+
+
+LOSS_CONTINUITY_TOL = 5e-3   # fp reassociation across reshard, nothing more
+RECOVERY_STEPS_MAX = 3       # == save_every: worst-case replay window
+STEP_TIME_RECOVERY_X = 5.0   # post-reform median step vs pre-kill median
+
+
+def bench_elastic(tmp):
+    """Kill a rank mid-run: survivors must reform at N-1 and the loss
+    trajectory must continue as if nothing happened (hard gates); then a
+    slow-rank pass must rebalance, not eject."""
+    members, nsteps, kill_step = [0, 1, 2, 3], 12, 7
+    batches = [(b[0].repeat(2, axis=0), b[1].repeat(2, axis=0))
+               for b in _batches(nsteps)]  # 16 rows: divisible work at 4->1
+
+    _, clean_reps, _ = _elastic_world(
+        os.path.join(tmp, "elastic_clean"), members, batches, nsteps)
+    clean_losses = clean_reps[0]["losses"]
+
+    trainers, reps, wall = _elastic_world(
+        os.path.join(tmp, "elastic_kill"), members, batches, nsteps,
+        kill=(2, kill_step))
+    by = {r["member"]: r for r in reps}
+    survivors = [by[m] for m in (0, 1, 3)]
+
+    completed_at_n1 = (
+        by[2]["status"] == "killed"
+        and all(r["status"] == "completed" and r["final_world_size"] == 3
+                and r["step"] == nsteps for r in survivors))
+    reforms = survivors[0]["reforms"]
+    recovery_steps = (reforms[0]["detected_at_step"]
+                      - reforms[0]["resumed_step"]) if reforms else None
+    losses = survivors[0]["losses"]
+    loss_dev = max(abs(losses[s] - clean_losses[s])
+                   for s in clean_losses) if completed_at_n1 else None
+
+    # step-time recovery: median wall AFTER the reform (excluding the
+    # detection step itself) vs the pre-kill median
+    walls = survivors[0]["step_walls"]  # (step, wall_s, gen, world)
+    pre = sorted(w for _, w, g, _ in walls if g == 0)
+    post = sorted(w for s, w, g, _ in walls
+                  if g > 0 and s > reforms[0]["resumed_step"]) if reforms \
+        else []
+    med = lambda xs: xs[len(xs) // 2] if xs else None  # noqa: E731
+    step_time_ratio = (med(post) / med(pre)
+                       if pre and post and med(pre) > 0 else None)
+
+    import numpy as _np
+    p0 = [_np.asarray(p._value) for p in trainers[0].step.params]
+    p3 = [_np.asarray(p._value) for p in trainers[3].step.params]
+    survivors_bitwise = all(_np.array_equal(a, b) for a, b in zip(p0, p3))
+
+    gates = {
+        "completes_at_n_minus_1": bool(completed_at_n1),
+        "loss_continuity": (loss_dev is not None
+                            and loss_dev <= LOSS_CONTINUITY_TOL),
+        "recovery_within_k_steps": (recovery_steps is not None
+                                    and recovery_steps
+                                    <= RECOVERY_STEPS_MAX),
+        "step_time_recovered": (step_time_ratio is not None
+                                and step_time_ratio
+                                <= STEP_TIME_RECOVERY_X),
+        "survivor_params_bitwise": bool(survivors_bitwise),
+    }
+
+    # slow-rank pass: rebalanced within the bound, nobody ejected
+    skew = 0.5
+    slow_tr, slow_reps, _ = _elastic_world(
+        os.path.join(tmp, "elastic_slow"), [0, 1],
+        batches, 8, slow=(1, 0.25), rebalance_skew=skew)
+    rb = slow_tr[0].rebalancer
+    w1 = rb.weights.get(1, 1.0)
+    shares = rb.shares(16, [0, 1])
+    gates["straggler_rebalanced_not_ejected"] = bool(
+        all(r["status"] == "completed" and r["final_world_size"] == 2
+            for r in slow_reps)
+        and w1 < 1.0 and w1 >= 1.0 - skew
+        and sum(shares) == 16 and shares[1] < 8 and shares[1] >= 1)
+
+    return {
+        "ok": all(gates.values()),
+        "gates": gates,
+        "killed_member": 2,
+        "kill_step": kill_step,
+        "reforms": reforms,
+        "recovery_steps": recovery_steps,
+        "loss_continuity_dev": loss_dev,
+        "loss_continuity_tol": LOSS_CONTINUITY_TOL,
+        "step_time_ratio": step_time_ratio,
+        "rebalanced_weight": w1,
+        "rebalanced_shares": shares,
+        "wall_clock_kill_run_s": round(wall, 3),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=os.path.join(_REPO,
-                                                  "FAULTBENCH_r06.json"))
+                                                  "FAULTBENCH_r17.json"))
     args = ap.parse_args()
 
     import jax
@@ -220,7 +369,8 @@ def main():
         for name, fn in [("crash_sweep", bench_crash_sweep),
                          ("corruption", bench_corruption),
                          ("nan_guard", bench_nan_guard),
-                         ("preemption", bench_preemption)]:
+                         ("preemption", bench_preemption),
+                         ("elastic", bench_elastic)]:
             chaos.clear()
             chaos.reset_stats()
             t0 = time.perf_counter()

@@ -69,6 +69,7 @@ from .fleet_executor import (  # noqa: F401
 )
 from .env import (  # noqa: F401
     ParallelEnv,
+    ReplicaRegistry,
     get_rank,
     get_world_size,
     init_parallel_env,

@@ -11,10 +11,11 @@ parallelism happens across mesh axes inside compiled programs.
 """
 from __future__ import annotations
 
+import json
 import os
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 import jax
 
@@ -177,6 +178,80 @@ def reset_store() -> None:
             except Exception:  # noqa: BLE001 — teardown best-effort
                 pass
         _store = None
+
+
+class ReplicaRegistry:
+    """Store-based serving-replica registry (fleet routing / discovery).
+
+    The serving FleetRouter and its replicas rendezvous through the same
+    process-group store the elastic trainer uses: registration is an
+    append-only log (`add` on a sequence counter + one entry key per
+    registration, the join-log idiom from ElasticMembership), liveness is
+    a heartbeat lease per replica id, and departure is a tombstone key —
+    so discovery works identically over an InProcStore (threads as
+    replicas) and a native TCPStore (real processes/hosts).
+    """
+
+    def __init__(self, store, *, prefix: str = "/pt/fleet",
+                 clock=time.monotonic):
+        self.store = store
+        self.prefix = prefix.rstrip("/")
+        self._clock = clock
+
+    def _k(self, *parts: str) -> str:
+        return "/".join((self.prefix,) + parts)
+
+    # -- membership --------------------------------------------------------
+    def register(self, replica_id: str, meta: Optional[dict] = None) -> None:
+        n = self.store.add(self._k("seq"), 1)
+        self.store.set(self._k("entry", str(n)), replica_id)
+        self.store.set(self._k("meta", replica_id),
+                       json.dumps(meta or {}, sort_keys=True))
+        self.store.delete(self._k("left", replica_id))
+        self.heartbeat(replica_id)
+
+    def deregister(self, replica_id: str, reason: str = "left") -> None:
+        self.store.set(self._k("left", replica_id), reason)
+
+    def replicas(self, include_left: bool = False) -> List[str]:
+        """Registered replica ids in registration order (re-registration
+        keeps the original position)."""
+        raw = self.store.get(self._k("seq"), blocking=False)
+        n = int(raw) if raw else 0
+        seen, out = set(), []
+        for i in range(1, n + 1):
+            rid = self.store.get(self._k("entry", str(i)), blocking=False)
+            if rid is None:
+                continue
+            rid = rid.decode()
+            if rid in seen:
+                continue
+            seen.add(rid)
+            if include_left or not self.has_left(rid):
+                out.append(rid)
+        return out
+
+    def meta(self, replica_id: str) -> dict:
+        raw = self.store.get(self._k("meta", replica_id), blocking=False)
+        return json.loads(raw.decode()) if raw else {}
+
+    def has_left(self, replica_id: str) -> bool:
+        return self.store.get(self._k("left", replica_id),
+                              blocking=False) is not None
+
+    # -- liveness ----------------------------------------------------------
+    def heartbeat(self, replica_id: str) -> None:
+        self.store.set(self._k("hb", replica_id), repr(self._clock()))
+
+    def heartbeat_age(self, replica_id: str) -> float:
+        raw = self.store.get(self._k("hb", replica_id), blocking=False)
+        if raw is None:
+            return float("inf")
+        return self._clock() - float(raw)
+
+    def alive(self, replica_id: str, lease_ttl_s: float) -> bool:
+        return (not self.has_left(replica_id)
+                and self.heartbeat_age(replica_id) <= float(lease_ttl_s))
 
 
 class ParallelEnv:

@@ -260,6 +260,20 @@ def on_membership_change(info: Dict[str, Any]) -> Optional[str]:
                     extra={"membership": dict(info)})
 
 
+def on_member_ejected(info: Dict[str, Any]) -> Optional[str]:
+    """A chronically slow rank was auto-ejected by ElasticTrainer (pinned
+    at the rebalance clamp past FLAGS_elastic_eject_patience windows).
+    Distinct from membership_change — this is a DECISION, recorded with
+    the evidence (streak, weight) that justified it. No-op while metrics
+    are off."""
+    if not metrics_enabled():
+        return None
+    rec = get_flight_recorder()
+    rec.note("member_ejected", **{k: info[k] for k in sorted(info)})
+    return rec.dump(f"eject_member{info.get('member', '?')}",
+                    extra={"ejection": dict(info)})
+
+
 def on_exception(exc: BaseException) -> Optional[str]:
     """Uncaught exception escaping ResilientTrainer.run."""
     if not metrics_enabled():

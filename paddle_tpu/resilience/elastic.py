@@ -60,6 +60,7 @@ from ..distributed.checkpoint import split_bounds
 from ..distributed.elastic import (ElasticMembership, MembershipView,
                                    PeerLostError, StoreReducer)
 from ..observability import cluster as _cluster  # noqa: F401 — straggler flags
+from ..observability import flight_recorder as _flight
 from ..observability.registry import counter as _counter
 
 define_flag("elastic_rebalance_skew", 0.0,
@@ -67,6 +68,14 @@ define_flag("elastic_rebalance_skew", 0.0,
             "straggler's batch share can shrink to at most (1 - skew) of "
             "its equal share, the slack spread over the others. 0 disables "
             "rebalancing (always equal split).")
+define_flag("elastic_eject_patience", 0,
+            "Auto-eject chronically slow ranks: when the rebalancer has "
+            "pinned a member at the (1 - skew) share clamp for this many "
+            "consecutive observation windows, the lowest-id non-straggler "
+            "member ejects it from the view and training reforms at N-1 "
+            "(membership_ejections_total counts it; the flight recorder "
+            "dumps the evidence). 0 (default) disables auto-ejection — "
+            "eject() stays a manual operation.")
 
 _REBALANCES = _counter("elastic_rebalance_events_total",
                        "Steps whose batch shares deviated from the equal "
@@ -74,6 +83,10 @@ _REBALANCES = _counter("elastic_rebalance_events_total",
 _REFORM_STEPS = _counter("elastic_reforms_total",
                          "Mesh reformations performed by ElasticTrainer.",
                          always=True)
+_EJECTIONS = _counter("membership_ejections_total",
+                      "Members auto-ejected by ElasticTrainer for chronic "
+                      "straggling pinned past the rebalance clamp.",
+                      always=True)
 
 __all__ = ["ElasticTrainer", "MicroBatchRebalancer"]
 
@@ -101,12 +114,22 @@ class MicroBatchRebalancer:
         self.ema_alpha = float(ema_alpha)
         self._ema: Dict[int, float] = {}
         self._streak: Dict[int, int] = {}
+        self._pinned: Dict[int, int] = {}
         self.weights: Dict[int, float] = {}
 
     def reset(self) -> None:
         self._ema.clear()
         self._streak.clear()
+        self._pinned.clear()
         self.weights.clear()
+
+    def pinned_streak(self, member: int) -> int:
+        """Consecutive observation windows this member's weight sat AT
+        the (1 - skew) clamp — i.e. it is slower than the rebalance bound
+        can compensate for. Deterministic across members (same walls in,
+        same streak out), so the auto-eject decision built on it needs no
+        extra coordination."""
+        return self._pinned.get(member, 0)
 
     def observe(self, step: int, walls: Dict[int, float]) -> None:
         """Fold one step's per-member wall times (from the allreduce
@@ -122,6 +145,7 @@ class MicroBatchRebalancer:
             if m not in walls:  # member reformed away
                 self._ema.pop(m, None)
                 self._streak.pop(m, None)
+                self._pinned.pop(m, None)
                 self.weights.pop(m, None)
         for m, w in walls.items():
             prev = self._ema.get(m)
@@ -139,10 +163,15 @@ class MicroBatchRebalancer:
                 others_e = [self._ema[o] for o in walls if o != m]
                 base_e = statistics.median(others_e) if others_e else 0.0
                 ema = self._ema[m]
-                self.weights[m] = max(1.0 - self.skew,
-                                      base_e / ema if ema > 0 else 1.0)
+                ratio = base_e / ema if ema > 0 else 1.0
+                self.weights[m] = max(1.0 - self.skew, ratio)
+                if ratio <= 1.0 - self.skew:
+                    self._pinned[m] = self._pinned.get(m, 0) + 1
+                else:
+                    self._pinned[m] = 0
             else:
                 self.weights[m] = 1.0
+                self._pinned[m] = 0
 
     def shares(self, batch_size: int, members: Sequence[int]) -> List[int]:
         """Per-member item counts summing to batch_size, in member order.
@@ -191,6 +220,9 @@ class ElasticTrainer:
             missing members (default: a few lease TTLs).
         rebalance_skew: bound for straggler rebalancing (default: flag;
             0 disables).
+        eject_patience: consecutive windows a member may sit pinned at
+            the rebalance clamp before it is auto-ejected (default:
+            FLAGS_elastic_eject_patience; 0 disables).
         clock: injectable monotonic clock for the membership layer.
     """
 
@@ -202,6 +234,7 @@ class ElasticTrainer:
                  allreduce_timeout_s: Optional[float] = None,
                  sync_timeout_s: float = 20.0,
                  rebalance_skew: Optional[float] = None,
+                 eject_patience: Optional[int] = None,
                  clock: Callable[[], float] = time.monotonic):
         from ..jit.trainer import TrainStep
 
@@ -224,6 +257,9 @@ class ElasticTrainer:
             heartbeat_s=heartbeat_s, clock=clock)
         self.reducer = StoreReducer(store, member_id)
         self.rebalancer = MicroBatchRebalancer(skew=rebalance_skew)
+        self.eject_patience = int(
+            get_flag("elastic_eject_patience")
+            if eject_patience is None else eject_patience)
         self.allreduce_timeout_s = float(
             allreduce_timeout_s if allreduce_timeout_s is not None
             else max(3.0 * self.membership.lease_ttl_s, 2.0))
@@ -490,28 +526,81 @@ class ElasticTrainer:
                 step_retries = 0
                 self._gstep += 1
                 report["steps_run"] += 1
+                if self._maybe_auto_eject(report):
+                    continue            # reformed at N-1 inside
                 if self.save_every and self._gstep < total \
                         and self._gstep % self.save_every == 0:
-                    self._checked_save(report)
+                    if not self._checked_save(report):
+                        return report   # ejected while saving
             self._checked_save(report)
             return report
         finally:
             self.membership.stop()
             self._finalize_report(report)
 
-    def _checked_save(self, report: Dict[str, Any]) -> None:
+    def _maybe_auto_eject(self, report: Dict[str, Any]) -> bool:
+        """Flag-gated auto-ejection of a chronically slow member: once
+        the rebalancer has pinned someone at the (1 - skew) clamp for
+        `eject_patience` consecutive windows, rebalancing has hit its
+        bound and the straggler is still throttling every step — remove
+        it. The pinned streak is computed from allreduce metadata that is
+        identical on every member, so all survivors agree on the victim;
+        the lowest-id non-straggler acts (eject is an idempotent store
+        tombstone — a racing duplicate would be harmless, but a single
+        deterministic actor keeps the counters honest) and everyone else
+        adopts the new view through their own poll(). Returns True when
+        THIS member ejected someone and reformed."""
+        patience = self.eject_patience
+        if patience <= 0:
+            return False
+        view = self.membership.view
+        if view.world_size <= 1:
+            return False
+        me = self.member_id
+        victims = [m for m in view.members
+                   if self.rebalancer.pinned_streak(m) >= patience]
+        victims = [m for m in victims if m != me]
+        if not victims:
+            return False
+        actor = min(m for m in view.members if m not in victims)
+        if me != actor:
+            return False                # the actor's tombstone reaches us
+        victim = min(victims)           # one per window; streaks persist
+        info = {
+            "member": int(victim), "by": int(me),
+            "step": int(self._gstep), "gen": int(view.gen),
+            "pinned_windows": int(self.rebalancer.pinned_streak(victim)),
+            "weight": float(self.rebalancer.weights.get(victim, 1.0)),
+        }
+        _EJECTIONS.inc()
+        _flight.on_member_ejected(info)
+        report.setdefault("ejections", []).append(info)
+        new_view = self.membership.eject(victim)
+        if new_view is not None and new_view.contains(me):
+            self._reform(new_view)
+            return True
+        return False
+
+    def _checked_save(self, report: Dict[str, Any]) -> bool:
         """A synchronized save can be the first place a death is noticed
         (the barrier times out instead of the allreduce): treat that like
         a peer loss — reform and carry on; the failed attempt never
         committed, and its coordination keys are namespaced to the dead
-        generation."""
+        generation. It can equally be where THIS member first learns it
+        was ejected (the others reformed to a new generation mid-save and
+        will never join the old one's commit) — then the report flips to
+        "ejected" and False comes back so the loop exits cleanly."""
         try:
             self._save()
         except TimeoutError:
             view = self._await_reform()
-            if view is None or not view.contains(self.member_id):
+            if view is None:
                 raise
+            if not view.contains(self.member_id):
+                report["status"] = "ejected"
+                return False
             self._reform(view)
+        return True
 
     def _finalize_report(self, report: Dict[str, Any]) -> None:
         v = self.membership.view

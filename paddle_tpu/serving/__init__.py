@@ -28,7 +28,15 @@ This package replaces that with the vLLM/TPU-serving shape:
                    the program, page buffers donated), chunked prefill,
                    works unchanged with the int8 weight-only swap.
   * server.py    — stdlib HTTP front end (POST /generate) with
-                   per-request telemetry: queue time, TTFT, tokens/s.
+                   per-request telemetry: queue time, TTFT, tokens/s;
+                   FleetServer exposes the same protocol over a
+                   FleetRouter (plus /drain for rolling restarts).
+  * fleet.py     — FleetRouter: prefix-cache-aware routing across N
+                   replicas, heartbeat-lease failure detection + circuit
+                   breakers, re-dispatch of in-flight requests off dead
+                   replicas (bitwise-identical greedy output), hedged
+                   retries past a TTFT deadline, graceful drain, and
+                   fleet-level load shedding with jittered Retry-After.
   * speculative.py — draft-model-free self-speculation: n-gram prompt-
                    lookup drafting from each request's own history plus
                    the per-request adaptive-k throttle; the engine
@@ -49,15 +57,32 @@ from .observability import (  # noqa: F401
 from .paged import PagedKVPool, PagedLayerCache  # noqa: F401
 from .scheduler import Request, Scheduler  # noqa: F401
 from .speculative import NgramDrafter, SpecState  # noqa: F401
-from .engine import QueueFullError, ServingEngine  # noqa: F401
-from .server import ServingServer  # noqa: F401
+from .engine import (  # noqa: F401
+    EngineDrainingError,
+    QueueFullError,
+    ServingEngine,
+)
+from .fleet import (  # noqa: F401
+    CircuitBreaker,
+    FleetRequest,
+    FleetRouter,
+    Replica,
+    build_fleet,
+)
+from .server import FleetServer, ServingServer  # noqa: F401
 
 __all__ = [
     "BlockAllocator",
+    "CircuitBreaker",
+    "EngineDrainingError",
+    "FleetRequest",
+    "FleetRouter",
+    "FleetServer",
     "NgramDrafter",
     "PagedKVPool",
     "PagedLayerCache",
     "QueueFullError",
+    "Replica",
     "Request",
     "RequestTrace",
     "Scheduler",
@@ -65,5 +90,5 @@ __all__ = [
     "ServingObservability",
     "ServingServer",
     "SpecState",
-    "export_request_trace",
+    "build_fleet",
 ]

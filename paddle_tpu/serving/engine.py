@@ -32,6 +32,7 @@ count) — no per-request recompiles at steady state.
 """
 from __future__ import annotations
 
+import random
 import threading
 from typing import List, Optional
 
@@ -106,8 +107,14 @@ _flags.define_flag("serving_max_queue", 0,
                    "instead of growing the queue without bound. 0 = "
                    "unbounded (default).")
 _flags.define_flag("serving_retry_after_s", 1.0,
-                   "Retry-After hint (seconds) returned with 503 "
+                   "Base Retry-After hint (seconds) returned with 503 "
                    "queue-full responses.")
+_flags.define_flag("serving_retry_after_jitter", 0.5,
+                   "Fractional forward jitter on queue-full Retry-After "
+                   "hints: each shed client is told to come back after "
+                   "uniform[base, base * (1 + jitter)] seconds, so a burst "
+                   "shed together does not retry in lockstep against a "
+                   "recovering fleet. 0 disables jitter.")
 _flags.define_flag("serving_prefill_bucket", 16,
                    "Length bucket (tokens) for the batched multi-prompt "
                    "prefill program: a burst's unmatched suffixes pad to "
@@ -125,13 +132,27 @@ class QueueFullError(RuntimeError):
                  retry_after_s: Optional[float] = None):
         self.depth = int(depth)
         self.limit = int(limit)
-        self.retry_after_s = float(
-            _flags.get_flag("serving_retry_after_s")
-            if retry_after_s is None else retry_after_s)
+        if retry_after_s is None:
+            base = float(_flags.get_flag("serving_retry_after_s"))
+            jitter = max(0.0, float(
+                _flags.get_flag("serving_retry_after_jitter")))
+            # forward-only jitter: never tell a client to come back
+            # EARLIER than the base hint, just spread the retry wave out
+            retry_after_s = base * (1.0 + random.uniform(0.0, jitter))
+        self.retry_after_s = float(retry_after_s)
         super().__init__(
             f"serving queue full: {self.depth} requests waiting >= "
             f"FLAGS_serving_max_queue={self.limit}; retry after "
             f"{self.retry_after_s:g}s")
+
+
+class EngineDrainingError(RuntimeError):
+    """submit() rejected: the engine is draining for a rolling restart.
+    New work belongs on another replica; in-flight requests finish."""
+
+    def __init__(self):
+        super().__init__("serving engine is draining: not admitting new "
+                         "requests (in-flight work will complete)")
 
 # SLO histograms (TTFT/queue/TPOT/e2e/tokrate, tier-labeled) and the
 # per-request lifecycle trace live in serving/observability.py; the engine
@@ -223,6 +244,7 @@ class ServingEngine:
         self._jit = {}
         self._fns = None
         self._lock = threading.RLock()
+        self._draining = False
         self._step_seed = 0
         self._sample_nonce = 0   # per-admission entropy for _sample_host
         self.steps = 0
@@ -597,6 +619,9 @@ class ServingEngine:
                       request_id=request_id, tier=tier)
         max_queue = int(_flags.get_flag("serving_max_queue"))
         with self._lock:
+            if self._draining:
+                self.obs.on_shed(req, "draining")
+                raise EngineDrainingError()
             depth = len(self.sched.waiting)
             if max_queue > 0 and depth >= max_queue:
                 self.obs.on_shed(req, "queue_full")
@@ -604,6 +629,30 @@ class ServingEngine:
             self.obs.on_submit(req)
             self.sched.submit(req)
         return req
+
+    # ----------------------------------------------------------- drain
+    def drain(self):
+        """Graceful drain for rolling restarts: stop admitting new
+        requests (submit() raises EngineDrainingError) while everything
+        already accepted — queued, prefilling, running — completes
+        normally. /healthz reports `draining` with ok=False so a load
+        balancer takes the replica out of rotation."""
+        with self._lock:
+            self._draining = True
+
+    def resume(self):
+        """Re-open admissions after a drain()."""
+        with self._lock:
+            self._draining = False
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drained(self) -> bool:
+        """True once a draining engine has no in-flight work left."""
+        with self._lock:
+            return self._draining and not self.sched.has_work()
 
     def cancel(self, req: Request, reason: str = "cancelled") -> bool:
         """Evict a request in any pre-finished state — queued, prefilling,

@@ -1,0 +1,723 @@
+"""FleetRouter: fault-tolerant routing across N ServingEngine replicas.
+
+One engine is one replica and one point of failure; the fleet layer puts
+a router in front of N of them (replica loops as threads, discovery and
+liveness through the process-group store — the same ranks-as-threads
+trick the elastic trainer uses, so the shape carries to real processes
+over a TCPStore unchanged):
+
+  * prefix-cache-aware routing: the chain hashes in serving/blocks.py are
+    content addresses, so the router asks each healthy replica how many
+    prompt tokens its cache would serve (allocator.peek_match, no side
+    effects) and routes to the longest matching chain, breaking ties by
+    least load.
+  * health: every replica loop heartbeats a store lease
+    (ReplicaRegistry); a replica whose lease expires or whose loop thread
+    died is DEAD. A consecutive-error circuit breaker (open -> half-open
+    probe -> closed) takes a replica that keeps failing submissions or
+    ticks out of rotation without waiting for the lease to lapse.
+  * re-dispatch: requests in flight on a dead replica are resubmitted —
+    same request id, full prompt — onto a survivor. Partial output is
+    discarded; greedy decode is deterministic, so the re-dispatched
+    output is bitwise-identical to a no-failure run.
+  * hedged retries: a request stuck past a TTFT deadline on a live-but-
+    slow replica is duplicated onto a second one; the first replica to
+    produce a token wins and the loser is cancelled through
+    ServingEngine.cancel(), freeing its slot and KV reservation.
+  * graceful drain: drain(rid) stops admitting to one replica while its
+    in-flight work completes (/healthz says `draining`) — rolling
+    restarts without dropping a request.
+  * load shedding: when every healthy replica's queue is full the router
+    raises QueueFullError with a jittered Retry-After, so the shed wave
+    does not come back in lockstep.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..core import flags as _flags
+from ..distributed.env import InProcStore, ReplicaRegistry
+from ..observability.registry import counter as _counter
+from ..observability.registry import gauge as _gauge
+from ..observability.registry import histogram as _histogram
+from .engine import EngineDrainingError, QueueFullError, ServingEngine
+
+_flags.define_flag("fleet_replicas", 2,
+                   "Serving replicas a fleet front end builds when not "
+                   "given explicit engines (tools/servebench.py fleet "
+                   "mode; FleetServer).")
+_flags.define_flag("fleet_hedge_ttft_ms", 0.0,
+                   "Hedged-retry TTFT deadline in milliseconds: a request "
+                   "with no first token past this age is duplicated onto "
+                   "a second healthy replica; first token wins and the "
+                   "loser is cancelled (slot + KV reservation freed). "
+                   "0 (default) disables hedging.")
+_flags.define_flag("fleet_breaker_errors", 3,
+                   "Consecutive submission/tick errors that open a "
+                   "replica's circuit breaker (replica leaves the routing "
+                   "set until a half-open probe succeeds).")
+_flags.define_flag("fleet_breaker_cooldown_s", 2.0,
+                   "Seconds an open circuit breaker waits before allowing "
+                   "one half-open probe request through.")
+
+# fleet-level SLO + routing telemetry: always-on like the engine's tier
+# histograms. The engine-level serving_* histograms are registry-global,
+# so they already aggregate across every replica in the process; the
+# fleet_* ones below measure the REQUEST as the client saw it (arrival at
+# the router to first token / finish, across re-dispatches and hedges).
+_ROUTED = _counter("fleet_requests_routed_total",
+                   "Requests dispatched to a replica (first placement).",
+                   labelnames=("replica",), always=True)
+_REDISPATCHED = _counter("fleet_requests_redispatched_total",
+                         "In-flight requests resubmitted to a survivor "
+                         "after their replica died.", always=True)
+_HEDGED = _counter("fleet_requests_hedged_total",
+                   "Requests duplicated onto a second replica past the "
+                   "TTFT hedge deadline.", always=True)
+_HEDGE_WINS = _counter("fleet_hedge_wins_total",
+                       "Hedged requests resolved, by which attempt "
+                       "produced the first token.",
+                       labelnames=("winner",), always=True)
+_FLEET_SHED = _counter("fleet_requests_shed_total",
+                       "Requests rejected fleet-wide (503 + Retry-After).",
+                       labelnames=("reason",), always=True)
+_REPLICA_UP = _gauge("fleet_replica_health",
+                     "Routable health per replica: 1 healthy, 0.5 "
+                     "draining, 0.25 breaker open, 0 dead.",
+                     labelnames=("replica",), always=True)
+_FLEET_TTFT = _histogram("fleet_ttft_seconds",
+                         "Router arrival to first token, across "
+                         "re-dispatches and hedges.",
+                         labelnames=("tier",), always=True)
+_FLEET_E2E = _histogram("fleet_e2e_seconds",
+                        "Router arrival to finish, across re-dispatches "
+                        "and hedges.", labelnames=("tier",), always=True)
+
+_GOOD_REASONS = ("stop", "length")
+
+_fleet_req_lock = threading.Lock()
+_fleet_req_counter = 0
+
+
+def _next_fleet_id() -> str:
+    global _fleet_req_counter
+    with _fleet_req_lock:
+        _fleet_req_counter += 1
+        return f"fleet-{_fleet_req_counter}"
+
+
+class CircuitBreaker:
+    """Consecutive-error breaker: closed -> open after `max_errors`
+    failures in a row -> half-open after `cooldown_s` (ONE probe allowed
+    through) -> closed on probe success, re-open on probe failure."""
+
+    def __init__(self, max_errors: int, cooldown_s: float,
+                 clock=time.monotonic):
+        self.max_errors = int(max_errors)
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._errors = 0
+        self._opened_at: Optional[float] = None
+        self._probing = False
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state_locked()
+
+    def _state_locked(self) -> str:
+        if self._opened_at is None:
+            return "closed"
+        if self._clock() - self._opened_at >= self.cooldown_s:
+            return "half_open"
+        return "open"
+
+    def allow(self) -> bool:
+        """May a request be sent through right now? In half-open exactly
+        one caller wins the probe token; the rest stay rejected until the
+        probe resolves via record_success/record_failure."""
+        with self._lock:
+            st = self._state_locked()
+            if st == "closed":
+                return True
+            if st == "half_open" and not self._probing:
+                self._probing = True
+                return True
+            return False
+
+    def record_success(self):
+        with self._lock:
+            self._errors = 0
+            self._opened_at = None
+            self._probing = False
+
+    def record_failure(self):
+        with self._lock:
+            self._errors += 1
+            if self._probing or self._errors >= self.max_errors:
+                self._opened_at = self._clock()
+                self._probing = False
+
+
+class _Attempt:
+    """One engine-level placement of a fleet request."""
+    __slots__ = ("replica", "req", "kind", "failed")
+
+    def __init__(self, replica: "Replica", req, kind: str):
+        self.replica = replica
+        self.req = req
+        self.kind = kind            # "primary" | "redispatch" | "hedge"
+        self.failed = False
+
+
+class FleetRequest:
+    """Router-level request handle: survives replica death (the engine
+    request it maps to may be replaced by a re-dispatch or raced by a
+    hedge; callers only ever see this object)."""
+
+    def __init__(self, prompt: List[int], *, max_new_tokens: int,
+                 temperature: float, eos_token_id: Optional[int],
+                 request_id: Optional[str], tier: str, router: "FleetRouter",
+                 submit_ts: float):
+        self.prompt = list(prompt)
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.eos_token_id = eos_token_id
+        self.request_id = request_id or _next_fleet_id()
+        self.tier = tier
+        self.submit_ts = submit_ts
+        self.first_token_ts: Optional[float] = None
+        self.finish_ts: Optional[float] = None
+        self.output_tokens: List[int] = []
+        self.finish_reason: Optional[str] = None
+        self.attempts: List[_Attempt] = []
+        self.hedged = False
+        self.redispatches = 0
+        self._router = router
+        self._lock = threading.Lock()
+        self._settled = False
+        self._done = threading.Event()
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def live_attempts(self) -> List[_Attempt]:
+        with self._lock:
+            return [a for a in self.attempts if not a.failed]
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the request finishes (on ANY replica). Driven by
+        the engine-level done events of the current attempts, with the
+        router's settle logic run from the waiter's thread — completion
+        does not wait for the monitor tick."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + float(timeout))
+        while True:
+            if self._done.is_set():
+                return True
+            self._router._settle(self)
+            if self._done.is_set():
+                return True
+            remaining = (None if deadline is None
+                         else deadline - time.monotonic())
+            if remaining is not None and remaining <= 0:
+                return False
+            slice_s = 0.05 if remaining is None else min(0.05, remaining)
+            atts = self.live_attempts()
+            if atts:
+                atts[0].req.wait(slice_s)
+            else:
+                # between death and re-dispatch: nothing to wait on
+                time.sleep(min(slice_s, 0.005))
+
+
+class Replica:
+    """One ServingEngine plus its loop thread, heartbeat lease, breaker,
+    and drain flag. kill() simulates a crash (loop exits, heartbeats
+    stop, nothing cleaned up); pause() simulates a hang (loop alive and
+    heartbeating but not stepping — the hedging target)."""
+
+    def __init__(self, rid: str, engine: ServingEngine, *,
+                 registry: ReplicaRegistry, heartbeat_s: float,
+                 breaker: CircuitBreaker, clock=time.monotonic,
+                 idle_sleep_s: float = 0.002):
+        self.rid = rid
+        self.engine = engine
+        self.registry = registry
+        self.heartbeat_s = float(heartbeat_s)
+        self.breaker = breaker
+        self.draining = False
+        self._clock = clock
+        self._idle_sleep_s = float(idle_sleep_s)
+        self._stop = threading.Event()
+        self._pause = threading.Event()
+        self._killed = False
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        if self._thread is not None:
+            return
+        self.registry.heartbeat(self.rid)
+        self._thread = threading.Thread(
+            target=self._loop, name=f"fleet-{self.rid}", daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+
+    def kill(self):
+        """Simulated crash: the loop exits without any cleanup and the
+        heartbeat lease is left to expire."""
+        self._killed = True
+        self._stop.set()
+
+    def pause(self):
+        self._pause.set()
+
+    def unpause(self):
+        self._pause.clear()
+
+    def loop_alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def _loop(self):
+        hb_last = -float("inf")
+        while not self._stop.is_set():
+            now = self._clock()
+            if now - hb_last >= self.heartbeat_s:
+                self.registry.heartbeat(self.rid)
+                hb_last = now
+            if self._pause.is_set():
+                time.sleep(self._idle_sleep_s)
+                continue
+            try:
+                if self.engine.sched.has_work():
+                    self.engine.step()
+                    self.breaker.record_success()
+                else:
+                    time.sleep(self._idle_sleep_s)
+            except Exception:  # noqa: BLE001 — a tick fault is a breaker
+                self.breaker.record_failure()  # strike, not a loop crash
+                time.sleep(self._idle_sleep_s)
+
+    # -- routing inputs ----------------------------------------------------
+    def load(self) -> int:
+        s = self.engine.sched
+        return len(s.waiting) + len(s.prefilling) + len(s.running)
+
+    def affinity(self, prompt: List[int]) -> int:
+        """Prompt tokens this replica's cache would serve (content-
+        addressed chain match; consistent read under the engine lock)."""
+        if not self.engine.prefix_cache:
+            return 0
+        with self.engine._lock:
+            return int(self.engine.allocator.peek_match(prompt))
+
+    def queue_depth(self) -> int:
+        return len(self.engine.sched.waiting)
+
+
+class FleetRouter:
+    """Routes requests across replicas; detects failures via store
+    heartbeat leases + circuit breakers; re-dispatches, hedges, drains
+    and sheds. Replica engine loops and the monitor are daemon threads
+    owned by the router (start()/stop())."""
+
+    def __init__(self, engines: List[ServingEngine], *,
+                 store=None, prefix: str = "/pt/fleet",
+                 hedge_ttft_ms: Optional[float] = None,
+                 breaker_errors: Optional[int] = None,
+                 breaker_cooldown_s: Optional[float] = None,
+                 heartbeat_s: float = 0.05, lease_ttl_s: float = 0.5,
+                 poll_interval_s: float = 0.02,
+                 idle_sleep_s: float = 0.002, clock=time.monotonic):
+        if not engines:
+            raise ValueError("FleetRouter needs at least one engine")
+        self._clock = clock
+        self.lease_ttl_s = float(lease_ttl_s)
+        self.poll_interval_s = float(poll_interval_s)
+        self.hedge_ttft_s = float(
+            _flags.get_flag("fleet_hedge_ttft_ms")
+            if hedge_ttft_ms is None else hedge_ttft_ms) / 1000.0
+        max_errors = int(_flags.get_flag("fleet_breaker_errors")
+                         if breaker_errors is None else breaker_errors)
+        cooldown = float(_flags.get_flag("fleet_breaker_cooldown_s")
+                         if breaker_cooldown_s is None else
+                         breaker_cooldown_s)
+        self.registry = ReplicaRegistry(store if store is not None
+                                        else InProcStore(),
+                                        prefix=prefix, clock=clock)
+        self.replicas: Dict[str, Replica] = {}
+        for i, eng in enumerate(engines):
+            rid = f"replica-{i}"
+            rep = Replica(rid, eng, registry=self.registry,
+                          heartbeat_s=heartbeat_s,
+                          breaker=CircuitBreaker(max_errors, cooldown,
+                                                 clock=clock),
+                          clock=clock, idle_sleep_s=idle_sleep_s)
+            self.replicas[rid] = rep
+            self.registry.register(rid, meta={
+                "slots": eng.max_slots, "blocks": eng.num_blocks})
+        self._inflight: Dict[str, FleetRequest] = {}
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        for rep in self.replicas.values():
+            rep.start()
+        if self._monitor is None:
+            self._monitor = threading.Thread(
+                target=self._monitor_loop, name="fleet-monitor", daemon=True)
+            self._monitor.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=10.0)
+            self._monitor = None
+        for rep in self.replicas.values():
+            rep.stop()
+
+    def _monitor_loop(self):
+        while not self._stop.is_set():
+            try:
+                self.poll()
+            except Exception:  # noqa: BLE001 — the monitor must survive
+                pass
+            time.sleep(self.poll_interval_s)
+
+    # -- health ------------------------------------------------------------
+    def replica_dead(self, rep: Replica) -> bool:
+        if rep._killed:
+            return True
+        if rep._thread is not None and not rep._thread.is_alive():
+            return True
+        return not self.registry.alive(rep.rid, self.lease_ttl_s)
+
+    def routable(self, rep: Replica) -> bool:
+        """May NEW work be placed on this replica right now? (Breaker
+        half-open counts: allow() hands out the probe token at submit.)"""
+        return (not self.replica_dead(rep) and not rep.draining
+                and rep.breaker.state != "open")
+
+    def _refresh_health_gauges(self):
+        for rep in self.replicas.values():
+            if self.replica_dead(rep):
+                v = 0.0
+            elif rep.draining:
+                v = 0.5
+            elif rep.breaker.state == "open":
+                v = 0.25
+            else:
+                v = 1.0
+            _REPLICA_UP.set(v, replica=rep.rid)
+
+    # -- admission / routing -----------------------------------------------
+    def _ranked(self, prompt: List[int],
+                exclude: Optional[set] = None) -> List[Replica]:
+        """Healthy replicas, best first: longest cached prefix chain,
+        then least load, then stable id order."""
+        scored = []
+        for rep in self.replicas.values():
+            if exclude and rep.rid in exclude:
+                continue
+            if not self.routable(rep):
+                continue
+            scored.append((-rep.affinity(prompt), rep.load(), rep.rid, rep))
+        scored.sort(key=lambda t: t[:3])
+        return [t[3] for t in scored]
+
+    def submit(self, prompt: List[int], max_new_tokens: int = 16,
+               temperature: float = 0.0,
+               eos_token_id: Optional[int] = None,
+               request_id: Optional[str] = None,
+               tier: str = "default") -> FleetRequest:
+        """Route a request to the best healthy replica. Raises
+        QueueFullError (with a jittered Retry-After) when every healthy
+        replica's queue is full — fleet-level load shedding."""
+        freq = FleetRequest(prompt, max_new_tokens=max_new_tokens,
+                            temperature=temperature,
+                            eos_token_id=eos_token_id,
+                            request_id=request_id, tier=tier, router=self,
+                            submit_ts=self._clock())
+        candidates = self._ranked(freq.prompt)
+        saw_queue_full = None
+        for rep in candidates:
+            if not rep.breaker.allow():
+                continue
+            try:
+                req = rep.engine.submit(
+                    freq.prompt, max_new_tokens=freq.max_new_tokens,
+                    temperature=freq.temperature,
+                    eos_token_id=freq.eos_token_id,
+                    request_id=freq.request_id, tier=freq.tier)
+            except QueueFullError as e:
+                # load, not fault: no breaker strike
+                rep.breaker.record_success()
+                saw_queue_full = e
+                continue
+            except EngineDrainingError:
+                rep.breaker.record_success()
+                continue
+            except ValueError:
+                raise                   # bad request, not a replica fault
+            except Exception:  # noqa: BLE001 — replica fault
+                rep.breaker.record_failure()
+                continue
+            rep.breaker.record_success()
+            with freq._lock:
+                freq.attempts.append(_Attempt(rep, req, "primary"))
+            with self._lock:
+                self._inflight[freq.request_id] = freq
+            _ROUTED.inc(replica=rep.rid)
+            return freq
+        if saw_queue_full is not None:
+            _FLEET_SHED.inc(reason="queue_full")
+            raise QueueFullError(saw_queue_full.depth, saw_queue_full.limit)
+        _FLEET_SHED.inc(reason="no_healthy_replica")
+        raise QueueFullError(0, 0)
+
+    # -- monitor pass (public so tests can drive it deterministically) -----
+    def poll(self):
+        """One supervision pass: refresh health, settle finished
+        requests, re-dispatch orphans of dead replicas, resolve and fire
+        hedges."""
+        self._refresh_health_gauges()
+        now = self._clock()
+        with self._lock:
+            pending = list(self._inflight.values())
+        for freq in pending:
+            if self._settle(freq):
+                continue
+            self._redispatch_if_orphaned(freq)
+            self._resolve_hedge(freq)
+            self._maybe_hedge(freq, now)
+
+    def _settle(self, freq: FleetRequest) -> bool:
+        """Complete the fleet request if any attempt finished cleanly;
+        cancel the losers. Returns True when the request is done."""
+        with freq._lock:
+            if freq._settled:
+                return True
+            winner = None
+            for att in freq.attempts:
+                if att.failed:
+                    continue
+                toks, state, reason = \
+                    att.replica.engine.snapshot_output(att.req)
+                if state == "finished":
+                    if reason in _GOOD_REASONS:
+                        winner = (att, toks, reason)
+                        break
+                    att.failed = True    # cancelled out from under us
+            if winner is None:
+                return False
+            att, toks, reason = winner
+            freq.output_tokens = list(toks)
+            freq.finish_reason = reason
+            if freq.first_token_ts is None \
+                    and att.req.first_token_time is not None:
+                freq.first_token_ts = att.req.first_token_time
+            freq.finish_ts = self._clock()
+            losers = [a for a in freq.attempts
+                      if a is not att and not a.failed]
+            for a in losers:
+                a.failed = True
+            if freq.hedged:
+                _HEDGE_WINS.inc(
+                    winner="hedge" if att.kind == "hedge" else "primary")
+            freq._settled = True
+        for a in losers:
+            a.replica.engine.cancel(a.req, "hedge_lost")
+        if freq.first_token_ts is not None:
+            _FLEET_TTFT.observe(max(0.0, freq.first_token_ts
+                                    - freq.submit_ts), tier=freq.tier)
+        _FLEET_E2E.observe(max(0.0, freq.finish_ts - freq.submit_ts),
+                           tier=freq.tier)
+        with self._lock:
+            self._inflight.pop(freq.request_id, None)
+        freq._done.set()
+        return True
+
+    def _redispatch_if_orphaned(self, freq: FleetRequest):
+        """Requests in flight on a dead replica are resubmitted (same id,
+        full prompt) onto the best survivor; the dead attempt's partial
+        output is discarded. Greedy decode is deterministic, so the
+        survivor's output is bitwise what the dead replica would have
+        produced."""
+        dead = []
+        with freq._lock:
+            for att in freq.attempts:
+                if not att.failed and self.replica_dead(att.replica):
+                    att.failed = True
+                    dead.append(att)
+            tried = {a.replica.rid for a in freq.attempts}
+            needs_new = not any(not a.failed for a in freq.attempts)
+        for att in dead:
+            # bookkeeping on the dead engine is still consistent (its
+            # loop died, not the object): free the slot + reservation
+            try:
+                att.replica.engine.cancel(att.req, "replica_dead")
+            except Exception:  # noqa: BLE001 — dead replica, best effort
+                pass
+        if not needs_new:
+            return
+        candidates = (self._ranked(freq.prompt, exclude=tried)
+                      or self._ranked(freq.prompt))
+        for rep in candidates:
+            if not rep.breaker.allow():
+                continue
+            try:
+                req = rep.engine.submit(
+                    freq.prompt, max_new_tokens=freq.max_new_tokens,
+                    temperature=freq.temperature,
+                    eos_token_id=freq.eos_token_id,
+                    request_id=freq.request_id, tier=freq.tier)
+            except (QueueFullError, EngineDrainingError):
+                rep.breaker.record_success()
+                continue
+            except Exception:  # noqa: BLE001 — replica fault
+                rep.breaker.record_failure()
+                continue
+            rep.breaker.record_success()
+            with freq._lock:
+                freq.attempts.append(_Attempt(rep, req, "redispatch"))
+                freq.redispatches += 1
+            _REDISPATCHED.inc()
+            _ROUTED.inc(replica=rep.rid)
+            return
+        # nowhere to go this pass (everyone full/dead): the next poll
+        # retries — accepted requests are never dropped
+
+    def _resolve_hedge(self, freq: FleetRequest):
+        """First token wins: as soon as exactly one live attempt has
+        produced output, cancel the rest (don't wait for the finish)."""
+        if not freq.hedged:
+            return
+        with freq._lock:
+            live = [a for a in freq.attempts if not a.failed]
+            if len(live) < 2:
+                return
+            holders = []
+            for att in live:
+                toks, _state, _reason = \
+                    att.replica.engine.snapshot_output(att.req)
+                if toks:
+                    holders.append(att)
+            if not holders:
+                return
+            winner = holders[0]
+            if freq.first_token_ts is None \
+                    and winner.req.first_token_time is not None:
+                freq.first_token_ts = winner.req.first_token_time
+            losers = [a for a in live if a is not winner]
+            for a in losers:
+                a.failed = True
+        for a in losers:
+            a.replica.engine.cancel(a.req, "hedge_lost")
+
+    def _maybe_hedge(self, freq: FleetRequest, now: float):
+        if self.hedge_ttft_s <= 0 or freq.hedged:
+            return
+        if now - freq.submit_ts < self.hedge_ttft_s:
+            return
+        with freq._lock:
+            live = [a for a in freq.attempts if not a.failed]
+            hosting = {a.replica.rid for a in live}
+        for att in live:
+            toks, _state, _reason = \
+                att.replica.engine.snapshot_output(att.req)
+            if toks:
+                return                  # first token already arrived
+        for rep in self._ranked(freq.prompt, exclude=hosting):
+            if not rep.breaker.allow():
+                continue
+            try:
+                req = rep.engine.submit(
+                    freq.prompt, max_new_tokens=freq.max_new_tokens,
+                    temperature=freq.temperature,
+                    eos_token_id=freq.eos_token_id,
+                    request_id=freq.request_id, tier=freq.tier)
+            except (QueueFullError, EngineDrainingError):
+                rep.breaker.record_success()
+                continue
+            except Exception:  # noqa: BLE001 — replica fault
+                rep.breaker.record_failure()
+                continue
+            rep.breaker.record_success()
+            with freq._lock:
+                freq.attempts.append(_Attempt(rep, req, "hedge"))
+                freq.hedged = True
+            _HEDGED.inc()
+            return
+
+    # -- drain / chaos -----------------------------------------------------
+    def drain(self, rid: str):
+        """Rolling-restart drain: stop routing to `rid`, stop its engine
+        admitting, let in-flight work finish."""
+        rep = self.replicas[rid]
+        rep.draining = True
+        rep.engine.drain()
+
+    def resume(self, rid: str):
+        rep = self.replicas[rid]
+        rep.engine.resume()
+        rep.draining = False
+
+    def drained(self, rid: str) -> bool:
+        return self.replicas[rid].engine.drained()
+
+    def kill_replica(self, rid: str):
+        """Chaos hook (tests / servebench): crash one replica."""
+        self.replicas[rid].kill()
+
+    # -- introspection -----------------------------------------------------
+    def inflight(self) -> int:
+        with self._lock:
+            return len(self._inflight)
+
+    def health(self) -> dict:
+        """Fleet /healthz body: ok while at least one replica can take
+        traffic; per-replica engine snapshots say why not."""
+        out: Dict[str, dict] = {}
+        ok_any = False
+        for rid, rep in self.replicas.items():
+            dead = self.replica_dead(rep)
+            snap = rep.engine.obs.health_snapshot(
+                loop_alive=rep.loop_alive() and not dead)
+            snap["breaker"] = rep.breaker.state
+            out[rid] = snap
+            if self.routable(rep):
+                ok_any = True
+        return {"ok": ok_any, "replicas": out}
+
+    def stats(self) -> dict:
+        return {
+            "inflight": self.inflight(),
+            "replicas": {rid: rep.engine.stats()
+                         for rid, rep in self.replicas.items()},
+        }
+
+
+def build_fleet(model_factory, n_replicas: Optional[int] = None, *,
+                router_kwargs: Optional[dict] = None,
+                **engine_kwargs) -> FleetRouter:
+    """Build N independent replicas (each its OWN model instance from
+    `model_factory` — no shared mutable state between replica threads;
+    seed the factory identically for bitwise-interchangeable replicas)
+    and a router over them."""
+    n = int(_flags.get_flag("fleet_replicas")
+            if n_replicas is None else n_replicas)
+    engines = [ServingEngine(model_factory(), **engine_kwargs)
+               for _ in range(n)]
+    return FleetRouter(engines, **(router_kwargs or {}))

@@ -581,6 +581,7 @@ class ServingObservability:
             counts = eng.sched.counts()
             steps = eng.steps
             has_work = eng.sched.has_work()
+            draining = bool(getattr(eng, "_draining", False))
             last_tick = self.last_tick_ts
             anomaly = self._anomaly
         out: Dict[str, Any] = {
@@ -605,6 +606,10 @@ class ServingObservability:
         elif has_work and last_tick is not None \
                 and now - last_tick > float(stale_after_s):
             out["status"], out["ok"] = "stale", False
+        elif draining:
+            # deliberate drain: not a fault, but ok=False so a load
+            # balancer stops routing here while in-flight work finishes
+            out["status"], out["ok"] = "draining", False
         elif steps == 0 and not has_work:
             out["status"] = "idle"
         return out

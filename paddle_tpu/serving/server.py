@@ -205,6 +205,139 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
 
+class _FleetHandler(_Handler):
+    """Fleet front end: same wire protocol as _Handler, but requests are
+    routed across N replicas by a FleetRouter — replica death, hedging
+    and drains are invisible to the client beyond the telemetry block.
+
+      POST /generate   as _Handler (no streaming: a fleet request may
+                       migrate replicas mid-flight, so tokens are only
+                       final once the request settles)
+      POST /drain      {"replica": "replica-0"} — rolling-restart drain;
+                       /resume undoes it
+      GET  /healthz    200 while ANY replica can take traffic; body
+                       carries every replica's own health snapshot
+                       (including `draining`) + breaker state
+      GET  /stats      router + per-replica engine snapshots
+    """
+
+    @property
+    def _router(self):
+        return self._srv.router  # type: ignore[attr-defined]
+
+    def do_POST(self):  # noqa: N802
+        path = self.path.split("?", 1)[0]
+        if path in ("/drain", "/resume"):
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                rid = str(body.get("replica", ""))
+                if rid not in self._router.replicas:
+                    self._reply(404, {"error": f"unknown replica {rid!r}"})
+                    return
+                if path == "/drain":
+                    self._router.drain(rid)
+                    self._reply(200, {"replica": rid, "status": "draining",
+                                      "drained": self._router.drained(rid)})
+                else:
+                    self._router.resume(rid)
+                    self._reply(200, {"replica": rid, "status": "ok"})
+            except Exception as e:  # noqa: BLE001 — malformed JSON etc.
+                self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+            return
+        if path != "/generate":
+            self._reply(404, {"error": "not found"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            prompt = body.get("prompt")
+            if (not isinstance(prompt, list) or not prompt
+                    or not all(isinstance(t, int) for t in prompt)):
+                self._reply(400, {"error": "prompt must be a non-empty "
+                                           "list of token ids"})
+                return
+            freq = self._router.submit(
+                prompt,
+                max_new_tokens=int(body.get("max_new_tokens", 16)),
+                temperature=float(body.get("temperature", 0.0)),
+                eos_token_id=body.get("eos_token_id"),
+                tier=str(body.get("tier", "default")))
+        except QueueFullError as e:
+            self._reply(503, {"error": str(e),
+                              "queue_depth": e.depth,
+                              "queue_limit": e.limit,
+                              "retry_after_s": e.retry_after_s},
+                        headers={"Retry-After":
+                                 str(max(1, int(round(e.retry_after_s))))})
+            return
+        except ValueError as e:
+            self._reply(400, {"error": str(e)})
+            return
+        except Exception as e:  # noqa: BLE001 — malformed JSON etc.
+            self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+            return
+        timeout = float(get_flag("serving_request_timeout_s"))
+        if not freq.wait(timeout):
+            self._reply(504, {"error": "generation timed out",
+                              "request_id": freq.request_id})
+            return
+        self._reply(200, {
+            "request_id": freq.request_id,
+            "output_tokens": freq.output_tokens,
+            "finish_reason": freq.finish_reason,
+            "fleet": {"redispatches": freq.redispatches,
+                      "hedged": freq.hedged},
+        })
+
+    def do_GET(self):  # noqa: N802
+        path = self.path.split("?", 1)[0]
+        if path == "/stats":
+            self._reply(200, self._router.stats())
+        elif path == "/metrics":
+            self._reply_raw(200, _obs_serve.metrics_body(),
+                            "text/plain; version=0.0.4; charset=utf-8")
+        elif path in ("/healthz", "/health"):
+            snap = self._router.health()
+            self._reply(200 if snap["ok"] else 503, snap)
+        else:
+            self._reply(404, {"error": "not found"})
+
+
+class FleetServer:
+    """HTTP front end over a FleetRouter. The router owns the replica
+    engine loops and the failure monitor; this server only binds the
+    socket and starts/stops the router alongside it."""
+
+    def __init__(self, router, port: Optional[int] = None,
+                 host: str = "127.0.0.1"):
+        self.router = router
+        if port is None:
+            port = int(get_flag("serving_port"))
+        self._httpd = ThreadingHTTPServer((host, int(port)), _FleetHandler)
+        self._httpd.daemon_threads = True
+        self._httpd._serving_server = self  # type: ignore[attr-defined]
+        self.port = int(self._httpd.server_address[1])
+        self.host = host
+        self.router.start()
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.25},
+            name="fleet-http", daemon=True)
+        self._http_thread.start()
+
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._http_thread.join(timeout=5)
+        self.router.stop()
+
+    def __repr__(self):  # pragma: no cover
+        return f"FleetServer(port={self.port})"
+
+
 class ServingServer:
     """HTTP server + the engine loop thread. The loop runs engine ticks
     while there is work and idles (short sleep) otherwise; handler threads

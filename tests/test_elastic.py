@@ -533,6 +533,119 @@ class TestElasticTrainer:
             pi = [np.asarray(p._value) for p in trainers[i].step.params]
             assert all(np.array_equal(a, b) for a, b in zip(p0, pi))
 
+    def test_scale_up_join_reforms_and_continues_training(self, tmp_path):
+        """Scale-UP end-to-end: train at world 3, have a fourth rank
+        request_join mid-run, and verify the incumbents reform to world 4
+        with the joiner resharded in — all four members' params bitwise
+        identical at the end, loss trajectory within fp reassociation
+        noise of an uninterrupted world-3 run."""
+        batches = _batches(6)
+        _, clean = _run_world(str(tmp_path / "clean"), [0, 1, 2],
+                              batches, 12)
+        assert all(r["status"] == "completed" for r in clean)
+
+        store = InProcStore()
+        root = str(tmp_path / "join")
+        trainers = {m: _elastic(root, store, m, [0, 1, 2])
+                    for m in (0, 1, 2)}
+        reports = {}
+
+        def go(mid):
+            reports[mid] = trainers[mid].run(batches, total_steps=12)
+
+        ts = [threading.Thread(target=go, args=(m,)) for m in (0, 1, 2)]
+        t0 = time.monotonic()
+        for t in ts:
+            t.start()
+        # let the incumbents make real progress before the join lands
+        while trainers[0]._gstep < 4 and time.monotonic() - t0 < 120:
+            time.sleep(0.05)
+        assert trainers[0]._gstep >= 4, "incumbents never progressed"
+        # the joiner announces itself on the SAME store; an incumbent's
+        # next poll() sponsors it into a grow view at gen 1. Keep this
+        # pre-trainer membership heartbeating until the run ends so the
+        # lease can't lapse while the joiner's trainer is constructed.
+        pre = ElasticMembership(store, 3, [3],
+                                lease_ttl_s=1.0, heartbeat_s=0.2)
+        pre.start()
+        try:
+            view = pre.request_join(timeout_s=30)
+            assert view.contains(3) and view.gen == 1
+            trainers[3] = _elastic(root, store, 3, [0, 1, 2, 3])
+            tj = threading.Thread(target=go, args=(3,))
+            tj.start()
+            for t in ts:
+                t.join(timeout=300)
+            tj.join(timeout=300)
+        finally:
+            pre.stop()
+
+        assert all(reports[m]["status"] == "completed"
+                   for m in (0, 1, 2, 3))
+        assert all(reports[m]["final_world_size"] == 4
+                   for m in (0, 1, 2, 3))
+        assert reports[3]["steps_run"] > 0  # the joiner actually trained
+        # incumbents recorded exactly one grow reform to [0, 1, 2, 3]
+        for m in (0, 1, 2):
+            (reform,) = reports[m]["reforms"]
+            assert reform["gen"] == 1
+            assert reform["members"] == [0, 1, 2, 3]
+        # every member (joiner included) holds bitwise-identical params:
+        # the join resharded the committed checkpoint, not an approximation
+        p0 = [np.asarray(p._value) for p in trainers[0].step.params]
+        for m in (1, 2, 3):
+            pm = [np.asarray(p._value) for p in trainers[m].step.params]
+            assert all(np.array_equal(a, b) for a, b in zip(p0, pm))
+        # loss continuity vs the uninterrupted world-3 run
+        clean_losses = clean[0]["losses"]
+        join_losses = reports[0]["losses"]
+        assert set(join_losses) == set(clean_losses)
+        worst = max(abs(join_losses[s] - clean_losses[s])
+                    for s in clean_losses)
+        assert worst <= 1e-4, f"loss trajectory diverged by {worst}"
+
+    def test_chronically_pinned_rank_auto_ejected(self, tmp_path):
+        """FLAGS_elastic_eject_patience satellite: a member pinned at the
+        rebalance clamp for `patience` consecutive windows is ejected by
+        the lowest-id healthy member; the survivor reforms and completes,
+        the victim exits with status "ejected", and the decision is
+        counted + recorded."""
+        from paddle_tpu.observability import registry
+
+        before = registry.REGISTRY.get("membership_ejections_total").total()
+        chaos.slow_rank(1, 0.4)
+        store = InProcStore()
+        trainers = [
+            _elastic(str(tmp_path / "eject"), store, m, [0, 1],
+                     rebalance_skew=0.5, eject_patience=2,
+                     sync_timeout_s=4.0)
+            for m in (0, 1)
+        ]
+        for tr in trainers:
+            # fast, deterministic straggler detection for the test
+            tr.rebalancer.k = 2.0
+            tr.rebalancer.m = 2
+        reports = [None, None]
+
+        def go(i):
+            reports[i] = trainers[i].run(_batches(10), total_steps=10)
+
+        ts = [threading.Thread(target=go, args=(i,)) for i in (0, 1)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=300)
+
+        assert reports[0]["status"] == "completed"
+        assert reports[0]["final_world_size"] == 1
+        assert reports[1]["status"] == "ejected"
+        (ej,) = reports[0]["ejections"]
+        assert ej["member"] == 1 and ej["by"] == 0
+        assert ej["pinned_windows"] >= 2
+        assert ej["weight"] == 0.5  # pinned AT the (1 - skew) clamp
+        after = registry.REGISTRY.get("membership_ejections_total").total()
+        assert after == before + 1
+
     @pytest.mark.slow
     def test_slow_rank_is_rebalanced_not_ejected(self, tmp_path):
         chaos.slow_rank(1, 0.25)

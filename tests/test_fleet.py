@@ -1,0 +1,441 @@
+"""Serving-fleet tests (ISSUE r18): circuit breaker state machine,
+store-backed replica registry, jittered Retry-After, prefix-affinity
+routing, dead-replica re-dispatch with bitwise greedy parity, hedged
+retries with loser cancellation, graceful drain, fleet-level load
+shedding, and the FleetServer HTTP front end.
+
+Most router tests run the fleet UNSTARTED on a fake clock: replica
+engines are stepped by hand and `router.poll()` is the monitor tick,
+so failure detection, re-dispatch and hedging are fully deterministic
+(no thread timing in the assertions). The drain and HTTP tests run the
+real threads — that is the surface they exist to cover.
+"""
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import flags as _flags
+from paddle_tpu.distributed.env import InProcStore, ReplicaRegistry
+from paddle_tpu.models import GPTConfig, GPTForCausalLM
+from paddle_tpu.observability import registry
+from paddle_tpu.serving import (
+    CircuitBreaker,
+    EngineDrainingError,
+    FleetRouter,
+    FleetServer,
+    QueueFullError,
+    ServingEngine,
+)
+
+
+def _model():
+    # every replica (and the parity oracle) is seeded identically:
+    # replicas must be bitwise-interchangeable for re-dispatch parity
+    paddle.seed(11)
+    cfg = GPTConfig.tiny()
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return cfg, m
+
+
+def _fleet(n=2, **router_kw):
+    cfg = None
+    engines = []
+    for _ in range(n):
+        cfg, m = _model()
+        engines.append(ServingEngine(m, max_slots=3, block_size=16,
+                                     prefill_chunk=16))
+    return cfg, FleetRouter(engines, **router_kw)
+
+
+def _drive(router, freqs, max_iters=5000):
+    """Manual engine loop + monitor: step every live replica that has
+    work, then poll, until every fleet request settles."""
+    for _ in range(max_iters):
+        if all(f.done for f in freqs):
+            return
+        for rep in router.replicas.values():
+            if not rep._killed and rep.engine.sched.has_work():
+                rep.engine.step()
+        router.poll()
+    raise AssertionError(
+        f"requests did not settle: {[f.done for f in freqs]}")
+
+
+# --------------------------------------------------------- circuit breaker
+class TestCircuitBreaker:
+    def test_closed_open_half_open_cycle(self):
+        fake = [0.0]
+        br = CircuitBreaker(max_errors=3, cooldown_s=2.0,
+                            clock=lambda: fake[0])
+        assert br.state == "closed" and br.allow()
+        br.record_failure()
+        br.record_failure()
+        assert br.state == "closed"      # under the threshold
+        br.record_failure()
+        assert br.state == "open" and not br.allow()
+        fake[0] = 1.9
+        assert br.state == "open"        # cooldown not elapsed
+        fake[0] = 2.0
+        assert br.state == "half_open"
+        # exactly ONE probe token while half-open
+        assert br.allow()
+        assert not br.allow()
+        br.record_failure()              # probe failed: re-open, new clock
+        assert br.state == "open" and not br.allow()
+        fake[0] = 4.0
+        assert br.state == "half_open" and br.allow()
+        br.record_success()              # probe succeeded: fully closed
+        assert br.state == "closed"
+        assert br.allow() and br.allow()  # no probe rationing when closed
+
+    def test_success_resets_error_streak(self):
+        br = CircuitBreaker(max_errors=2, cooldown_s=1.0)
+        br.record_failure()
+        br.record_success()
+        br.record_failure()
+        assert br.state == "closed"      # streak broken — CONSECUTIVE errors
+
+
+# --------------------------------------------------------- replica registry
+class TestReplicaRegistry:
+    def test_register_heartbeat_lease_deregister(self):
+        fake = [0.0]
+        reg = ReplicaRegistry(InProcStore(), clock=lambda: fake[0])
+        reg.register("r0", meta={"slots": 4})
+        reg.register("r1")
+        assert reg.replicas() == ["r0", "r1"]
+        assert reg.meta("r0") == {"slots": 4}
+        assert reg.meta("r1") == {}
+        assert reg.alive("r0", lease_ttl_s=0.5)
+        fake[0] = 0.6                    # lease lapses without a heartbeat
+        assert not reg.alive("r0", lease_ttl_s=0.5)
+        reg.heartbeat("r0")
+        assert reg.alive("r0", lease_ttl_s=0.5)
+        assert reg.heartbeat_age("nope") == float("inf")
+        reg.deregister("r1", reason="drain")
+        assert reg.replicas() == ["r0"]
+        assert reg.replicas(include_left=True) == ["r0", "r1"]
+        assert reg.has_left("r1") and not reg.has_left("r0")
+        reg.register("r1")               # rejoin clears the tombstone
+        assert reg.replicas() == ["r0", "r1"]
+        assert not reg.has_left("r1")
+
+
+# ------------------------------------------------------- Retry-After jitter
+class TestRetryAfterJitter:
+    def test_jitter_is_forward_only_and_spread(self):
+        old_base = _flags.get_flag("serving_retry_after_s")
+        old_jit = _flags.get_flag("serving_retry_after_jitter")
+        _flags.set_flags({"serving_retry_after_s": 2.0,
+                          "serving_retry_after_jitter": 0.5})
+        try:
+            vals = {QueueFullError(1, 1).retry_after_s for _ in range(32)}
+            # never earlier than the base hint, never past base*(1+jitter)
+            assert all(2.0 <= v <= 3.0 for v in vals)
+            assert len(vals) > 1         # the shed wave is actually spread
+            _flags.set_flags({"serving_retry_after_jitter": 0.0})
+            assert QueueFullError(1, 1).retry_after_s == 2.0
+            # explicit value bypasses the jitter entirely
+            assert QueueFullError(1, 1, retry_after_s=7.5).retry_after_s \
+                == 7.5
+        finally:
+            _flags.set_flags({"serving_retry_after_s": old_base,
+                              "serving_retry_after_jitter": old_jit})
+
+
+# ------------------------------------------------------------- fleet router
+class TestFleetRouter:
+    def test_prefix_affinity_and_least_loaded_routing(self):
+        fake = [0.0]
+        cfg, router = _fleet(2, clock=lambda: fake[0], lease_ttl_s=1000.0)
+        rng = np.random.default_rng(0)
+        prompt = [int(t) for t in rng.integers(0, cfg.vocab_size, 20)]
+        a = router.submit(prompt, max_new_tokens=4)
+        assert a.attempts[0].replica.rid == "replica-0"  # idle tie: id order
+        _drive(router, [a])
+        # replica-0 now owns the prompt's chain in its prefix cache; the
+        # follow-up must route there even though loads are equal again
+        b = router.submit(prompt, max_new_tokens=4)
+        assert b.attempts[0].replica.rid == "replica-0"
+        # a cache-cold prompt balances AWAY from the busy replica
+        cold = [int(t) for t in rng.integers(0, cfg.vocab_size, 10)]
+        c = router.submit(cold, max_new_tokens=4)
+        assert c.attempts[0].replica.rid == "replica-1"
+        _drive(router, [b, c])
+        ids = {a.request_id, b.request_id, c.request_id}
+        assert len(ids) == 3             # auto-assigned ids are unique
+
+    def test_kill_redispatch_bitwise_parity_zero_lost(self):
+        fake = [0.0]
+        cfg, router = _fleet(2, clock=lambda: fake[0], lease_ttl_s=1000.0)
+        _, ref = _model()
+        rng = np.random.default_rng(1)
+        n_new = 8
+        prompts = [[int(t) for t in rng.integers(0, cfg.vocab_size, n)]
+                   for n in (5, 19, 33, 7)]
+        expected = []
+        for p in prompts:
+            ids = np.asarray([p], np.int32)
+            out = ref.generate(paddle.to_tensor(ids),
+                               max_new_tokens=n_new).numpy()[0, -n_new:]
+            expected.append([int(t) for t in out])
+
+        red0 = registry.REGISTRY.get(
+            "fleet_requests_redispatched_total").total()
+        freqs = [router.submit(p, max_new_tokens=n_new) for p in prompts]
+        on_r0 = [f for f in freqs
+                 if f.attempts[0].replica.rid == "replica-0"]
+        assert len(on_r0) == 2           # load balancing alternated
+        # let the doomed replica make partial progress, then crash it
+        for _ in range(3):
+            router.replicas["replica-0"].engine.step()
+        router.kill_replica("replica-0")
+        router.poll()                    # detect + re-dispatch orphans
+        for f in on_r0:
+            (live,) = f.live_attempts()
+            assert live.kind == "redispatch"
+            assert live.replica.rid == "replica-1"
+        _drive(router, freqs)
+        # zero lost: every accepted request completed...
+        assert all(f.finish_reason == "length" for f in freqs)
+        # ...and greedy re-decode is bitwise what the dead replica owed
+        for f, want in zip(freqs, expected):
+            assert f.output_tokens == want
+        assert sum(f.redispatches for f in freqs) == 2
+        assert registry.REGISTRY.get(
+            "fleet_requests_redispatched_total").total() == red0 + 2
+        assert not router.routable(router.replicas["replica-0"])
+        assert router.health()["ok"]     # fleet still serves on replica-1
+
+    def test_hedge_fires_past_deadline_and_cancels_loser(self):
+        fake = [0.0]
+        cfg, router = _fleet(2, clock=lambda: fake[0], lease_ttl_s=1000.0,
+                             hedge_ttft_ms=50.0)
+        _, ref = _model()
+        rng = np.random.default_rng(2)
+        n_new = 6
+        prompt = [int(t) for t in rng.integers(0, cfg.vocab_size, 6)]
+        ids = np.asarray([prompt], np.int32)
+        want = [int(t) for t in ref.generate(
+            paddle.to_tensor(ids), max_new_tokens=n_new).numpy()[0, -n_new:]]
+
+        hedged0 = registry.REGISTRY.get("fleet_requests_hedged_total").total()
+        wins0 = registry.REGISTRY.get(
+            "fleet_hedge_wins_total").value(winner="hedge")
+        freq = router.submit(prompt, max_new_tokens=n_new)
+        assert freq.attempts[0].replica.rid == "replica-0"
+        r0 = router.replicas["replica-0"].engine
+        r0.step()                        # admitted + prefilling, no token yet
+        router.poll()
+        assert not freq.hedged           # deadline not reached at t=0
+        fake[0] = 0.1                    # past the 50ms TTFT deadline
+        router.poll()
+        assert freq.hedged
+        assert [a.kind for a in freq.attempts] == ["primary", "hedge"]
+        assert freq.attempts[1].replica.rid == "replica-1"
+        assert registry.REGISTRY.get(
+            "fleet_requests_hedged_total").total() == hedged0 + 1
+        # ONLY the hedge replica makes progress (the primary is hung):
+        # first token wins and the primary is cancelled mid-flight
+        r1 = router.replicas["replica-1"].engine
+        for _ in range(2000):
+            if freq.done:
+                break
+            if r1.sched.has_work():
+                r1.step()
+            router.poll()
+        assert freq.done
+        assert freq.output_tokens == want
+        winner = [a for a in freq.attempts if not a.failed]
+        assert [a.kind for a in winner] == ["hedge"]
+        assert registry.REGISTRY.get(
+            "fleet_hedge_wins_total").value(winner="hedge") == wins0 + 1
+        # the loser's slot + worst-case KV reservation went back to the
+        # pool the moment it lost the race (not when it would have ended)
+        st = r0.stats()
+        assert st["running"] == 0 and st["waiting"] == 0
+        assert st["prefilling"] == 0 and st["reserved_blocks"] == 0
+
+    def test_fleet_shed_when_every_queue_full(self):
+        fake = [0.0]
+        old = _flags.get_flag("serving_max_queue")
+        _flags.set_flags({"serving_max_queue": 1})
+        try:
+            cfg, router = _fleet(2, clock=lambda: fake[0],
+                                 lease_ttl_s=1000.0)
+            shed = registry.REGISTRY.get("fleet_requests_shed_total")
+            before = shed.value(reason="queue_full")
+            router.submit([1, 2, 3])     # replica-0's queue (never stepped)
+            router.submit([4, 5, 6])     # balances to replica-1's queue
+            with pytest.raises(QueueFullError) as ei:
+                router.submit([7, 8, 9])
+            assert ei.value.retry_after_s > 0
+            assert shed.value(reason="queue_full") == before + 1
+        finally:
+            _flags.set_flags({"serving_max_queue": old})
+
+    def test_shed_when_no_replica_routable(self):
+        fake = [0.0]
+        cfg, router = _fleet(2, clock=lambda: fake[0], lease_ttl_s=1000.0)
+        shed = registry.REGISTRY.get("fleet_requests_shed_total")
+        before = shed.value(reason="no_healthy_replica")
+        router.kill_replica("replica-0")
+        router.kill_replica("replica-1")
+        with pytest.raises(QueueFullError):
+            router.submit([1, 2, 3])
+        assert shed.value(reason="no_healthy_replica") == before + 1
+        assert router.health()["ok"] is False
+
+    def test_breaker_takes_faulty_replica_out_of_rotation(self):
+        fake = [0.0]
+        cfg, router = _fleet(2, clock=lambda: fake[0], lease_ttl_s=1000.0,
+                             breaker_errors=2, breaker_cooldown_s=5.0)
+        r0 = router.replicas["replica-0"]
+        boom = RuntimeError("injected submit fault")
+
+        def bad_submit(*a, **kw):
+            raise boom
+
+        real_submit = r0.engine.submit
+        r0.engine.submit = bad_submit
+        # each submit strikes replica-0 once, then falls through to
+        # replica-1 — the client never sees the fault
+        a = router.submit([1, 2, 3], max_new_tokens=2)
+        assert a.attempts[0].replica.rid == "replica-1"
+        assert r0.breaker.state == "closed"
+        b = router.submit([4, 5, 6], max_new_tokens=2)
+        assert b.attempts[0].replica.rid == "replica-1"
+        assert r0.breaker.state == "open"          # 2nd consecutive strike
+        assert not router.routable(r0)
+        assert router.health()["replicas"]["replica-0"]["breaker"] == "open"
+        # cooldown elapses -> half-open -> the probe heals the replica
+        r0.engine.submit = real_submit
+        fake[0] = 5.0
+        assert r0.breaker.state == "half_open"
+        c = router.submit([7, 8, 9], max_new_tokens=2)
+        assert c.attempts[0].replica.rid == "replica-0"  # the probe
+        assert r0.breaker.state == "closed"
+        _drive(router, [a, b, c])
+
+    def test_drain_routes_around_and_resume_restores(self):
+        cfg, router = _fleet(2)
+        router.start()
+        try:
+            rng = np.random.default_rng(3)
+            prompt = [int(t) for t in rng.integers(0, cfg.vocab_size, 12)]
+            a = router.submit(prompt, max_new_tokens=48)
+            assert a.attempts[0].replica.rid == "replica-0"
+            router.drain("replica-0")
+            # the draining engine itself refuses new work...
+            with pytest.raises(EngineDrainingError):
+                router.replicas["replica-0"].engine.submit([1, 2, 3])
+            # ...and the router routes around it, even against affinity
+            b = router.submit(prompt, max_new_tokens=4)
+            assert b.attempts[0].replica.rid == "replica-1"
+            health = router.health()
+            assert health["ok"]          # fleet still up on replica-1
+            snap = health["replicas"]["replica-0"]
+            assert snap["status"] == "draining" and snap["ok"] is False
+            # in-flight work on the draining replica runs to completion
+            assert a.wait(timeout=120) and a.finish_reason == "length"
+            deadline = time.monotonic() + 30
+            while not router.drained("replica-0") \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert router.drained("replica-0")
+            router.resume("replica-0")
+            assert router.health()["replicas"]["replica-0"]["status"] \
+                != "draining"
+            c = router.submit(prompt, max_new_tokens=4)
+            assert c.attempts[0].replica.rid == "replica-0"  # affinity back
+            assert b.wait(timeout=120) and c.wait(timeout=120)
+        finally:
+            router.stop()
+
+
+# ---------------------------------------------------------------- HTTP API
+class TestFleetHTTP:
+    def test_fleet_server_roundtrip_drain_and_shed(self):
+        cfg, router = _fleet(2)
+        _, ref = _model()
+        srv = FleetServer(router, port=0)
+        old = _flags.get_flag("serving_max_queue")
+        try:
+            rng = np.random.default_rng(4)
+            prompt = [int(t) for t in rng.integers(0, cfg.vocab_size, 5)]
+            body = json.dumps({"prompt": prompt,
+                               "max_new_tokens": 4}).encode()
+            req = urllib.request.Request(
+                srv.url() + "/generate", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                assert resp.status == 200
+                out = json.loads(resp.read())
+            ids = np.asarray([prompt], np.int32)
+            want = ref.generate(paddle.to_tensor(ids),
+                                max_new_tokens=4).numpy()[0, -4:]
+            assert out["output_tokens"] == [int(t) for t in want]
+            assert out["finish_reason"] == "length"
+            assert out["fleet"] == {"redispatches": 0, "hedged": False}
+
+            with urllib.request.urlopen(srv.url() + "/healthz",
+                                        timeout=30) as resp:
+                assert resp.status == 200
+                health = json.loads(resp.read())
+            assert health["ok"] is True
+            assert set(health["replicas"]) == {"replica-0", "replica-1"}
+            with urllib.request.urlopen(srv.url() + "/stats",
+                                        timeout=30) as resp:
+                st = json.loads(resp.read())
+            assert set(st["replicas"]) == {"replica-0", "replica-1"}
+
+            # rolling-restart drain over the wire
+            drain = urllib.request.Request(
+                srv.url() + "/drain",
+                data=json.dumps({"replica": "replica-0"}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(drain, timeout=30) as resp:
+                assert json.loads(resp.read())["status"] == "draining"
+            with urllib.request.urlopen(srv.url() + "/healthz",
+                                        timeout=30) as resp:
+                health = json.loads(resp.read())
+            assert health["replicas"]["replica-0"]["status"] == "draining"
+            assert health["ok"] is True  # replica-1 still takes traffic
+            resume = urllib.request.Request(
+                srv.url() + "/resume",
+                data=json.dumps({"replica": "replica-0"}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(resume, timeout=30) as resp:
+                assert json.loads(resp.read())["status"] == "ok"
+            bad = urllib.request.Request(
+                srv.url() + "/drain",
+                data=json.dumps({"replica": "nope"}).encode(),
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(bad, timeout=30)
+            assert ei.value.code == 404
+
+            # fleet-wide shed: pause both replica loops (alive + leased,
+            # just not draining their queues) and fill every queue
+            _flags.set_flags({"serving_max_queue": 1})
+            for rep in router.replicas.values():
+                rep.pause()
+            fillers = [router.submit([1, 2, 3], max_new_tokens=2),
+                       router.submit([4, 5, 6], max_new_tokens=2)]
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=30)
+            assert ei.value.code == 503
+            assert int(ei.value.headers["Retry-After"]) >= 1
+            assert json.loads(ei.value.read())["retry_after_s"] > 0
+            for rep in router.replicas.values():
+                rep.unpause()
+            assert all(f.wait(timeout=120) for f in fillers)
+        finally:
+            _flags.set_flags({"serving_max_queue": old})
+            srv.stop()

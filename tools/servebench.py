@@ -13,7 +13,7 @@ the backlog.
 
 Per load point it reports aggregate generated tokens/s and request-latency
 p50/p99 (arrival -> finish) for both schedulers, and writes the whole run
-to SERVEBENCH_r13.json (--out). Exit is non-zero when either scheduler
+to SERVEBENCH_r17.json (--out). Exit is non-zero when either scheduler
 completes zero requests, or when continuous batching fails --min-speedup
 (default 1.5x) over static at the HIGHEST load point.
 
@@ -49,6 +49,33 @@ TTFT/TPOT/queue histograms and cache/occupancy gauges populated, and —
 via an injected goodput collapse fed through the anomaly seam — a serving
 flight dump containing the offending requests' traces. SLO p50/p95/p99
 (TTFT, TPOT, queue) land in the report row.
+
+A fifth workload measures the SERVING FLEET (r18): the same Poisson +
+heavy-tail trace at saturation against 1 replica, FLEET_REPLICAS clean
+replicas, and FLEET_REPLICAS with one replica crashed mid-run. Every
+replica is an independently constructed, identically seeded engine
+(bitwise-interchangeable), warmed before measurement. The replay runs
+in VIRTUAL time: replicas-as-threads on one host share the GIL and the
+core budget, so a wall-clock ratio would measure the bench machine's
+core count (on a 1-core CI box N threads are strictly slower than 1),
+not the fleet. Instead every engine step executes for real (tokens,
+re-dispatch, and output parity are genuine) while the replica's virtual
+clock is charged a CALIBRATED cost for that step's shape — the median
+wall cost keyed by (prefill pending, decode batch width), measured once
+on a dedicated saturated engine. Charging calibrated rather than live
+per-step wall times matters on the bench host: interleaving N engines'
+distinct compiled programs on one core roughly doubles per-step wall
+cost (cache thrash), an artifact of co-location that real one-replica-
+per-host fleets never pay and that would contaminate the arms
+asymmetrically. Replicas overlap in virtual time exactly as N
+independent hosts would, and the crash is detected after a virtual
+lease TTL. The goodput ratio therefore measures what the router
+controls: placement balance, slot capacity, re-dispatch. Gates: with
+the crash, every accepted request still completes (zero lost) with
+greedy outputs bitwise-identical to the clean fleet run; the clean
+fleet sustains >= --min-fleet-goodput x the single replica's goodput;
+and the crash run's fleet p99 TTFT (router arrival -> first token,
+across the re-dispatch) stays under --fleet-p99-ttft virtual seconds.
 """
 from __future__ import annotations
 
@@ -102,6 +129,19 @@ SPEC_NEW = 96
 # number is the amortized ratio, not one dominated by the probes
 SPEC_ADV_NEW = 256
 SPEC_PROMPTS = 4
+
+# fleet workload (r18): saturation trace against 1 vs FLEET_REPLICAS
+# replicas; the kill arm crashes one replica FLEET_KILL_FRAC into the
+# clean arm's measured span (deep enough that it holds in-flight work,
+# early enough that re-dispatch + drain-down are inside the measurement)
+FLEET_REPLICAS = 4
+# virtual arrival rate: high enough that the arrival window is a small
+# fraction of even the FLEET span — otherwise the fleet arm is
+# arrival-limited and the goodput ratio measures the trace, not capacity
+FLEET_RPS = 1024.0
+FLEET_KILL_FRAC = 0.3
+FLEET_LEASE_TTL_S = 0.4
+FLEET_HEARTBEAT_S = 0.05
 
 
 def _build_model():
@@ -440,6 +480,244 @@ def _run_spec_workload(min_speedup):
     return row, ok
 
 
+def _build_fleet_router(n_replicas, slots, **router_kw):
+    """N independent replicas, each its OWN identically seeded model +
+    engine (bitwise-interchangeable: a re-dispatched greedy request
+    decodes to the same tokens on any of them)."""
+    from paddle_tpu.serving import FleetRouter, ServingEngine
+
+    engines = []
+    for _ in range(n_replicas):
+        _, m = _build_model()
+        engines.append(ServingEngine(
+            m, max_slots=slots, block_size=16,
+            prefill_chunk=PROMPT_RANGE[1],
+            max_model_len=PROMPT_RANGE[1] + NEW_LONG[1]))
+    router_kw.setdefault("lease_ttl_s", FLEET_LEASE_TTL_S)
+    router_kw.setdefault("heartbeat_s", FLEET_HEARTBEAT_S)
+    return FleetRouter(engines, **router_kw)
+
+
+def _warm_fleet(router):
+    """Compile every program shape the trace can hit, per replica (each
+    engine owns its compiled closures), before the router threads start:
+    single-prompt prefills per bucket, batched-prefill (S, P) combos, and
+    the decode program. Constant-token warm prompts can't collide with
+    the measured random trace in the prefix cache."""
+    pmax = -(-PROMPT_RANGE[1] // BUCKET) * BUCKET
+    for rep in router.replicas.values():
+        eng = rep.engine
+        _run_continuous(eng, [(0.0, [1] * plen, 2)
+                              for plen in range(BUCKET, pmax + 1, BUCKET)])
+        for i, s_len in enumerate(range(BUCKET, eng.prefill_chunk + 1,
+                                        BUCKET)):
+            _run_continuous(eng, [(0.0, [10 + 2 * i] * s_len, 2),
+                                  (0.0, [11 + 2 * i] * s_len, 2)])
+
+
+def _calibrate_step_costs(slots):
+    """Median engine-step wall cost keyed by (prefill work pending,
+    decode batch width), measured on ONE dedicated saturated engine.
+    Every arm charges its virtual clock from this shared table rather
+    than from its own measured step times: interleaving N engines'
+    distinct compiled programs on one bench core thrashes caches and
+    inflates per-step cost ~2x — an artifact of co-locating replicas
+    that real fleet hosts (one replica each) never pay, and one that
+    would bill the fleet arm but not the single-replica arm."""
+    from paddle_tpu.serving import ServingEngine
+
+    _, m = _build_model()
+    eng = ServingEngine(m, max_slots=slots, block_size=16,
+                        prefill_chunk=PROMPT_RANGE[1],
+                        max_model_len=PROMPT_RANGE[1] + NEW_LONG[1])
+    pmax = -(-PROMPT_RANGE[1] // BUCKET) * BUCKET
+    _run_continuous(eng, [(0.0, [1] * plen, 2)
+                          for plen in range(BUCKET, pmax + 1, BUCKET)])
+    for i, s_len in enumerate(range(BUCKET, eng.prefill_chunk + 1, BUCKET)):
+        _run_continuous(eng, [(0.0, [10 + 2 * i] * s_len, 2),
+                              (0.0, [11 + 2 * i] * s_len, 2)])
+    rng = np.random.default_rng(77)
+    for _ in range(3 * slots):      # oversubscribed: all widths appear
+        plen = int(rng.integers(PROMPT_RANGE[0], PROMPT_RANGE[1] + 1))
+        lo, hi = NEW_SHORT if rng.random() < 0.75 else NEW_LONG
+        eng.submit([int(x) for x in rng.integers(0, MODEL["vocab"], plen)],
+                   max_new_tokens=int(rng.integers(lo, hi + 1)))
+    samples = {}
+    while eng.sched.has_work():
+        key = (bool(eng.sched.waiting) or bool(eng.sched.prefilling),
+               len(eng.sched.running))
+        t0 = time.perf_counter()
+        eng.step()
+        samples.setdefault(key, []).append(time.perf_counter() - t0)
+    table = {k: float(np.median(v)) for k, v in samples.items()}
+    fallback = float(np.median([d for v in samples.values() for d in v]))
+
+    def cost(has_prefill, width):
+        got = table.get((has_prefill, width))
+        if got is not None:
+            return got
+        near = [(abs(w - width), c) for (p, w), c in table.items()
+                if p == has_prefill]
+        return min(near)[1] if near else fallback
+
+    return cost
+
+
+def _sim_fleet_arm(n_rep, slots, trace, step_cost, crash_at_s=None,
+                   crash_rid="replica-0"):
+    """Virtual-time replay: an event loop advances a shared virtual
+    clock through arrivals, step completions, and the crash + lease
+    expiry; each replica with work runs a REAL engine.step() (tokens,
+    re-dispatch and parity are genuine) and books its virtual timeline
+    busy for the CALIBRATED cost of that step shape. Replicas overlap
+    in virtual time the way N independent hosts would — the router's
+    threads are never started, router.poll() is the monitor tick.
+    Returns (freqs, v_first, crash time)."""
+    vt = [0.0]
+    router = _build_fleet_router(n_rep, slots, clock=lambda: vt[0],
+                                 lease_ttl_s=1e9)
+    _warm_fleet(router)
+    pending = list(trace)
+    freqs = []
+    vfree = {rid: 0.0 for rid in router.replicas}
+    v_first = {}
+    crashed = killed = crash_at_s is None
+    if crash_at_s is None:
+        crash_rid = None            # no replica stops stepping
+    detect_at = (crash_at_s + FLEET_LEASE_TTL_S
+                 if crash_at_s is not None else None)
+    for _ in range(2_000_000):
+        router.poll()               # settle finished, re-dispatch orphans
+        if not pending and all(f.done for f in freqs):
+            break
+        # next event: an arrival, a replica free to step, or the crash
+        events = []
+        if pending:
+            events.append(pending[0][0])
+        if not crashed:
+            events.append(crash_at_s)
+        elif not killed:
+            events.append(detect_at)
+        for rid, rep in router.replicas.items():
+            if rep._killed or (crashed and rid == crash_rid):
+                continue            # crashed: stops stepping silently
+            if rep.engine.sched.has_work():
+                events.append(max(vfree[rid], vt[0]))
+        if not events:
+            time.sleep(0)           # idle tick (requests settling)
+            continue
+        vt[0] = max(vt[0], min(events))
+        if not crashed and vt[0] >= crash_at_s:
+            crashed = True          # heartbeats stop; lease still live
+        if crashed and not killed and vt[0] >= detect_at:
+            router.kill_replica(crash_rid)  # lease expired: now DEAD
+            killed = True
+        while pending and pending[0][0] <= vt[0]:
+            _, prompt, new = pending.pop(0)
+            freqs.append(router.submit(prompt, max_new_tokens=new))
+        for rid, rep in router.replicas.items():
+            if rep._killed or (crashed and rid == crash_rid):
+                continue
+            if vfree[rid] <= vt[0] and rep.engine.sched.has_work():
+                sched = rep.engine.sched
+                key = (bool(sched.waiting) or bool(sched.prefilling),
+                       len(sched.running))
+                rep.engine.step()
+                vfree[rid] = vt[0] + step_cost(*key)
+        for f in freqs:             # first token, to step granularity
+            if f.request_id in v_first:
+                continue
+            for a in f.attempts:
+                toks, _state, _r = a.replica.engine.snapshot_output(a.req)
+                if toks:
+                    v_first[f.request_id] = vt[0]
+                    break
+    else:
+        raise AssertionError("fleet replay did not converge")
+    return freqs, v_first, crash_at_s
+
+
+def _fleet_arm_stats(freqs, v_first):
+    done = [f for f in freqs if f.finish_reason in ("stop", "length")]
+    if not done:
+        return {"completed": 0}
+    tokens = sum(len(f.output_tokens) for f in done)
+    span = max(f.finish_ts for f in done)      # virtual t0 is 0
+    ttft = [v_first[f.request_id] - f.submit_ts for f in done
+            if f.request_id in v_first]
+    e2e = [f.finish_ts - f.submit_ts for f in done]
+    tp50, tp99 = _percentiles(ttft) if ttft else (None, None)
+    ep50, ep99 = _percentiles(e2e)
+    return {"completed": len(done), "tokens": tokens,
+            "span_s": round(span, 4),
+            "goodput_tokens_per_s": round(tokens / span, 1),
+            "ttft_p50_s": tp50, "ttft_p99_s": tp99,
+            "latency_p50_s": ep50, "latency_p99_s": ep99,
+            "redispatches": sum(f.redispatches for f in freqs),
+            "hedged": sum(1 for f in freqs if f.hedged)}
+
+
+def _run_fleet_workload(n, slots, min_goodput_ratio, p99_ttft_gate):
+    """Fleet robustness + scaling bench: the SAME saturation trace
+    against one replica, FLEET_REPLICAS clean replicas (parity oracle +
+    goodput numerator), and FLEET_REPLICAS with replica-0 crashed
+    mid-run. The trace must oversubscribe the WHOLE fleet: per-step cost
+    is dispatch-dominated for a bench-sized model, so a half-loaded
+    replica decodes fewer tokens per step at the same step cost and the
+    single replica wins the difference back by batching wider — the
+    goodput ratio only measures capacity when every replica's slots stay
+    full. Returns (row, ok)."""
+    n = max(n, 6 * slots * FLEET_REPLICAS)
+    trace = _trace(n, FLEET_RPS, seed=5)
+    step_cost = _calibrate_step_costs(slots)
+    arms = {}
+    outs = {}
+    killed_at = None
+    clean_span = None
+    for name, n_rep, kill in (("n1", 1, False),
+                              ("fleet", FLEET_REPLICAS, False),
+                              ("fleet_kill", FLEET_REPLICAS, True)):
+        kw = {}
+        if kill:
+            # crash deep enough into the run that replica-0 holds
+            # in-flight work (span measured off the clean fleet arm)
+            kw = {"crash_at_s": FLEET_KILL_FRAC * clean_span}
+        freqs, v_first, k_at = _sim_fleet_arm(n_rep, slots, trace,
+                                              step_cost, **kw)
+        arms[name] = _fleet_arm_stats(freqs, v_first)
+        arms[name]["accepted"] = len(freqs)
+        outs[name] = [f.output_tokens for f in freqs]
+        if name == "fleet":
+            clean_span = arms[name]["span_s"]
+        if kill:
+            killed_at = k_at
+
+    ok_lost = (arms["fleet_kill"].get("completed") == n
+               and arms["fleet_kill"]["accepted"] == n)
+    identical = outs["fleet_kill"] == outs["fleet"]
+    g1 = arms["n1"].get("goodput_tokens_per_s") or 0.0
+    gn = arms["fleet"].get("goodput_tokens_per_s") or 0.0
+    ratio = round(gn / g1, 2) if g1 else None
+    p99 = arms["fleet_kill"].get("ttft_p99_s")
+    ok = (ok_lost and bool(identical)
+          and ratio is not None and ratio >= min_goodput_ratio
+          and p99 is not None and p99 <= p99_ttft_gate)
+    row = {"workload": "fleet", "replicas": FLEET_REPLICAS,
+           "load_rps": FLEET_RPS, "requests": n, "slots": slots,
+           "virtual_time": True,
+           "crashed_at_s": (round(killed_at, 3)
+                            if killed_at is not None else None),
+           "lease_ttl_s": FLEET_LEASE_TTL_S,
+           "n1": arms["n1"], "fleet": arms["fleet"],
+           "fleet_kill": arms["fleet_kill"],
+           "zero_lost_after_kill": bool(ok_lost),
+           "outputs_identical_after_kill": bool(identical),
+           "goodput_ratio": ratio,
+           "min_goodput_ratio": min_goodput_ratio,
+           "p99_ttft_gate_s": p99_ttft_gate, "ok": ok}
+    return row, ok
+
+
 # observability workload: saturated batches (overhead is engine-tick host
 # work, so measure with every slot busy, not a paced trace) + one paced
 # trace with metrics on for honest queue/TTFT quantiles
@@ -591,7 +869,7 @@ def _run_obs_workload(model, n, slots, min_ratio=0.97):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=os.path.join(_REPO,
-                                                  "SERVEBENCH_r16.json"))
+                                                  "SERVEBENCH_r17.json"))
     ap.add_argument("--requests", type=int, default=48)
     ap.add_argument("--slots", type=int, default=8)
     ap.add_argument("--min-speedup", type=float, default=1.5,
@@ -600,6 +878,13 @@ def main():
     ap.add_argument("--min-spec-speedup", type=float, default=1.3,
                     help="required spec-on/spec-off wall-clock ratio on "
                          "the repetitive arm")
+    ap.add_argument("--min-fleet-goodput", type=float, default=3.0,
+                    help="required clean-fleet/single-replica goodput "
+                         "ratio at saturation")
+    ap.add_argument("--fleet-p99-ttft", type=float, default=2.5,
+                    help="p99 TTFT bound (seconds) for the fleet arm with "
+                         "a replica killed mid-run — generous enough to "
+                         "absorb lease expiry + re-dispatch")
     args = ap.parse_args()
 
     import jax
@@ -690,6 +975,21 @@ def main():
               f"speedup={rep['speedup']} adv_ratio={adv['ratio']}")
         ok = False
 
+    fleet_row, fleet_ok = _run_fleet_workload(
+        args.requests, args.slots, args.min_fleet_goodput,
+        args.fleet_p99_ttft)
+    print(json.dumps(fleet_row), flush=True)
+    if not fleet_ok:
+        print("FAIL: fleet workload — need zero lost requests and "
+              "bitwise-identical outputs after a mid-run replica kill, "
+              f">={args.min_fleet_goodput}x clean-fleet goodput over one "
+              f"replica, and kill-arm p99 TTFT <= {args.fleet_p99_ttft}s; "
+              f"got lost={fleet_row['requests'] - (fleet_row['fleet_kill'].get('completed') or 0)} "
+              f"identical={fleet_row['outputs_identical_after_kill']} "
+              f"goodput_ratio={fleet_row['goodput_ratio']} "
+              f"p99_ttft={fleet_row['fleet_kill'].get('ttft_p99_s')}")
+        ok = False
+
     obs_row, obs_ok = _run_obs_workload(model, args.requests, args.slots)
     print(json.dumps(obs_row), flush=True)
     if not obs_ok:
@@ -714,6 +1014,7 @@ def main():
         "points": points,
         "prefix_caching": prefix_row,
         "speculation": spec_row,
+        "fleet": fleet_row,
         "observability": obs_row,
         "ok": ok,
         "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),

@@ -329,6 +329,102 @@ def serving_default_detectors(**kw) -> List[RollingDetector]:
             CacheHitCollapse(**kw), KVConservationBreach()]
 
 
+# -- fleet detectors (r19) ---------------------------------------------------
+# Router-level pathologies over the FleetObservability per-poll tick
+# records (serving/fleet_observability.py assembles them). These are
+# absolute-threshold detectors, not ratio-vs-median ones: the healthy
+# baseline for hedges, re-dispatches and breaker transitions is ZERO, so
+# a median-relative detector could never warm up into firing.
+
+class _SustainedThreshold(RollingDetector):
+    """value crossing an absolute bound for `patience` consecutive
+    records. min_points defaults to 0 — an absolute bound needs no
+    warm-up history, and record fields are already windowed rates."""
+
+    bound = 1.0
+    patience = 1
+    direction = "above"  # or "below"
+
+    def __init__(self, window: int = 32, min_points: int = 0,
+                 cooldown: int = 25, patience: Optional[int] = None,
+                 bound: Optional[float] = None):
+        super().__init__(window, min_points, cooldown)
+        if patience is not None:
+            self.patience = int(patience)
+        if bound is not None:
+            self.bound = float(bound)
+        self._streak = 0
+
+    def check(self, v, rec):
+        bad = v > self.bound if self.direction == "above" \
+            else v < self.bound
+        if not bad:
+            self._streak = 0
+            return None
+        self._streak += 1
+        if self._streak < self.patience:
+            return None
+        self._streak = 0
+        return {"bound": self.bound, "patience": self.patience}
+
+
+class HedgeRateSpike(_SustainedThreshold):
+    """Hedges fired / requests placed over the tick window past the
+    bound: a hedge storm (systemically slow replicas, or a hedge
+    deadline tuned below honest TTFT) — every hedge doubles load."""
+
+    kind = "hedge_rate_spike"
+    field = "hedge_rate"
+    bound = 0.3
+    patience = 1
+
+
+class RedispatchStorm(_SustainedThreshold):
+    """Re-dispatches / placements over the tick window past the bound:
+    replicas are dying (or being declared dead) faster than a one-off
+    failure — lease TTL vs heartbeat misconfiguration, crash loop."""
+
+    kind = "redispatch_storm"
+    field = "redispatch_rate"
+    bound = 0.3
+    patience = 1
+
+
+class BreakerFlap(_SustainedThreshold):
+    """Circuit-breaker oscillation: max per-replica breaker transitions
+    inside the detector window >= bound (two full open->half_open->
+    open cycles). A flapping breaker means probes keep succeeding into
+    a replica that keeps failing real traffic."""
+
+    kind = "breaker_flap"
+    field = "breaker_flaps"
+    bound = 4.0
+    patience = 1
+
+    def check(self, v, rec):
+        # >= semantics: four transitions in-window IS two flap cycles
+        if v < self.bound:
+            self._streak = 0
+            return None
+        return {"bound": self.bound, "patience": self.patience}
+
+
+class ReplicaSkew(_SustainedThreshold):
+    """Sustained cross-replica p95-TTFT skew (max replica p95 / min
+    replica p95) past the bound: one replica is systematically slower —
+    thermal throttle, noisy neighbor, or a cache gone cold."""
+
+    kind = "replica_skew"
+    field = "ttft_skew"
+    bound = 3.0
+    patience = 3
+
+
+def fleet_default_detectors(**kw) -> List[RollingDetector]:
+    return [HedgeRateSpike(**kw), RedispatchStorm(**kw),
+            BreakerFlap(**kw), ReplicaSkew(**kw)]
+
+
 class AnomalyEngine:
     """Feeds step records through every detector; on a hit emits the
     structured `anomaly` event (JSONL + Prometheus counter + flight-recorder

@@ -194,15 +194,25 @@ class Histogram(_Metric):
     def quantile(self, q: float, **labels) -> Optional[float]:
         """Approximate quantile from the cumulative buckets (linear
         interpolation inside the bucket, Prometheus histogram_quantile
-        semantics). None when nothing was observed; q clamps to [0, 1].
-        Observations past the last finite bound report that bound."""
+        semantics). Degenerate rows are well-defined rather than
+        interpolation artifacts: nan when nothing was observed, the sole
+        observation (recovered exactly from _sum) when count == 1 — a
+        freshly started replica's rollup must not fabricate a latency.
+        q clamps to [0, 1]; observations past the last finite bound
+        report that bound."""
         q = min(max(float(q), 0.0), 1.0)
         key = self._key(labels)
         with self._lock:
             row = self._hist.get(key)
-            if row is None or row[-2] <= 0:
-                return None
-            row = list(row)
+            row = list(row) if row is not None else None
+        return self._row_quantile(row, q)
+
+    def _row_quantile(self, row: Optional[List[float]],
+                      q: float) -> Optional[float]:
+        if row is None or row[-2] <= 0:
+            return float("nan")
+        if row[-2] == 1:
+            return row[-1]          # _sum of a single observation IS it
         rank = q * row[-2]
         lo = 0.0
         prev_count = 0.0
@@ -215,6 +225,25 @@ class Histogram(_Metric):
                 return lo + width * (rank - prev_count) / in_bucket
             lo, prev_count = b, row[i]
         return self.buckets[-1] if self.buckets else None
+
+    def rollup_quantiles(self, qs=(0.5, 0.95, 0.99)) -> Dict[str, float]:
+        """Fleet-level rollup: quantiles over the MERGE of every label
+        row (bucket counts and sums are additive), keyed "p50"/"p95"/...
+        Empty dict when nothing was observed under any label set."""
+        with self._lock:
+            rows = [list(r) for r in self._hist.values()]
+        merged = None
+        for r in rows:
+            if r[-2] <= 0:
+                continue
+            if merged is None:
+                merged = list(r)
+            else:
+                merged = [a + b for a, b in zip(merged, r)]
+        if merged is None:
+            return {}
+        return {f"p{int(round(float(q) * 100))}":
+                self._row_quantile(merged, float(q)) for q in qs}
 
     def samples(self):  # prometheus expansion handled by the text writer
         with self._lock:

@@ -47,6 +47,13 @@ This package replaces that with the vLLM/TPU-serving shape:
                    queue, e2e), goodput/shed counters, per-tick engine
                    gauges, serving anomaly detectors + the flight-
                    recorder arm that auto-dumps on regression.
+  * fleet_observability.py — fleet-wide distributed tracing: router-
+                   stamped trace context (attempt/cause) on every
+                   placement, cross-replica merged chrome traces
+                   (pid=replica, tid=slot), attempt-attributed SLO
+                   histograms with fleet rollups, and fleet anomaly
+                   detectors (hedge spike, re-dispatch storm, breaker
+                   flap, replica TTFT skew) with router-state dumps.
 """
 from .blocks import BlockAllocator  # noqa: F401
 from .observability import (  # noqa: F401
@@ -69,12 +76,17 @@ from .fleet import (  # noqa: F401
     Replica,
     build_fleet,
 )
+from .fleet_observability import (  # noqa: F401
+    FleetObservability,
+    export_fleet_trace,
+)
 from .server import FleetServer, ServingServer  # noqa: F401
 
 __all__ = [
     "BlockAllocator",
     "CircuitBreaker",
     "EngineDrainingError",
+    "FleetObservability",
     "FleetRequest",
     "FleetRouter",
     "FleetServer",
@@ -91,4 +103,6 @@ __all__ = [
     "ServingServer",
     "SpecState",
     "build_fleet",
+    "export_fleet_trace",
+    "export_request_trace",
 ]

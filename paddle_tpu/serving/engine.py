@@ -613,10 +613,11 @@ class ServingEngine:
                temperature: float = 0.0,
                eos_token_id: Optional[int] = None,
                request_id: Optional[str] = None,
-               tier: str = "default") -> Request:
+               tier: str = "default",
+               trace_ctx: Optional[dict] = None) -> Request:
         req = Request(prompt, max_new_tokens=max_new_tokens,
                       temperature=temperature, eos_token_id=eos_token_id,
-                      request_id=request_id, tier=tier)
+                      request_id=request_id, tier=tier, trace_ctx=trace_ctx)
         max_queue = int(_flags.get_flag("serving_max_queue"))
         with self._lock:
             if self._draining:

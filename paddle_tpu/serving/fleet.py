@@ -39,10 +39,13 @@ from typing import Dict, List, Optional
 
 from ..core import flags as _flags
 from ..distributed.env import InProcStore, ReplicaRegistry
+from ..observability import spans as _spans
 from ..observability.registry import counter as _counter
 from ..observability.registry import gauge as _gauge
 from ..observability.registry import histogram as _histogram
+from . import fleet_observability as _fobs
 from .engine import EngineDrainingError, QueueFullError, ServingEngine
+from .observability import RequestTrace
 
 _flags.define_flag("fleet_replicas", 2,
                    "Serving replicas a fleet front end builds when not "
@@ -164,13 +167,16 @@ class CircuitBreaker:
 
 class _Attempt:
     """One engine-level placement of a fleet request."""
-    __slots__ = ("replica", "req", "kind", "failed")
+    __slots__ = ("replica", "req", "kind", "failed", "index", "route_t0")
 
-    def __init__(self, replica: "Replica", req, kind: str):
+    def __init__(self, replica: "Replica", req, kind: str,
+                 index: int = 0, route_t0: Optional[float] = None):
         self.replica = replica
         self.req = req
         self.kind = kind            # "primary" | "redispatch" | "hedge"
         self.failed = False
+        self.index = int(index)     # position in FleetRequest.attempts
+        self.route_t0 = route_t0    # monotonic s at routing-decision entry
 
 
 class FleetRequest:
@@ -196,6 +202,10 @@ class FleetRequest:
         self.attempts: List[_Attempt] = []
         self.hedged = False
         self.redispatches = 0
+        # router-lane RequestTrace (route decisions, queue-at-router,
+        # hedge fire/win/cancel); None when spans were off at submit
+        self.trace: Optional[RequestTrace] = None
+        self._orphan_ns: Optional[int] = None  # orphan-detection instant
         self._router = router
         self._lock = threading.Lock()
         self._settled = False
@@ -369,6 +379,13 @@ class FleetRouter:
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._monitor: Optional[threading.Thread] = None
+        # fleet observability hub: trace merge, attempt SLOs, anomaly
+        # detectors + flight dumps (serving/fleet_observability.py)
+        self.obs = _fobs.FleetObservability(self)
+        # last breaker state seen per replica, to turn the breakers'
+        # implicit (time-derived) transitions into explicit events
+        self._breaker_seen: Dict[str, str] = {
+            rid: "closed" for rid in self.replicas}
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -410,8 +427,21 @@ class FleetRouter:
         return (not self.replica_dead(rep) and not rep.draining
                 and rep.breaker.state != "open")
 
+    def _breaker_event(self, rep: Replica):
+        """Surface a breaker state change as an observability event.
+        Called after every record_success/record_failure on the router
+        path and once per poll per replica (the engine loop strikes the
+        breaker from its own thread, and open -> half_open is
+        time-derived, so poll-time sampling catches both)."""
+        new = rep.breaker.state
+        old = self._breaker_seen.get(rep.rid)
+        if new != old:
+            self._breaker_seen[rep.rid] = new
+            self.obs.on_breaker(rep.rid, old, new)
+
     def _refresh_health_gauges(self):
         for rep in self.replicas.values():
+            self._breaker_event(rep)
             if self.replica_dead(rep):
                 v = 0.0
             elif rep.draining:
@@ -437,6 +467,71 @@ class FleetRouter:
         scored.sort(key=lambda t: t[:3])
         return [t[3] for t in scored]
 
+    def _place(self, freq: FleetRequest, cause: str,
+               exclude: Optional[set] = None):
+        """Place ONE attempt of `freq` on the best healthy replica —
+        the single routing path behind primary submit, re-dispatch and
+        hedge. Probes every candidate (affinity + load), stamps the
+        engine placement with the distributed trace context
+        ``{fleet_request_id, attempt, cause}``, and records the
+        route-decision span (probe results included) through the fleet
+        observability hub. Returns ``(attempt, saw_queue_full)`` with
+        ``attempt is None`` when no replica accepted."""
+        t0_ns = time.monotonic_ns()
+        probes = []
+        scored = []
+        for rep in self.replicas.values():
+            if exclude and rep.rid in exclude:
+                continue
+            if not self.routable(rep):
+                continue
+            aff = rep.affinity(freq.prompt)
+            load = rep.load()
+            probes.append({"replica": rep.rid, "affinity": int(aff),
+                           "load": int(load)})
+            scored.append((-aff, load, rep.rid, rep))
+        scored.sort(key=lambda t: t[:3])
+        saw_queue_full = None
+        for _, _, _, rep in scored:
+            if not rep.breaker.allow():
+                continue
+            idx = len(freq.attempts)
+            try:
+                req = rep.engine.submit(
+                    freq.prompt, max_new_tokens=freq.max_new_tokens,
+                    temperature=freq.temperature,
+                    eos_token_id=freq.eos_token_id,
+                    request_id=freq.request_id, tier=freq.tier,
+                    trace_ctx=_fobs.trace_context(freq.request_id, idx,
+                                                  cause))
+            except QueueFullError as e:
+                # load, not fault: no breaker strike
+                rep.breaker.record_success()
+                self._breaker_event(rep)
+                saw_queue_full = e
+                continue
+            except EngineDrainingError:
+                rep.breaker.record_success()
+                self._breaker_event(rep)
+                continue
+            except ValueError:
+                raise                   # bad request, not a replica fault
+            except Exception:  # noqa: BLE001 — replica fault
+                rep.breaker.record_failure()
+                self._breaker_event(rep)
+                continue
+            rep.breaker.record_success()
+            self._breaker_event(rep)
+            att = _Attempt(rep, req, cause, index=idx,
+                           route_t0=t0_ns / 1e9)
+            with freq._lock:
+                freq.attempts.append(att)
+            self.obs.on_dispatch(freq, att, probes, t0_ns)
+            freq._orphan_ns = None
+            _ROUTED.inc(replica=rep.rid)
+            return att, saw_queue_full
+        return None, saw_queue_full
+
     def submit(self, prompt: List[int], max_new_tokens: int = 16,
                temperature: float = 0.0,
                eos_token_id: Optional[int] = None,
@@ -450,42 +545,19 @@ class FleetRouter:
                             eos_token_id=eos_token_id,
                             request_id=request_id, tier=tier, router=self,
                             submit_ts=self._clock())
-        candidates = self._ranked(freq.prompt)
-        saw_queue_full = None
-        for rep in candidates:
-            if not rep.breaker.allow():
-                continue
-            try:
-                req = rep.engine.submit(
-                    freq.prompt, max_new_tokens=freq.max_new_tokens,
-                    temperature=freq.temperature,
-                    eos_token_id=freq.eos_token_id,
-                    request_id=freq.request_id, tier=freq.tier)
-            except QueueFullError as e:
-                # load, not fault: no breaker strike
-                rep.breaker.record_success()
-                saw_queue_full = e
-                continue
-            except EngineDrainingError:
-                rep.breaker.record_success()
-                continue
-            except ValueError:
-                raise                   # bad request, not a replica fault
-            except Exception:  # noqa: BLE001 — replica fault
-                rep.breaker.record_failure()
-                continue
-            rep.breaker.record_success()
-            with freq._lock:
-                freq.attempts.append(_Attempt(rep, req, "primary"))
-            with self._lock:
-                self._inflight[freq.request_id] = freq
-            _ROUTED.inc(replica=rep.rid)
-            return freq
-        if saw_queue_full is not None:
-            _FLEET_SHED.inc(reason="queue_full")
-            raise QueueFullError(saw_queue_full.depth, saw_queue_full.limit)
-        _FLEET_SHED.inc(reason="no_healthy_replica")
-        raise QueueFullError(0, 0)
+        if _spans.enabled():
+            freq.trace = RequestTrace(freq.request_id, freq.tier)
+        att, saw_queue_full = self._place(freq, "primary")
+        if att is None:
+            if saw_queue_full is not None:
+                _FLEET_SHED.inc(reason="queue_full")
+                raise QueueFullError(saw_queue_full.depth,
+                                     saw_queue_full.limit)
+            _FLEET_SHED.inc(reason="no_healthy_replica")
+            raise QueueFullError(0, 0)
+        with self._lock:
+            self._inflight[freq.request_id] = freq
+        return freq
 
     # -- monitor pass (public so tests can drive it deterministically) -----
     def poll(self):
@@ -502,6 +574,7 @@ class FleetRouter:
             self._redispatch_if_orphaned(freq)
             self._resolve_hedge(freq)
             self._maybe_hedge(freq, now)
+        self.obs.tick()
 
     def _settle(self, freq: FleetRequest) -> bool:
         """Complete the fleet request if any attempt finished cleanly;
@@ -538,12 +611,20 @@ class FleetRouter:
                     winner="hedge" if att.kind == "hedge" else "primary")
             freq._settled = True
         for a in losers:
+            toks_lost, _s, _r = a.replica.engine.snapshot_output(a.req)
             a.replica.engine.cancel(a.req, "hedge_lost")
+            self.obs.on_cancelled(freq, a, len(toks_lost), "hedge_lost")
+        if freq.hedged and losers:
+            # hedge raced all the way to the finish (first token and
+            # completion arrived in the same tick) — _resolve_hedge
+            # never got to declare the winner
+            self.obs.on_hedge_win(freq, att)
         if freq.first_token_ts is not None:
             _FLEET_TTFT.observe(max(0.0, freq.first_token_ts
                                     - freq.submit_ts), tier=freq.tier)
         _FLEET_E2E.observe(max(0.0, freq.finish_ts - freq.submit_ts),
                            tier=freq.tier)
+        self.obs.on_settle(freq, att)
         with self._lock:
             self._inflight.pop(freq.request_id, None)
         freq._done.set()
@@ -566,38 +647,32 @@ class FleetRouter:
         for att in dead:
             # bookkeeping on the dead engine is still consistent (its
             # loop died, not the object): free the slot + reservation
+            toks_lost = 0
             try:
+                toks, _s, _r = att.replica.engine.snapshot_output(att.req)
+                toks_lost = len(toks)
                 att.replica.engine.cancel(att.req, "replica_dead")
             except Exception:  # noqa: BLE001 — dead replica, best effort
                 pass
+            self.obs.on_cancelled(freq, att, toks_lost, "replica_dead")
         if not needs_new:
             return
-        candidates = (self._ranked(freq.prompt, exclude=tried)
-                      or self._ranked(freq.prompt))
-        for rep in candidates:
-            if not rep.breaker.allow():
-                continue
-            try:
-                req = rep.engine.submit(
-                    freq.prompt, max_new_tokens=freq.max_new_tokens,
-                    temperature=freq.temperature,
-                    eos_token_id=freq.eos_token_id,
-                    request_id=freq.request_id, tier=freq.tier)
-            except (QueueFullError, EngineDrainingError):
-                rep.breaker.record_success()
-                continue
-            except Exception:  # noqa: BLE001 — replica fault
-                rep.breaker.record_failure()
-                continue
-            rep.breaker.record_success()
+        if dead and freq._orphan_ns is None:
+            # queue-at-router span anchor: orphan detected, not yet
+            # re-placed (cleared by _place on success)
+            freq._orphan_ns = time.monotonic_ns()
+        # prefer a replica this request has not touched, but fall back
+        # to retrying anywhere rather than dropping an accepted request
+        fresh = any(self.routable(r) and r.rid not in tried
+                    for r in self.replicas.values())
+        att, _ = self._place(freq, "redispatch",
+                             exclude=tried if fresh else None)
+        if att is not None:
             with freq._lock:
-                freq.attempts.append(_Attempt(rep, req, "redispatch"))
                 freq.redispatches += 1
             _REDISPATCHED.inc()
-            _ROUTED.inc(replica=rep.rid)
-            return
-        # nowhere to go this pass (everyone full/dead): the next poll
-        # retries — accepted requests are never dropped
+        # else: nowhere to go this pass (everyone full/dead) — the next
+        # poll retries; accepted requests are never dropped
 
     def _resolve_hedge(self, freq: FleetRequest):
         """First token wins: as soon as exactly one live attempt has
@@ -623,8 +698,11 @@ class FleetRouter:
             losers = [a for a in live if a is not winner]
             for a in losers:
                 a.failed = True
+        self.obs.on_hedge_win(freq, winner)
         for a in losers:
+            toks_lost, _s, _r = a.replica.engine.snapshot_output(a.req)
             a.replica.engine.cancel(a.req, "hedge_lost")
+            self.obs.on_cancelled(freq, a, len(toks_lost), "hedge_lost")
 
     def _maybe_hedge(self, freq: FleetRequest, now: float):
         if self.hedge_ttft_s <= 0 or freq.hedged:
@@ -639,40 +717,26 @@ class FleetRouter:
                 att.replica.engine.snapshot_output(att.req)
             if toks:
                 return                  # first token already arrived
-        for rep in self._ranked(freq.prompt, exclude=hosting):
-            if not rep.breaker.allow():
-                continue
-            try:
-                req = rep.engine.submit(
-                    freq.prompt, max_new_tokens=freq.max_new_tokens,
-                    temperature=freq.temperature,
-                    eos_token_id=freq.eos_token_id,
-                    request_id=freq.request_id, tier=freq.tier)
-            except (QueueFullError, EngineDrainingError):
-                rep.breaker.record_success()
-                continue
-            except Exception:  # noqa: BLE001 — replica fault
-                rep.breaker.record_failure()
-                continue
-            rep.breaker.record_success()
+        att, _ = self._place(freq, "hedge", exclude=hosting)
+        if att is not None:
             with freq._lock:
-                freq.attempts.append(_Attempt(rep, req, "hedge"))
                 freq.hedged = True
             _HEDGED.inc()
-            return
 
     # -- drain / chaos -----------------------------------------------------
     def drain(self, rid: str):
         """Rolling-restart drain: stop routing to `rid`, stop its engine
         admitting, let in-flight work finish."""
-        rep = self.replicas[rid]
-        rep.draining = True
-        rep.engine.drain()
+        with self._lock:
+            rep = self.replicas[rid]
+            rep.draining = True
+            rep.engine.drain()
 
     def resume(self, rid: str):
-        rep = self.replicas[rid]
-        rep.engine.resume()
-        rep.draining = False
+        with self._lock:
+            rep = self.replicas[rid]
+            rep.engine.resume()
+            rep.draining = False
 
     def drained(self, rid: str) -> bool:
         return self.replicas[rid].engine.drained()
@@ -688,25 +752,39 @@ class FleetRouter:
 
     def health(self) -> dict:
         """Fleet /healthz body: ok while at least one replica can take
-        traffic; per-replica engine snapshots say why not."""
-        out: Dict[str, dict] = {}
-        ok_any = False
-        for rid, rep in self.replicas.items():
-            dead = self.replica_dead(rep)
-            snap = rep.engine.obs.health_snapshot(
-                loop_alive=rep.loop_alive() and not dead)
-            snap["breaker"] = rep.breaker.state
-            out[rid] = snap
-            if self.routable(rep):
-                ok_any = True
-        return {"ok": ok_any, "replicas": out}
+        traffic; per-replica engine snapshots say why not. The whole
+        body is assembled under the router lock so the router-level
+        fields (inflight, draining, breaker) and every replica snapshot
+        come from ONE instant — no replica can die or settle between
+        rows of the same response."""
+        with self._lock:
+            out: Dict[str, dict] = {}
+            ok_any = False
+            for rid, rep in self.replicas.items():
+                dead = self.replica_dead(rep)
+                snap = rep.engine.obs.health_snapshot(
+                    loop_alive=rep.loop_alive() and not dead)
+                snap["breaker"] = rep.breaker.state
+                snap["dead"] = dead
+                snap["draining"] = rep.draining
+                out[rid] = snap
+                if self.routable(rep):
+                    ok_any = True
+            return {"ok": ok_any, "inflight": len(self._inflight),
+                    "replicas": out}
 
     def stats(self) -> dict:
-        return {
-            "inflight": self.inflight(),
-            "replicas": {rid: rep.engine.stats()
-                         for rid, rep in self.replicas.items()},
-        }
+        """One consistent router + per-replica snapshot (same locking
+        contract as health())."""
+        with self._lock:
+            reps: Dict[str, dict] = {}
+            for rid, rep in self.replicas.items():
+                s = rep.engine.stats()
+                s["breaker"] = rep.breaker.state
+                s["draining"] = rep.draining
+                s["dead"] = self.replica_dead(rep)
+                reps[rid] = s
+            return {"inflight": len(self._inflight), "replicas": reps}
 
 
 def build_fleet(model_factory, n_replicas: Optional[int] = None, *,

@@ -211,19 +211,33 @@ class RequestTrace:
 
     MAX_SPANS = 1024
 
-    __slots__ = ("request_id", "tier", "spans")
+    __slots__ = ("request_id", "tier", "spans", "ctx", "slot")
 
-    def __init__(self, request_id: str, tier: str = "default"):
+    def __init__(self, request_id: str, tier: str = "default",
+                 ctx: Optional[Dict[str, Any]] = None):
         self.request_id = str(request_id)
         self.tier = str(tier)
+        # fleet trace context (fleet_request_id / attempt / cause) stamped
+        # by the router at dispatch: baked into every request-scoped
+        # span's args so a cross-replica merge needs no re-tagging.
+        # Batch-scoped spans (shared dict, see on_decode) are tagged at
+        # export time on copies instead.
+        self.ctx = dict(ctx) if ctx else None
+        # decode slot, captured at admission (the scheduler clears
+        # req.slot at finish; the merged fleet trace wants tid=slot)
+        self.slot: Optional[int] = None
         self.spans: deque = deque(maxlen=self.MAX_SPANS)
 
     def _span(self, name: str, begin_ns: int, end_ns: int,
               **args) -> Dict[str, Any]:
+        base = {"request_id": self.request_id}
+        if self.ctx:
+            base.update(self.ctx)
+        base.update(args)
         return {"name": str(name), "begin_ns": int(begin_ns),
                 "end_ns": int(end_ns), "cat": "serving",
                 "tid": threading.get_ident() & 0xFFFF,
-                "args": {"request_id": self.request_id, **args}}
+                "args": base}
 
     def add(self, name: str, begin_ns: int, end_ns: int, **args) -> None:
         """Record a request-scoped span (local list + global ring)."""
@@ -241,19 +255,35 @@ class RequestTrace:
         return [s["name"] for s in self.spans]
 
 
-def chrome_trace_events(span_dicts) -> List[Dict[str, Any]]:
+def chrome_trace_events(span_dicts, *, pid: Optional[int] = None,
+                        tid: Optional[int] = None,
+                        extra_args: Optional[Dict[str, Any]] = None
+                        ) -> List[Dict[str, Any]]:
     """Convert ring-format span dicts to chrome-trace complete events
-    (the same event shape profiler/xplane.py merges)."""
-    pid = os.getpid()
+    (the same event shape profiler/xplane.py merges).
+
+    Every event gets its OWN args dict (deep-copied from the span): the
+    engine appends one shared per-tick span dict by reference to every
+    traced participant (on_decode), so tagging export-time fields on the
+    original would corrupt every other request's trace. `pid`/`tid`
+    override the lane (the fleet merge maps pid=replica, tid=slot);
+    `extra_args` fills attribution keys (attempt/cause) without
+    clobbering anything the span already carries."""
+    default_pid = os.getpid() if pid is None else pid
     out = []
     for s in span_dicts:
         begin = int(s.get("begin_ns", 0))
+        args = dict(s.get("args") or {})
+        if extra_args:
+            for k, v in extra_args.items():
+                args.setdefault(k, v)
         out.append({"name": s.get("name", "?"), "ph": "X",
                     "cat": s.get("cat", "serving"),
                     "ts": begin / 1e3,
                     "dur": max(int(s.get("end_ns", begin)) - begin, 0) / 1e3,
-                    "pid": pid, "tid": s.get("tid", 0),
-                    "args": s.get("args", {})})
+                    "pid": default_pid,
+                    "tid": s.get("tid", 0) if tid is None else tid,
+                    "args": args})
     return out
 
 
@@ -326,7 +356,8 @@ class ServingObservability:
     # -- request lifecycle hooks (engine lock held) ------------------------
     def on_submit(self, req) -> None:
         if _spans.enabled():
-            req.trace = RequestTrace(req.request_id, req.tier)
+            req.trace = RequestTrace(req.request_id, req.tier,
+                                     ctx=getattr(req, "trace_ctx", None))
 
     def on_shed(self, req, reason: str) -> None:
         """Request rejected at admission (never entered the queue): shed
@@ -347,11 +378,13 @@ class ServingObservability:
         self._admit_matched += m
         self._admit_total += p
         tr = req.trace
-        if tr is not None and req.prefill_start is not None:
-            tr.add("serving.queue", int(req.arrival_time * 1e9),
-                   int(req.prefill_start * 1e9),
-                   prompt_tokens=len(req.prompt),
-                   prefix_matched=req.prefix_matched)
+        if tr is not None:
+            tr.slot = req.slot
+            if req.prefill_start is not None:
+                tr.add("serving.queue", int(req.arrival_time * 1e9),
+                       int(req.prefill_start * 1e9),
+                       prompt_tokens=len(req.prompt),
+                       prefix_matched=req.prefix_matched)
 
     def on_prefill_chunk(self, req, t0_ns: Optional[int],
                          tokens: int, batched: bool = False) -> None:

@@ -61,7 +61,8 @@ class Request:
 
     def __init__(self, prompt: List[int], max_new_tokens: int = 16,
                  temperature: float = 0.0, eos_token_id: Optional[int] = None,
-                 request_id: Optional[str] = None, tier: str = "default"):
+                 request_id: Optional[str] = None, tier: str = "default",
+                 trace_ctx: Optional[dict] = None):
         self.request_id = (request_id if request_id is not None
                            else f"req-{next(_req_counter)}")
         self.prompt = [int(t) for t in prompt]
@@ -74,6 +75,10 @@ class Request:
         # per-request lifecycle trace, attached by the engine at submit
         # when span recording is on (serving/observability.RequestTrace)
         self.trace = None
+        # distributed trace context stamped by the FleetRouter: which
+        # fleet request / attempt / cause this engine-level placement
+        # serves — RequestTrace inherits it so every span is attributed
+        self.trace_ctx = dict(trace_ctx) if trace_ctx else None
         self.output_tokens: List[int] = []
         self.state = "queued"
         self.finish_reason: Optional[str] = None

@@ -42,6 +42,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs
 
 from ..core.flags import define_flag, get_flag
 from ..observability import serve as _obs_serve
@@ -291,15 +292,33 @@ class _FleetHandler(_Handler):
         })
 
     def do_GET(self):  # noqa: N802
-        path = self.path.split("?", 1)[0]
+        split = self.path.split("?", 1)
+        path = split[0]
         if path == "/stats":
             self._reply(200, self._router.stats())
         elif path == "/metrics":
+            # fleet_slo_seconds gauges are rollups over the attempt
+            # histograms: recompute at scrape time so they are current
+            self._router.obs.publish_rollups()
             self._reply_raw(200, _obs_serve.metrics_body(),
                             "text/plain; version=0.0.4; charset=utf-8")
         elif path in ("/healthz", "/health"):
             snap = self._router.health()
             self._reply(200 if snap["ok"] else 503, snap)
+        elif path == "/trace":
+            query = parse_qs(split[1]) if len(split) > 1 else {}
+            rid = (query.get("id") or [None])[0]
+            if not rid:
+                self._reply(400, {"error": "usage: /trace?id=<request_id>"})
+                return
+            payload = self._router.obs.trace_payload(rid)
+            if payload is None:
+                self._reply(404, {
+                    "error": f"no merged trace for request {rid!r} "
+                             "(unknown id, evicted from the settled "
+                             "ring, or FLAGS_metrics was off at submit)"})
+                return
+            self._reply(200, payload)
         else:
             self._reply(404, {"error": "not found"})
 

@@ -22,7 +22,7 @@ import paddle_tpu as paddle
 from paddle_tpu.core import flags as _flags
 from paddle_tpu.distributed.env import InProcStore, ReplicaRegistry
 from paddle_tpu.models import GPTConfig, GPTForCausalLM
-from paddle_tpu.observability import registry
+from paddle_tpu.observability import registry, reset_all
 from paddle_tpu.serving import (
     CircuitBreaker,
     EngineDrainingError,
@@ -30,6 +30,11 @@ from paddle_tpu.serving import (
     FleetServer,
     QueueFullError,
     ServingEngine,
+    export_fleet_trace,
+)
+from paddle_tpu.serving.fleet_observability import (
+    coverage_of,
+    unparented_spans,
 )
 
 
@@ -438,4 +443,222 @@ class TestFleetHTTP:
             assert all(f.wait(timeout=120) for f in fillers)
         finally:
             _flags.set_flags({"serving_max_queue": old})
+            srv.stop()
+
+
+# ------------------------------------------------- fleet distributed tracing
+class TestFleetTracing:
+    """r19: trace-context propagation (attempt/cause tags on every span),
+    cross-replica merged chrome traces, and attempt-attributed SLOs.
+    Fake-clock, unstarted routers throughout — failure detection and
+    hedging are deterministic, so the assertions are on tags and counts,
+    never durations."""
+
+    @pytest.fixture(autouse=True)
+    def _traced(self):
+        reset_all()
+        _flags.set_flags({"metrics": "on"})
+        yield
+        _flags.set_flags({"metrics": "off"})
+        reset_all()
+
+    def test_redispatch_exports_one_merged_trace(self, tmp_path):
+        fake = [0.0]
+        cfg, router = _fleet(2, clock=lambda: fake[0], lease_ttl_s=1000.0)
+        rng = np.random.default_rng(7)
+        prompt = [int(t) for t in rng.integers(0, cfg.vocab_size, 8)]
+        red0 = registry.REGISTRY.get(
+            "fleet_requests_redispatched_total").total()
+        freq = router.submit(prompt, max_new_tokens=6)
+        assert freq.attempts[0].replica.rid == "replica-0"
+        # the engine placement carries the router's trace context
+        assert freq.attempts[0].req.trace_ctx == {
+            "fleet_request_id": freq.request_id,
+            "attempt": 0, "cause": "primary"}
+        for _ in range(3):               # partial progress, then crash
+            router.replicas["replica-0"].engine.step()
+        router.kill_replica("replica-0")
+        router.poll()                    # detect + re-dispatch
+        (live,) = freq.live_attempts()
+        assert live.kind == "redispatch" and live.index == 1
+        assert live.req.trace_ctx == {
+            "fleet_request_id": freq.request_id,
+            "attempt": 1, "cause": "redispatch"}
+        _drive(router, [freq])
+        assert freq.finish_reason == "length"
+
+        # ONE merged chrome trace: a lane per replica, attempt/cause on
+        # every replica-lane span, dead attempt marked cancelled
+        payload = router.obs.trace_payload(freq.request_id)
+        assert payload is not None
+        evs = payload["traceEvents"]
+        # attempt count in the trace matches the re-dispatch counter
+        reds = registry.REGISTRY.get(
+            "fleet_requests_redispatched_total").total() - red0
+        tags = {(e["args"]["attempt"], e["args"]["cause"])
+                for e in evs if e.get("ph") == "X" and e["pid"] != 0}
+        assert tags == {(0, "primary"), (1, "redispatch")}
+        assert len(tags) == 1 + reds == len(freq.attempts)
+        # both replicas contribute a process lane + the router lane
+        lanes = {e["pid"] for e in evs if e.get("ph") == "X"}
+        assert lanes == {0, 1, 2}
+        # the dead primary's spans are all flagged cancelled; the
+        # winner's never are
+        for e in evs:
+            if e.get("ph") != "X" or e["pid"] == 0:
+                continue
+            if e["args"]["cause"] == "primary":
+                assert e["args"]["cancelled"] is True
+            else:
+                assert "cancelled" not in e["args"]
+        # router lane recorded the route decision (probe results) for
+        # both placements and the queue-at-router wait for the orphan
+        router_spans = [e["name"] for e in evs
+                        if e.get("ph") == "X" and e["pid"] == 0]
+        assert router_spans.count("fleet.route") == 2
+        assert "fleet.queue" in router_spans
+        route = [e for e in evs if e["name"] == "fleet.route"][0]
+        assert {p["replica"] for p in route["args"]["probes"]} \
+            <= {"replica-0", "replica-1"}
+        # single contiguous waterfall: covered wall time + no orphans
+        assert coverage_of(evs) >= 0.99
+        assert unparented_spans(evs, freq.request_id) == []
+        # export round-trips through the file API too
+        p = str(tmp_path / "fleet_trace.json")
+        export_fleet_trace(router, freq.request_id, p)
+        with open(p) as f:
+            assert json.load(f)["traceEvents"]
+
+    def test_hedge_exports_one_merged_trace_with_cancelled_arm(self):
+        fake = [0.0]
+        cfg, router = _fleet(2, clock=lambda: fake[0], lease_ttl_s=1000.0,
+                             hedge_ttft_ms=50.0)
+        rng = np.random.default_rng(8)
+        prompt = [int(t) for t in rng.integers(0, cfg.vocab_size, 6)]
+        hed0 = registry.REGISTRY.get("fleet_requests_hedged_total").total()
+        freq = router.submit(prompt, max_new_tokens=6)
+        r0 = router.replicas["replica-0"].engine
+        r0.step()                        # admitted, no first token yet
+        fake[0] = 0.1                    # past the 50ms deadline
+        router.poll()
+        assert freq.hedged
+        assert freq.attempts[1].req.trace_ctx == {
+            "fleet_request_id": freq.request_id,
+            "attempt": 1, "cause": "hedge"}
+        # only the hedge arm progresses: the primary is hung
+        r1 = router.replicas["replica-1"].engine
+        for _ in range(2000):
+            if freq.done:
+                break
+            if r1.sched.has_work():
+                r1.step()
+            router.poll()
+        assert freq.done
+
+        payload = router.obs.trace_payload(freq.request_id)
+        evs = payload["traceEvents"]
+        heds = registry.REGISTRY.get(
+            "fleet_requests_hedged_total").total() - hed0
+        tags = {(e["args"]["attempt"], e["args"]["cause"])
+                for e in evs if e.get("ph") == "X" and e["pid"] != 0}
+        assert tags == {(0, "primary"), (1, "hedge")}
+        assert len(tags) == 1 + heds == len(freq.attempts)
+        # the losing arm is in the trace, marked cancelled
+        primary = [e for e in evs if e.get("ph") == "X" and e["pid"] != 0
+                   and e["args"]["cause"] == "primary"]
+        assert primary and all(e["args"]["cancelled"] is True
+                               for e in primary)
+        names = {e["name"] for e in evs}
+        assert {"fleet.hedge_fire", "fleet.hedge_win",
+                "fleet.hedge_cancel"} <= names
+        assert coverage_of(evs) >= 0.99
+        assert unparented_spans(evs, freq.request_id) == []
+
+    def test_attempt_attributed_slos_and_rollups(self):
+        fake = [0.0]
+        cfg, router = _fleet(2, clock=lambda: fake[0], lease_ttl_s=1000.0)
+        rng = np.random.default_rng(9)
+        prompts = [[int(t) for t in rng.integers(0, cfg.vocab_size, 6)]
+                   for _ in range(2)]
+        freqs = [router.submit(p, max_new_tokens=4) for p in prompts]
+        _drive(router, freqs)
+        ttft = registry.REGISTRY.get("fleet_attempt_ttft_seconds")
+        e2e = registry.REGISTRY.get("fleet_attempt_e2e_seconds")
+        # one primary attempt per replica (load-balanced), cause-labeled
+        for rid in ("replica-0", "replica-1"):
+            assert ttft.stats(tier="default", replica=rid,
+                              cause="primary")["count"] == 1
+            assert e2e.stats(tier="default", replica=rid,
+                             cause="primary")["count"] == 1
+        # fleet-level rollups merge every {tier,replica,cause} row
+        roll = router.obs.publish_rollups()
+        assert {"route", "queue", "ttft", "e2e"} <= set(roll)
+        assert roll["ttft"]["p50"] <= roll["ttft"]["p99"]
+        g = registry.REGISTRY.get("fleet_slo_seconds")
+        assert g.value(metric="ttft", quantile="p99") == \
+            pytest.approx(roll["ttft"]["p99"])
+        # settled ring answers trace_payload after the fact
+        for f in freqs:
+            assert router.obs.trace_payload(f.request_id) is not None
+        assert router.obs.trace_payload("no-such-id") is None
+
+    def test_breaker_transitions_become_events(self):
+        fake = [0.0]
+        cfg, router = _fleet(2, clock=lambda: fake[0], lease_ttl_s=1000.0,
+                             breaker_errors=2, breaker_cooldown_s=5.0)
+        r0 = router.replicas["replica-0"]
+        real_submit = r0.engine.submit
+
+        def bad_submit(*a, **kw):
+            raise RuntimeError("injected submit fault")
+
+        r0.engine.submit = bad_submit
+        router.submit([1, 2, 3], max_new_tokens=2)
+        router.submit([4, 5, 6], max_new_tokens=2)
+        assert r0.breaker.state == "open"
+        fake[0] = 5.0                    # open -> half_open (time-derived)
+        router.poll()
+        r0.engine.submit = real_submit
+        router.submit([7, 8, 9], max_new_tokens=2)   # probe heals
+        states = [(t["replica"], t["from"], t["to"])
+                  for t in router.obs._breaker_log]
+        assert ("replica-0", "closed", "open") in states
+        assert ("replica-0", "open", "half_open") in states
+        assert ("replica-0", "half_open", "closed") in states
+
+    def test_fleet_server_trace_endpoint(self):
+        cfg, router = _fleet(2)
+        srv = FleetServer(router, port=0)
+        try:
+            rng = np.random.default_rng(10)
+            prompt = [int(t) for t in rng.integers(0, cfg.vocab_size, 5)]
+            body = json.dumps({"prompt": prompt,
+                               "max_new_tokens": 3}).encode()
+            req = urllib.request.Request(
+                srv.url() + "/generate", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                rid = json.loads(resp.read())["request_id"]
+            with urllib.request.urlopen(
+                    srv.url() + f"/trace?id={rid}", timeout=30) as resp:
+                assert resp.status == 200
+                tr = json.loads(resp.read())
+            assert tr["displayTimeUnit"] == "ms"
+            assert unparented_spans(tr["traceEvents"], rid) == []
+            assert any(e["name"] == "fleet.route"
+                       for e in tr["traceEvents"])
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(srv.url() + "/trace?id=nope",
+                                       timeout=30)
+            assert ei.value.code == 404
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(srv.url() + "/trace", timeout=30)
+            assert ei.value.code == 400
+            # /metrics surfaces the fleet SLO rollup gauges
+            with urllib.request.urlopen(srv.url() + "/metrics",
+                                        timeout=30) as resp:
+                text = resp.read().decode()
+            assert "fleet_slo_seconds" in text
+            assert "fleet_attempt_e2e_seconds" in text
+        finally:
             srv.stop()

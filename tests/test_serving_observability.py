@@ -6,6 +6,7 @@ request's trace aboard, engine-counter thin views, locked /stats + enriched
 no-op contract.
 """
 import json
+import math
 import os
 import threading
 import time
@@ -93,6 +94,41 @@ class TestRequestTraces:
         assert len(evs) == len(names)
         assert all(e["ph"] == "X" and e["dur"] >= 0 for e in evs)
         assert evs[0]["name"] == "serving.queue"
+
+    def test_export_tagging_never_leaks_into_shared_tick_spans(
+            self, metrics_on):
+        """on_decode appends ONE shared per-tick span dict by reference
+        to every traced participant (perf): export-time tagging must
+        copy, or exporting request A's trace with attribution args would
+        corrupt request B's."""
+        from paddle_tpu.serving.observability import chrome_trace_events
+
+        eng = _engine()
+        r1 = eng.submit(list(range(1, 9)), max_new_tokens=4)
+        r2 = eng.submit(list(range(101, 109)), max_new_tokens=4)
+        eng.run_until_idle()
+        decode1 = [s for s in r1.trace.spans
+                   if s["name"] == "serving.decode"]
+        ids2 = {id(s) for s in r2.trace.spans}
+        # precondition: at least one tick span IS the same dict object
+        assert any(id(s) in ids2 for s in decode1)
+        ev1 = chrome_trace_events(list(r1.trace.spans), pid=7,
+                                  extra_args={"attempt": 0,
+                                              "cause": "primary"})
+        # request 1's export tagged nothing onto the raw shared spans
+        assert all("attempt" not in (s.get("args") or {})
+                   for s in r2.trace.spans)
+        ev2 = chrome_trace_events(list(r2.trace.spans), pid=8,
+                                  extra_args={"attempt": 1,
+                                              "cause": "hedge"})
+        assert {e["args"]["attempt"] for e in ev1} == {0}
+        assert {e["args"]["attempt"] for e in ev2} == {1}
+        assert all(e["pid"] == 7 for e in ev1)
+        assert all(e["pid"] == 8 for e in ev2)
+        # mutating an exported event can never reach the live spans
+        ev1[0]["args"]["poison"] = True
+        assert all("poison" not in (s.get("args") or {})
+                   for s in r1.trace.spans)
 
     def test_cow_admission_traces_without_prefill(self, metrics_on):
         eng = _engine()
@@ -184,7 +220,7 @@ class TestSLOMetrics:
     def test_quantile_linear_interpolation(self):
         h = registry.histogram("q_test_seconds", buckets=(1.0, 2.0, 4.0),
                                always=True)
-        assert h.quantile(0.5) is None
+        assert math.isnan(h.quantile(0.5))   # empty: well-defined nan
         for v in (0.5, 1.5, 1.5, 3.0):
             h.observe(v)
         # ranks: bucket<=1 holds 1, <=2 holds 3, <=4 holds 4
@@ -193,6 +229,37 @@ class TestSLOMetrics:
         assert h.quantile(1.0) == pytest.approx(4.0)
         h.observe(100.0)                               # +Inf bucket
         assert h.quantile(1.0) == pytest.approx(4.0)   # clamped to last
+
+    def test_quantile_degenerate_rows(self):
+        """Empty row -> nan for EVERY q; single observation -> the sole
+        value exactly (not a bucket midpoint interpolation)."""
+        h = registry.histogram("q_edge_seconds", buckets=(1.0, 2.0, 4.0),
+                               always=True)
+        for q in (0.0, 0.5, 0.95, 1.0):
+            assert math.isnan(h.quantile(q))
+        h.observe(1.7)
+        for q in (0.0, 0.5, 0.99, 1.0):
+            assert h.quantile(q) == pytest.approx(1.7)
+        # unknown label rows stay nan, never a crash
+        hl = registry.histogram("q_edge_lbl_seconds", buckets=(1.0,),
+                                labelnames=("tier",), always=True)
+        assert math.isnan(hl.quantile(0.5, tier="nope"))
+        hl.observe(0.25, tier="gold")
+        assert hl.quantile(0.5, tier="gold") == pytest.approx(0.25)
+
+    def test_rollup_quantiles_merge_label_rows(self):
+        h = registry.histogram("q_roll_seconds", buckets=(1.0, 2.0, 4.0),
+                               labelnames=("replica",), always=True)
+        assert h.rollup_quantiles() == {}     # nothing observed anywhere
+        h.observe(0.5, replica="a")
+        h.observe(3.0, replica="b")
+        h.observe(3.0, replica="b")
+        h.observe(3.0, replica="b")
+        roll = h.rollup_quantiles(qs=(0.5, 0.95))
+        # merged ranks: <=1 holds 1, <=4 holds 4 -> p95 in the top bucket
+        assert set(roll) == {"p50", "p95"}
+        assert 2.0 <= roll["p95"] <= 4.0
+        assert roll["p50"] <= roll["p95"]
 
     def test_tier_label_rides_through(self, metrics_on):
         eng = _engine()

@@ -1,6 +1,6 @@
-"""Observability benchmark + gate (ISSUE r9, extended r10).
+"""Observability benchmark + gate (ISSUE r9, extended r10 + r11).
 
-Five checks, all CPU-safe:
+Six checks, all CPU-safe:
 
   * overhead — steps/s of an identical TrainStep loop with FLAGS_metrics on
                vs off; the acceptance bar is ON within OVERHEAD_TOLERANCE
@@ -22,11 +22,21 @@ Five checks, all CPU-safe:
                stay silent; an injected loss spike must produce exactly one
                anomaly-tagged flight dump that parses with the anomaly and
                the step ring inside.
+  * fleet_trace — (r11) fleet-wide distributed tracing gates: every
+               finished request's merged cross-replica chrome trace covers
+               >= 99% of its wall window with zero unparented spans (clean,
+               kill->re-dispatch, and hedge scenarios); the four fleet
+               detectors each fire on their injected fault and stay silent
+               on the clean run; an injected breaker flap produces a flight
+               dump embedding the router state AND merged traces; and
+               fleet serving with metrics+tracing ON keeps >= 97% of the
+               OFF throughput (best-of-5, interleaved arms, identical
+               outputs).
 
-Writes one JSON artifact (default OBSBENCH_r10.json at the repo root) and
+Writes one JSON artifact (default OBSBENCH_r11.json at the repo root) and
 exits nonzero when any check fails, so the verify pipeline can gate on it.
 
-Usage: python tools/obsbench.py [--steps N] [--out OBSBENCH_r10.json]
+Usage: python tools/obsbench.py [--steps N] [--out OBSBENCH_r11.json]
 """
 import argparse
 import json
@@ -345,10 +355,279 @@ def bench_anomaly_dump() -> dict:
         reset_all()
 
 
+# --------------------------------------------------------------------------
+# fleet tracing half (r11): merged-trace completeness, fleet detectors,
+# breaker-flap flight dump, and serve-path tracing overhead
+# --------------------------------------------------------------------------
+
+FLEET_COVERAGE_MIN = 0.99      # merged trace must cover >= 99% of wall time
+FLEET_OVERHEAD_RATIO = 0.97    # tracing ON keeps >= 97% of OFF throughput
+
+
+def bench_fleet_trace() -> dict:
+    import glob
+
+    import tools.cpu_force  # noqa: F401
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core import flags
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.observability import reset_all
+    from paddle_tpu.serving import FleetRouter, ServingEngine
+    from paddle_tpu.serving.fleet_observability import (
+        coverage_of,
+        unparented_spans,
+    )
+
+    mdir = tempfile.mkdtemp(prefix="ob_fleet_")
+    reset_all()
+    flags.set_flags({"metrics": "on", "metrics_dir": mdir,
+                     "fleet_anomaly": "on"})
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                    num_heads=2, hidden_dropout_prob=0.0,
+                    attention_dropout_prob=0.0)
+
+    def engine():
+        paddle.seed(0)
+        m = GPTForCausalLM(cfg)
+        m.eval()
+        return ServingEngine(m, max_slots=3, block_size=16,
+                             prefill_chunk=16)
+
+    def drive(router, freqs, skip_dead=True, max_iters=20000):
+        for _ in range(max_iters):
+            if all(f.done for f in freqs):
+                return
+            for rep in router.replicas.values():
+                if (not (skip_dead and rep._killed)
+                        and rep.engine.sched.has_work()):
+                    rep.engine.step()
+            router.poll()
+        raise AssertionError("fleet requests did not settle")
+
+    def prompts(seed, n, lo=4, hi=10):
+        rng = np.random.RandomState(seed)
+        return [[int(t) for t in rng.randint(0, cfg.vocab_size,
+                                             rng.randint(lo, hi))]
+                for _ in range(n)]
+
+    def trace_gate(router, freqs):
+        """Coverage + attribution for every finished request's merged
+        trace; returns (min_coverage, total_unparented)."""
+        cov, unp = 1.0, 0
+        for f in freqs:
+            payload = router.obs.trace_payload(f.request_id)
+            if payload is None:
+                return 0.0, -1
+            evs = payload["traceEvents"]
+            cov = min(cov, coverage_of(evs))
+            unp += len(unparented_spans(evs, f.request_id))
+        return cov, unp
+
+    result = {}
+    fired = set()
+    try:
+        # ---- clean run: full coverage, zero unparented, detectors silent
+        router = FleetRouter([engine(), engine()], lease_ttl_s=1000.0)
+        freqs = [router.submit(p, max_new_tokens=4)
+                 for p in prompts(0, 4)]
+        drive(router, freqs)
+        cov, unp = trace_gate(router, freqs)
+        result["clean"] = {
+            "requests": len(freqs), "min_coverage": round(cov, 4),
+            "unparented": unp,
+            "anomalies": len(router.obs.anomalies_recent(100)),
+        }
+        result["clean"]["ok"] = (cov >= FLEET_COVERAGE_MIN and unp == 0
+                                 and not router.obs.anomalies_recent(100))
+
+        # ---- kill -> re-dispatch: one merged waterfall across replicas
+        fake = [0.0]
+        router = FleetRouter([engine(), engine()], clock=lambda: fake[0],
+                             lease_ttl_s=1000.0)
+        freq = router.submit(prompts(1, 1)[0], max_new_tokens=6)
+        victim = freq.attempts[0].replica.rid
+        for _ in range(3):
+            router.replicas[victim].engine.step()
+        router.kill_replica(victim)
+        router.poll()
+        drive(router, [freq])
+        cov, unp = trace_gate(router, [freq])
+        causes = [a.kind for a in freq.attempts]
+        fired |= {e["kind"] for e in router.obs.anomalies_recent(100)}
+        result["redispatch"] = {
+            "causes": causes, "min_coverage": round(cov, 4),
+            "unparented": unp,
+            "ok": (causes == ["primary", "redispatch"]
+                   and cov >= FLEET_COVERAGE_MIN and unp == 0),
+        }
+
+        # ---- hedge: losing arm present + cancelled in the merged trace
+        fake = [0.0]
+        router = FleetRouter([engine(), engine()], clock=lambda: fake[0],
+                             lease_ttl_s=1000.0, hedge_ttft_ms=50.0)
+        freq = router.submit(prompts(2, 1)[0], max_new_tokens=6)
+        primary = freq.attempts[0].replica.rid
+        router.replicas[primary].engine.step()   # admitted, no token yet
+        fake[0] = 0.1                            # past the deadline
+        router.poll()                            # fires the hedge
+        hedge_rep = [r for r in router.replicas.values()
+                     if r.rid != primary][0]
+        for _ in range(20000):                   # only the hedge progresses
+            if freq.done:
+                break
+            if hedge_rep.engine.sched.has_work():
+                hedge_rep.engine.step()
+            router.poll()
+        cov, unp = trace_gate(router, [freq])
+        evs = router.obs.trace_payload(freq.request_id)["traceEvents"]
+        cancelled = [e for e in evs if e.get("ph") == "X"
+                     and (e.get("args") or {}).get("cancelled")]
+        fired |= {e["kind"] for e in router.obs.anomalies_recent(100)}
+        result["hedge"] = {
+            "hedged": freq.hedged, "min_coverage": round(cov, 4),
+            "unparented": unp, "cancelled_spans": len(cancelled),
+            "ok": (freq.hedged and freq.done and len(cancelled) > 0
+                   and cov >= FLEET_COVERAGE_MIN and unp == 0),
+        }
+
+        # ---- breaker flap: injected submit faults + cooldown cycling;
+        # the detector must fire AND dump a flight record embedding the
+        # router state and the recent requests' merged traces
+        fake = [0.0]
+        router = FleetRouter([engine(), engine()], clock=lambda: fake[0],
+                             lease_ttl_s=1000.0, breaker_errors=1,
+                             breaker_cooldown_s=0.1)
+        warm = [router.submit(p, max_new_tokens=3) for p in prompts(3, 2)]
+        drive(router, warm)                      # traces into the ring
+        r0 = router.replicas["replica-0"]
+        real_submit = r0.engine.submit
+
+        def bad_submit(*a, **kw):
+            raise RuntimeError("injected flap fault")
+
+        r0.engine.submit = bad_submit
+        flapping = []
+        for cycle in range(2):                   # open/half_open/open ...
+            flapping.append(router.submit(prompts(10 + cycle, 1)[0],
+                                          max_new_tokens=3))
+            fake[0] += 0.2                       # past the cooldown
+            router.poll()                        # open -> half_open event
+            flapping.append(router.submit(prompts(20 + cycle, 1)[0],
+                                          max_new_tokens=3))  # probe fails
+        r0.engine.submit = real_submit
+        drive(router, flapping)                  # detector fires mid-drive
+        fired |= {e["kind"] for e in router.obs.anomalies_recent(100)}
+        flap_dumps = sorted(glob.glob(
+            os.path.join(mdir, "flight", "*fleet_breaker_flap.json")))
+        flap = {"dumps": len(flap_dumps),
+                "transitions": len(router.obs._breaker_log)}
+        dump_ok = False
+        if flap_dumps:
+            with open(flap_dumps[0]) as f:
+                payload = json.load(f)           # a torn file raises here
+            rstate = payload.get("router") or {}
+            reqs = payload.get("fleet_requests") or []
+            flap["dump_replicas"] = sorted(rstate.get("replicas") or {})
+            dump_ok = (
+                payload.get("anomaly", {}).get("kind") == "breaker_flap"
+                and {"breaker", "load", "lease_age_s"} <= set(
+                    next(iter(rstate.get("replicas", {}).values()), {}))
+                and any(r.get("trace") for r in reqs)
+                and not glob.glob(os.path.join(mdir, "flight", "*.tmp")))
+        flap["ok"] = bool(flap_dumps) and dump_ok
+        result["breaker_flap"] = flap
+
+        # ---- replica skew: sustained p95-TTFT imbalance through the
+        # public record seam (the same path tick() feeds)
+        router = FleetRouter([engine(), engine()], lease_ttl_s=1000.0)
+        skew_fired = []
+        for s in range(12):
+            skew = 1.0 if s < 8 else 5.0
+            skew_fired += router.obs.observe_record({
+                "kind": "fleet_tick", "step": s, "hedge_rate": 0.0,
+                "redispatch_rate": 0.0, "breaker_flaps": 0.0,
+                "ttft_skew": skew})
+        fired |= {e["kind"] for e in skew_fired}
+        result["skew"] = {"fired": sorted({e["kind"] for e in skew_fired}),
+                          "ok": any(e["kind"] == "replica_skew"
+                                    for e in skew_fired)}
+        result["detectors_fired"] = sorted(fired)
+        result["detectors_ok"] = {
+            "hedge_rate_spike", "redispatch_storm", "breaker_flap",
+            "replica_skew"} <= fired
+
+        # ---- serve-path overhead: metrics+tracing ON vs OFF, best-of-5
+        # interleaved arms on the SAME warm fleet (jit caches shared), and
+        # the outputs must be bitwise identical across arms. The overhead
+        # fleet uses a wider model than the scenario fleets so each decode
+        # tick carries realistic compute — on a toy step the fixed cost of
+        # span recording would swamp the ratio with timer noise.
+        ocfg = GPTConfig(vocab_size=256, hidden_size=128, num_layers=3,
+                         num_heads=4, hidden_dropout_prob=0.0,
+                         attention_dropout_prob=0.0)
+
+        def overhead_engine():
+            paddle.seed(0)
+            m = GPTForCausalLM(ocfg)
+            m.eval()
+            return ServingEngine(m, max_slots=4, block_size=16,
+                                 prefill_chunk=16)
+
+        router = FleetRouter([overhead_engine(), overhead_engine()],
+                             lease_ttl_s=1000.0)
+        bench_prompts = prompts(4, 8, lo=6, hi=12)
+
+        def arm(metrics_on):
+            flags.set_flags({"metrics": "on" if metrics_on else "off"})
+            t0 = time.perf_counter()
+            fs = [router.submit(p, max_new_tokens=16)
+                  for p in bench_prompts]
+            drive(router, fs)
+            dt = time.perf_counter() - t0
+            return dt, [f.output_tokens for f in fs]
+
+        arm(True)                                # warm both paths
+        arm(False)
+        best = {"on": float("inf"), "off": float("inf")}
+        outs = {}
+        for _ in range(5):
+            for mode in ("on", "off"):
+                dt, toks = arm(mode == "on")
+                best[mode] = min(best[mode], dt)
+                outs.setdefault(mode, toks)
+        flags.set_flags({"metrics": "on"})
+        ratio = best["off"] / best["on"]         # ON throughput / OFF
+        result["overhead"] = {
+            "best_on_s": round(best["on"], 4),
+            "best_off_s": round(best["off"], 4),
+            "throughput_ratio": round(ratio, 4),
+            "floor": FLEET_OVERHEAD_RATIO,
+            "outputs_identical": outs["on"] == outs["off"],
+            "ok": (ratio >= FLEET_OVERHEAD_RATIO
+                   and outs["on"] == outs["off"]),
+        }
+
+        result["ok"] = bool(result["clean"]["ok"]
+                            and result["redispatch"]["ok"]
+                            and result["hedge"]["ok"]
+                            and result["breaker_flap"]["ok"]
+                            and result["skew"]["ok"]
+                            and result["detectors_ok"]
+                            and result["overhead"]["ok"])
+        return result
+    finally:
+        flags.set_flags({"metrics": "off", "metrics_dir": "",
+                         "fleet_anomaly": "auto"})
+        reset_all()
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=30)
-    ap.add_argument("--out", default=os.path.join(_REPO, "OBSBENCH_r10.json"))
+    ap.add_argument("--out", default=os.path.join(_REPO, "OBSBENCH_r11.json"))
     args = ap.parse_args()
 
     result = {"tool": "obsbench",
@@ -386,11 +665,22 @@ def main() -> int:
         result["anomaly"] = {"ok": False,
                              "error": f"{type(e).__name__}: {e}"}
     log(json.dumps(result["anomaly"]))
+    log("--- fleet tracing (merge completeness, detectors, overhead)")
+    try:
+        result["fleet_trace"] = bench_fleet_trace()
+    except Exception as e:
+        import traceback
+
+        traceback.print_exc()
+        result["fleet_trace"] = {"ok": False,
+                                 "error": f"{type(e).__name__}: {e}"}
+    log(json.dumps(result["fleet_trace"]))
 
     result["ok"] = bool(result["overhead"].get("ok")
                         and result["flight_sinks"].get("ok")
                         and result["straggler"].get("ok")
-                        and result["anomaly"].get("ok"))
+                        and result["anomaly"].get("ok")
+                        and result["fleet_trace"].get("ok"))
     with open(args.out, "w") as f:
         json.dump(result, f, indent=2)
     print(json.dumps(result), flush=True)

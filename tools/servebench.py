@@ -81,6 +81,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import sys
 import time
@@ -634,7 +635,7 @@ def _sim_fleet_arm(n_rep, slots, trace, step_cost, crash_at_s=None,
                     break
     else:
         raise AssertionError("fleet replay did not converge")
-    return freqs, v_first, crash_at_s
+    return router, freqs, v_first, crash_at_s
 
 
 def _fleet_arm_stats(freqs, v_first):
@@ -657,6 +658,35 @@ def _fleet_arm_stats(freqs, v_first):
             "hedged": sum(1 for f in freqs if f.hedged)}
 
 
+def _kill_arm_trace_gate(router, freqs):
+    """Merged-trace completeness for the (metrics-on) kill arm: every
+    re-dispatched or hedged request exports ONE merged chrome trace
+    spanning router + all attempted replicas — >=99% of its wall window
+    covered, zero unparented spans, and exactly one fleet.attempt lane
+    per attempt."""
+    from paddle_tpu.serving.fleet_observability import (
+        coverage_of, unparented_spans)
+
+    checked, min_cov, unparented, attempts_ok = 0, 1.0, 0, True
+    for f in freqs:
+        if not (f.redispatches or f.hedged):
+            continue
+        payload = router.obs.trace_payload(f.request_id)
+        if payload is None:
+            return {"traced": checked, "missing": f.request_id,
+                    "ok": False}
+        evs = payload["traceEvents"]
+        checked += 1
+        min_cov = min(min_cov, coverage_of(evs))
+        unparented += len(unparented_spans(evs, f.request_id))
+        lanes = sum(1 for e in evs if e.get("name") == "fleet.attempt")
+        attempts_ok = attempts_ok and lanes == len(f.attempts)
+    return {"traced": checked, "min_coverage": round(min_cov, 4),
+            "unparented": unparented, "attempts_match": attempts_ok,
+            "ok": (checked > 0 and min_cov >= 0.99 and unparented == 0
+                   and attempts_ok)}
+
+
 def _run_fleet_workload(n, slots, min_goodput_ratio, p99_ttft_gate):
     """Fleet robustness + scaling bench: the SAME saturation trace
     against one replica, FLEET_REPLICAS clean replicas (parity oracle +
@@ -667,6 +697,8 @@ def _run_fleet_workload(n, slots, min_goodput_ratio, p99_ttft_gate):
     single replica wins the difference back by batching wider — the
     goodput ratio only measures capacity when every replica's slots stay
     full. Returns (row, ok)."""
+    from paddle_tpu.core import flags as _flags
+
     n = max(n, 6 * slots * FLEET_REPLICAS)
     trace = _trace(n, FLEET_RPS, seed=5)
     step_cost = _calibrate_step_costs(slots)
@@ -680,10 +712,21 @@ def _run_fleet_workload(n, slots, min_goodput_ratio, p99_ttft_gate):
         kw = {}
         if kill:
             # crash deep enough into the run that replica-0 holds
-            # in-flight work (span measured off the clean fleet arm)
+            # in-flight work (span measured off the clean fleet arm);
+            # the kill arm runs metrics-ON so every re-dispatch exports
+            # a merged cross-replica trace (gated below) — tracing must
+            # not perturb outputs, which outputs_identical_after_kill
+            # already proves against the metrics-off clean arm.
             kw = {"crash_at_s": FLEET_KILL_FRAC * clean_span}
-        freqs, v_first, k_at = _sim_fleet_arm(n_rep, slots, trace,
-                                              step_cost, **kw)
+            _flags.set_flags({"metrics": "on",
+                              "fleet_flight_requests": n + 64})
+        try:
+            router, freqs, v_first, k_at = _sim_fleet_arm(
+                n_rep, slots, trace, step_cost, **kw)
+        finally:
+            if kill:
+                _flags.set_flags({"metrics": "off",
+                                  "fleet_flight_requests": 64})
         arms[name] = _fleet_arm_stats(freqs, v_first)
         arms[name]["accepted"] = len(freqs)
         outs[name] = [f.output_tokens for f in freqs]
@@ -691,6 +734,7 @@ def _run_fleet_workload(n, slots, min_goodput_ratio, p99_ttft_gate):
             clean_span = arms[name]["span_s"]
         if kill:
             killed_at = k_at
+            trace_gate = _kill_arm_trace_gate(router, freqs)
 
     ok_lost = (arms["fleet_kill"].get("completed") == n
                and arms["fleet_kill"]["accepted"] == n)
@@ -701,7 +745,8 @@ def _run_fleet_workload(n, slots, min_goodput_ratio, p99_ttft_gate):
     p99 = arms["fleet_kill"].get("ttft_p99_s")
     ok = (ok_lost and bool(identical)
           and ratio is not None and ratio >= min_goodput_ratio
-          and p99 is not None and p99 <= p99_ttft_gate)
+          and p99 is not None and p99 <= p99_ttft_gate
+          and trace_gate["ok"])
     row = {"workload": "fleet", "replicas": FLEET_REPLICAS,
            "load_rps": FLEET_RPS, "requests": n, "slots": slots,
            "virtual_time": True,
@@ -712,6 +757,7 @@ def _run_fleet_workload(n, slots, min_goodput_ratio, p99_ttft_gate):
            "fleet_kill": arms["fleet_kill"],
            "zero_lost_after_kill": bool(ok_lost),
            "outputs_identical_after_kill": bool(identical),
+           "kill_trace": trace_gate,
            "goodput_ratio": ratio,
            "min_goodput_ratio": min_goodput_ratio,
            "p99_ttft_gate_s": p99_ttft_gate, "ok": ok}
@@ -813,7 +859,8 @@ def _run_obs_workload(model, n, slots, min_ratio=0.97):
             h = reg.get(metric)
             slo[key] = {
                 f"p{int(q * 100)}": (round(v, 5) if (v := h.quantile(
-                    q, tier="default")) is not None else None)
+                    q, tier="default")) is not None
+                    and not math.isnan(v) else None)
                 for q in (0.50, 0.95, 0.99)}
         parsed = _sinks.parse_prometheus_text(_sinks.prometheus_text(reg))
         series = {name for name, _ in parsed}
@@ -869,7 +916,7 @@ def _run_obs_workload(model, n, slots, min_ratio=0.97):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=os.path.join(_REPO,
-                                                  "SERVEBENCH_r17.json"))
+                                                  "SERVEBENCH_r19.json"))
     ap.add_argument("--requests", type=int, default=48)
     ap.add_argument("--slots", type=int, default=8)
     ap.add_argument("--min-speedup", type=float, default=1.5,

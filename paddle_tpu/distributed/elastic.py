@@ -164,6 +164,14 @@ class ElasticMembership:
             heartbeat_s if heartbeat_s is not None
             else get_flag("elastic_heartbeat_s"))
         self._clock = clock
+        # observer-side lease state (same scheme as ReplicaRegistry):
+        # heartbeat values are opaque change tokens aged on THIS member's
+        # clock from last observed change — writer clocks never enter the
+        # comparison, so leases survive real process boundaries and NTP
+        # wall-clock steps alike.
+        self._hb_lock = threading.Lock()
+        self._hb_seen: Dict[int, tuple] = {}
+        self._hb_seq = 0
         self._view_lock = threading.RLock()
         self.view = MembershipView(0, members)
         self.changes: List[dict] = []     # adopted views, newest last
@@ -187,18 +195,30 @@ class ElasticMembership:
 
     # -- liveness -----------------------------------------------------------
     def heartbeat(self) -> None:
-        self.store.set(self._k("hb", self.member_id),
-                       json.dumps({"m": self.member_id,
-                                   "t": self._clock()}))
+        """Renew this member's lease. The "n" sequence makes the value
+        change every beat (frozen test clocks included); "t" is kept for
+        humans reading store dumps, not for age computation."""
+        with self._hb_lock:
+            self._hb_seq += 1
+            raw = json.dumps({"m": self.member_id, "n": self._hb_seq,
+                              "t": self._clock()}).encode()
+            self._hb_seen[self.member_id] = (raw, self._clock())
+        self.store.set(self._k("hb", self.member_id), raw)
 
     def heartbeat_age(self, member: int) -> float:
+        """Local monotonic seconds since this member last saw `member`'s
+        heartbeat value change (0.0 on first sight: a lease is granted
+        from first observation); inf when it never heartbeat."""
         raw = self.store.get(self._k("hb", member), blocking=False)
         if raw is None:
             return float("inf")
-        try:
-            return max(0.0, self._clock() - float(json.loads(raw)["t"]))
-        except (ValueError, KeyError):
-            return float("inf")
+        now = self._clock()
+        with self._hb_lock:
+            seen = self._hb_seen.get(int(member))
+            if seen is None or seen[0] != bytes(raw):
+                self._hb_seen[int(member)] = (bytes(raw), now)
+                return 0.0
+            return max(0.0, now - seen[1])
 
     def has_left(self, member: int) -> bool:
         return self.store.get(self._k("left", member),
@@ -261,11 +281,9 @@ class ElasticMembership:
         """Members in the join log that are not in the current view and
         are heartbeating. The log is an append-only counter + entries, so
         no two joiners can clobber each other."""
-        raw = self.store.get(self._k("join_seq"), blocking=False)
-        try:
-            seq = int(raw) if raw is not None else 0
-        except ValueError:
-            seq = 0
+        # add(key, 0) is the cross-store atomic counter read (the native
+        # TCPStore packs counters as int64 — get() is not portable)
+        seq = self.store.add(self._k("join_seq"), 0)
         out = []
         for i in range(1, seq + 1):
             raw = self.store.get(self._k("join", i), blocking=False)

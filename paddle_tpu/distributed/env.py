@@ -197,6 +197,15 @@ class ReplicaRegistry:
         self.store = store
         self.prefix = prefix.rstrip("/")
         self._clock = clock
+        # observer-side lease state: heartbeat VALUES are opaque change
+        # tokens; age is measured on THIS reader's clock from the moment
+        # the value was last seen to change. Writer clocks never enter
+        # the comparison, so leases work across processes (monotonic
+        # clocks are per-process) and an NTP wall-clock step cannot
+        # mass-expire every lease.
+        self._hb_lock = threading.Lock()
+        self._hb_seen: Dict[str, tuple] = {}  # rid -> (raw, local first-seen)
+        self._hb_seq = 0
 
     def _k(self, *parts: str) -> str:
         return "/".join((self.prefix,) + parts)
@@ -216,8 +225,10 @@ class ReplicaRegistry:
     def replicas(self, include_left: bool = False) -> List[str]:
         """Registered replica ids in registration order (re-registration
         keeps the original position)."""
-        raw = self.store.get(self._k("seq"), blocking=False)
-        n = int(raw) if raw else 0
+        # add(key, 0) is the cross-store atomic counter read: InProcStore
+        # mirrors counters as text but the native TCPStore packs them as
+        # int64, so get() on a counter key is not portable
+        n = self.store.add(self._k("seq"), 0)
         seen, out = set(), []
         for i in range(1, n + 1):
             rid = self.store.get(self._k("entry", str(i)), blocking=False)
@@ -241,13 +252,31 @@ class ReplicaRegistry:
 
     # -- liveness ----------------------------------------------------------
     def heartbeat(self, replica_id: str) -> None:
-        self.store.set(self._k("hb", replica_id), repr(self._clock()))
+        """Renew the lease. The value embeds a per-registry sequence so it
+        CHANGES on every beat even under a frozen injected clock; the
+        writer also primes its own observer cache at write time, so a
+        registry that both heartbeats and reads (thread-replica fleets)
+        ages the lease from the last write exactly as before."""
+        with self._hb_lock:
+            self._hb_seq += 1
+            raw = f"{self._hb_seq}:{self._clock():.9f}".encode()
+            self._hb_seen[str(replica_id)] = (raw, self._clock())
+        self.store.set(self._k("hb", replica_id), raw)
 
     def heartbeat_age(self, replica_id: str) -> float:
+        """Local monotonic seconds since this reader last saw the
+        replica's heartbeat value change (0.0 on first sight — a lease is
+        granted from first observation); inf when it never heartbeat."""
         raw = self.store.get(self._k("hb", replica_id), blocking=False)
         if raw is None:
             return float("inf")
-        return self._clock() - float(raw)
+        now = self._clock()
+        with self._hb_lock:
+            seen = self._hb_seen.get(str(replica_id))
+            if seen is None or seen[0] != raw:
+                self._hb_seen[str(replica_id)] = (raw, now)
+                return 0.0
+            return max(0.0, now - seen[1])
 
     def alive(self, replica_id: str, lease_ttl_s: float) -> bool:
         return (not self.has_left(replica_id)

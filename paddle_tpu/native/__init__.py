@@ -18,8 +18,10 @@ from __future__ import annotations
 import ctypes
 import hashlib
 import os
+import struct
 import subprocess
 import threading
+import time
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC_DIR = os.path.join(_HERE, "src")
@@ -175,7 +177,18 @@ class TCPStore:
     API mirrors the reference store semantics: set/get are byte-valued,
     add() is an atomic counter, wait() blocks until a key exists, and
     barrier() is an add + wait-ge rendezvous.
+
+    The blocking entry points (get / wait_ge / barrier) take the same
+    ``timeout_s`` keyword as distributed.env.InProcStore and raise
+    TimeoutError with the same diagnostics — the two stores are
+    interchangeable behind one contract (tests/test_store_contract.py).
+    Timeouts are implemented client-side by polling the non-blocking
+    primitives: the C++ server parks blocking requests forever, and a
+    parked request cannot be cancelled without tearing down the
+    connection, so the wrapper never issues an unbounded blocking RPC.
     """
+
+    _POLL_S = 0.005  # client-side poll interval for timed blocking ops
 
     def __init__(self, host: str, port: int, *, is_master: bool = False,
                  world_size: int = 1, timeout_s: float = 60.0,
@@ -218,8 +231,6 @@ class TCPStore:
                 self._server = None
             raise RuntimeError(
                 f"TCPStore: cannot connect to {host}:{port}") from e
-        self._barrier_gen = 0
-        self._named_barrier_gen: dict[str, int] = {}
 
     def set(self, key: str, value: bytes | str) -> None:
         if isinstance(value, str):
@@ -229,22 +240,37 @@ class TCPStore:
         if rc != 0:
             raise RuntimeError("TCPStore.set failed")
 
-    def get(self, key: str, *, blocking: bool = True) -> bytes | None:
+    def _get_once(self, key: str) -> bytes | None:
+        """One non-blocking fetch; None when the key is missing."""
         cap = 1 << 20
         while True:
             buf = ctypes.create_string_buffer(cap)
-            n = self._lib.pt_store_get(self._client, key.encode(), buf, cap,
-                                       1 if blocking else 0)
+            n = self._lib.pt_store_get(self._client, key.encode(), buf, cap, 0)
             if n == -2:
                 return None
             if n < 0:
                 raise RuntimeError("TCPStore.get failed")
             if n <= cap:
                 return buf.raw[: int(n)]
-            # value larger than the buffer: refetch non-blocking (the key
-            # exists now) with an exactly-sized buffer
+            # value larger than the buffer: refetch with an exactly-sized
+            # buffer (the key exists now)
             cap = int(n)
-            blocking = False
+
+    def get(self, key: str, *, blocking: bool = True,
+            timeout_s: float = 60.0) -> bytes | None:
+        v = self._get_once(key)
+        if v is not None or not blocking:
+            return v
+        deadline = time.monotonic() + float(timeout_s)
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(f"TCPStore.get({key!r}) timed out "
+                                   f"after {float(timeout_s):g}s")
+            time.sleep(min(self._POLL_S, max(remaining, 0.0)))
+            v = self._get_once(key)
+            if v is not None:
+                return v
 
     def add(self, key: str, delta: int = 1) -> int:
         v = self._lib.pt_store_add(self._client, key.encode(), delta)
@@ -252,11 +278,33 @@ class TCPStore:
             raise RuntimeError("TCPStore.add failed")
         return int(v)
 
-    def wait_ge(self, key: str, target: int) -> int:
-        v = self._lib.pt_store_wait_ge(self._client, key.encode(), target)
-        if v == -1:
-            raise RuntimeError("TCPStore.wait_ge failed")
-        return int(v)
+    def _counter(self, key: str) -> int:
+        """Read a counter without creating it: counters are stored as one
+        packed native int64 (tcp_store.cc kAdd); a missing key is 0."""
+        raw = self._get_once(key)
+        if raw is None:
+            return 0
+        if len(raw) == 8:
+            return int(struct.unpack("<q", raw)[0])
+        try:  # a set() may have overwritten the counter with text
+            return int(raw.decode())
+        except (UnicodeDecodeError, ValueError):
+            return 0
+
+    def wait_ge(self, key: str, target: int, *,
+                timeout_s: float = 60.0) -> int:
+        deadline = time.monotonic() + float(timeout_s)
+        while True:
+            cur = self._counter(key)
+            if cur >= int(target):
+                return cur
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"TCPStore.wait_ge({key!r}, {target}) timed out "
+                    f"after {float(timeout_s):g}s: counter at {cur}, "
+                    f"{int(target) - cur} arrival(s) never happened")
+            time.sleep(min(self._POLL_S, max(remaining, 0.0)))
 
     def delete(self, key: str) -> None:
         self._lib.pt_store_delete(self._client, key.encode())
@@ -265,18 +313,40 @@ class TCPStore:
         return int(self._lib.pt_store_num_keys(self._client))
 
     def barrier(self, name: str | None = None,
-                world_size: int | None = None) -> None:
-        world = world_size or self.world_size
+                world_size: int | None = None, *,
+                rank: int | None = None,
+                timeout_s: float = 60.0) -> None:
+        """Rendezvous of `world_size` callers. Client-STATELESS wave
+        counting (same scheme as InProcStore.barrier): the n-th arrival
+        belongs to wave ceil(n/world) and waits for that wave to fill, so
+        a reused name re-rendezvouses correctly and a reconnected client
+        carries no barrier generation to lose.
+
+        With `rank` given, a timeout names the ranks whose arrival key
+        never appeared for this wave instead of just "timed out"."""
+        world = int(world_size or self.world_size)
         if name is None:
-            name = f"__anon_{self._barrier_gen}"
-            self._barrier_gen += 1
-        # A reused name must rendezvous again: every rank tracks how many
-        # times it has hit this barrier and waits for world * generation.
-        gen = self._named_barrier_gen.get(name, 0) + 1
-        self._named_barrier_gen[name] = gen
-        key = f"/barrier/{name}"
-        self.add(key, 1)
-        self.wait_ge(key, world * gen)
+            name = "__anon"
+        n = self.add(f"/barrier/{name}", 1)
+        wave = (n + world - 1) // world
+        if rank is not None:
+            self.set(f"/barrier/{name}/w{wave}/r{int(rank)}", b"1")
+        try:
+            self.wait_ge(f"/barrier/{name}", world * wave,
+                         timeout_s=timeout_s)
+        except TimeoutError:
+            arrived = self._counter(f"/barrier/{name}") - world * (wave - 1)
+            msg = (f"TCPStore.barrier({name!r}) timed out after "
+                   f"{float(timeout_s):g}s: {arrived}/{world} callers "
+                   f"arrived in wave {wave}")
+            if rank is not None:
+                missing = [r for r in range(world)
+                           if self._get_once(
+                               f"/barrier/{name}/w{wave}/r{r}") is None]
+                if missing:
+                    msg += (f"; ranks whose arrival key never appeared: "
+                            f"{missing}")
+            raise TimeoutError(msg) from None
 
     def close(self) -> None:
         if getattr(self, "_client", None):

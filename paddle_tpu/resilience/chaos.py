@@ -24,6 +24,8 @@ __all__ = [
     "fake_preemption", "stats", "reset_stats", "scope",
     "kill_rank", "should_kill_rank", "note_rank_killed",
     "slow_rank", "rank_delay",
+    "kill_process", "hang_process", "resume_process", "sigstop_supported",
+    "StorePartitionProxy",
 ]
 
 
@@ -47,6 +49,10 @@ stats = {
     "workers_killed": 0,
     "signals_sent": 0,
     "ranks_killed": 0,
+    "processes_killed": 0,
+    "processes_hung": 0,
+    "processes_resumed": 0,
+    "partitions_started": 0,
 }
 
 
@@ -173,6 +179,180 @@ def fake_preemption(sig: int = _signal.SIGTERM):
     PreemptionHandler exactly like a TPU maintenance-event SIGTERM."""
     stats["signals_sent"] += 1
     os.kill(os.getpid(), sig)
+
+
+def _pid_of(proc_or_pid) -> int:
+    return int(getattr(proc_or_pid, "pid", proc_or_pid))
+
+
+def sigstop_supported() -> bool:
+    """Can this platform hard-freeze a process (SIGSTOP/SIGCONT)? The
+    faultbench hang scenarios skip gracefully where it can't."""
+    return (os.name == "posix" and hasattr(_signal, "SIGSTOP")
+            and hasattr(_signal, "SIGCONT"))
+
+
+def kill_process(proc_or_pid):
+    """SIGKILL a real OS process (process replica / elastic rank child):
+    no cleanup handlers run, heartbeats simply stop — the genuine article
+    the thread-level kill_rank/kill() only simulate."""
+    os.kill(_pid_of(proc_or_pid), _signal.SIGKILL)
+    stats["processes_killed"] += 1
+
+
+def hang_process(proc_or_pid):
+    """SIGSTOP a real OS process: still alive by waitpid (no exit code)
+    but silent — heartbeats freeze, so only lease expiry can declare it
+    dead. Pair with resume_process() to wake the zombie and exercise
+    fence-token rejection."""
+    if not sigstop_supported():
+        raise RuntimeError("SIGSTOP/SIGCONT not supported on this platform")
+    os.kill(_pid_of(proc_or_pid), _signal.SIGSTOP)
+    stats["processes_hung"] += 1
+
+
+def resume_process(proc_or_pid):
+    """SIGCONT a hung process — the revived zombie must fence itself out
+    (see serving/fleet_proc.py) rather than serve stale state."""
+    if not sigstop_supported():
+        raise RuntimeError("SIGSTOP/SIGCONT not supported on this platform")
+    os.kill(_pid_of(proc_or_pid), _signal.SIGCONT)
+    stats["processes_resumed"] += 1
+
+
+class StorePartitionProxy:
+    """Network-partition shim for one store member: a real TCP forwarding
+    proxy a victim's TCPStore client connects THROUGH, so its store
+    traffic can be stalled (held, delivered after heal — the classic
+    partition) or dropped (connections severed) for a window without
+    touching the process itself. Lease expiry and the supervisor's
+    heal-without-respawn grace path get exercised with everyone alive.
+
+    Pure stdlib sockets + threads; forwarding is byte-level so it works
+    for any store protocol."""
+
+    def __init__(self, upstream_host: str, upstream_port: int,
+                 listen_host: str = "127.0.0.1"):
+        import socket
+
+        self.upstream = (str(upstream_host), int(upstream_port))
+        self._gate = threading.Event()   # set = traffic flows
+        self._gate.set()
+        self._mode = "stall"
+        self._open = True
+        self._conns = []                 # live socket pairs, for drop mode
+        self._conns_lock = threading.Lock()
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((listen_host, 0))
+        self._srv.listen(16)
+        self.host, self.port = self._srv.getsockname()[:2]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="chaos-partition-accept",
+            daemon=True)
+        self._accept_thread.start()
+
+    # -- forwarding ---------------------------------------------------------
+    def _accept_loop(self):
+        import socket
+
+        while self._open:
+            try:
+                cli, _ = self._srv.accept()
+            except OSError:
+                return
+            if not self._open:
+                cli.close()
+                return
+            try:
+                up = socket.create_connection(self.upstream, timeout=10)
+            except OSError:
+                cli.close()
+                continue
+            with self._conns_lock:
+                self._conns.append((cli, up))
+            for a, b in ((cli, up), (up, cli)):
+                threading.Thread(target=self._pump, args=(a, b),
+                                 name="chaos-partition-pump",
+                                 daemon=True).start()
+
+    def _pump(self, src, dst):
+        try:
+            while True:
+                data = src.recv(65536)
+                if not data:
+                    break
+                # the partition gate: while down, bytes are HELD here
+                # (stall mode) — delivered when the partition heals, like
+                # a switch buffering across a link flap
+                while not self._gate.wait(timeout=0.5):
+                    if not self._open:
+                        return
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            for s in (src, dst):
+                try:
+                    s.shutdown(2)
+                except OSError:
+                    pass
+
+    # -- chaos controls -----------------------------------------------------
+    def partition(self, duration_s: float = 0.0, mode: str = "stall"):
+        """Cut the victim's store traffic. mode="stall" holds bytes until
+        heal(); mode="drop" severs every live connection (a client with a
+        single persistent socket sees hard errors). duration_s > 0 arms a
+        timer that heals automatically."""
+        if mode not in ("stall", "drop"):
+            raise ValueError(f"unknown partition mode {mode!r}")
+        self._mode = mode
+        stats["partitions_started"] += 1
+        self._gate.clear()
+        if mode == "drop":
+            with self._conns_lock:
+                conns, self._conns = self._conns, []
+            for cli, up in conns:
+                for s in (cli, up):
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+        if duration_s > 0:
+            t = threading.Timer(float(duration_s), self.heal)
+            t.daemon = True
+            t.start()
+
+    def heal(self):
+        """Restore traffic (held bytes from a stall flush through)."""
+        self._gate.set()
+
+    @property
+    def partitioned(self) -> bool:
+        return not self._gate.is_set()
+
+    def close(self):
+        self._open = False
+        self._gate.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._conns_lock:
+            conns, self._conns = self._conns, []
+        for cli, up in conns:
+            for s in (cli, up):
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
 
 
 class scope:

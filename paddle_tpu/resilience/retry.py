@@ -126,6 +126,13 @@ class RetryPolicy:
         but want this policy's pacing."""
         self._sleep(self._jittered(self.delay_for(attempt)))
 
+    def jittered_delay(self, attempt: int) -> float:
+        """The jittered backoff for `attempt` WITHOUT sleeping — for
+        callers that schedule recovery on their own event loop (e.g. the
+        fleet replica supervisor arming a respawn deadline) rather than
+        blocking a thread on it."""
+        return self._jittered(self.delay_for(attempt))
+
     def wrap(self, fn: Callable) -> Callable:
         """Decorator form: `resilient_fn = policy.wrap(fn)`."""
 

@@ -37,6 +37,12 @@ This package replaces that with the vLLM/TPU-serving shape:
                    replicas (bitwise-identical greedy output), hedged
                    retries past a TTFT deadline, graceful drain, and
                    fleet-level load shedding with jittered Retry-After.
+  * fleet_proc.py — process-granularity replicas: each replica is a
+                   supervised OS subprocess (own model + engine + HTTP
+                   server) spoken to over the server.py wire protocol;
+                   crash/hang/zombie survival via waitpid + heartbeat-
+                   lease death detection, capped+jittered respawn, a
+                   warm-up routing gate, and incarnation fence tokens.
   * speculative.py — draft-model-free self-speculation: n-gram prompt-
                    lookup drafting from each request's own history plus
                    the per-request adaptive-k throttle; the engine
@@ -80,6 +86,12 @@ from .fleet_observability import (  # noqa: F401
     FleetObservability,
     export_fleet_trace,
 )
+from .fleet_proc import (  # noqa: F401
+    ProcessReplica,
+    ProcessReplicaSpec,
+    build_process_fleet,
+    wait_fleet_ready,
+)
 from .server import FleetServer, ServingServer  # noqa: F401
 
 __all__ = [
@@ -93,6 +105,8 @@ __all__ = [
     "NgramDrafter",
     "PagedKVPool",
     "PagedLayerCache",
+    "ProcessReplica",
+    "ProcessReplicaSpec",
     "QueueFullError",
     "Replica",
     "Request",
@@ -103,6 +117,8 @@ __all__ = [
     "ServingServer",
     "SpecState",
     "build_fleet",
+    "build_process_fleet",
     "export_fleet_trace",
+    "wait_fleet_ready",
     "export_request_trace",
 ]

@@ -33,6 +33,7 @@ over a TCPStore unchanged):
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Dict, List, Optional
@@ -249,7 +250,13 @@ class Replica:
     """One ServingEngine plus its loop thread, heartbeat lease, breaker,
     and drain flag. kill() simulates a crash (loop exits, heartbeats
     stop, nothing cleaned up); pause() simulates a hang (loop alive and
-    heartbeating but not stepping — the hedging target)."""
+    heartbeating but not stepping — the hedging target).
+
+    Subclasses with real isolation (serving/fleet_proc.ProcessReplica)
+    override the lifecycle + liveness surface: dead(), warming(),
+    supervise() and the routing probes. The router only ever talks to
+    this interface, so in-proc threads and supervised OS processes ride
+    the same `_place()` path."""
 
     def __init__(self, rid: str, engine: ServingEngine, *,
                  registry: ReplicaRegistry, heartbeat_s: float,
@@ -261,6 +268,13 @@ class Replica:
         self.heartbeat_s = float(heartbeat_s)
         self.breaker = breaker
         self.draining = False
+        # supervision surface (constant for thread replicas; live for
+        # process replicas): incarnation fence, host pid, respawn count,
+        # last exit record {incarnation, pid, exit_code, reason, ...}
+        self.incarnation = 0
+        self.pid: Optional[int] = os.getpid()
+        self.respawns = 0
+        self.last_exit: Optional[dict] = None
         self._clock = clock
         self._idle_sleep_s = float(idle_sleep_s)
         self._stop = threading.Event()
@@ -296,6 +310,28 @@ class Replica:
 
     def loop_alive(self) -> bool:
         return self._thread is not None and self._thread.is_alive()
+
+    # -- liveness / supervision (overridden by ProcessReplica) -------------
+    def dead(self, lease_ttl_s: float) -> bool:
+        """Is this replica dead right now? Thread replicas die when
+        killed, when their loop thread exited, or when their store lease
+        lapsed."""
+        if self._killed:
+            return True
+        if self._thread is not None and not self._thread.is_alive():
+            return True
+        return not self.registry.alive(self.rid, float(lease_ttl_s))
+
+    def warming(self) -> bool:
+        """True while the replica exists but must not take traffic yet
+        (a respawned process incarnation before its warm-up probe)."""
+        return False
+
+    def supervise(self, router: "FleetRouter") -> None:
+        """One supervision turn, called from every router poll. Thread
+        replicas have no supervisor (a dead thread stays dead); process
+        replicas detect death, run the backoff/fence/respawn state
+        machine here."""
 
     def _loop(self):
         hb_last = -float("inf")
@@ -340,7 +376,8 @@ class FleetRouter:
     and sheds. Replica engine loops and the monitor are daemon threads
     owned by the router (start()/stop())."""
 
-    def __init__(self, engines: List[ServingEngine], *,
+    def __init__(self, engines: Optional[List[ServingEngine]] = None, *,
+                 replica_specs: Optional[List] = None,
                  store=None, prefix: str = "/pt/fleet",
                  hedge_ttft_ms: Optional[float] = None,
                  breaker_errors: Optional[int] = None,
@@ -348,8 +385,11 @@ class FleetRouter:
                  heartbeat_s: float = 0.05, lease_ttl_s: float = 0.5,
                  poll_interval_s: float = 0.02,
                  idle_sleep_s: float = 0.002, clock=time.monotonic):
-        if not engines:
-            raise ValueError("FleetRouter needs at least one engine")
+        engines = list(engines or [])
+        replica_specs = list(replica_specs or [])
+        if not engines and not replica_specs:
+            raise ValueError("FleetRouter needs at least one engine or "
+                             "replica spec")
         self._clock = clock
         self.lease_ttl_s = float(lease_ttl_s)
         self.poll_interval_s = float(poll_interval_s)
@@ -375,6 +415,18 @@ class FleetRouter:
             self.replicas[rid] = rep
             self.registry.register(rid, meta={
                 "slots": eng.max_slots, "blocks": eng.num_blocks})
+        # process-isolated replicas: each spec builds a Replica subclass
+        # (serving/fleet_proc.ProcessReplicaSpec -> ProcessReplica) that
+        # rides the same _place()/poll() path as the thread replicas
+        for j, spec in enumerate(replica_specs):
+            rid = f"replica-{len(engines) + j}"
+            rep = spec.build(rid, registry=self.registry,
+                             heartbeat_s=heartbeat_s,
+                             breaker=CircuitBreaker(max_errors, cooldown,
+                                                    clock=clock),
+                             clock=clock, idle_sleep_s=idle_sleep_s)
+            self.replicas[rid] = rep
+            self.registry.register(rid, meta={"kind": "process"})
         self._inflight: Dict[str, FleetRequest] = {}
         self._lock = threading.RLock()
         self._stop = threading.Event()
@@ -415,17 +467,13 @@ class FleetRouter:
 
     # -- health ------------------------------------------------------------
     def replica_dead(self, rep: Replica) -> bool:
-        if rep._killed:
-            return True
-        if rep._thread is not None and not rep._thread.is_alive():
-            return True
-        return not self.registry.alive(rep.rid, self.lease_ttl_s)
+        return rep.dead(self.lease_ttl_s)
 
     def routable(self, rep: Replica) -> bool:
         """May NEW work be placed on this replica right now? (Breaker
         half-open counts: allow() hands out the probe token at submit.)"""
         return (not self.replica_dead(rep) and not rep.draining
-                and rep.breaker.state != "open")
+                and not rep.warming() and rep.breaker.state != "open")
 
     def _breaker_event(self, rep: Replica):
         """Surface a breaker state change as an observability event.
@@ -561,9 +609,15 @@ class FleetRouter:
 
     # -- monitor pass (public so tests can drive it deterministically) -----
     def poll(self):
-        """One supervision pass: refresh health, settle finished
-        requests, re-dispatch orphans of dead replicas, resolve and fire
-        hedges."""
+        """One supervision pass: refresh health, run each replica's
+        supervisor turn (death detection / respawn state machine for
+        process replicas; no-op for threads), settle finished requests,
+        re-dispatch orphans of dead replicas, resolve and fire hedges."""
+        for rep in list(self.replicas.values()):
+            try:
+                rep.supervise(self)
+            except Exception:  # noqa: BLE001 — supervision must survive
+                pass
         self._refresh_health_gauges()
         now = self._clock()
         with self._lock:
@@ -767,6 +821,11 @@ class FleetRouter:
                 snap["breaker"] = rep.breaker.state
                 snap["dead"] = dead
                 snap["draining"] = rep.draining
+                snap["warming"] = rep.warming()
+                snap["incarnation"] = rep.incarnation
+                snap["pid"] = rep.pid
+                snap["respawns"] = rep.respawns
+                snap["last_exit"] = rep.last_exit
                 out[rid] = snap
                 if self.routable(rep):
                     ok_any = True
@@ -783,6 +842,11 @@ class FleetRouter:
                 s["breaker"] = rep.breaker.state
                 s["draining"] = rep.draining
                 s["dead"] = self.replica_dead(rep)
+                s["warming"] = rep.warming()
+                s["incarnation"] = rep.incarnation
+                s["pid"] = rep.pid
+                s["respawns"] = rep.respawns
+                s["last_exit"] = rep.last_exit
                 reps[rid] = s
             return {"inflight": len(self._inflight), "replicas": reps}
 

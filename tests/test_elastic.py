@@ -229,7 +229,8 @@ class TestMembership:
         ms = _mk_members(store, [0, 1], lambda: fake[0])
         before = registry.REGISTRY.get(
             "elastic_membership_changes_total").value(kind="shrink")
-        fake[0] = 5.0
+        assert ms[0].poll() is None  # observe steady state: leases age from
+        fake[0] = 5.0                # first observation, not writer clocks
         ms[0].heartbeat()
         ms[0].poll()
         assert ms[0].changes[-1]["lost"] == [1]

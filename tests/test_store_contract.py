@@ -1,0 +1,229 @@
+"""Store contract suite (ISSUE r20 satellite): the SAME behavioral tests
+run over distributed.env.InProcStore and the native socket TCPStore, so
+every consumer (checkpoint commit barriers, replica registries, elastic
+membership, the process fleet) can treat "a store" as one thing.
+
+Plus the lease-clock audit regressions: heartbeat leases are aged on the
+OBSERVER's monotonic clock from the last observed value change — writer
+clocks never enter the comparison, so a wall-clock NTP step (or a frozen
+injected test clock) on either side can neither kill a live lease nor
+keep a dead one alive.
+"""
+import threading
+import time
+
+import pytest
+
+from paddle_tpu import native
+from paddle_tpu.distributed.env import InProcStore, ReplicaRegistry
+
+STORES = ["inproc", "tcp"]
+
+
+def _make_store(kind):
+    if kind == "inproc":
+        return InProcStore(world_size=1)
+    if not native.available():
+        pytest.skip("native TCPStore library unavailable")
+    return native.TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+
+
+@pytest.fixture(params=STORES)
+def store(request):
+    s = _make_store(request.param)
+    yield s
+    s.close()
+
+
+class TestStoreContract:
+    def test_set_get_roundtrip(self, store):
+        store.set("/c/a", b"bytes-value")
+        assert store.get("/c/a", blocking=False) == b"bytes-value"
+        store.set("/c/b", "str-value")          # str values are encoded
+        assert store.get("/c/b", blocking=False) == b"str-value"
+        store.set("/c/a", b"overwritten")
+        assert store.get("/c/a", blocking=False) == b"overwritten"
+
+    def test_get_nonblocking_missing_is_none(self, store):
+        assert store.get("/c/missing", blocking=False) is None
+
+    def test_get_blocking_timeout_raises(self, store):
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            store.get("/c/never", blocking=True, timeout_s=0.2)
+        assert time.monotonic() - t0 >= 0.15
+
+    def test_get_blocking_wakes_on_set(self, store):
+        def later():
+            time.sleep(0.15)
+            store.set("/c/late", b"arrived")
+
+        t = threading.Thread(target=later, daemon=True)
+        t.start()
+        assert store.get("/c/late", blocking=True, timeout_s=10.0) \
+            == b"arrived"
+        t.join()
+
+    def test_add_counter_and_atomic_read(self, store):
+        assert store.add("/c/n", 1) == 1
+        assert store.add("/c/n", 4) == 5
+        assert store.add("/c/n", -2) == 3
+        # add(key, 0) is THE portable atomic counter read: the native
+        # store packs counters as little-endian int64, so get() bytes are
+        # not comparable across flavors, but the returned int is
+        assert store.add("/c/n", 0) == 3
+        assert store.add("/c/other", 0) == 0
+
+    def test_wait_ge_blocks_until_target(self, store):
+        def arrivals():
+            for _ in range(3):
+                time.sleep(0.05)
+                store.add("/c/arrive", 1)
+
+        t = threading.Thread(target=arrivals, daemon=True)
+        t.start()
+        assert store.wait_ge("/c/arrive", 3, timeout_s=10.0) >= 3
+        t.join()
+
+    def test_wait_ge_timeout_diagnostics(self, store):
+        store.add("/c/partial", 1)
+        with pytest.raises(TimeoutError, match="never happened"):
+            store.wait_ge("/c/partial", 5, timeout_s=0.2)
+
+    def test_delete_and_num_keys(self, store):
+        n0 = store.num_keys()
+        store.set("/c/d1", b"x")
+        store.set("/c/d2", b"y")
+        assert store.num_keys() == n0 + 2
+        store.delete("/c/d1")
+        assert store.num_keys() == n0 + 1
+        assert store.get("/c/d1", blocking=False) is None
+        assert store.get("/c/d2", blocking=False) == b"y"
+        store.delete("/c/d1")                    # deleting absent: no-op
+        assert store.num_keys() == n0 + 1
+
+    def test_delete_resets_counter(self, store):
+        store.add("/c/reset", 7)
+        store.delete("/c/reset")
+        assert store.add("/c/reset", 0) == 0
+
+    def test_barrier_rendezvous_and_reuse(self, store):
+        done = []
+
+        def rank(r):
+            store.barrier("sync", 2, rank=r, timeout_s=10.0)
+            done.append(r)
+            store.barrier("sync", 2, rank=r, timeout_s=10.0)  # reused name
+            done.append(r + 10)
+
+        ts = [threading.Thread(target=rank, args=(r,)) for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        assert sorted(done) == [0, 1, 10, 11]
+
+    def test_barrier_timeout_names_missing_ranks(self, store):
+        with pytest.raises(TimeoutError) as ei:
+            store.barrier("lonely", 3, rank=0, timeout_s=0.3)
+        msg = str(ei.value)
+        assert "1/3" in msg
+        assert "1" in msg and "2" in msg      # the ranks that never came
+        assert "0" not in msg.split("never appeared: ")[-1]
+
+    def test_close_idempotent(self, request, store):
+        store.close()
+        store.close()                          # second close must not raise
+
+
+# ---------------------------------------------------------------------------
+# lease clock audit: observer-side monotonic aging (NTP-step immunity)
+# ---------------------------------------------------------------------------
+
+class _FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+
+class TestLeaseClocks:
+    def test_registry_lease_ignores_writer_clock_steps(self):
+        """The writer's clock steps wildly (NTP jump simulation) between
+        heartbeats; the observer ages the lease purely on ITS clock from
+        the last value change, so liveness tracks beats, not timestamps."""
+        store = InProcStore()
+        wclock, rclock = _FakeClock(5_000.0), _FakeClock(100.0)
+        writer = ReplicaRegistry(store, prefix="/lease", clock=wclock)
+        reader = ReplicaRegistry(store, prefix="/lease", clock=rclock)
+
+        writer.heartbeat("r0")
+        assert reader.alive("r0", 1.0)         # first sight grants a lease
+        wclock.t -= 10_000.0                   # writer wall clock steps BACK
+        rclock.t += 0.5
+        writer.heartbeat("r0")                 # value still changes (seq)
+        assert reader.heartbeat_age("r0") == 0.0
+        assert reader.alive("r0", 1.0)
+
+        wclock.t += 50_000.0                   # and forward, mid-lease
+        rclock.t += 0.5
+        writer.heartbeat("r0")
+        assert reader.alive("r0", 1.0)
+
+        # no more beats: the observer's OWN clock expires the lease
+        rclock.t += 1.51
+        assert not reader.alive("r0", 1.0)
+        # a fresh beat revives it no matter what the writer clock says
+        wclock.t = -3.0
+        writer.heartbeat("r0")
+        assert reader.alive("r0", 1.0)
+
+    def test_registry_frozen_writer_clock_still_beats(self):
+        """A completely frozen writer clock (the fake-clock fleet tests)
+        must still renew the lease: the heartbeat value embeds a sequence
+        so it changes every beat."""
+        store = InProcStore()
+        wclock, rclock = _FakeClock(), _FakeClock()
+        writer = ReplicaRegistry(store, prefix="/frz", clock=wclock)
+        reader = ReplicaRegistry(store, prefix="/frz", clock=rclock)
+        for _ in range(3):
+            writer.heartbeat("r0")
+            rclock.t += 0.9
+            assert reader.alive("r0", 1.0)
+        rclock.t += 1.2                        # beats stop -> lease expires
+        assert not reader.alive("r0", 1.0)
+
+    def test_registry_writer_reads_own_lease_under_frozen_clock(self):
+        """The writer primes its own observer cache at write time, so a
+        registry that both beats and reads (thread fleets) sees its own
+        lease age from the last write on its own clock."""
+        store = InProcStore()
+        clock = _FakeClock()
+        reg = ReplicaRegistry(store, prefix="/own", clock=clock)
+        reg.heartbeat("me")
+        assert reg.alive("me", 1.0)
+        clock.t += 1.5
+        assert not reg.alive("me", 1.0)        # no beat: expired on time
+        reg.heartbeat("me")
+        assert reg.alive("me", 1.0)
+
+    def test_elastic_membership_age_is_observer_side(self):
+        from paddle_tpu.distributed.elastic import ElasticMembership
+
+        store = InProcStore()
+        wclock, rclock = _FakeClock(9_999.0), _FakeClock(0.0)
+        w = ElasticMembership(store, 0, [0, 1], lease_ttl_s=1.0,
+                              heartbeat_s=0.2, prefix="/em", clock=wclock)
+        r = ElasticMembership(store, 1, [0, 1], lease_ttl_s=1.0,
+                              heartbeat_s=0.2, prefix="/em", clock=rclock)
+        assert r.heartbeat_age(0) == 0.0       # first observation
+        wclock.t -= 123_456.0                  # NTP step on the writer
+        rclock.t += 0.4
+        w.heartbeat()
+        assert r.heartbeat_age(0) == 0.0       # change observed -> age 0
+        assert r.is_alive(0)
+        rclock.t += 1.2                        # silence ages on MY clock
+        assert r.heartbeat_age(0) >= 1.2
+        assert not r.is_alive(0)
+        assert r.heartbeat_age(2) == float("inf")   # never heartbeat

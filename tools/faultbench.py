@@ -33,13 +33,30 @@ Scenarios (all CPU, deterministic, a few seconds total):
                       A second pass slows (not kills) a rank and requires
                       the straggler-aware rebalancer to shrink its batch
                       share within the configured bound.
+  * proc            — process-granularity fault isolation (r20): serving
+                      replicas and elastic ranks as REAL supervised OS
+                      processes over a socket TCPStore. HARD GATES:
+                      SIGKILL a replica child mid-request -> bitwise
+                      re-dispatch + capped-backoff respawn; SIGSTOP a
+                      child past its lease -> replacement spawns, and on
+                      SIGCONT the zombie fences itself out (exit 43,
+                      never a stale response); stall the child's store
+                      traffic through a partition proxy -> declared dead,
+                      then heals inside the grace window with NO respawn
+                      and NO fence bump; elastic rank processes where a
+                      spawned joiner request_join()s in (grow reform) and
+                      a SIGKILLed incumbent's survivors reform to N-1
+                      from the last committed checkpoint with the clean
+                      run's loss trajectory. Skips gracefully where
+                      SIGSTOP semantics or the native store are missing.
 
-Usage: python tools/faultbench.py [--out FAULTBENCH_r17.json]
+Usage: python tools/faultbench.py [--out FAULTBENCH_r20.json] [--only proc]
 """
 import argparse
 import json
 import os
 import signal
+import subprocess
 import sys
 import tempfile
 import time
@@ -352,11 +369,374 @@ def bench_elastic(tmp):
     }
 
 
+# ---------------------------------------------------------------------------
+# proc — process-granularity fault isolation (ISSUE r20)
+# ---------------------------------------------------------------------------
+
+PROC_PROMPT = [5, 6, 7, 8]
+PROC_ENGINE_KW = {"max_slots": 3, "block_size": 16, "prefill_chunk": 16}
+_ELASTIC_VIEW_KEY = "/pt/elastic/view"
+
+
+def _wait_for(cond, timeout_s, poll_s=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(poll_s)
+    return False
+
+
+def _pid_alive(pid):
+    try:
+        os.kill(pid, 0)
+        return True
+    except (ProcessLookupError, PermissionError):
+        return False
+
+
+def _rank_child_main(spec_json):
+    """Hidden entry point (--_rank-child): ONE elastic data-parallel rank
+    as a real OS process. Connects a TCPStore client, builds the seeded
+    model, optionally request_join()s as a late joiner, runs the
+    ElasticTrainer to completion and prints its report as one JSON line
+    the parent scrapes off stdout."""
+    import hashlib
+
+    from paddle_tpu import native, nn, optimizer
+    from paddle_tpu.distributed.elastic import ElasticMembership
+    from paddle_tpu.resilience.elastic import ElasticTrainer
+
+    spec = json.loads(spec_json)
+    host, port = spec["store"]
+    store = native.TCPStore(host, int(port), is_master=False,
+                            world_size=1, timeout_s=30.0)
+    mid = int(spec["member_id"])
+    m = _build()
+    opt = optimizer.SGD(0.1, parameters=m.parameters())
+    loss_fn = nn.MSELoss()
+    batches = [(b[0].repeat(2, axis=0), b[1].repeat(2, axis=0))
+               for b in _batches(spec["n_batches"])]
+
+    pre = None
+    if spec.get("join"):
+        # joiner choreography (mirrors tests/test_elastic.py): wait for
+        # the incumbents' published view — constructing a membership
+        # before ANY view exists would publish a solo gen-0 view and
+        # fork the world — then announce the join with a pre-trainer
+        # membership that keeps heartbeating until the trainer's own
+        # membership takes over.
+        if not _wait_for(lambda: store.get(_ELASTIC_VIEW_KEY,
+                                           blocking=False) is not None,
+                         60.0, poll_s=0.05):
+            print("FAULTBENCH_RANK_REPORT "
+                  + json.dumps({"member": mid, "status": "no_view"}),
+                  flush=True)
+            return 1
+        pre = ElasticMembership(store, mid, [mid],
+                                lease_ttl_s=spec["lease_ttl_s"],
+                                heartbeat_s=spec["heartbeat_s"])
+        pre.start()
+        pre.request_join(timeout_s=60)
+
+    tr = ElasticTrainer(
+        m, lambda a, b: loss_fn(m(a), b), opt, spec["root"],
+        store=store, member_id=mid, members=spec["members"],
+        save_every=spec["save_every"], lease_ttl_s=spec["lease_ttl_s"],
+        heartbeat_s=spec["heartbeat_s"],
+        allreduce_timeout_s=spec["allreduce_timeout_s"],
+        sync_timeout_s=spec.get("sync_timeout_s", 10.0))
+    try:
+        rep = tr.run(batches, total_steps=spec["nsteps"])
+    finally:
+        if pre is not None:
+            pre.stop()
+    sha = hashlib.sha256()
+    for p in tr.step.params:
+        sha.update(np.ascontiguousarray(np.asarray(p._value)).tobytes())
+    rep["params_sha"] = sha.hexdigest()
+    print("FAULTBENCH_RANK_REPORT " + json.dumps(rep), flush=True)
+    return 0
+
+
+def _spawn_rank(spec):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__),
+         "--_rank-child", json.dumps(spec)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env)
+
+
+def _scrape_rank_report(proc, timeout_s):
+    out, _ = proc.communicate(timeout=timeout_s)
+    for line in out.decode(errors="replace").splitlines():
+        if line.startswith("FAULTBENCH_RANK_REPORT "):
+            rep = json.loads(line.split(" ", 1)[1])
+            if "losses" in rep:
+                rep["losses"] = {int(k): float(v)
+                                 for k, v in rep["losses"].items()}
+            return rep
+    return None
+
+
+def _proc_fleet_gates(gates, detail, chaos):
+    """Gates 1+2: SIGKILL a serving replica child mid-request (bitwise
+    re-dispatch + capped respawn) and SIGSTOP/SIGCONT a zombie (lease
+    death -> replacement -> fence-token exit, never a stale response)."""
+    from paddle_tpu import native
+    from paddle_tpu.observability import registry as _oreg
+    from paddle_tpu.serving import build_process_fleet, wait_fleet_ready
+
+    store = native.TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+    router = build_process_fleet(
+        2, store=store, store_addr=("127.0.0.1", store.port),
+        spec_kwargs=dict(engine_kwargs=PROC_ENGINE_KW,
+                         child_heartbeat_s=0.2, respawn_backoff_s=0.5,
+                         respawn_max=5),
+        router_kwargs=dict(heartbeat_s=0.05, lease_ttl_s=1.0,
+                           prefix="/fb/fleet"))
+    router.start()
+    try:
+        ready = wait_fleet_ready(router, 120)
+        oracle = None
+        if ready:
+            r0 = router.submit(PROC_PROMPT, max_new_tokens=48)
+            if r0.wait(60) and r0.finish_reason in ("stop", "length"):
+                oracle = list(r0.output_tokens)
+
+        # -- SIGKILL with the request in flight ------------------------------
+        kill_ok, victim, vinc = False, None, 0
+        if oracle:
+            r1 = router.submit(PROC_PROMPT, max_new_tokens=48)
+            victim = r1.attempts[0].replica
+            vinc = victim.incarnation
+            chaos.kill_process(victim.pid)
+            kill_ok = (r1.wait(90) and r1.redispatches >= 1
+                       and list(r1.output_tokens) == oracle)
+            detail["kill_redispatches"] = getattr(r1, "redispatches", None)
+        gates["fleet_kill_redispatch_bitwise"] = bool(kill_ok)
+
+        # -- respawn under backoff, then parity on the new incarnation -------
+        respawned = victim is not None and _wait_for(
+            lambda: (victim.incarnation > vinc and not victim.warming()
+                     and not victim.dead(router.lease_ttl_s)), 90)
+        parity = False
+        if respawned:
+            r2 = router.submit(PROC_PROMPT, max_new_tokens=48)
+            parity = r2.wait(60) and list(r2.output_tokens) == oracle
+        gates["fleet_respawn_and_parity"] = bool(
+            respawned and parity and victim.respawns >= 1)
+        detail["victim_last_exit"] = victim.last_exit if victim else None
+        detail["respawns_total"] = _oreg.REGISTRY.get(
+            "fleet_replica_respawns_total").total()
+
+        # -- zombie fencing --------------------------------------------------
+        if not chaos.sigstop_supported():
+            gates["fleet_zombie_fenced"] = True
+            detail["zombie_skipped"] = "no SIGSTOP/SIGCONT on this platform"
+            return
+        z = next(rep for rep in router.replicas.values()
+                 if rep is not victim)
+        zpid, zinc = z.pid, z.incarnation
+        chaos.hang_process(zpid)
+        replaced = _wait_for(
+            lambda: (z.incarnation > zinc and not z.warming()
+                     and not z.dead(router.lease_ttl_s)), 90)
+        served = False
+        if replaced and oracle:
+            # the frozen incarnation is orphaned, not routed: answers
+            # keep coming from live incarnations and stay bitwise
+            r3 = router.submit(PROC_PROMPT, max_new_tokens=48)
+            served = r3.wait(60) and list(r3.output_tokens) == oracle
+        chaos.resume_process(zpid)
+        fenced = _wait_for(
+            lambda: (not _pid_alive(zpid) and z.last_exit is not None
+                     and z.last_exit.get("fenced_pid") == zpid), 30)
+        gates["fleet_zombie_fenced"] = bool(replaced and served and fenced)
+        detail["zombie_last_exit"] = z.last_exit
+        detail["fenced_total"] = _oreg.REGISTRY.get(
+            "fleet_replica_fenced_total").total()
+    finally:
+        router.stop()
+        store.close()
+
+
+def _proc_partition_gate(gates, detail, chaos):
+    """Gate 3: stall the child's store traffic through a partition proxy
+    past the lease TTL — the supervisor must declare it dead, then heal
+    inside the grace window with NO respawn and NO fence bump."""
+    from paddle_tpu import native
+    from paddle_tpu.serving import build_process_fleet, wait_fleet_ready
+
+    store = native.TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+    proxy = chaos.StorePartitionProxy("127.0.0.1", store.port)
+    router = build_process_fleet(
+        1, store=store, store_addr=(proxy.host, proxy.port),
+        spec_kwargs=dict(engine_kwargs=PROC_ENGINE_KW,
+                         child_heartbeat_s=0.2, respawn_backoff_s=5.0,
+                         respawn_max=3),
+        router_kwargs=dict(heartbeat_s=0.05, lease_ttl_s=1.0,
+                           prefix="/fb/part"))
+    router.start()
+    try:
+        ready = wait_fleet_ready(router, 120)
+        rep = router.replicas["replica-0"]
+        inc0, respawns0 = rep.incarnation, rep.respawns
+        oracle = None
+        if ready:
+            r0 = router.submit(PROC_PROMPT, max_new_tokens=16)
+            if r0.wait(60):
+                oracle = list(r0.output_tokens)
+        proxy.partition(duration_s=2.0, mode="stall")
+        declared_dead = _wait_for(lambda: rep.dead(router.lease_ttl_s), 10)
+        revived = _wait_for(
+            lambda: not rep.dead(router.lease_ttl_s) and not rep.warming(),
+            20)
+        healed_serves = False
+        if revived and oracle:
+            r1 = router.submit(PROC_PROMPT, max_new_tokens=16)
+            healed_serves = r1.wait(60) and list(r1.output_tokens) == oracle
+        gates["partition_heals_without_respawn"] = bool(
+            ready and declared_dead and revived and healed_serves
+            and rep.incarnation == inc0 and rep.respawns == respawns0)
+        detail["partition"] = {
+            "declared_dead": declared_dead, "revived": revived,
+            "incarnation": rep.incarnation, "respawns": rep.respawns,
+        }
+    finally:
+        router.stop()
+        store.close()
+        proxy.close()
+
+
+def _proc_elastic_gates(tmp, gates, detail, chaos):
+    """Gate 4: elastic ranks as real processes over a socket TCPStore — a
+    spawned rank request_join()s into the running world (grow reform),
+    then one incumbent is SIGKILLed and the survivors reform to N-1 from
+    the last committed checkpoint, finishing every step with the loss
+    trajectory of an undisturbed run."""
+    from paddle_tpu import native
+
+    nsteps, save_every, n_batches = 40, 3, 12
+    batches = [(b[0].repeat(2, axis=0), b[1].repeat(2, axis=0))
+               for b in _batches(n_batches)]
+    # clean oracle: the loss trajectory is a function of the global batch
+    # alone (world-size independent), so a cheap thread world stands in
+    _, clean_reps, _ = _elastic_world(os.path.join(tmp, "proc_clean"),
+                                      [0, 1], batches, nsteps)
+    clean_losses = clean_reps[0]["losses"]
+
+    store = native.TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+    root = os.path.join(tmp, "proc_elastic")
+    base = dict(store=["127.0.0.1", store.port], root=root,
+                members=[0, 1], nsteps=nsteps, n_batches=n_batches,
+                save_every=save_every, lease_ttl_s=2.0, heartbeat_s=0.25,
+                allreduce_timeout_s=8.0, sync_timeout_s=10.0)
+    procs, reports = {}, {}
+    joined = False
+    try:
+        for mid in (0, 1):
+            procs[mid] = _spawn_rank(dict(base, member_id=mid))
+        procs[2] = _spawn_rank(dict(base, member_id=2,
+                                    members=[0, 1, 2], join=True))
+
+        def _members():
+            raw = store.get(_ELASTIC_VIEW_KEY, blocking=False)
+            if raw is None:
+                return set()
+            try:
+                return set(json.loads(raw.decode()).get("members") or [])
+            except ValueError:
+                return set()
+
+        joined = _wait_for(lambda: 2 in _members(), 180)
+        detail["elastic_joined"] = joined
+        if joined:
+            time.sleep(1.2)     # let the grown world commit a checkpoint
+            chaos.kill_process(procs[1].pid)
+        for mid in (0, 2):
+            try:
+                reports[mid] = _scrape_rank_report(procs[mid], 300)
+            except subprocess.TimeoutExpired:
+                procs[mid].kill()
+                reports[mid] = None
+        try:
+            procs[1].wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            procs[1].kill()
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        store.close()
+
+    r0, r2 = reports.get(0), reports.get(2)
+    survivors_done = bool(
+        joined and r0 and r2
+        and r0["status"] == "completed" and r2["status"] == "completed"
+        and r0["step"] == nsteps and r2["step"] == nsteps
+        and r0["final_world_size"] == 2 and r2["final_world_size"] == 2
+        and sorted(r0["final_members"]) == [0, 2]
+        and r2["steps_run"] > 0)
+    grew = bool(r0 and any(sorted(f["members"]) == [0, 1, 2]
+                           for f in r0.get("reforms", [])))
+    shrank = bool(r0 and any(sorted(f["members"]) == [0, 2]
+                             for f in r0.get("reforms", [])))
+    loss_dev = None
+    if survivors_done and set(r0["losses"]) >= set(clean_losses):
+        loss_dev = max(abs(r0["losses"][s] - clean_losses[s])
+                       for s in clean_losses)
+    gates["elastic_proc_join_then_survive_kill"] = bool(
+        survivors_done and grew and shrank)
+    gates["elastic_proc_loss_continuity"] = (
+        loss_dev is not None and loss_dev <= LOSS_CONTINUITY_TOL)
+    gates["elastic_proc_survivors_bitwise"] = bool(
+        survivors_done and r0.get("params_sha")
+        and r0["params_sha"] == r2["params_sha"])
+    detail["elastic_proc"] = {
+        "loss_continuity_dev": loss_dev,
+        "reforms": (r0 or {}).get("reforms"),
+        "survivor_reports": {m: (r and {k: r[k] for k in
+                                        ("status", "step", "steps_run",
+                                         "final_world_size",
+                                         "final_members")})
+                             for m, r in ((0, r0), (2, r2))},
+    }
+
+
+def bench_proc(tmp):
+    """Replicas and ranks as supervised OS processes: crash, hang/zombie,
+    store partition, and elastic join/leave survival — every fault is the
+    genuine OS article (SIGKILL/SIGSTOP/TCP stall), every gate hard."""
+    from paddle_tpu import native
+    from paddle_tpu.resilience import chaos
+
+    if not native.available():
+        return {"ok": True, "gates": {},
+                "skipped": "native TCPStore unavailable on this platform"}
+    # respawn flight dumps follow FLAGS_metrics_dir — keep them in the
+    # bench tmp dir instead of ./flight_recorder under the repo
+    from paddle_tpu.core import flags
+    flags.set_flags({"metrics_dir": os.path.join(tmp, "flight")})
+    gates, detail = {}, {}
+    _proc_fleet_gates(gates, detail, chaos)
+    _proc_partition_gate(gates, detail, chaos)
+    _proc_elastic_gates(tmp, gates, detail, chaos)
+    return {"ok": all(gates.values()), "gates": gates, **detail}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=os.path.join(_REPO,
-                                                  "FAULTBENCH_r17.json"))
+                                                  "FAULTBENCH_r20.json"))
+    ap.add_argument("--only", default=None,
+                    help="run a single scenario by name")
+    ap.add_argument("--_rank-child", dest="rank_child", default=None,
+                    help=argparse.SUPPRESS)
     args = ap.parse_args()
+    if args.rank_child is not None:
+        return _rank_child_main(args.rank_child)
 
     import jax
 
@@ -370,7 +750,10 @@ def main():
                          ("corruption", bench_corruption),
                          ("nan_guard", bench_nan_guard),
                          ("preemption", bench_preemption),
-                         ("elastic", bench_elastic)]:
+                         ("elastic", bench_elastic),
+                         ("proc", bench_proc)]:
+            if args.only and name != args.only:
+                continue
             chaos.clear()
             chaos.reset_stats()
             t0 = time.perf_counter()

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ...core.flags import define_flag, get_flag
 from ..analyzer import ProgramInfo, aval_of, eqn_source, iter_eqns
 from ..findings import Finding, Severity
 from ..registry import register_rule
@@ -18,7 +19,19 @@ _WIDE = ("float64", "complex128")
 _LOW = ("bfloat16", "float16")
 # reductions whose output dtype == accumulate dtype
 _ACCUM_PRIMS = ("reduce_sum", "cumsum", "reduce_window_sum", "dot_general")
-_MAX_REPORTS = 8  # one bad const can fan out to hundreds of f64 eqns
+
+define_flag(
+    "lint_dtype_max_reports", 8,
+    "Per-program cap on dtype-promotion findings (one bad const can fan "
+    "out to hundreds of f64 eqns). When the cap is hit, the rule emits "
+    "one INFO summary finding with the suppressed count. 0 = unlimited.")
+
+
+def _max_reports() -> int:
+    try:
+        return int(get_flag("lint_dtype_max_reports"))
+    except Exception:  # noqa: BLE001 — flag registry unavailable
+        return 8
 
 
 def _dt(v):
@@ -35,49 +48,62 @@ def _dt(v):
         "the program, and bf16/f16 reductions that accumulate in the input "
         "precision.")
 def check(program: ProgramInfo):
+    cap = _max_reports()
     n = 0
+    suppressed = 0
+
+    def emit(finding):
+        # cap <= 0 means unlimited; past the cap, count instead of yield
+        nonlocal n, suppressed
+        if cap > 0 and n >= cap:
+            suppressed += 1
+            return None
+        n += 1
+        return finding
+
     # f64 reaching the program from outside
     for v in program.jaxpr.invars:
-        if _dt(v) in _WIDE and n < _MAX_REPORTS:
-            n += 1
-            yield Finding(
+        if _dt(v) in _WIDE:
+            f = emit(Finding(
                 rule="dtype-promotion", severity=Severity.WARNING,
                 message=f"program input is {_dt(v)} "
                         f"(shape {tuple(getattr(aval_of(v), 'shape', ()))})",
                 fix_hint="cast at the boundary: jnp.asarray(x, jnp.float32) "
-                         "— f64 is emulated on TPU and doubles HBM traffic")
+                         "— f64 is emulated on TPU and doubles HBM traffic"))
+            if f:
+                yield f
     for c in program.closed_jaxpr.consts:
-        if str(getattr(c, "dtype", "")) in _WIDE and n < _MAX_REPORTS:
-            n += 1
-            yield Finding(
+        if str(getattr(c, "dtype", "")) in _WIDE:
+            f = emit(Finding(
                 rule="dtype-promotion", severity=Severity.WARNING,
                 message=f"captured constant is {c.dtype} "
                         f"(shape {tuple(getattr(c, 'shape', ()))})",
                 fix_hint="build the constant with an explicit f32/i32 dtype "
-                         "(np.arange/np.asarray default to float64)")
+                         "(np.arange/np.asarray default to float64)"))
+            if f:
+                yield f
     # host-side f64 arrays in the example args (with x64 off these are
     # silently downcast at trace — a different surprise, same root cause)
     import jax
 
     for leaf in jax.tree_util.tree_leaves((program.args, program.kwargs)):
-        if isinstance(leaf, np.ndarray) and str(leaf.dtype) in _WIDE \
-                and n < _MAX_REPORTS:
-            n += 1
-            yield Finding(
+        if isinstance(leaf, np.ndarray) and str(leaf.dtype) in _WIDE:
+            f = emit(Finding(
                 rule="dtype-promotion", severity=Severity.WARNING,
                 message=f"host numpy array argument is {leaf.dtype} (shape "
                         f"{leaf.shape}) — silently cast to f32 at trace "
                         "time (or upcast everything if x64 is on)",
                 fix_hint="convert once at the data boundary: "
-                         ".astype(np.float32)")
+                         ".astype(np.float32)"))
+            if f:
+                yield f
 
     for idx, eqn in iter_eqns(program.closed_jaxpr):
         in_dts = [_dt(v) for v in eqn.invars]
         out_dts = [_dt(v) for v in eqn.outvars]
-        if n < _MAX_REPORTS and any(d in _WIDE for d in out_dts) \
+        if any(d in _WIDE for d in out_dts) \
                 and not any(d in _WIDE for d in in_dts):
-            n += 1
-            yield Finding(
+            f = emit(Finding(
                 rule="dtype-promotion", severity=Severity.WARNING,
                 message=f"{eqn.primitive.name} introduces "
                         f"{[d for d in out_dts if d in _WIDE][0]} from "
@@ -86,11 +112,13 @@ def check(program: ProgramInfo):
                 source=eqn_source(eqn),
                 fix_hint="pass an explicit dtype (python floats + x64, "
                          "np.float64 scalars, and jnp.float64 casts are the "
-                         "usual culprits)")
+                         "usual culprits)"))
+            if f:
+                yield f
         if eqn.primitive.name in _ACCUM_PRIMS:
             fin = [d for d in in_dts if d in _LOW]
             if fin and out_dts and out_dts[0] in _LOW:
-                yield Finding(
+                f = emit(Finding(
                     rule="dtype-promotion", severity=Severity.WARNING,
                     message=f"{eqn.primitive.name} accumulates in "
                             f"{out_dts[0]} — long sums lose ~8 mantissa "
@@ -99,4 +127,16 @@ def check(program: ProgramInfo):
                     source=eqn_source(eqn),
                     fix_hint="accumulate in f32: preferred_element_type="
                              "jnp.float32 (dot_general) or .astype("
-                             "jnp.float32) before the reduce")
+                             "jnp.float32) before the reduce"))
+                if f:
+                    yield f
+
+    if suppressed:
+        yield Finding(
+            rule="dtype-promotion", severity=Severity.INFO,
+            message=f"{suppressed} further dtype-promotion finding(s) "
+                    f"suppressed past the {cap}-report cap — one bad "
+                    "const can fan out to hundreds of f64 eqns",
+            fix_hint="raise FLAGS_lint_dtype_max_reports (0 = unlimited) "
+                     "to see every site; fixing the first few usually "
+                     "clears the fan-out")

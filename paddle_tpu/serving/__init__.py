@@ -77,10 +77,12 @@ from .engine import (  # noqa: F401
 )
 from .fleet import (  # noqa: F401
     CircuitBreaker,
+    FleetAutoscaler,
     FleetRequest,
     FleetRouter,
     Replica,
     build_fleet,
+    parse_fleet_roles,
 )
 from .fleet_observability import (  # noqa: F401
     FleetObservability,
@@ -98,6 +100,7 @@ __all__ = [
     "BlockAllocator",
     "CircuitBreaker",
     "EngineDrainingError",
+    "FleetAutoscaler",
     "FleetObservability",
     "FleetRequest",
     "FleetRouter",
@@ -118,6 +121,7 @@ __all__ = [
     "SpecState",
     "build_fleet",
     "build_process_fleet",
+    "parse_fleet_roles",
     "export_fleet_trace",
     "wait_fleet_ready",
     "export_request_trace",

@@ -90,6 +90,12 @@ _PREFIX_DEDUPS = _counter("serving_prefix_dedup_blocks_total",
                           "Private prefilled blocks swapped for an "
                           "already-indexed twin at register time.",
                           always=True)
+_PREFIX_IMPORTS = _counter("serving_prefix_imported_blocks_total",
+                           "Streamed KV blocks admitted into the cache "
+                           "after chain-hash verification.", always=True)
+_PREFIX_IMPORT_DEDUPS = _counter("serving_prefix_import_dedup_total",
+                                 "Streamed blocks whose digest was already "
+                                 "resident (idempotent no-op).", always=True)
 
 
 class BlockAllocator:
@@ -157,6 +163,17 @@ class BlockAllocator:
         return self.blocks_for(n_tokens) <= self.available_blocks
 
     # -- content addressing -----------------------------------------------
+    def chain_digest(self, prev: bytes, tokens) -> bytes:
+        """One link of the chain hash: commits to `prev` (the previous
+        full block's digest, b"" at the chain head) plus this block's
+        token ids — so a digest identifies the whole prefix up to and
+        including its block, and a receiver can verify a streamed block
+        against nothing but the preceding digest and the claimed tokens."""
+        h = hashlib.blake2b(prev, digest_size=16)
+        for t in tokens:
+            h.update(int(t).to_bytes(8, "little", signed=True))
+        return h.digest()
+
     def block_hashes(self, tokens) -> List[bytes]:
         """Chain digests for every FULL block of `tokens`: digest i commits
         to tokens[0 : (i+1)*block_size], so equal digests imply equal whole
@@ -165,12 +182,74 @@ class BlockAllocator:
         prev = b""
         bs = self.block_size
         for i in range(len(tokens) // bs):
-            h = hashlib.blake2b(prev, digest_size=16)
-            for t in tokens[i * bs:(i + 1) * bs]:
-                h.update(int(t).to_bytes(8, "little", signed=True))
-            prev = h.digest()
+            prev = self.chain_digest(prev, tokens[i * bs:(i + 1) * bs])
             out.append(prev)
         return out
+
+    # -- KV-block streaming (disaggregated serving / live migration) -------
+    def export_prefix(self, tokens) -> List[dict]:
+        """Wire metadata for the RESIDENT full-block prefix of `tokens`:
+        one record per indexed full block, in chain order, stopping at the
+        first full block that is not in the index. Each record carries the
+        chain digest, the previous link's digest, the block's token ids,
+        and the local block id (so a caller that owns the device pool can
+        attach the block's KV bytes). Read-only — no refcounts move."""
+        out: List[dict] = []
+        prev = b""
+        bs = self.block_size
+        for i in range(len(tokens) // bs):
+            blk_tokens = [int(t) for t in tokens[i * bs:(i + 1) * bs]]
+            key = self.chain_digest(prev, blk_tokens)
+            blk = self._index.get(key)
+            if blk is None:
+                break
+            out.append({"digest": key, "prev": prev, "block": blk,
+                        "tokens": blk_tokens})
+            prev = key
+        return out
+
+    def import_block(self, prev_digest: bytes, tokens,
+                     digest: bytes) -> Tuple[int, bool]:
+        """Admit one streamed FULL block into the cache. The chain digest
+        is recomputed from `prev_digest` + `tokens` and must equal the
+        claimed `digest` — a corrupted or mislabeled block is rejected
+        (ValueError) before it can poison the index. Returns
+        `(block_id, imported)`:
+
+          * already-resident digest -> `(existing_block, False)`: the
+            transfer is an idempotent no-op (its LRU position refreshes so
+            a chain being streamed can't evict its own head);
+          * otherwise a blank block is claimed (free stack, then LRU
+            eviction) and published directly into the evictable cached
+            pool — refcount 0, matchable, reclaimable — and the caller
+            must scatter the block's KV bytes into the device pool at
+            `block_id` before any reservation can match it.
+
+        Conservation holds by construction: the block moves free/evicted ->
+        evictable. Raises MemoryError when no blank block exists."""
+        if not self.prefix_cache:
+            raise ValueError("prefix cache disabled: an imported block "
+                             "could never be matched")
+        if len(tokens) != self.block_size:
+            raise ValueError(f"imported block carries {len(tokens)} tokens, "
+                             f"expected a full block of {self.block_size}")
+        want = self.chain_digest(prev_digest, tokens)
+        if want != bytes(digest):
+            raise ValueError("chain-hash mismatch: streamed block rejected "
+                             "(corrupt payload or broken chain)")
+        blk = self._index.get(want)
+        if blk is not None:
+            if blk in self._evictable:
+                self._evictable.move_to_end(blk)
+            _PREFIX_IMPORT_DEDUPS.inc()
+            return blk, False
+        blk = self._pop_block()
+        self._digest[blk] = want
+        self._index[want] = blk
+        self._evictable[blk] = None      # newest at the LRU tail
+        _PREFIX_IMPORTS.inc()
+        self._publish()
+        return blk, True
 
     def _match(self, tokens) -> List[int]:
         """Longest run of cached blocks covering a prefix of `tokens`."""

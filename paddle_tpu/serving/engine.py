@@ -614,10 +614,12 @@ class ServingEngine:
                eos_token_id: Optional[int] = None,
                request_id: Optional[str] = None,
                tier: str = "default",
-               trace_ctx: Optional[dict] = None) -> Request:
+               trace_ctx: Optional[dict] = None,
+               prefill_only: bool = False) -> Request:
         req = Request(prompt, max_new_tokens=max_new_tokens,
                       temperature=temperature, eos_token_id=eos_token_id,
-                      request_id=request_id, tier=tier, trace_ctx=trace_ctx)
+                      request_id=request_id, tier=tier, trace_ctx=trace_ctx,
+                      prefill_only=prefill_only)
         max_queue = int(_flags.get_flag("serving_max_queue"))
         with self._lock:
             if self._draining:
@@ -666,6 +668,102 @@ class ServingEngine:
                 return False
             self._finish(req, reason)
             return True
+
+    # ------------------------------------------- KV-block streaming wire
+    def export_kv_blocks(self, tokens: List[int]) -> List[dict]:
+        """Serialize the RESIDENT full-block prefix of `tokens` for
+        streaming to another replica: one record per indexed block, chain
+        order, each carrying the chain digest (hex), the previous link's
+        digest, the block's token ids, and the raw per-layer (K, V) page
+        bytes gathered from the device pool. Read-only; the wire format is
+        what ingest_kv_blocks() (and the HTTP /kv/ingest endpoint, after
+        base64) accepts."""
+        with self._lock:
+            recs = self.allocator.export_prefix(tokens)
+            if not recs:
+                return []
+            blks = np.asarray([r["block"] for r in recs], np.int32)
+            layers = [(np.asarray(jax.device_get(kp[blks])),
+                       np.asarray(jax.device_get(vp[blks])))
+                      for kp, vp in self.pool.layers]
+            out = []
+            for i, r in enumerate(recs):
+                out.append({
+                    "digest": r["digest"].hex(),
+                    "prev": r["prev"].hex(),
+                    "tokens": r["tokens"],
+                    "layers": [(k[i].tobytes(), v[i].tobytes())
+                               for k, v in layers],
+                })
+            return out
+
+    def ingest_kv_blocks(self, records: List[dict]) -> dict:
+        """Admit streamed KV blocks into the local pool as prefix-cache
+        entries. Each record is verified against the chain hash
+        (allocator.import_block) and its byte payload against the pool
+        geometry BEFORE anything is claimed; a failed link stops the chain
+        (descendants could never be matched past the hole). Idempotent:
+        already-resident digests are deduped without touching the pool.
+        Returns {"imported", "dedup", "rejected", "skipped", "bytes"}."""
+        n_layers = len(self.pool.layers)
+        kp0 = self.pool.layers[0][0]
+        np_dtype = np.dtype(kp0.dtype)
+        blk_shape = (self.block_size, kp0.shape[2], kp0.shape[3])
+        blk_bytes = int(np.prod(blk_shape)) * np_dtype.itemsize
+        imported = dedup = rejected = skipped = nbytes = 0
+        with self._lock:
+            prev = b""
+            pend = []               # (block_id, [(k_arr, v_arr), ...])
+            for i, rec in enumerate(records):
+                try:
+                    digest = bytes.fromhex(rec["digest"])
+                    rec_prev = bytes.fromhex(rec["prev"])
+                    layers = rec["layers"]
+                    if rec_prev != prev:
+                        raise ValueError("broken chain: prev digest does "
+                                         "not match the previous record")
+                    if len(layers) != n_layers or any(
+                            len(k) != blk_bytes or len(v) != blk_bytes
+                            for k, v in layers):
+                        raise ValueError("payload does not match the pool "
+                                         "geometry")
+                    blk, fresh = self.allocator.import_block(
+                        prev, rec["tokens"], digest)
+                except ValueError:
+                    # corrupt/mislabeled link: everything after it hangs
+                    # off an unverifiable digest — drop the rest
+                    rejected += 1
+                    skipped += len(records) - i - 1
+                    break
+                except MemoryError:
+                    # pool full: a mid-chain hole makes descendants
+                    # unmatchable, so don't import past it either
+                    skipped += len(records) - i
+                    break
+                prev = digest
+                if fresh:
+                    imported += 1
+                    nbytes += 2 * n_layers * blk_bytes
+                    pend.append((blk, [
+                        (np.frombuffer(k, np_dtype).reshape(blk_shape),
+                         np.frombuffer(v, np_dtype).reshape(blk_shape))
+                        for k, v in layers]))
+                else:
+                    dedup += 1
+            if pend:
+                idx = jnp.asarray(np.asarray([b for b, _ in pend],
+                                             np.int32))
+                new_layers = []
+                for li, (kp, vp) in enumerate(self.pool.layers):
+                    k_new = jnp.asarray(np.stack([a[li][0]
+                                                  for _, a in pend]))
+                    v_new = jnp.asarray(np.stack([a[li][1]
+                                                  for _, a in pend]))
+                    new_layers.append((kp.at[idx].set(k_new),
+                                       vp.at[idx].set(v_new)))
+                self.pool.replace(new_layers)
+        return {"imported": imported, "dedup": dedup, "rejected": rejected,
+                "skipped": skipped, "bytes": nbytes}
 
     # ------------------------------------------------------------ tick
     def step(self) -> dict:
@@ -739,6 +837,13 @@ class ServingEngine:
         step (token = prompt[-1] at seq_len = plen - 1): its K/V write
         lands in the copy-on-write fork of the final shared block, and its
         logits yield the first generated token on the next decode tick."""
+        if req.prefill_only:
+            # every prompt block is already resident and indexed: a
+            # prefill-only pass has nothing to compute OR publish — finish
+            # without the COW dispatch (the fork block frees with the
+            # reservation)
+            self._finish(req, "prefill_complete")
+            return
         plen = len(req.prompt)
         slot = req.slot
         table = np.asarray(self.allocator.table(req.request_id), np.int32)
@@ -850,6 +955,12 @@ class ServingEngine:
                         d_lens, d_temps, d_seed)
                     self._stats.inc("dedup_admissions")
             self.obs.on_prefill_chunk(req, t0, suffixes[r], batched=True)
+            if req.prefill_only:
+                # the row rode the shared dispatch for its KV only; finish
+                # instead of joining decode (the deferred first-token fetch
+                # skips finished requests at flush)
+                self._finish(req, "prefill_complete")
+                continue
             self.sched.start_running(req)
             self.obs.on_first_token(req)
             if req.eos_token_id is not None or req.max_new_tokens <= 1:
@@ -916,6 +1027,13 @@ class ServingEngine:
                 table = np.asarray(self.allocator.table(req.request_id),
                                    np.int32)
                 self._stats.inc("dedup_admissions")
+        if req.prefill_only:
+            # disaggregated prefill pass: the prompt's KV is scattered and
+            # its full blocks indexed — they stay resident (evictable,
+            # matchable, exportable) after the finish releases the
+            # sequence. No first token: the decode replica samples it.
+            self._finish(req, "prefill_complete")
+            return
         slot = req.slot
         self._tables[slot] = 0
         self._tables[slot, :len(table)] = table

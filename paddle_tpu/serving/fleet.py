@@ -30,6 +30,22 @@ over a TCPStore unchanged):
   * load shedding: when every healthy replica's queue is full the router
     raises QueueFullError with a jittered Retry-After, so the shed wave
     does not come back in lockstep.
+  * disaggregated prefill/decode: FLAGS_fleet_roles splits the fleet
+    into prefill-heavy and decode-packed replicas. A request first runs
+    prefill-only on a prefill replica; its finished FULL KV blocks
+    stream to the best decode replica over the /kv wire (chain-hash
+    keyed, idempotent — engine.export_kv_blocks/ingest_kv_blocks), and
+    the decode attempt admits them as local prefix-cache hits. The
+    default ("symmetric") keeps every replica dual-role: exactly
+    today's behavior.
+  * live KV migration: drain(rid, migrate=True) ships each in-flight
+    session's resident prompt blocks to a survivor over the same wire
+    and re-places the attempt there — the survivor re-decodes (greedy:
+    bitwise identical) without re-prefilling any already-full block.
+  * elastic autoscaling: FleetAutoscaler tracks offered load against
+    fleet capacity and spawns (add_replica + the r20 warm-up gate) or
+    retires (migration-assisted drain, then remove_replica) replicas
+    under hysteresis thresholds and a cooldown.
 """
 from __future__ import annotations
 
@@ -65,6 +81,38 @@ _flags.define_flag("fleet_breaker_errors", 3,
 _flags.define_flag("fleet_breaker_cooldown_s", 2.0,
                    "Seconds an open circuit breaker waits before allowing "
                    "one half-open probe request through.")
+_flags.define_flag("fleet_roles", "symmetric",
+                   "Replica role layout for disaggregated serving: "
+                   "'symmetric' (default — every replica both prefils and "
+                   "decodes, exactly the pre-disagg behavior) or a "
+                   "'role:count,...' spec like 'prefill:1,decode:3' "
+                   "assigned to replicas in construction order. Prefill "
+                   "replicas only run prefill-only attempts and stream "
+                   "their finished KV blocks; decode replicas only host "
+                   "decode attempts.")
+_flags.define_flag("fleet_drain_migrate", False,
+                   "When on, drain(rid) also live-migrates in-flight "
+                   "sessions: their resident prompt KV blocks stream to a "
+                   "survivor and the attempts re-place there instead of "
+                   "finishing on the draining replica. Off keeps the r18 "
+                   "drain semantics (in-flight work completes in place).")
+_flags.define_flag("fleet_scale_min", 1,
+                   "FleetAutoscaler floor: scalable replicas are never "
+                   "drained below this count.")
+_flags.define_flag("fleet_scale_max", 8,
+                   "FleetAutoscaler ceiling: never spawn past this many "
+                   "scalable replicas.")
+_flags.define_flag("fleet_scale_hi", 0.85,
+                   "Scale-up threshold: utilization (offered load / fleet "
+                   "slot capacity) at or above this spawns a replica once "
+                   "the cooldown allows.")
+_flags.define_flag("fleet_scale_lo", 0.25,
+                   "Scale-down threshold: utilization at or below this "
+                   "drains (migration-assisted) and retires the least "
+                   "loaded scalable replica.")
+_flags.define_flag("fleet_scale_cooldown_s", 5.0,
+                   "Minimum seconds between autoscaler actions, so a "
+                   "bursty curve cannot flap the fleet.")
 
 # fleet-level SLO + routing telemetry: always-on like the engine's tier
 # histograms. The engine-level serving_* histograms are registry-global,
@@ -110,6 +158,30 @@ def _next_fleet_id() -> str:
     with _fleet_req_lock:
         _fleet_req_counter += 1
         return f"fleet-{_fleet_req_counter}"
+
+
+_ROLES = ("prefill", "decode", "any")
+
+
+def parse_fleet_roles(spec: Optional[str], n_replicas: int) -> List[str]:
+    """Expand a FLAGS_fleet_roles spec to one role per replica, in
+    construction order. 'symmetric' / empty -> all 'any' (the pre-disagg
+    behavior); otherwise 'role:count,...' must cover every replica."""
+    spec = (spec or "symmetric").strip().lower()
+    if spec in ("", "symmetric"):
+        return ["any"] * n_replicas
+    roles: List[str] = []
+    for part in spec.split(","):
+        name, _, count = part.partition(":")
+        name = name.strip()
+        if name not in _ROLES:
+            raise ValueError(f"unknown fleet role {name!r} "
+                             f"(want one of {_ROLES})")
+        roles.extend([name] * int(count or 1))
+    if len(roles) != n_replicas:
+        raise ValueError(f"fleet_roles covers {len(roles)} replicas, "
+                         f"fleet has {n_replicas}")
+    return roles
 
 
 class CircuitBreaker:
@@ -203,6 +275,11 @@ class FleetRequest:
         self.attempts: List[_Attempt] = []
         self.hedged = False
         self.redispatches = 0
+        # disaggregation bookkeeping: the last KV-block transfer this
+        # request rode ({src, dst, imported, dedup, ...}) and how many
+        # times it was live-migrated off a draining replica
+        self.kv_streamed: Optional[dict] = None
+        self.migrations = 0
         # router-lane RequestTrace (route decisions, queue-at-router,
         # hedge fire/win/cancel); None when spans were off at submit
         self.trace: Optional[RequestTrace] = None
@@ -268,6 +345,10 @@ class Replica:
         self.heartbeat_s = float(heartbeat_s)
         self.breaker = breaker
         self.draining = False
+        # disaggregation role: "any" (dual: the symmetric default),
+        # "prefill" (prefill-only attempts; KV streams out), "decode"
+        # (decode attempts only; KV streams in)
+        self.role = "any"
         # supervision surface (constant for thread replicas; live for
         # process replicas): incarnation fence, host pid, respawn count,
         # last exit record {incarnation, pid, exit_code, reason, ...}
@@ -379,6 +460,7 @@ class FleetRouter:
     def __init__(self, engines: Optional[List[ServingEngine]] = None, *,
                  replica_specs: Optional[List] = None,
                  store=None, prefix: str = "/pt/fleet",
+                 roles: Optional[str] = None,
                  hedge_ttft_ms: Optional[float] = None,
                  breaker_errors: Optional[int] = None,
                  breaker_cooldown_s: Optional[float] = None,
@@ -393,6 +475,8 @@ class FleetRouter:
         self._clock = clock
         self.lease_ttl_s = float(lease_ttl_s)
         self.poll_interval_s = float(poll_interval_s)
+        self._heartbeat_s = float(heartbeat_s)
+        self._idle_sleep_s = float(idle_sleep_s)
         self.hedge_ttft_s = float(
             _flags.get_flag("fleet_hedge_ttft_ms")
             if hedge_ttft_ms is None else hedge_ttft_ms) / 1000.0
@@ -401,6 +485,7 @@ class FleetRouter:
         cooldown = float(_flags.get_flag("fleet_breaker_cooldown_s")
                          if breaker_cooldown_s is None else
                          breaker_cooldown_s)
+        self._breaker_cfg = (max_errors, cooldown)
         self.registry = ReplicaRegistry(store if store is not None
                                         else InProcStore(),
                                         prefix=prefix, clock=clock)
@@ -427,6 +512,15 @@ class FleetRouter:
                              clock=clock, idle_sleep_s=idle_sleep_s)
             self.replicas[rid] = rep
             self.registry.register(rid, meta={"kind": "process"})
+        role_spec = (str(_flags.get_flag("fleet_roles"))
+                     if roles is None else roles)
+        for rep, role in zip(self.replicas.values(),
+                             parse_fleet_roles(role_spec,
+                                               len(self.replicas))):
+            rep.role = role
+        self._next_rid = len(self.replicas)
+        self._started = False
+        self.autoscaler = None          # attach_autoscaler() ticks in poll
         self._inflight: Dict[str, FleetRequest] = {}
         self._lock = threading.RLock()
         self._stop = threading.Event()
@@ -441,7 +535,8 @@ class FleetRouter:
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
-        for rep in self.replicas.values():
+        self._started = True
+        for rep in list(self.replicas.values()):
             rep.start()
         if self._monitor is None:
             self._monitor = threading.Thread(
@@ -454,7 +549,7 @@ class FleetRouter:
         if self._monitor is not None:
             self._monitor.join(timeout=10.0)
             self._monitor = None
-        for rep in self.replicas.values():
+        for rep in list(self.replicas.values()):
             rep.stop()
 
     def _monitor_loop(self):
@@ -515,35 +610,51 @@ class FleetRouter:
         scored.sort(key=lambda t: t[:3])
         return [t[3] for t in scored]
 
+    def _role_ok(self, rep: Replica, cause: str) -> bool:
+        """May a `cause` attempt land on this replica's role? Prefill-only
+        attempts go to prefill replicas, everything else to decode ones;
+        'any' (the symmetric default) hosts both."""
+        if cause == "prefill":
+            return rep.role in ("prefill", "any")
+        return rep.role in ("decode", "any")
+
     def _place(self, freq: FleetRequest, cause: str,
-               exclude: Optional[set] = None):
+               exclude: Optional[set] = None,
+               prefer: Optional[str] = None):
         """Place ONE attempt of `freq` on the best healthy replica —
-        the single routing path behind primary submit, re-dispatch and
-        hedge. Probes every candidate (affinity + load), stamps the
+        the single routing path behind primary submit, re-dispatch,
+        hedge, disaggregated prefill/decode and migration. Probes every
+        role-compatible candidate (affinity + load; `prefer` pins a
+        replica to the front, e.g. the KV-transfer target), stamps the
         engine placement with the distributed trace context
         ``{fleet_request_id, attempt, cause}``, and records the
         route-decision span (probe results included) through the fleet
-        observability hub. Returns ``(attempt, saw_queue_full)`` with
-        ``attempt is None`` when no replica accepted."""
+        observability hub. A ``cause="prefill"`` placement submits
+        prefill-only: the engine computes + keeps the prompt KV and
+        finishes with "prefill_complete" instead of decoding. Returns
+        ``(attempt, saw_queue_full)`` with ``attempt is None`` when no
+        replica accepted."""
         t0_ns = time.monotonic_ns()
         probes = []
         scored = []
         for rep in self.replicas.values():
             if exclude and rep.rid in exclude:
                 continue
-            if not self.routable(rep):
+            if not self.routable(rep) or not self._role_ok(rep, cause):
                 continue
             aff = rep.affinity(freq.prompt)
             load = rep.load()
             probes.append({"replica": rep.rid, "affinity": int(aff),
                            "load": int(load)})
-            scored.append((-aff, load, rep.rid, rep))
-        scored.sort(key=lambda t: t[:3])
+            scored.append((0 if rep.rid == prefer else 1, -aff, load,
+                           rep.rid, rep))
+        scored.sort(key=lambda t: t[:4])
         saw_queue_full = None
-        for _, _, _, rep in scored:
+        for *_key, rep in scored:
             if not rep.breaker.allow():
                 continue
             idx = len(freq.attempts)
+            extra_kw = {"prefill_only": True} if cause == "prefill" else {}
             try:
                 req = rep.engine.submit(
                     freq.prompt, max_new_tokens=freq.max_new_tokens,
@@ -551,7 +662,8 @@ class FleetRouter:
                     eos_token_id=freq.eos_token_id,
                     request_id=freq.request_id, tier=freq.tier,
                     trace_ctx=_fobs.trace_context(freq.request_id, idx,
-                                                  cause))
+                                                  cause),
+                    **extra_kw)
             except QueueFullError as e:
                 # load, not fault: no breaker strike
                 rep.breaker.record_success()
@@ -595,7 +707,16 @@ class FleetRouter:
                             submit_ts=self._clock())
         if _spans.enabled():
             freq.trace = RequestTrace(freq.request_id, freq.tier)
-        att, saw_queue_full = self._place(freq, "primary")
+        att = saw_queue_full = None
+        if self._disagg_active():
+            # stage 1 of the disaggregated pipeline: prefill-only on a
+            # prefill replica. _settle() advances the request to the KV
+            # transfer + decode placement when it finishes. Falls through
+            # to a direct decode placement when no prefill replica can
+            # take it (all dead/full) — disagg degrades, never rejects.
+            att, saw_queue_full = self._place(freq, "prefill")
+        if att is None:
+            att, saw_queue_full = self._place(freq, "primary")
         if att is None:
             if saw_queue_full is not None:
                 _FLEET_SHED.inc(reason="queue_full")
@@ -619,6 +740,11 @@ class FleetRouter:
             except Exception:  # noqa: BLE001 — supervision must survive
                 pass
         self._refresh_health_gauges()
+        if self.autoscaler is not None:
+            try:
+                self.autoscaler.tick()
+            except Exception:  # noqa: BLE001 — scaling must not wound poll
+                pass
         now = self._clock()
         with self._lock:
             pending = list(self._inflight.values())
@@ -632,7 +758,10 @@ class FleetRouter:
 
     def _settle(self, freq: FleetRequest) -> bool:
         """Complete the fleet request if any attempt finished cleanly;
-        cancel the losers. Returns True when the request is done."""
+        cancel the losers. Returns True when the request is done. A
+        finished prefill-only attempt never wins: it advances the
+        disaggregated pipeline (KV stream + decode placement) instead."""
+        advance = None
         with freq._lock:
             if freq._settled:
                 return True
@@ -643,27 +772,40 @@ class FleetRouter:
                 toks, state, reason = \
                     att.replica.engine.snapshot_output(att.req)
                 if state == "finished":
+                    if att.kind == "prefill":
+                        # consumed either way: on prefill_complete the KV
+                        # streams to a decode replica; on anything else
+                        # (cancel, error) the decode placement below
+                        # simply won't find streamed blocks
+                        att.failed = True
+                        advance = (att, reason)
+                        continue
                     if reason in _GOOD_REASONS:
                         winner = (att, toks, reason)
                         break
                     att.failed = True    # cancelled out from under us
             if winner is None:
-                return False
-            att, toks, reason = winner
-            freq.output_tokens = list(toks)
-            freq.finish_reason = reason
-            if freq.first_token_ts is None \
-                    and att.req.first_token_time is not None:
-                freq.first_token_ts = att.req.first_token_time
-            freq.finish_ts = self._clock()
-            losers = [a for a in freq.attempts
-                      if a is not att and not a.failed]
-            for a in losers:
-                a.failed = True
-            if freq.hedged:
-                _HEDGE_WINS.inc(
-                    winner="hedge" if att.kind == "hedge" else "primary")
-            freq._settled = True
+                if advance is None:
+                    return False
+            else:
+                att, toks, reason = winner
+                freq.output_tokens = list(toks)
+                freq.finish_reason = reason
+                if freq.first_token_ts is None \
+                        and att.req.first_token_time is not None:
+                    freq.first_token_ts = att.req.first_token_time
+                freq.finish_ts = self._clock()
+                losers = [a for a in freq.attempts
+                          if a is not att and not a.failed]
+                for a in losers:
+                    a.failed = True
+                if freq.hedged:
+                    _HEDGE_WINS.inc(
+                        winner="hedge" if att.kind == "hedge" else "primary")
+                freq._settled = True
+        if winner is None:
+            self._advance_disagg(freq, advance[0], advance[1])
+            return False
         for a in losers:
             toks_lost, _s, _r = a.replica.engine.snapshot_output(a.req)
             a.replica.engine.cancel(a.req, "hedge_lost")
@@ -683,6 +825,68 @@ class FleetRouter:
             self._inflight.pop(freq.request_id, None)
         freq._done.set()
         return True
+
+    # -- disaggregated prefill/decode pipeline ------------------------------
+    def _disagg_active(self) -> bool:
+        """Run the two-stage pipeline only while a prefill replica can
+        actually take work — otherwise requests place directly on the
+        decode pool (full prefill there, symmetric behavior)."""
+        return any(rep.role == "prefill" and self.routable(rep)
+                   for rep in self.replicas.values())
+
+    def _pick_decode_target(self, freq: FleetRequest,
+                            exclude: Optional[set] = None
+                            ) -> Optional[Replica]:
+        """Best decode-capable replica for a KV transfer: longest cached
+        chain (it may already hold the prefix), then least load."""
+        scored = []
+        for rep in self.replicas.values():
+            if exclude and rep.rid in exclude:
+                continue
+            if not self.routable(rep) or not self._role_ok(rep, "decode"):
+                continue
+            scored.append((-rep.affinity(freq.prompt), rep.load(),
+                           rep.rid, rep))
+        scored.sort(key=lambda t: t[:3])
+        return scored[0][3] if scored else None
+
+    def _stream_kv(self, freq: FleetRequest, src: Replica,
+                   dst: Replica, kind: str) -> Optional[dict]:
+        """Ship `freq`'s resident prompt blocks src -> dst over the
+        chain-hash wire. Best-effort: a failed transfer only costs the
+        prefix hit (the decode replica re-prefils), never the request."""
+        try:
+            recs = src.engine.export_kv_blocks(freq.prompt)
+            if not recs:
+                return None
+            stats = dst.engine.ingest_kv_blocks(recs)
+        except Exception:  # noqa: BLE001 — replica died mid-transfer
+            return None
+        stats = dict(stats, src=src.rid, dst=dst.rid, kind=kind)
+        freq.kv_streamed = stats
+        self.obs.on_kv_transfer(freq, src.rid, dst.rid, stats, kind=kind)
+        return stats
+
+    def _advance_disagg(self, freq: FleetRequest, att: _Attempt,
+                        reason: str) -> None:
+        """Stage 2: the prefill-only attempt finished. Stream its KV
+        blocks to the best decode replica, then place the decode attempt
+        — preferring the transfer target, though affinity would find it
+        anyway (the streamed chain IS the prefix-cache content the
+        ranking probes). On a failed prefill (cancel/error) this is a
+        plain decode placement: full prefill on the decode replica."""
+        prefer = None
+        if reason == "prefill_complete":
+            target = self._pick_decode_target(freq,
+                                              exclude={att.replica.rid})
+            if target is not None:
+                self._stream_kv(freq, att.replica, target, "prefill")
+                prefer = target.rid
+        att2, _ = self._place(freq, "decode", prefer=prefer)
+        if att2 is None and freq._orphan_ns is None:
+            # decode pool full/dead this pass: the next poll's orphan
+            # re-dispatch keeps retrying — accepted requests never drop
+            freq._orphan_ns = time.monotonic_ns()
 
     def _redispatch_if_orphaned(self, freq: FleetRequest):
         """Requests in flight on a dead replica are resubmitted (same id,
@@ -766,6 +970,8 @@ class FleetRouter:
         with freq._lock:
             live = [a for a in freq.attempts if not a.failed]
             hosting = {a.replica.rid for a in live}
+        if any(a.kind == "prefill" for a in live):
+            return          # still in the prefill stage: nothing to hedge
         for att in live:
             toks, _state, _reason = \
                 att.replica.engine.snapshot_output(att.req)
@@ -778,13 +984,69 @@ class FleetRouter:
             _HEDGED.inc()
 
     # -- drain / chaos -----------------------------------------------------
-    def drain(self, rid: str):
+    def drain(self, rid: str, migrate: Optional[bool] = None):
         """Rolling-restart drain: stop routing to `rid`, stop its engine
-        admitting, let in-flight work finish."""
+        admitting. With `migrate` (default FLAGS_fleet_drain_migrate,
+        off) in-flight sessions live-migrate to a survivor — their
+        resident prompt KV blocks stream over the chain-hash wire and
+        the attempts re-place there, so the survivor re-decodes (greedy:
+        bitwise identical) without re-prefilling any already-full block.
+        Without it they finish in place (the r18 semantics)."""
         with self._lock:
             rep = self.replicas[rid]
             rep.draining = True
             rep.engine.drain()
+        if (bool(_flags.get_flag("fleet_drain_migrate"))
+                if migrate is None else bool(migrate)):
+            self.migrate_from(rid)
+
+    def migrate_from(self, rid: str) -> int:
+        """Live KV migration: for every in-flight attempt on `rid`, ship
+        the session's resident prompt blocks to the best survivor,
+        cancel the attempt locally and re-place it pinned to the
+        survivor. Returns how many attempts moved; sessions with no
+        routable survivor stay and finish on the draining replica."""
+        rep = self.replicas[rid]
+        with self._lock:
+            pending = list(self._inflight.values())
+        moved = 0
+        for freq in pending:
+            with freq._lock:
+                if freq._settled:
+                    continue
+                atts = [a for a in freq.attempts
+                        if not a.failed and a.replica is rep]
+            for att in atts:
+                target = self._pick_decode_target(freq, exclude={rid})
+                if target is None:
+                    break
+                stats = self._stream_kv(freq, rep, target, "migrate")
+                with freq._lock:
+                    if att.failed or freq._settled:
+                        continue
+                # place the survivor attempt BEFORE failing the old one:
+                # the poll thread re-dispatches any request whose attempts
+                # are all failed, and would race in a duplicate decode
+                new_att, _qf = self._place(freq, "migrate",
+                                           prefer=target.rid)
+                if new_att is None:
+                    continue    # no capacity — finish on the drainer
+                with freq._lock:
+                    if freq._settled:
+                        continue
+                    att.failed = True
+                    freq.migrations += 1
+                toks_lost = 0
+                try:
+                    toks, _s, _r = rep.engine.snapshot_output(att.req)
+                    toks_lost = len(toks)
+                    rep.engine.cancel(att.req, "migrated")
+                except Exception:  # noqa: BLE001 — dying replica
+                    pass
+                self.obs.on_cancelled(freq, att, toks_lost, "migrated")
+                self.obs.on_migrate(freq, rid, target.rid, stats)
+                moved += 1
+        return moved
 
     def resume(self, rid: str):
         with self._lock:
@@ -798,6 +1060,70 @@ class FleetRouter:
     def kill_replica(self, rid: str):
         """Chaos hook (tests / servebench): crash one replica."""
         self.replicas[rid].kill()
+
+    # -- elastic fleet membership ------------------------------------------
+    def add_replica(self, engine=None, *, spec=None,
+                    role: str = "any") -> str:
+        """Scale-up: join a new replica — either a ServingEngine (thread
+        replica) or a ProcessReplicaSpec (supervised OS process; its r20
+        warm-up gate keeps it unroutable until /healthz passes). Started
+        immediately when the router is running."""
+        if (engine is None) == (spec is None):
+            raise ValueError("add_replica wants exactly one of engine= "
+                             "or spec=")
+        if role not in _ROLES:
+            raise ValueError(f"unknown fleet role {role!r}")
+        max_errors, cooldown = self._breaker_cfg
+        with self._lock:
+            rid = f"replica-{self._next_rid}"
+            self._next_rid += 1
+            breaker = CircuitBreaker(max_errors, cooldown,
+                                     clock=self._clock)
+            if engine is not None:
+                rep = Replica(rid, engine, registry=self.registry,
+                              heartbeat_s=self._heartbeat_s,
+                              breaker=breaker, clock=self._clock,
+                              idle_sleep_s=self._idle_sleep_s)
+                meta = {"slots": getattr(engine, "max_slots", None)}
+            else:
+                rep = spec.build(rid, registry=self.registry,
+                                 heartbeat_s=self._heartbeat_s,
+                                 breaker=breaker, clock=self._clock,
+                                 idle_sleep_s=self._idle_sleep_s)
+                meta = {"kind": "process"}
+            rep.role = role
+            self.replicas[rid] = rep
+            self._breaker_seen[rid] = "closed"
+            self.registry.register(rid, meta=meta)
+            started = self._started
+            n = len(self.replicas)
+        if started:
+            rep.start()
+        self.obs.on_scale("up", rid, role=role, replicas=n)
+        return rid
+
+    def remove_replica(self, rid: str) -> bool:
+        """Scale-down (after a drain — ideally migration-assisted — ran
+        the replica dry): detach and stop it. In-flight attempts still
+        referencing it settle normally; its health gauge drops to 0."""
+        with self._lock:
+            rep = self.replicas.pop(rid, None)
+            self._breaker_seen.pop(rid, None)
+            n = len(self.replicas)
+        if rep is None:
+            return False
+        _REPLICA_UP.set(0.0, replica=rid)
+        try:
+            rep.stop()
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
+        self.obs.on_scale("down", rid, role=rep.role, replicas=n)
+        return True
+
+    def attach_autoscaler(self, scaler) -> None:
+        """Tick `scaler` from every poll (FleetAutoscaler or anything
+        with .tick())."""
+        self.autoscaler = scaler
 
     # -- introspection -----------------------------------------------------
     def inflight(self) -> int:
@@ -849,6 +1175,111 @@ class FleetRouter:
                 s["last_exit"] = rep.last_exit
                 reps[rid] = s
             return {"inflight": len(self._inflight), "replicas": reps}
+
+
+class FleetAutoscaler:
+    """Elastic replica-count control over one role pool of a FleetRouter.
+
+    Ticked from every router poll (attach_autoscaler). Utilization is
+    offered load over slot capacity across the pool's live replicas;
+    crossing `hi` spawns one replica (via the `spawn` callback — a
+    ServingEngine for thread replicas or a ProcessReplicaSpec for
+    supervised processes, whose r20 warm-up gate keeps the newcomer
+    unroutable until healthy), crossing `lo` retires the least-loaded
+    one through a migration-assisted drain followed by remove_replica
+    once it runs dry. One action per cooldown window; floor/ceiling
+    bound the pool. All timing runs on the router's clock, so
+    virtual-time benches drive it deterministically."""
+
+    def __init__(self, router: FleetRouter, spawn, *, role: str = "any",
+                 min_replicas: Optional[int] = None,
+                 max_replicas: Optional[int] = None,
+                 hi: Optional[float] = None, lo: Optional[float] = None,
+                 cooldown_s: Optional[float] = None,
+                 slots_per_replica: int = 8):
+        self.router = router
+        self.spawn = spawn
+        self.role = str(role)
+        self.min_replicas = int(_flags.get_flag("fleet_scale_min")
+                                if min_replicas is None else min_replicas)
+        self.max_replicas = int(_flags.get_flag("fleet_scale_max")
+                                if max_replicas is None else max_replicas)
+        self.hi = float(_flags.get_flag("fleet_scale_hi")
+                        if hi is None else hi)
+        self.lo = float(_flags.get_flag("fleet_scale_lo")
+                        if lo is None else lo)
+        self.cooldown_s = float(_flags.get_flag("fleet_scale_cooldown_s")
+                                if cooldown_s is None else cooldown_s)
+        if not (0.0 <= self.lo < self.hi):
+            raise ValueError(f"need 0 <= lo < hi, got lo={self.lo} "
+                             f"hi={self.hi}")
+        self.slots_per_replica = int(slots_per_replica)
+        self.last_utilization: Optional[float] = None
+        self.events: List[dict] = []    # {ts, dir, replica, utilization}
+        self._retiring: Optional[str] = None
+        self._last_action = -float("inf")
+
+    def _slots(self, rep: Replica) -> int:
+        return int(getattr(rep.engine, "max_slots", 0)
+                   or self.slots_per_replica)
+
+    def _pool(self) -> List[Replica]:
+        return [rep for rep in self.router.replicas.values()
+                if rep.role == self.role
+                and not rep.draining
+                and not self.router.replica_dead(rep)]
+
+    def utilization(self) -> float:
+        pool = self._pool()
+        cap = sum(self._slots(r) for r in pool)
+        if cap <= 0:
+            return float("inf")
+        return sum(r.load() for r in pool) / cap
+
+    def tick(self) -> Optional[str]:
+        """One control turn; returns "up"/"down" when an action fired.
+        A pending retirement completes (drained -> removed) before any
+        new decision — at most one membership change is ever in flight."""
+        now = self.router._clock()
+        if self._retiring is not None:
+            rid = self._retiring
+            if rid not in self.router.replicas:
+                self._retiring = None
+            else:
+                try:
+                    dry = self.router.drained(rid)
+                except Exception:  # noqa: BLE001 — replica died draining
+                    dry = True
+                if dry:
+                    self.router.remove_replica(rid)
+                    self._retiring = None
+            return None
+        u = self.utilization()
+        self.last_utilization = u
+        if now - self._last_action < self.cooldown_s:
+            return None
+        pool = self._pool()
+        if u >= self.hi and len(pool) < self.max_replicas:
+            new = self.spawn()
+            kw = ({"spec": new} if hasattr(new, "build") else
+                  {"engine": new})
+            rid = self.router.add_replica(role=self.role, **kw)
+            self._last_action = now
+            self.events.append({"ts": now, "dir": "up", "replica": rid,
+                                "utilization": round(u, 4),
+                                "replicas": len(pool) + 1})
+            return "up"
+        if u <= self.lo and len(pool) > self.min_replicas:
+            victim = min(pool, key=lambda r: (r.load(), r.rid))
+            self.router.drain(victim.rid, migrate=True)
+            self._retiring = victim.rid
+            self._last_action = now
+            self.events.append({"ts": now, "dir": "down",
+                                "replica": victim.rid,
+                                "utilization": round(u, 4),
+                                "replicas": len(pool) - 1})
+            return "down"
+        return None
 
 
 def build_fleet(model_factory, n_replicas: Optional[int] = None, *,

@@ -109,6 +109,25 @@ _SLO_ROLLUP = _gauge("fleet_slo_seconds",
                      "merge of every {tier,replica,cause} row of the "
                      "fleet_attempt_*_seconds histograms.",
                      labelnames=("metric", "quantile"), always=True)
+_KV_BLOCKS = _counter("fleet_kv_streamed_blocks_total",
+                      "KV blocks on the chain-hash transfer wire "
+                      "(disaggregated prefill->decode streaming and live "
+                      "migration), by ingest outcome: imported (fresh), "
+                      "dedup (already resident), rejected (chain-hash "
+                      "mismatch), skipped (pool full / after a break).",
+                      labelnames=("result",), always=True)
+_KV_BYTES = _counter("fleet_kv_streamed_bytes_total",
+                     "Raw KV page bytes admitted over the transfer wire "
+                     "(fresh imports only — dedups move nothing).",
+                     always=True)
+_MIGRATIONS = _counter("fleet_migrations_total",
+                       "In-flight sessions live-migrated off a draining "
+                       "replica onto a survivor.", always=True)
+_SCALE_EVENTS = _counter("fleet_scale_events_total",
+                         "Elastic fleet membership changes, by "
+                         "direction (up = replica joined, down = replica "
+                         "retired).", labelnames=("direction",),
+                         always=True)
 
 _ROLLUP_SOURCES = (("route", _ATT_ROUTE), ("queue", _ATT_QUEUE),
                    ("ttft", _ATT_TTFT), ("e2e", _ATT_E2E))
@@ -154,6 +173,7 @@ class FleetObservability:
         self._lock = threading.Lock()
         self._settled: deque = deque(maxlen=n)   # finished fleet records
         self._breaker_log: deque = deque(maxlen=256)
+        self._scale_log: deque = deque(maxlen=256)   # membership changes
         self._ttft: Dict[str, deque] = {}        # rid -> recent TTFTs
         self._tick_n = 0
         self._win_dispatch = 0    # placements since the last tick
@@ -214,6 +234,64 @@ class FleetObservability:
                    cause=att.kind, replica=att.replica.rid,
                    reason=str(reason), wasted_tokens=int(tokens),
                    fleet_request_id=freq.request_id)
+
+    # -- disaggregation / migration / scaling hooks ------------------------
+    def on_kv_transfer(self, freq, src: str, dst: str, stats: dict,
+                       kind: str = "prefill") -> None:
+        """One KV-block transfer over the chain-hash wire (prefill
+        streaming or migration): counters by outcome plus a router-lane
+        span carrying the full stats."""
+        for key in ("imported", "dedup", "rejected", "skipped"):
+            n = int(stats.get(key, 0))
+            if n:
+                _KV_BLOCKS.inc(n, result=key)
+        nbytes = int(stats.get("bytes", 0))
+        if nbytes:
+            _KV_BYTES.inc(nbytes)
+        tr = freq.trace
+        if tr is not None:
+            now = time.monotonic_ns()
+            tr.add("fleet.kv_transfer", now, now, src=src, dst=dst,
+                   kind=str(kind),
+                   **{k: int(stats.get(k, 0)) for k in
+                      ("imported", "dedup", "rejected", "skipped",
+                       "bytes")},
+                   fleet_request_id=freq.request_id)
+
+    def on_migrate(self, freq, src: str, dst: str,
+                   stats: Optional[dict]) -> None:
+        _MIGRATIONS.inc()
+        tr = freq.trace
+        if tr is not None:
+            now = time.monotonic_ns()
+            tr.add("fleet.migrate", now, now, src=src, dst=dst,
+                   streamed_blocks=int((stats or {}).get("imported", 0)
+                                       + (stats or {}).get("dedup", 0)),
+                   fleet_request_id=freq.request_id)
+
+    def on_scale(self, direction: str, rid: str, *, role: str = "any",
+                 replicas: int = 0) -> None:
+        """Elastic membership change: counter + the scale log merged
+        into cross-replica traces as router-lane instants (the breaker
+        pattern), + a global span so scrapes and dumps see it."""
+        _SCALE_EVENTS.inc(direction=str(direction))
+        now_ns = time.monotonic_ns()
+        with self._lock:
+            self._scale_log.append({
+                "ts_ns": now_ns, "ts": time.time(), "tick": self._tick_n,
+                "direction": str(direction), "replica": str(rid),
+                "role": str(role), "replicas": int(replicas)})
+        if _spans.enabled():
+            _spans.record_span("fleet.scale", now_ns, now_ns, cat="fleet",
+                               args={"direction": str(direction),
+                                     "replica": str(rid),
+                                     "role": str(role),
+                                     "replicas": int(replicas)})
+
+    def scale_log(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [{k: v for k, v in ev.items() if k != "ts_ns"}
+                    for ev in self._scale_log]
 
     def on_breaker(self, rid: str, old: Optional[str], new: str) -> None:
         """Breaker state transition (detected at the router's record
@@ -468,6 +546,7 @@ class FleetObservability:
             att_rids = {a.replica.rid for a in atts}
             with self._lock:
                 translog = list(self._breaker_log)
+                scalelog = list(self._scale_log)
             for ev in translog:
                 ts = ev["ts_ns"] / 1e3
                 if ev["replica"] in att_rids and lo <= ts <= hi:
@@ -477,6 +556,18 @@ class FleetObservability:
                         "args": {"fleet_request_id": freq.request_id,
                                  "replica": ev["replica"],
                                  "from": ev["from"], "to": ev["to"]}})
+            # scale events are fleet-wide: any membership change inside
+            # this request's window lands on its router lane
+            for ev in scalelog:
+                ts = ev["ts_ns"] / 1e3
+                if lo <= ts <= hi:
+                    events.append({
+                        "name": "fleet.scale", "ph": "X", "cat": "fleet",
+                        "ts": ts, "dur": 0.0, "pid": 0, "tid": 0,
+                        "args": {"fleet_request_id": freq.request_id,
+                                 "direction": ev["direction"],
+                                 "replica": ev["replica"],
+                                 "replicas": ev["replicas"]}})
         for pid in sorted(procs):
             events.append({"ph": "M", "name": "process_name", "pid": pid,
                            "tid": 0, "args": {"name": procs[pid]}})

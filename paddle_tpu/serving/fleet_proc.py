@@ -246,7 +246,8 @@ class _RemoteEngine:
     def submit(self, prompt: List[int], max_new_tokens: int = 16,
                temperature: float = 0.0, eos_token_id=None,
                request_id: Optional[str] = None, tier: str = "default",
-               trace_ctx: Optional[dict] = None) -> _RemoteRequest:
+               trace_ctx: Optional[dict] = None,
+               prefill_only: bool = False) -> _RemoteRequest:
         if self.base_url is None:
             raise RuntimeError("replica incarnation not ready")
         if self._draining:
@@ -257,6 +258,7 @@ class _RemoteEngine:
             "prompt": req.prompt, "max_new_tokens": req.max_new_tokens,
             "temperature": req.temperature, "eos_token_id": req.eos_token_id,
             "tier": req.tier, "stream": True,
+            "prefill_only": bool(prefill_only),
         }).encode()
         http_req = urllib.request.Request(
             self.base_url + "/generate", data=body,
@@ -378,6 +380,40 @@ class _RemoteEngine:
             return {"remote": True, "unreachable": True}
         s["remote"] = True
         return s
+
+    # -- KV-block transfer wire (disagg streaming / live migration) ---------
+    def export_kv_blocks(self, tokens: List[int]) -> List[dict]:
+        """POST /kv/export on the child; decoded to the same record list
+        ServingEngine.export_kv_blocks returns. Best-effort: a dead or
+        frozen child exports nothing (the receiver just re-prefils)."""
+        if self.base_url is None:
+            return []
+        from .server import kv_wire_decode
+
+        body = json.dumps({"tokens": [int(t) for t in tokens]}).encode()
+        http_req = urllib.request.Request(
+            self.base_url + "/kv/export", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(
+                    http_req, timeout=self._HTTP_TIMEOUT_S) as resp:
+                return kv_wire_decode(resp.read())
+        except Exception:  # noqa: BLE001 — unreachable child
+            return []
+
+    def ingest_kv_blocks(self, records: List[dict]) -> dict:
+        """POST /kv/ingest on the child; raises on an unreachable child
+        so the router's transfer path falls back to plain re-prefill."""
+        if self.base_url is None:
+            raise RuntimeError("replica incarnation not ready")
+        from .server import kv_wire_encode
+
+        http_req = urllib.request.Request(
+            self.base_url + "/kv/ingest", data=kv_wire_encode(records),
+            headers={"Content-Type": "application/x-ndjson"})
+        with urllib.request.urlopen(
+                http_req, timeout=self._HTTP_TIMEOUT_S) as resp:
+            return json.loads(resp.read().decode())
 
 
 # ---------------------------------------------------------------------------

@@ -132,8 +132,10 @@ _GOODPUT_G = _gauge("serving_goodput_tokens_per_s",
                     "Decoded tokens per second over the recent tick "
                     "window, sampled per tick.")
 
-#: finish reasons that count as delivered work (everything else is shed)
-_GOOD_REASONS = ("stop", "length")
+#: finish reasons that count as delivered work (everything else is shed).
+#: "prefill_complete" is the disaggregated prefill-only finish: the KV it
+#: computed is the product, not the (zero) output tokens.
+_GOOD_REASONS = ("stop", "length", "prefill_complete")
 
 _ENGINE_SEQ = itertools.count()
 

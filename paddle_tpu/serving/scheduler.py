@@ -62,7 +62,8 @@ class Request:
     def __init__(self, prompt: List[int], max_new_tokens: int = 16,
                  temperature: float = 0.0, eos_token_id: Optional[int] = None,
                  request_id: Optional[str] = None, tier: str = "default",
-                 trace_ctx: Optional[dict] = None):
+                 trace_ctx: Optional[dict] = None,
+                 prefill_only: bool = False):
         self.request_id = (request_id if request_id is not None
                            else f"req-{next(_req_counter)}")
         self.prompt = [int(t) for t in prompt]
@@ -79,6 +80,11 @@ class Request:
         # fleet request / attempt / cause this engine-level placement
         # serves — RequestTrace inherits it so every span is attributed
         self.trace_ctx = dict(trace_ctx) if trace_ctx else None
+        # disaggregated serving: compute + register + keep the prompt's KV
+        # blocks, then finish with reason "prefill_complete" WITHOUT
+        # sampling a first token — the blocks are exported to a decode
+        # replica instead of decoded locally
+        self.prefill_only = bool(prefill_only)
         self.output_tokens: List[int] = []
         self.state = "queued"
         self.finish_reason: Optional[str] = None

@@ -17,6 +17,12 @@ CONTINUOUSLY by the single engine loop rather than serialized.
                    {"done": true, "finish_reason", "telemetry"} line.
                    A client disconnect cancels the request (its slot and
                    KV reservation return to the pool immediately).
+  POST /kv/export  {"tokens": [...]} -> NDJSON: one line per resident
+                   full prompt block (chain digest + base64 page bytes)
+  POST /kv/ingest  that NDJSON -> {"imported", "dedup", "rejected",
+                   "skipped", "bytes"}; chain-hash verified, idempotent
+                   (disaggregated prefill->decode streaming + live KV
+                   migration ride this wire)
   GET  /stats      engine + KV-pool occupancy snapshot (JSON), taken in
                    ONE engine-lock acquisition so concurrent streaming
                    never yields a torn scrape
@@ -37,6 +43,7 @@ are ALSO served on a dedicated port (one scrape target per concern).
 """
 from __future__ import annotations
 
+import base64
 import json
 import threading
 import time
@@ -57,6 +64,37 @@ define_flag("serving_request_timeout_s", 300.0,
             "server answers 504.")
 
 
+# -------------------------------------------- KV-block wire format
+# One NDJSON line per streamed block, chain order:
+#   {"digest": hex, "prev": hex, "tokens": [int, ...],
+#    "layers": [[k_b64, v_b64], ...]}
+# — exactly engine.export_kv_blocks()'s records with the raw page bytes
+# base64'd. The receiver re-derives every digest from (prev, tokens)
+# before admitting anything, so a corrupted or mislabeled line is
+# rejected rather than poisoning the prefix cache.
+
+def kv_wire_encode(records) -> bytes:
+    lines = [json.dumps({
+        "digest": r["digest"], "prev": r["prev"], "tokens": r["tokens"],
+        "layers": [[base64.b64encode(k).decode("ascii"),
+                    base64.b64encode(v).decode("ascii")]
+                   for k, v in r["layers"]],
+    }) for r in records]
+    return ("\n".join(lines) + "\n").encode() if lines else b""
+
+
+def kv_wire_decode(body: bytes):
+    records = []
+    for line in body.splitlines():
+        if not line.strip():
+            continue
+        o = json.loads(line)
+        o["layers"] = [(base64.b64decode(k), base64.b64decode(v))
+                       for k, v in o["layers"]]
+        records.append(o)
+    return records
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "paddle_tpu_serving/1.0"
     # chunked transfer-encoding (streaming) requires HTTP/1.1; every
@@ -69,6 +107,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler contract
         path = self.path.split("?", 1)[0]
+        if path in ("/kv/export", "/kv/ingest"):
+            self._kv_transfer(path)
+            return
         if path != "/generate":
             self._reply(404, {"error": "not found"})
             return
@@ -87,7 +128,8 @@ class _Handler(BaseHTTPRequestHandler):
                 max_new_tokens=int(body.get("max_new_tokens", 16)),
                 temperature=float(body.get("temperature", 0.0)),
                 eos_token_id=body.get("eos_token_id"),
-                tier=str(body.get("tier", "default")))
+                tier=str(body.get("tier", "default")),
+                prefill_only=bool(body.get("prefill_only", False)))
         except QueueFullError as e:
             # honest load shedding: tell the client WHEN to come back
             # instead of queueing without bound or failing opaquely
@@ -123,6 +165,37 @@ class _Handler(BaseHTTPRequestHandler):
             "finish_reason": req.finish_reason,
             "telemetry": req.telemetry(),
         })
+
+    def _kv_transfer(self, path: str) -> None:
+        """Block-transfer wire for disaggregated serving / live migration.
+
+          POST /kv/export  {"tokens": [int, ...]}
+                       ->  NDJSON, one line per RESIDENT full prompt
+                           block (chain order, base64 page payloads)
+          POST /kv/ingest  that NDJSON body
+                       ->  {"imported", "dedup", "rejected", "skipped",
+                            "bytes"} — chain-hash verified, idempotent
+        """
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            raw = self.rfile.read(length)
+            if path == "/kv/export":
+                body = json.loads(raw or b"{}")
+                tokens = body.get("tokens")
+                if (not isinstance(tokens, list)
+                        or not all(isinstance(t, int) for t in tokens)):
+                    self._reply(400, {"error": "tokens must be a list of "
+                                               "token ids"})
+                    return
+                recs = self._srv.engine.export_kv_blocks(tokens)
+                self._reply_raw(200, kv_wire_encode(recs),
+                                "application/x-ndjson")
+            else:
+                stats = self._srv.engine.ingest_kv_blocks(
+                    kv_wire_decode(raw))
+                self._reply(200, stats)
+        except Exception as e:  # noqa: BLE001 — malformed payloads etc.
+            self._reply(400, {"error": f"{type(e).__name__}: {e}"})
 
     def _stream(self, req, timeout: float) -> None:
         """Chunked NDJSON: one line per engine flush with the newly

@@ -26,11 +26,13 @@ from paddle_tpu.observability import registry, reset_all
 from paddle_tpu.serving import (
     CircuitBreaker,
     EngineDrainingError,
+    FleetAutoscaler,
     FleetRouter,
     FleetServer,
     QueueFullError,
     ServingEngine,
     export_fleet_trace,
+    parse_fleet_roles,
 )
 from paddle_tpu.serving.fleet_observability import (
     coverage_of,
@@ -362,6 +364,133 @@ class TestFleetRouter:
             assert b.wait(timeout=120) and c.wait(timeout=120)
         finally:
             router.stop()
+
+
+# ------------------------------------------------ disaggregated serving
+class TestDisaggregatedFleet:
+    def test_parse_fleet_roles(self):
+        assert parse_fleet_roles(None, 3) == ["any"] * 3
+        assert parse_fleet_roles("symmetric", 2) == ["any", "any"]
+        assert (parse_fleet_roles("prefill:1,decode:2", 3)
+                == ["prefill", "decode", "decode"])
+        with pytest.raises(ValueError):
+            parse_fleet_roles("prefill:1,decode:1", 3)  # doesn't cover
+        with pytest.raises(ValueError):
+            parse_fleet_roles("oracle:2", 2)            # unknown role
+
+    def test_disagg_streams_kv_and_decode_pool_never_prefills(self):
+        fake = [0.0]
+        cfg, router = _fleet(3, clock=lambda: fake[0], lease_ttl_s=1000.0,
+                             roles="prefill:1,decode:2")
+        _, ref = _model()
+        rng = np.random.default_rng(21)
+        n_new = 6
+        prompts = [[int(t) for t in rng.integers(0, cfg.vocab_size, 32)]
+                   for _ in range(4)]
+        want = []
+        for p in prompts:
+            ids = np.asarray([p], np.int32)
+            out = ref.generate(paddle.to_tensor(ids),
+                               max_new_tokens=n_new).numpy()[0, -n_new:]
+            want.append([int(t) for t in out])
+        freqs = [router.submit(p, max_new_tokens=n_new) for p in prompts]
+        # admission lands every prompt on the (single) prefill replica
+        assert all(f.attempts[0].kind == "prefill" for f in freqs)
+        assert {f.attempts[0].replica.rid for f in freqs} == {"replica-0"}
+        _drive(router, freqs)
+        for f, w in zip(freqs, want):
+            assert f.output_tokens == w           # bitwise vs the oracle
+            # the winning attempt is the decode stage on a decode replica
+            (winner,) = [a for a in f.attempts if not a.failed]
+            assert winner.kind == "decode"
+            assert winner.replica.role == "decode"
+            # the whole prompt chain crossed the wire (2 blocks of 16)
+            ks = f.kv_streamed
+            assert ks and ks["kind"] == "prefill"
+            assert ks["imported"] + ks["dedup"] == 2
+            assert winner.req.prefix_matched == len(f.prompt)
+        # the decode pool computed ZERO prefill tokens
+        for rid in ("replica-1", "replica-2"):
+            assert router.replicas[rid].engine.prefill_tokens == 0
+        assert router.replicas["replica-0"].engine.prefill_tokens > 0
+
+    def test_drain_migrates_mid_decode_with_zero_reprefill(self):
+        fake = [0.0]
+        cfg, router = _fleet(2, clock=lambda: fake[0], lease_ttl_s=1000.0)
+        _, ref = _model()
+        rng = np.random.default_rng(22)
+        n_new = 48
+        prompt = [int(t) for t in rng.integers(0, cfg.vocab_size, 32)]
+        ids = np.asarray([prompt], np.int32)
+        want = [int(t) for t in ref.generate(
+            paddle.to_tensor(ids), max_new_tokens=n_new).numpy()[0, -n_new:]]
+        f = router.submit(prompt, max_new_tokens=n_new)
+        rep = f.attempts[0].replica
+        for _ in range(8):          # 2 prefill chunks + a few decode steps
+            rep.engine.step()
+        _, state, _ = rep.engine.snapshot_output(f.attempts[0].req)
+        assert state != "finished"  # caught mid-decode, KV chain live
+        router.drain(rep.rid, migrate=True)   # synchronous migration
+        assert f.migrations == 1
+        _drive(router, [f])
+        # exactly one handoff, no duplicate re-dispatch raced in
+        assert [a.kind for a in f.attempts] == ["primary", "migrate"]
+        mig = f.attempts[1]
+        assert mig.replica.rid != rep.rid
+        # the streamed prompt chain admitted as a FULL prefix hit: the
+        # survivor re-prefilled nothing
+        assert mig.req.prefix_matched == len(prompt)
+        assert mig.replica.engine.prefill_tokens == 0
+        assert f.output_tokens == want        # bitwise across the handoff
+        assert router.drained(rep.rid)
+
+    def test_autoscaler_tracks_load_up_and_down_bitwise(self):
+        fake = [0.0]
+        cfg, router = _fleet(1, clock=lambda: fake[0], lease_ttl_s=1000.0)
+        _, ref = _model()
+
+        def spawn():
+            _, m = _model()
+            return ServingEngine(m, max_slots=3, block_size=16,
+                                 prefill_chunk=16)
+
+        scaler = FleetAutoscaler(router, spawn, min_replicas=1,
+                                 max_replicas=3, hi=0.75, lo=0.25,
+                                 cooldown_s=1.0)
+        router.attach_autoscaler(scaler)
+        rng = np.random.default_rng(23)
+        n_new = 6
+        prompts = [[int(t) for t in rng.integers(0, cfg.vocab_size, 8)]
+                   for _ in range(8)]
+        want = []
+        for p in prompts:
+            ids = np.asarray([p], np.int32)
+            out = ref.generate(paddle.to_tensor(ids),
+                               max_new_tokens=n_new).numpy()[0, -n_new:]
+            want.append([int(t) for t in out])
+        freqs = [router.submit(p, max_new_tokens=n_new) for p in prompts]
+        # 8 queued requests over 3 slots: utilization >> hi, the pool
+        # grows one replica per cooldown window up to the ceiling
+        for _ in range(8):
+            fake[0] += 1.1
+            router.poll()
+            if len(router.replicas) == 3:
+                break
+        assert len(router.replicas) == 3
+        assert sum(e["dir"] == "up" for e in scaler.events) == 2
+        _drive(router, freqs)
+        for f, w in zip(freqs, want):
+            assert f.output_tokens == w
+        # idle pool: drains back to the floor, one retirement at a time
+        for _ in range(64):
+            fake[0] += 1.1
+            router.poll()
+            if (scaler._retiring is None
+                    and len(router.replicas) == scaler.min_replicas):
+                break
+        assert len(router.replicas) == 1
+        assert sum(e["dir"] == "down" for e in scaler.events) == 2
+        assert len(router.obs.scale_log()) >= 4   # 2 up + 2 down
 
 
 # ---------------------------------------------------------------- HTTP API

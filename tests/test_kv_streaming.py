@@ -1,0 +1,142 @@
+"""KV-block streaming round-trip (ISSUE r21): the export/import wire that
+disaggregated serving and live migration ride on.
+
+Allocator level: chain-hash export into a second allocator, corruption
+rejection, conservation. Engine level: streamed blocks land bitwise-
+identical in the receiving pool, admit as FULL prefix hits (the decode
+replica runs zero prefill for them), and the transfer is idempotent.
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu.models import GPTConfig, GPTForCausalLM
+from paddle_tpu.serving import BlockAllocator, ServingEngine
+
+
+# ---------------------------------------------------- allocator wire level
+def _chained_allocator(tokens, bs=4):
+    a = BlockAllocator(num_blocks=16, block_size=bs)
+    a.reserve_prefix("seq", tokens, len(tokens))
+    a.register_prefix("seq", tokens)
+    return a
+
+
+class TestAllocatorRoundTrip:
+    def test_export_import_chain_into_second_allocator(self):
+        tokens = list(range(100, 112))            # 3 full blocks of 4
+        a = _chained_allocator(tokens)
+        recs = a.export_prefix(tokens)
+        assert len(recs) == 3
+        # the chain links: every record's prev is the prior digest
+        prev = b""
+        for r in recs:
+            assert r["prev"] == prev
+            prev = r["digest"]
+        b = BlockAllocator(num_blocks=16, block_size=4)
+        for r in recs:
+            blk, imported = b.import_block(r["prev"], r["tokens"],
+                                           r["digest"])
+            assert imported
+        b.check_invariants()
+        # the receiver now matches the whole prefix without prefilling
+        assert b.peek_match(tokens) == len(tokens)
+        _, matched, _, _ = b.reserve_prefix("s2", tokens, len(tokens) + 4)
+        assert matched == len(tokens)
+        b.check_invariants()
+
+    def test_import_is_idempotent(self):
+        tokens = list(range(8))
+        a = _chained_allocator(tokens)
+        b = BlockAllocator(num_blocks=16, block_size=4)
+        recs = a.export_prefix(tokens)
+        first = [b.import_block(r["prev"], r["tokens"], r["digest"])
+                 for r in recs]
+        again = [b.import_block(r["prev"], r["tokens"], r["digest"])
+                 for r in recs]
+        assert all(imp for _, imp in first)
+        assert not any(imp for _, imp in again)
+        # the dedup returns the SAME resident blocks, nothing new claimed
+        assert [blk for blk, _ in again] == [blk for blk, _ in first]
+        b.check_invariants()
+
+    def test_chain_hash_rejects_corruption(self):
+        tokens = list(range(8))
+        a = _chained_allocator(tokens)
+        recs = a.export_prefix(tokens)
+        b = BlockAllocator(num_blocks=16, block_size=4)
+        free_before = b.free_blocks
+        tampered = dict(recs[0])
+        tampered["tokens"] = [t + 1 for t in tampered["tokens"]]
+        with pytest.raises(ValueError):
+            b.import_block(tampered["prev"], tampered["tokens"],
+                           tampered["digest"])
+        # a mislabeled digest is just as dead as tampered tokens
+        with pytest.raises(ValueError):
+            b.import_block(recs[1]["prev"], recs[1]["tokens"],
+                           recs[0]["digest"])
+        assert b.free_blocks == free_before   # nothing claimed
+        b.check_invariants()
+
+
+# ------------------------------------------------------------ engine level
+def _engines():
+    cfg = GPTConfig.tiny()
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    mk = lambda: ServingEngine(m, max_slots=2, block_size=16,  # noqa: E731
+                               prefill_chunk=16)
+    return cfg, mk(), mk()
+
+
+class TestEngineRoundTrip:
+    def test_streamed_blocks_bitwise_identical_and_full_prefix_hit(self):
+        cfg, eng_a, eng_b = _engines()
+        rng = np.random.default_rng(7)
+        prompt = [int(t) for t in rng.integers(0, cfg.vocab_size, 32)]
+        ref = eng_a.generate([prompt], max_new_tokens=6)[0]
+
+        recs = eng_a.export_kv_blocks(prompt)
+        assert len(recs) == 2                     # 32 tokens / 16
+        stats = eng_b.ingest_kv_blocks(recs)
+        assert stats["imported"] == 2 and stats["rejected"] == 0
+        assert stats["bytes"] > 0
+
+        # accepted blocks are bitwise-identical: re-export from the
+        # receiver and compare every layer's K/V page bytes
+        recs_b = eng_b.export_kv_blocks(prompt)
+        assert [r["digest"] for r in recs_b] == [r["digest"] for r in recs]
+        for ra, rb in zip(recs, recs_b):
+            for (ka, va), (kb, vb) in zip(ra["layers"], rb["layers"]):
+                assert ka == kb and va == vb
+
+        # the receiver admits the prompt as a FULL prefix hit — decode
+        # starts immediately, zero prefill tokens computed locally
+        req = eng_b.submit(prompt, max_new_tokens=6)
+        eng_b.run_until_idle()
+        assert req.prefix_matched == len(prompt)
+        assert eng_b.prefill_tokens == 0
+        assert prompt + req.output_tokens == ref  # bitwise-identical decode
+
+        # re-streaming the same chain is an idempotent no-op
+        again = eng_b.ingest_kv_blocks(eng_a.export_kv_blocks(prompt))
+        assert again["imported"] == 0 and again["dedup"] == 2
+
+    def test_corrupt_link_stops_chain_but_keeps_verified_head(self):
+        cfg, eng_a, eng_b = _engines()
+        rng = np.random.default_rng(11)
+        prompt = [int(t) for t in rng.integers(0, cfg.vocab_size, 48)]
+        eng_a.generate([prompt], max_new_tokens=2)
+        recs = eng_a.export_kv_blocks(prompt)
+        assert len(recs) == 3
+        recs[1] = dict(recs[1],
+                       tokens=[(t + 1) % cfg.vocab_size
+                               for t in recs[1]["tokens"]])
+        stats = eng_b.ingest_kv_blocks(recs)
+        # the verified head lands; the corrupt link and everything
+        # chained past it is dropped (unverifiable descendants)
+        assert stats == dict(stats, imported=1, rejected=1, skipped=1)
+        assert eng_b.allocator.conservation_ok()
+        # a fresh, uncorrupted stream then completes the chain
+        stats2 = eng_b.ingest_kv_blocks(eng_a.export_kv_blocks(prompt))
+        assert stats2["rejected"] == 0
+        assert stats2["imported"] == 2 and stats2["dedup"] == 1

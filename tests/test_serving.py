@@ -95,6 +95,89 @@ class TestBlockAllocator:
         a.free("s0")
         assert a.allocate("s1", 6) == t   # hottest blocks come back first
 
+    def test_randomized_interleaved_stress_conservation(self):
+        """Hammer every mutating op in random interleavings under pool
+        pressure; the conservation law (live + evictable + free ==
+        allocatable) and the full invariant sweep must hold after EVERY
+        op — including the export/import streaming path into a second
+        allocator and rejected corrupt imports."""
+        rng = np.random.default_rng(0xC0FFEE)
+        bs = 4
+        a = BlockAllocator(num_blocks=24, block_size=bs)
+        b = BlockAllocator(num_blocks=24, block_size=bs)  # stream target
+
+        def check():
+            for al in (a, b):
+                al.check_invariants()
+                assert al.conservation_ok()
+                assert (al.used_blocks + al.cached_blocks + al.free_blocks
+                        == al.num_blocks - 1)
+
+        prompts = {}                     # seq_id -> prompt token ids
+        seq_no = 0
+        check()
+        for _ in range(700):
+            op = int(rng.integers(0, 7))
+            sids = a.sequences()
+            try:
+                if op == 0 or not sids:          # admit (3 entry points)
+                    seq_no += 1
+                    sid = f"s{seq_no}"
+                    plen = int(rng.integers(1, 13))
+                    # tiny vocab: later prompts really share prefixes
+                    toks = [int(t) for t in rng.integers(0, 5, plen)]
+                    mode = int(rng.integers(3))
+                    total = plen + int(rng.integers(0, 9))
+                    if mode == 0:
+                        a.allocate(sid, plen)
+                    elif mode == 1:
+                        a.reserve(sid, plen, total)
+                    else:
+                        a.reserve_prefix(sid, toks, total)
+                    prompts[sid] = toks
+                elif op == 1:                    # decode one token
+                    a.append_token(sids[int(rng.integers(len(sids)))])
+                elif op == 2:                    # speculative rollback
+                    sid = sids[int(rng.integers(len(sids)))]
+                    n = int(rng.integers(0, a.seq_len(sid) + 1))
+                    a.rollback(sid, min(n, 5))
+                elif op == 3:                    # publish prompt blocks
+                    sid = sids[int(rng.integers(len(sids)))]
+                    a.register_prefix(sid, prompts[sid])
+                elif op == 4:                    # finish
+                    sid = sids[int(rng.integers(len(sids)))]
+                    a.free(sid)
+                    prompts.pop(sid, None)
+                elif op == 5:                    # stream: export -> import
+                    sid = sids[int(rng.integers(len(sids)))]
+                    for rec in a.export_prefix(prompts[sid]):
+                        _, imp = a.import_block(rec["prev"], rec["tokens"],
+                                                rec["digest"])
+                        assert imp is False      # self-import dedups
+                        b.import_block(rec["prev"], rec["tokens"],
+                                       rec["digest"])
+                else:                            # corrupt stream rejected
+                    sid = sids[int(rng.integers(len(sids)))]
+                    recs = a.export_prefix(prompts[sid])
+                    if recs:
+                        bad = dict(recs[0])
+                        bad["tokens"] = [t + 1 for t in bad["tokens"]]
+                        with pytest.raises(ValueError):
+                            b.import_block(bad["prev"], bad["tokens"],
+                                           bad["digest"])
+            except MemoryError:
+                # pool pressure is part of the schedule: evict a victim
+                victims = a.sequences()
+                if victims:
+                    v = victims[int(rng.integers(len(victims)))]
+                    a.free(v)
+                    prompts.pop(v, None)
+            check()
+        for sid in a.sequences():                # drain to empty
+            a.free(sid)
+            check()
+        assert a.used_blocks == 0
+
 
 # ------------------------------------------------- paged attention numerics
 def _dense_oracle(q, k_pages, v_pages, tables, lens, scale):

@@ -66,6 +66,52 @@ def test_dtype_promotion_negative():
     assert not _hits(r, "dtype-promotion")
 
 
+def _many_f64_args(k):
+    # k host-side float64 leaves -> k independent dtype-promotion findings
+    args = tuple(np.ones((2,), np.float64) for _ in range(k))
+    return analyze(lambda *xs: sum(jnp.sum(x) for x in xs), *args)
+
+
+@pytest.fixture
+def _dtype_cap():
+    from paddle_tpu.core.flags import get_flag, set_flags
+
+    old = get_flag("lint_dtype_max_reports")
+
+    def put(v):
+        set_flags({"lint_dtype_max_reports": v})
+
+    yield put
+    set_flags({"lint_dtype_max_reports": old})
+
+
+def test_dtype_promotion_cap_emits_suppression_summary(_dtype_cap):
+    _dtype_cap(3)
+    r = _many_f64_args(6)
+    hits = _hits(r, "dtype-promotion")
+    warns = [f for f in hits if f.severity == Severity.WARNING]
+    infos = [f for f in hits if f.severity == Severity.INFO]
+    assert len(warns) == 3
+    assert len(infos) == 1 and "suppressed" in infos[0].message
+    assert "3" in infos[0].message  # 6 candidates - 3 reported
+
+
+def test_dtype_promotion_cap_zero_is_unlimited(_dtype_cap):
+    _dtype_cap(0)
+    r = _many_f64_args(12)
+    hits = _hits(r, "dtype-promotion")
+    assert len(hits) >= 12  # every arg reported (x64 off may add eqn hits)
+    assert not any("suppressed" in f.message for f in hits)
+
+
+def test_dtype_promotion_default_cap_unchanged():
+    r = _many_f64_args(12)  # default cap is 8
+    hits = _hits(r, "dtype-promotion")
+    warns = [f for f in hits if f.severity == Severity.WARNING]
+    assert len(warns) == 8
+    assert any("suppressed" in f.message for f in hits)
+
+
 # --------------------------------------------------------------------------
 # rule 3: recompile-hazard
 # --------------------------------------------------------------------------
